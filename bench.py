@@ -1,0 +1,195 @@
+"""North-star benchmark: rollback-frames resimulated per second.
+
+Config (BASELINE.json configs[0-1]): the reference's SyncTest loop — every
+tick, roll back `check_distance` frames, resimulate them plus one new frame,
+checksum-compare against history — over the 4096-entity flagship world, with
+the rollback executed by the fused device backend (one dispatch per tick).
+
+Baseline: the driver-set north star is an 8-frame rollback of the 4096-entity
+step in <1ms wall-clock, i.e. 8000 rollback-frames/sec. vs_baseline is
+measured_rate / 8000 (>1.0 beats the target). The reference itself publishes
+no numbers (BASELINE.md); a host-python execution of the identical SyncTest
+loop is also measured and reported for context.
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+ENTITIES = 4096
+PLAYERS = 2
+CHECK_DISTANCE = 8
+MAX_PREDICTION = 9  # check_distance must be < max_prediction
+WARMUP_TICKS = 30
+BENCH_TICKS = 400
+PARITY_TICKS = 50
+NORTH_STAR_FRAMES_PER_SEC = 8000.0  # 8 frames / 1 ms
+
+
+def make_session():
+    from ggrs_tpu import SessionBuilder
+
+    return (
+        SessionBuilder(input_size=1)
+        .with_num_players(PLAYERS)
+        .with_max_prediction_window(MAX_PREDICTION)
+        .with_check_distance(CHECK_DISTANCE)
+        .start_synctest_session()
+    )
+
+
+def input_script(frame: int, handle: int) -> bytes:
+    return bytes([(frame * (3 + handle) + handle) % 16])
+
+
+def drive(handler, ticks, start=0):
+    sess = make_session()
+    for frame in range(start, start + ticks):
+        for h in range(PLAYERS):
+            sess.add_local_input(h, input_script(frame, h))
+        handler.handle_requests(sess.advance_frame())
+
+
+def bench_device():
+    import jax
+
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.tpu import TpuRollbackBackend
+
+    game = ExGame(num_players=PLAYERS, num_entities=ENTITIES)
+    backend = TpuRollbackBackend(game, max_prediction=MAX_PREDICTION, num_players=PLAYERS)
+
+    sess = make_session()
+
+    def tick(frame):
+        for h in range(PLAYERS):
+            sess.add_local_input(h, input_script(frame, h))
+        backend.handle_requests(sess.advance_frame())
+
+    for f in range(WARMUP_TICKS):
+        tick(f)
+    backend.block_until_ready()
+
+    t0 = time.perf_counter()
+    for f in range(WARMUP_TICKS, WARMUP_TICKS + BENCH_TICKS):
+        tick(f)
+    backend.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    # every tick past warmup resimulates CHECK_DISTANCE rolled-back frames
+    # plus advances one new frame
+    resim_frames = BENCH_TICKS * CHECK_DISTANCE
+    rate = resim_frames / elapsed
+    ms_per_rollback = (elapsed / BENCH_TICKS) * 1000.0
+    return rate, ms_per_rollback, backend
+
+
+def parity_check(backend_cls, game):
+    """Bit-exact parity of the device SyncTest run vs the host numpy oracle."""
+    import jax
+
+    from ggrs_tpu.models.ex_game import checksum_oracle, init_oracle, step_oracle
+    from ggrs_tpu import AdvanceFrame, LoadGameState, SaveGameState
+
+    class OracleRunner:
+        def __init__(self):
+            self.state = init_oracle(PLAYERS, ENTITIES)
+
+        def handle_requests(self, requests):
+            for req in requests:
+                if isinstance(req, SaveGameState):
+                    req.cell.save(
+                        req.frame,
+                        {k: np.copy(v) for k, v in self.state.items()},
+                        None,
+                    )
+                elif isinstance(req, LoadGameState):
+                    self.state = {k: np.copy(v) for k, v in req.cell.load().items()}
+                elif isinstance(req, AdvanceFrame):
+                    inputs = np.array([b[0] for b, _ in req.inputs], dtype=np.uint8)
+                    statuses = np.array([int(s) for _, s in req.inputs], dtype=np.int32)
+                    self.state = step_oracle(self.state, inputs, statuses, PLAYERS)
+
+    backend = backend_cls(game, max_prediction=MAX_PREDICTION, num_players=PLAYERS)
+    oracle = OracleRunner()
+    drive(backend, PARITY_TICKS)
+    drive(oracle, PARITY_TICKS)
+    dev = backend.state_numpy()
+    for key in ("frame", "pos", "vel", "rot"):
+        if not np.array_equal(np.asarray(dev[key]), oracle.state[key]):
+            return False
+    return True
+
+
+def bench_host_python():
+    """The same SyncTest loop fulfilled on host with numpy — the unfused
+    reference-style execution, for context."""
+    from ggrs_tpu import AdvanceFrame, LoadGameState, SaveGameState
+    from ggrs_tpu.models.ex_game import checksum_oracle, init_oracle, step_oracle
+    from ggrs_tpu.ops.fixed_point import combine_checksum
+
+    class HostRunner:
+        def __init__(self):
+            self.state = init_oracle(PLAYERS, ENTITIES)
+
+        def handle_requests(self, requests):
+            for req in requests:
+                if isinstance(req, SaveGameState):
+                    req.cell.save(
+                        req.frame,
+                        {k: np.copy(v) for k, v in self.state.items()},
+                        combine_checksum(*checksum_oracle(self.state)),
+                    )
+                elif isinstance(req, LoadGameState):
+                    self.state = {k: np.copy(v) for k, v in req.cell.load().items()}
+                elif isinstance(req, AdvanceFrame):
+                    inputs = np.array([b[0] for b, _ in req.inputs], dtype=np.uint8)
+                    statuses = np.array([int(s) for _, s in req.inputs], dtype=np.int32)
+                    self.state = step_oracle(self.state, inputs, statuses, PLAYERS)
+
+    runner = HostRunner()
+    drive(runner, 10)
+    ticks = 60
+    t0 = time.perf_counter()
+    drive(runner, ticks, start=10)
+    elapsed = time.perf_counter() - t0
+    return (ticks * CHECK_DISTANCE) / elapsed
+
+
+def main():
+    import jax
+
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.tpu import TpuRollbackBackend
+
+    device = jax.devices()[0]
+    rate, ms_per_rollback, _backend = bench_device()
+    parity = parity_check(TpuRollbackBackend, ExGame(PLAYERS, ENTITIES))
+    host_rate = bench_host_python()
+
+    print(
+        json.dumps(
+            {
+                "metric": "rollback-frames resimulated/sec (8-frame window, 4k-entity state)",
+                "value": round(rate, 1),
+                "unit": "frames/sec",
+                "vs_baseline": round(rate / NORTH_STAR_FRAMES_PER_SEC, 3),
+                "ms_per_8frame_rollback": round(ms_per_rollback, 4),
+                "host_python_frames_per_sec": round(host_rate, 1),
+                "parity_vs_oracle": parity,
+                "device": str(device),
+                "entities": ENTITIES,
+                "check_distance": CHECK_DISTANCE,
+                "ticks": BENCH_TICKS,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""ggrs_tpu — a TPU-native rollback-networking framework.
+
+A ground-up reimagination of GGRS (the GGPO-style P2P rollback library,
+surveyed in SURVEY.md) built TPU-first: the session/protocol control plane is
+host code, while the rollback hot path — "load a confirmed snapshot, then
+resimulate N speculative frames" — executes as a single jit-compiled
+`lax.scan` over a device-resident snapshot ring (ggrs_tpu.tpu), with
+vmap-evaluated speculative input beams and on-device checksum reductions.
+
+Importing this package does NOT import jax; the device backend lives in
+`ggrs_tpu.tpu`, imported on demand.
+"""
+
+from .errors import (
+    GGRSError,
+    InvalidRequest,
+    MismatchedChecksum,
+    NotSynchronized,
+    PredictionThreshold,
+    SpectatorTooFarBehind,
+)
+from .frame_info import GameState, PlayerInput
+from .sessions.builder import SessionBuilder
+from .sync_layer import ConnectionStatus, GameStateCell
+from .types import (
+    NULL_FRAME,
+    AdvanceFrame,
+    DesyncDetected,
+    DesyncDetection,
+    Disconnected,
+    Frame,
+    InputStatus,
+    LoadGameState,
+    NetworkInterrupted,
+    NetworkResumed,
+    PlayerHandle,
+    PlayerType,
+    SaveGameState,
+    SessionState,
+    Synchronized,
+    Synchronizing,
+    WaitRecommendation,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "NULL_FRAME",
+    "AdvanceFrame",
+    "ConnectionStatus",
+    "DesyncDetected",
+    "DesyncDetection",
+    "Disconnected",
+    "Frame",
+    "GGRSError",
+    "GameState",
+    "GameStateCell",
+    "InputStatus",
+    "InvalidRequest",
+    "LoadGameState",
+    "MismatchedChecksum",
+    "NetworkInterrupted",
+    "NetworkResumed",
+    "NotSynchronized",
+    "PlayerHandle",
+    "PlayerInput",
+    "PlayerType",
+    "PredictionThreshold",
+    "SaveGameState",
+    "SessionBuilder",
+    "SessionState",
+    "SpectatorTooFarBehind",
+    "Synchronized",
+    "Synchronizing",
+    "WaitRecommendation",
+]

@@ -1,0 +1,43 @@
+"""Session error hierarchy (reference: src/error.rs:11-36)."""
+
+from __future__ import annotations
+
+
+class GGRSError(Exception):
+    """Base class for all session errors."""
+
+
+class PredictionThreshold(GGRSError):
+    """The prediction window is exhausted; cannot accept more local input
+    until remote input confirms older frames (src/error.rs:13)."""
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return "Prediction threshold is reached, cannot proceed without catching up."
+
+
+class InvalidRequest(GGRSError):
+    """Invalid API usage (src/error.rs:15-18)."""
+
+    def __init__(self, info: str):
+        super().__init__(info)
+        self.info = info
+
+
+class MismatchedChecksum(GGRSError):
+    """Checksum mismatch during a SyncTest resimulation (src/error.rs:22-25)."""
+
+    def __init__(self, frame: int, local: int | None = None, expected: int | None = None):
+        super().__init__(f"Detected checksum mismatch during rollback on frame {frame}.")
+        self.frame = frame
+        self.local = local
+        self.expected = expected
+
+
+class NotSynchronized(GGRSError):
+    """The session has not finished synchronizing with all remotes
+    (src/error.rs:27)."""
+
+
+class SpectatorTooFarBehind(GGRSError):
+    """The spectator fell further behind the host than its input buffer can
+    cover; catching up is impossible (src/error.rs:29)."""

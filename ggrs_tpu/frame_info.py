@@ -1,0 +1,39 @@
+"""Per-frame snapshot and input records (reference: src/frame_info.rs).
+
+Inputs are fixed-size byte strings — the Python analog of the reference's POD
+``Config::Input`` (src/lib.rs:250-255). A blank input is all-zero bytes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from .types import NULL_FRAME, Frame
+
+
+@dataclass
+class GameState:
+    """A saved snapshot record (src/frame_info.rs:6-23). ``data`` is opaque to
+    the framework: a user object on the CPU path, or a device snapshot handle
+    on the TPU path. ``checksum`` is optional and only consumed by SyncTest
+    and desync detection."""
+
+    frame: Frame = NULL_FRAME
+    data: Any = None
+    checksum: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class PlayerInput:
+    """One player's input for one frame (src/frame_info.rs:28-66)."""
+
+    frame: Frame
+    buf: bytes
+
+    @staticmethod
+    def blank(frame: Frame, size: int) -> "PlayerInput":
+        return PlayerInput(frame, bytes(size))
+
+    def equal(self, other: "PlayerInput", input_only: bool) -> bool:
+        return (input_only or self.frame == other.frame) and self.buf == other.buf

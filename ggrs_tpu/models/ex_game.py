@@ -1,0 +1,206 @@
+"""Flagship deterministic workload: the reference example game vectorized to
+an N-entity SoA world.
+
+The reference's ex_game (examples/ex_game/ex_game.rs:259-321) steps 2-4
+"ice physics" ships with per-player scalar float math. Here the same dynamics
+— friction, thrust along heading, turning, speed clamp, canvas clamp — are
+re-designed TPU-first:
+
+- SoA state as a pytree of int32 arrays (pos/vel Q8 subpixels, rot 16-bit
+  angle), N entities (default 4096) instead of 4 ships; entity i is owned by
+  player i % num_players and follows that player's input.
+- integer-only fixed-point math (see ggrs_tpu.ops.fixed_point) so a step is
+  bit-identical on CPU and TPU — the property SyncTest certifies.
+- the step is a pure function state -> state, jit/vmap/scan/shard-friendly.
+- the checksum (replacing ex_game.rs:42-52's host-side fletcher16) is an
+  order-invariant on-device reduction, psum-able across shards.
+
+The dynamics are defined once (`_step_generic`) and evaluated under two array
+backends: `ExGame` (jax — the device path) and `step_oracle` (numpy — the
+host oracle used by tests and bench parity checks). Parity between them
+certifies exactly the property rollback needs: the compiled TPU step is
+bit-identical to the host reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ..ops import fixed_point as fx
+from ..types import InputStatus
+
+# Input bitmask, one byte per player (examples/ex_game/ex_game.rs:16-19).
+INPUT_UP = 1 << 0
+INPUT_DOWN = 1 << 1
+INPUT_LEFT = 1 << 2
+INPUT_RIGHT = 1 << 3
+INPUT_SIZE = 1  # bytes per player per frame
+
+# Arena, matching the reference window (ex_game.rs:13-14), in Q8 subpixels.
+WINDOW_W = 600
+WINDOW_H = 800
+MAX_X = WINDOW_W * fx.SUBPIX
+MAX_Y = WINDOW_H * fx.SUBPIX
+
+# Dynamics constants (ex_game.rs:21-24), re-expressed in fixed point at 60fps.
+MOVE_SPEED = 64  # 0.25 px/frame = 15/60, in Q8 subpixels
+ROT_SPEED = 434  # 2.5 rad/s at 60fps, in 2^16-per-turn angle units
+MAX_SPEED = 7 * fx.SUBPIX
+FRICTION_NUM = 251  # ~0.98 as 251/256
+# Disconnected players spin: dummy input 4 == INPUT_LEFT (ex_game.rs:268).
+DISCONNECT_INPUT = 4
+
+State = Dict[str, Any]  # {"frame": i32[], "pos": i32[N,2], "vel": i32[N,2], "rot": i32[N]}
+
+
+def _init_arrays(num_entities: int) -> State:
+    """Ring formation around the arena center (ex_game.rs:239-248),
+    integer-only. Always built host-side with numpy (int64 intermediates are
+    fine here; the hot path stays strictly int32) and transferred to the
+    device once."""
+    i = np.arange(num_entities, dtype=np.int64)
+    rot_base = ((i * fx.ANGLE_MOD) // num_entities).astype(np.int32)
+    cos_t = fx.COS_TABLE[fx.angle_index(rot_base)]
+    sin_t = fx.SIN_TABLE[fx.angle_index(rot_base)]
+    r = (WINDOW_W // 4) * fx.SUBPIX
+    cx, cy = MAX_X // 2, MAX_Y // 2
+    pos = np.stack(
+        [cx + ((r * cos_t) >> fx.TRIG_SCALE_BITS), cy + ((r * sin_t) >> fx.TRIG_SCALE_BITS)],
+        axis=1,
+    ).astype(np.int32)
+    vel = np.zeros((num_entities, 2), dtype=np.int32)
+    rot = (rot_base + fx.ANGLE_MOD // 2) & (fx.ANGLE_MOD - 1)
+    return {
+        "frame": np.zeros((), dtype=np.int32),
+        "pos": pos,
+        "vel": vel,
+        "rot": rot.astype(np.int32),
+    }
+
+
+def _step_generic(state: State, inputs, statuses, num_players: int, xp) -> State:
+    """One deterministic frame. `inputs` is uint8[num_players], `statuses`
+    int32[num_players] (InputStatus values). Shared by the jax and numpy
+    implementations via the xp module argument."""
+    n = state["pos"].shape[0]
+    owner = xp.arange(n, dtype=xp.int32) % num_players
+
+    inp = inputs.astype(xp.int32)[owner]
+    status = statuses.astype(xp.int32)[owner]
+    inp = xp.where(status == int(InputStatus.DISCONNECTED), DISCONNECT_INPUT, inp)
+
+    up = (inp & INPUT_UP) != 0
+    down = (inp & INPUT_DOWN) != 0
+    left = (inp & INPUT_LEFT) != 0
+    right = (inp & INPUT_RIGHT) != 0
+
+    vel = state["vel"]
+    rot = state["rot"]
+
+    # friction (ex_game.rs:277-278): arithmetic shift == floor(v * 251 / 256)
+    vel = (vel * FRICTION_NUM) >> 8
+
+    # thrust/brake along current heading (ex_game.rs:281-289)
+    thrust = xp.where(up & ~down, 1, 0) + xp.where(down & ~up, -1, 0)
+    cos_t = xp.asarray(fx.COS_TABLE)[fx.angle_index(rot)]
+    sin_t = xp.asarray(fx.SIN_TABLE)[fx.angle_index(rot)]
+    dvx = (MOVE_SPEED * cos_t) >> fx.TRIG_SCALE_BITS
+    dvy = (MOVE_SPEED * sin_t) >> fx.TRIG_SCALE_BITS
+    vel = vel + xp.stack([thrust * dvx, thrust * dvy], axis=1)
+
+    # turn (ex_game.rs:291-297)
+    turn = xp.where(left & ~right, -ROT_SPEED, 0) + xp.where(right & ~left, ROT_SPEED, 0)
+    rot = (rot + turn) & (fx.ANGLE_MOD - 1)
+
+    # speed clamp (ex_game.rs:300-304), integer sqrt
+    vx, vy = vel[:, 0], vel[:, 1]
+    m2 = vx * vx + vy * vy
+    mag = fx.isqrt24(m2, xp)
+    over = m2 > MAX_SPEED * MAX_SPEED
+    safe_mag = xp.where(mag == 0, 1, mag)
+    vx = xp.where(over, (vx * MAX_SPEED) // safe_mag, vx)
+    vy = xp.where(over, (vy * MAX_SPEED) // safe_mag, vy)
+    vel = xp.stack([vx, vy], axis=1)
+
+    # integrate + clamp to arena (ex_game.rs:307-314)
+    pos = state["pos"] + vel
+    pos = xp.stack(
+        [xp.clip(pos[:, 0], 0, MAX_X), xp.clip(pos[:, 1], 0, MAX_Y)], axis=1
+    )
+
+    return {
+        "frame": state["frame"] + xp.int32(1),
+        "pos": pos.astype(xp.int32),
+        "vel": vel.astype(xp.int32),
+        "rot": rot.astype(xp.int32),
+    }
+
+
+def _checksum_generic(state: State, xp):
+    words = xp.concatenate(
+        [
+            state["pos"].astype(xp.uint32).reshape(-1),
+            state["vel"].astype(xp.uint32).reshape(-1),
+            state["rot"].astype(xp.uint32).reshape(-1),
+            state["frame"].astype(xp.uint32).reshape(-1),
+        ]
+    )
+    return fx.weighted_checksum(words, xp)
+
+
+# ---------------------------------------------------------------------------
+# Device implementation (jax)
+# ---------------------------------------------------------------------------
+
+
+class ExGame:
+    """Device game: pure-jax step/checksum over SoA int32 state.
+
+    Implements the DeviceGame interface consumed by
+    ggrs_tpu.tpu.backend.TpuRollbackBackend.
+    """
+
+    input_size = INPUT_SIZE
+
+    def __init__(self, num_players: int = 2, num_entities: int = 4096):
+        self.num_players = num_players
+        self.num_entities = num_entities
+
+    def init_state(self) -> State:
+        import jax
+
+        return jax.device_put(_init_arrays(self.num_entities))
+
+    def step(self, state: State, inputs, statuses) -> State:
+        """inputs: uint8[P, input_size] device array; statuses: int32[P]."""
+        import jax.numpy as jnp
+
+        return _step_generic(state, inputs.reshape(-1), statuses, self.num_players, jnp)
+
+    def checksum(self, state: State):
+        import jax.numpy as jnp
+
+        return _checksum_generic(state, jnp)
+
+
+# ---------------------------------------------------------------------------
+# Host oracle (numpy) — independent execution path used as ground truth
+# ---------------------------------------------------------------------------
+
+
+def init_oracle(num_players: int = 2, num_entities: int = 4096) -> State:
+    return _init_arrays(num_entities)
+
+
+def step_oracle(state: State, inputs: np.ndarray, statuses: np.ndarray, num_players: int) -> State:
+    """numpy mirror of ExGame.step; uint8[P] inputs, int32[P] statuses."""
+    with np.errstate(over="ignore"):
+        return _step_generic(state, inputs.reshape(-1), statuses, num_players, np)
+
+
+def checksum_oracle(state: State) -> tuple[int, int]:
+    with np.errstate(over="ignore"):
+        hi, lo = _checksum_generic(state, np)
+    return int(hi), int(lo)

@@ -1,0 +1,203 @@
+"""Wire messages and their flat binary codec.
+
+Message bodies mirror the reference protocol (src/network/messages.rs:6-106).
+Where the reference leans on bincode's derived serialization
+(src/network/udp_socket.rs:32,42), we define an explicit little-endian flat
+format (struct-packed, length-prefixed) so the C++ runtime can speak the same
+bytes without a serde dependency.
+
+Layout: every packet is `magic:u16 | body_type:u8 | body`. Integers are
+little-endian; frames are i32; checksums are u128 (16 bytes LE).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import List, Tuple, Union
+
+from ..sync_layer import ConnectionStatus
+from ..types import NULL_FRAME, Frame
+
+MSG_SYNC_REQUEST = 0
+MSG_SYNC_REPLY = 1
+MSG_INPUT = 2
+MSG_INPUT_ACK = 3
+MSG_QUALITY_REPORT = 4
+MSG_QUALITY_REPLY = 5
+MSG_CHECKSUM_REPORT = 6
+MSG_KEEP_ALIVE = 7
+
+_HEADER = struct.Struct("<HB")
+_U32 = struct.Struct("<I")
+_I32 = struct.Struct("<i")
+_U64 = struct.Struct("<Q")
+_INPUT_HEAD = struct.Struct("<iiBB")
+_STATUS = struct.Struct("<Bi")
+_QUALITY_REPORT = struct.Struct("<bQ")
+_CHECKSUM_REPORT = struct.Struct("<i16s")
+
+
+@dataclass(frozen=True)
+class SyncRequest:
+    random_request: int  # u32 nonce; peer must echo it back
+
+
+@dataclass(frozen=True)
+class SyncReply:
+    random_reply: int
+
+
+@dataclass
+class InputMsg:
+    """Compressed input batch (src/network/messages.rs:29-48): the whole
+    un-acked window, delta+RLE encoded against the last acked input."""
+
+    peer_connect_status: List[ConnectionStatus] = field(default_factory=list)
+    disconnect_requested: bool = False
+    start_frame: Frame = NULL_FRAME
+    ack_frame: Frame = NULL_FRAME
+    bytes_: bytes = b""
+
+
+@dataclass(frozen=True)
+class InputAck:
+    ack_frame: Frame
+
+
+@dataclass(frozen=True)
+class QualityReport:
+    frame_advantage: int  # i8, frame advantage of the other player
+    ping: int  # u64 ms timestamp, echoed back in QualityReply
+
+
+@dataclass(frozen=True)
+class QualityReply:
+    pong: int
+
+
+@dataclass(frozen=True)
+class ChecksumReport:
+    checksum: int  # u128
+    frame: Frame
+
+
+@dataclass(frozen=True)
+class KeepAlive:
+    pass
+
+
+Body = Union[
+    SyncRequest, SyncReply, InputMsg, InputAck, QualityReport, QualityReply,
+    ChecksumReport, KeepAlive,
+]
+
+
+@dataclass
+class Message:
+    magic: int  # u16 sender id, packet-auth filter (src/network/protocol.rs:551-553)
+    body: Body
+    # wire-encoding memo: a message is encoded once (for byte accounting in
+    # the endpoint) and sent later by the socket; bodies are never mutated
+    # after queuing, so caching is safe and halves hot-path serialization
+    _wire: bytes | None = field(default=None, repr=False, compare=False)
+
+
+def encode_message(msg: Message) -> bytes:
+    if msg._wire is None:
+        msg._wire = _encode_message_uncached(msg)
+    return msg._wire
+
+
+def _encode_message_uncached(msg: Message) -> bytes:
+    body = msg.body
+    if isinstance(body, SyncRequest):
+        return _HEADER.pack(msg.magic, MSG_SYNC_REQUEST) + _U32.pack(body.random_request)
+    if isinstance(body, SyncReply):
+        return _HEADER.pack(msg.magic, MSG_SYNC_REPLY) + _U32.pack(body.random_reply)
+    if isinstance(body, InputMsg):
+        out = bytearray(_HEADER.pack(msg.magic, MSG_INPUT))
+        out += _INPUT_HEAD.pack(
+            body.start_frame,
+            body.ack_frame,
+            1 if body.disconnect_requested else 0,
+            len(body.peer_connect_status),
+        )
+        for st in body.peer_connect_status:
+            out += _STATUS.pack(1 if st.disconnected else 0, st.last_frame)
+        assert len(body.bytes_) <= 0xFFFF
+        out += struct.pack("<H", len(body.bytes_)) + body.bytes_
+        return bytes(out)
+    if isinstance(body, InputAck):
+        return _HEADER.pack(msg.magic, MSG_INPUT_ACK) + _I32.pack(body.ack_frame)
+    if isinstance(body, QualityReport):
+        return _HEADER.pack(msg.magic, MSG_QUALITY_REPORT) + _QUALITY_REPORT.pack(
+            body.frame_advantage, body.ping
+        )
+    if isinstance(body, QualityReply):
+        return _HEADER.pack(msg.magic, MSG_QUALITY_REPLY) + _U64.pack(body.pong)
+    if isinstance(body, ChecksumReport):
+        return _HEADER.pack(msg.magic, MSG_CHECKSUM_REPORT) + _CHECKSUM_REPORT.pack(
+            body.frame, body.checksum.to_bytes(16, "little")
+        )
+    if isinstance(body, KeepAlive):
+        return _HEADER.pack(msg.magic, MSG_KEEP_ALIVE)
+    raise TypeError(f"unknown message body {body!r}")
+
+
+class DecodeError(ValueError):
+    pass
+
+
+def decode_message(buf: bytes) -> Message:
+    if len(buf) < _HEADER.size:
+        raise DecodeError("short packet")
+    magic, body_type = _HEADER.unpack_from(buf, 0)
+    off = _HEADER.size
+    try:
+        if body_type == MSG_SYNC_REQUEST:
+            (v,) = _U32.unpack_from(buf, off)
+            return Message(magic, SyncRequest(v))
+        if body_type == MSG_SYNC_REPLY:
+            (v,) = _U32.unpack_from(buf, off)
+            return Message(magic, SyncReply(v))
+        if body_type == MSG_INPUT:
+            start_frame, ack_frame, flags, n_status = _INPUT_HEAD.unpack_from(buf, off)
+            off += _INPUT_HEAD.size
+            statuses = []
+            for _ in range(n_status):
+                disc, last_frame = _STATUS.unpack_from(buf, off)
+                off += _STATUS.size
+                statuses.append(ConnectionStatus(bool(disc), last_frame))
+            (blen,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            payload = bytes(buf[off : off + blen])
+            if len(payload) != blen:
+                raise DecodeError("truncated input payload")
+            return Message(
+                magic,
+                InputMsg(
+                    peer_connect_status=statuses,
+                    disconnect_requested=bool(flags & 1),
+                    start_frame=start_frame,
+                    ack_frame=ack_frame,
+                    bytes_=payload,
+                ),
+            )
+        if body_type == MSG_INPUT_ACK:
+            (v,) = _I32.unpack_from(buf, off)
+            return Message(magic, InputAck(v))
+        if body_type == MSG_QUALITY_REPORT:
+            adv, ping = _QUALITY_REPORT.unpack_from(buf, off)
+            return Message(magic, QualityReport(adv, ping))
+        if body_type == MSG_QUALITY_REPLY:
+            (v,) = _U64.unpack_from(buf, off)
+            return Message(magic, QualityReply(v))
+        if body_type == MSG_CHECKSUM_REPORT:
+            frame, csum = _CHECKSUM_REPORT.unpack_from(buf, off)
+            return Message(magic, ChecksumReport(int.from_bytes(csum, "little"), frame))
+        if body_type == MSG_KEEP_ALIVE:
+            return Message(magic, KeepAlive())
+    except struct.error as exc:
+        raise DecodeError(str(exc)) from exc
+    raise DecodeError(f"unknown body type {body_type}")

@@ -1,0 +1,14 @@
+"""Connection-quality observability (reference: src/network/network_stats.rs)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class NetworkStats:
+    send_queue_len: int = 0
+    ping_ms: int = 0
+    kbps_sent: int = 0
+    local_frames_behind: int = 0
+    remote_frames_behind: int = 0

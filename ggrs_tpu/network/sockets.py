@@ -1,0 +1,132 @@
+"""Transport layer: the pluggable unreliable-datagram seam.
+
+Mirrors the reference's L1 (NonBlockingSocket trait, src/lib.rs:264-279 and
+UDP impl src/network/udp_socket.rs) and adds the piece the reference left
+unbuilt (SURVEY.md §4): an in-memory virtual network with programmable
+latency, loss, reordering and duplication driven by a seeded RNG and an
+injectable clock — deterministic protocol tests without real sockets.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+import socket as _socket
+from typing import Any, Dict, List, Protocol, Tuple
+
+from ..utils.clock import Clock
+from .messages import DecodeError, Message, decode_message, encode_message
+
+RECV_BUFFER_SIZE = 4096
+
+
+class NonBlockingSocket(Protocol):
+    """Unreliable, unordered datagram transport. The endpoint protocol layers
+    reliability on top; implementations must never block."""
+
+    def send_to(self, msg: Message, addr: Any) -> None: ...
+
+    def receive_all_messages(self) -> List[Tuple[Any, Message]]: ...
+
+
+class UdpNonBlockingSocket:
+    """Nonblocking UDP bound to 0.0.0.0:port (src/network/udp_socket.rs:17-55).
+    Addresses are (host, port) tuples."""
+
+    def __init__(self, port: int):
+        self.sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        self.sock.bind(("0.0.0.0", port))
+        self.sock.setblocking(False)
+
+    @property
+    def local_port(self) -> int:
+        return self.sock.getsockname()[1]
+
+    def send_to(self, msg: Message, addr: Any) -> None:
+        self.sock.sendto(encode_message(msg), addr)
+
+    def receive_all_messages(self) -> List[Tuple[Any, Message]]:
+        received: List[Tuple[Any, Message]] = []
+        while True:
+            try:
+                buf, src = self.sock.recvfrom(RECV_BUFFER_SIZE)
+            except BlockingIOError:
+                return received
+            except ConnectionResetError:
+                continue
+            try:
+                received.append((src, decode_message(buf)))
+            except DecodeError:
+                continue  # drop garbage, like the reference's bincode filter
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+class InMemoryNetwork:
+    """A hub of virtual endpoints sharing one fault model and one clock."""
+
+    def __init__(
+        self,
+        clock: Clock,
+        *,
+        latency_ms: int = 0,
+        jitter_ms: int = 0,
+        loss: float = 0.0,
+        duplicate: float = 0.0,
+        seed: int = 0,
+    ):
+        self.clock = clock
+        self.latency_ms = latency_ms
+        self.jitter_ms = jitter_ms
+        self.loss = loss
+        self.duplicate = duplicate
+        self.rng = random.Random(seed)
+        # addr -> heap of (deliver_at_ms, seq, (src, wire_bytes))
+        self.queues: Dict[Any, List[Tuple[int, int, Tuple[Any, bytes]]]] = {}
+        self._seq = 0
+
+    def socket(self, addr: Any) -> "InMemorySocket":
+        self.queues.setdefault(addr, [])
+        return InMemorySocket(self, addr)
+
+    def _deliver(self, src: Any, dst: Any, wire: bytes) -> None:
+        if self.rng.random() < self.loss:
+            return
+        copies = 2 if self.rng.random() < self.duplicate else 1
+        for _ in range(copies):
+            delay = self.latency_ms
+            if self.jitter_ms:
+                delay += self.rng.randint(0, self.jitter_ms)
+            self._seq += 1
+            heapq.heappush(
+                self.queues.setdefault(dst, []),
+                (self.clock.now_ms() + delay, self._seq, (src, wire)),
+            )
+
+    def _drain(self, addr: Any) -> List[Tuple[Any, Message]]:
+        q = self.queues.setdefault(addr, [])
+        now = self.clock.now_ms()
+        out: List[Tuple[Any, Message]] = []
+        while q and q[0][0] <= now:
+            _, _, (src, wire) = heapq.heappop(q)
+            try:
+                out.append((src, decode_message(wire)))
+            except DecodeError:
+                continue
+        return out
+
+
+class InMemorySocket:
+    """One endpoint's view of an InMemoryNetwork; satisfies NonBlockingSocket."""
+
+    def __init__(self, net: InMemoryNetwork, addr: Any):
+        self.net = net
+        self.addr = addr
+
+    def send_to(self, msg: Message, addr: Any) -> None:
+        # serialize through the real wire codec so fault tests cover it
+        self.net._deliver(self.addr, addr, encode_message(msg))
+
+    def receive_all_messages(self) -> List[Tuple[Any, Message]]:
+        return self.net._drain(self.addr)

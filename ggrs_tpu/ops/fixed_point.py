@@ -1,0 +1,90 @@
+"""Deterministic fixed-point primitives shared by device (jax) and oracle
+(numpy) code paths.
+
+Rollback correctness rests on bit-identical resimulation
+(reference: src/sessions/sync_test_session.rs:9-10), and the reference's own
+float example desyncs across platforms (examples/README.md). We therefore use
+integer-only math end to end: int32 Q8 subpixels for positions/velocities,
+a 1024-entry Q14 sine table for headings, and a branch-free integer square
+root. Every function takes an ``xp`` module argument (numpy or jax.numpy) so
+the TPU step and the host oracle share one definition.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+# Angle space: a full turn is 2^16 units.
+ANGLE_BITS = 16
+ANGLE_MOD = 1 << ANGLE_BITS
+# Sine table: 1024 entries, Q14 scale.
+TABLE_BITS = 10
+TABLE_SIZE = 1 << TABLE_BITS
+TRIG_SCALE_BITS = 14
+TRIG_SCALE = 1 << TRIG_SCALE_BITS
+
+# Positions/velocities are Q8 subpixels.
+SUBPIX_BITS = 8
+SUBPIX = 1 << SUBPIX_BITS
+
+
+def _build_trig_tables() -> tuple[np.ndarray, np.ndarray]:
+    idx = np.arange(TABLE_SIZE, dtype=np.float64)
+    theta = idx * (2.0 * math.pi / TABLE_SIZE)
+    cos_t = np.round(np.cos(theta) * TRIG_SCALE).astype(np.int32)
+    sin_t = np.round(np.sin(theta) * TRIG_SCALE).astype(np.int32)
+    return cos_t, sin_t
+
+
+COS_TABLE, SIN_TABLE = _build_trig_tables()
+
+
+def angle_index(rot):
+    """Map a 16-bit angle to a trig-table index."""
+    return rot >> (ANGLE_BITS - TABLE_BITS)
+
+
+def isqrt24(n, xp):
+    """Integer sqrt for 0 <= n < 2^24, branch-free (12 unrolled
+    digit-by-digit iterations), exact floor(sqrt(n)).
+
+    Avoids float sqrt entirely: TPU float sqrt/rsqrt may be approximated,
+    which would break bit-exact CPU parity.
+    """
+    x = n
+    c = xp.zeros_like(n)
+    d = 1 << 22
+    for _ in range(12):
+        cd = c + d
+        cond = x >= cd
+        x = xp.where(cond, x - cd, x)
+        c = xp.where(cond, (c >> 1) + d, c >> 1)
+        d >>= 2
+    return c
+
+
+# Knuth multiplicative constant for the checksum weight stream.
+GOLDEN32 = np.uint32(2654435761)
+
+
+def weighted_checksum(words, xp):
+    """Order-invariant 64-bit checksum of a uint32 word vector.
+
+    Returns (hi, lo) uint32: hi = sum(w_i * ((i+1) * GOLDEN32)) mod 2^32,
+    lo = sum(w_i) mod 2^32. Pure modular sums, so the reduction is
+    associative/commutative — safe to psum across shards and immune to XLA
+    reduction-order choices.
+    """
+    n = words.shape[0]
+    idx = xp.arange(1, n + 1, dtype=xp.uint32)
+    hi = xp.sum(words * (idx * GOLDEN32), dtype=xp.uint32)
+    lo = xp.sum(words, dtype=xp.uint32)
+    return hi, lo
+
+
+def combine_checksum(hi: int, lo: int) -> int:
+    """Fold the device (hi, lo) pair into one Python int (the u128-checksum
+    analog of reference src/network/messages.rs:76-79)."""
+    return (int(hi) << 32) | int(lo)

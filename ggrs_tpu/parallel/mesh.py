@@ -1,0 +1,31 @@
+"""Device-mesh construction helpers for the sharded rollback configs."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Tuple[str, str] = ("beam", "entity"),
+    beam_axis: Optional[int] = None,
+) -> Mesh:
+    """Build a 2D (beam x entity) mesh over the first n devices.
+
+    `beam` is the speculative-universe axis (data-parallel analog: replicated
+    world, different input futures). `entity` shards the world state itself
+    (tensor-parallel analog). Collectives over `entity` (the checksum psum)
+    ride ICI; the beam axis needs no communication at all.
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    assert n <= len(devices), f"requested {n} devices, have {len(devices)}"
+    if beam_axis is None:
+        beam_axis = 2 if n % 2 == 0 and n > 2 else 1
+    assert n % beam_axis == 0
+    dev_array = np.asarray(devices[:n]).reshape(beam_axis, n // beam_axis)
+    return Mesh(dev_array, axis_names)

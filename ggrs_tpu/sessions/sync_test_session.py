@@ -1,0 +1,115 @@
+"""Determinism harness: forced rollback + checksum comparison every frame.
+
+Behavioral parity with the reference (src/sessions/sync_test_session.rs):
+each tick, roll back `check_distance` frames, resimulate, and compare the
+resimulated checksums against the first-recorded history. This session is the
+CPU baseline of the north-star metric (BASELINE.json configs[0]); its fused
+device twin lives in ggrs_tpu.tpu.backend.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..errors import InvalidRequest, MismatchedChecksum
+from ..frame_info import PlayerInput
+from ..sync_layer import ConnectionStatus, SyncLayer
+from ..types import AdvanceFrame, Frame, PlayerHandle, Request
+
+
+class SyncTestSession:
+    def __init__(
+        self,
+        num_players: int,
+        max_prediction: int,
+        check_distance: int,
+        input_delay: int,
+        input_size: int,
+    ):
+        self.num_players = num_players
+        self.max_prediction = max_prediction
+        self.check_distance = check_distance
+        self.sync_layer = SyncLayer(num_players, max_prediction, input_size)
+        for handle in range(num_players):
+            self.sync_layer.set_frame_delay(handle, input_delay)
+        self.dummy_connect_status = [ConnectionStatus() for _ in range(num_players)]
+        # frame -> first recorded checksum (None allowed: user may omit them)
+        self.checksum_history: Dict[Frame, Optional[int]] = {}
+        self.local_inputs: Dict[PlayerHandle, PlayerInput] = {}
+
+    def add_local_input(self, player_handle: PlayerHandle, buf: bytes) -> None:
+        """All players are local in a sync test
+        (src/sessions/sync_test_session.rs:61-74)."""
+        if player_handle >= self.num_players:
+            raise InvalidRequest("The player handle you provided is not valid.")
+        self.local_inputs[player_handle] = PlayerInput(
+            self.sync_layer.current_frame, buf
+        )
+
+    def advance_frame(self) -> List[Request]:
+        """(src/sessions/sync_test_session.rs:85-146)"""
+        requests: List[Request] = []
+
+        # Once deep enough into the game, compare checksums and force a
+        # rollback of check_distance frames.
+        if self.check_distance > 0 and self.sync_layer.current_frame > self.check_distance:
+            for i in range(self.check_distance + 1):
+                frame_to_check = self.sync_layer.current_frame - i
+                if not self._checksums_consistent(frame_to_check):
+                    raise MismatchedChecksum(frame_to_check)
+
+            frame_to = self.sync_layer.current_frame - self.check_distance
+            self._adjust_gamestate(frame_to, requests)
+
+        if len(self.local_inputs) != self.num_players:
+            raise InvalidRequest("Missing local input while calling advance_frame().")
+        for handle, inp in self.local_inputs.items():
+            self.sync_layer.add_local_input(handle, inp)
+        self.local_inputs.clear()
+
+        if self.check_distance > 0:
+            requests.append(self.sync_layer.save_current_state())
+
+        inputs = self.sync_layer.synchronized_inputs(self.dummy_connect_status)
+        requests.append(AdvanceFrame(inputs=inputs))
+        self.sync_layer.advance_frame()
+
+        # Fake confirmation at current - check_distance so the sync layer
+        # never hits the prediction threshold (:134-138).
+        safe_frame = self.sync_layer.current_frame - self.check_distance
+        self.sync_layer.set_last_confirmed_frame(safe_frame, False)
+        for status in self.dummy_connect_status:
+            status.last_frame = self.sync_layer.current_frame
+
+        return requests
+
+    def _checksums_consistent(self, frame_to_check: Frame) -> bool:
+        """(src/sessions/sync_test_session.rs:159-176)"""
+        oldest_allowed = self.sync_layer.current_frame - self.check_distance
+        self.checksum_history = {
+            f: c for f, c in self.checksum_history.items() if f >= oldest_allowed
+        }
+        cell = self.sync_layer.saved_state_by_frame(frame_to_check)
+        if cell is None:
+            return True
+        if cell.frame in self.checksum_history:
+            return self.checksum_history[cell.frame] == cell.checksum
+        self.checksum_history[cell.frame] = cell.checksum
+        return True
+
+    def _adjust_gamestate(self, frame_to: Frame, requests: List[Request]) -> None:
+        """(src/sessions/sync_test_session.rs:178-203)"""
+        start_frame = self.sync_layer.current_frame
+        count = start_frame - frame_to
+
+        requests.append(self.sync_layer.load_frame(frame_to))
+        self.sync_layer.reset_prediction()
+        assert self.sync_layer.current_frame == frame_to
+
+        for i in range(count):
+            inputs = self.sync_layer.synchronized_inputs(self.dummy_connect_status)
+            if i > 0:
+                requests.append(self.sync_layer.save_current_state())
+            self.sync_layer.advance_frame()
+            requests.append(AdvanceFrame(inputs=inputs))
+        assert self.sync_layer.current_frame == start_frame

@@ -1,0 +1,9 @@
+"""Device backend: fused rollback/resimulation on TPU via jit + lax.scan.
+
+Importing this subpackage imports jax.
+"""
+
+from .backend import SnapshotRef, TpuRollbackBackend
+from .resim import ResimCore
+
+__all__ = ["ResimCore", "SnapshotRef", "TpuRollbackBackend"]

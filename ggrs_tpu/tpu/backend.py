@@ -1,0 +1,163 @@
+"""TpuRollbackBackend: fulfills a session's ordered request list on device.
+
+This is the pluggable seam BASELINE.json prescribes: sessions
+(SyncTestSession, P2PSession) keep emitting the reference's ordered
+Save/Load/Advance requests (src/lib.rs:169-194), and this backend consumes
+them — but instead of executing them one by one through user callbacks, it
+parses the request grammar
+
+    [Load?] (Save? Advance)* Save?
+
+(the exact shape every session emits per tick: first-frame double save,
+dense/sparse rollback blocks, trailing confirmed-frame saves) and lowers the
+whole tick into ONE fused device dispatch via ResimCore. Snapshot data never
+leaves the device; cells are filled with lightweight SnapshotRef handles and
+lazy checksums that only force a device->host transfer when read.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..ops.fixed_point import combine_checksum
+from ..types import AdvanceFrame, Frame, LoadGameState, Request, SaveGameState
+from ..utils.tracing import GLOBAL_TRACER
+from .resim import ResimCore
+
+
+@dataclass(frozen=True)
+class SnapshotRef:
+    """Opaque handle stored in a GameStateCell: the snapshot lives in the
+    device ring, addressed by frame (slot = frame % ring_len)."""
+
+    frame: Frame
+    ring_slot: int
+
+
+class _ChecksumBatch:
+    """One tick's worth of device checksums; fetched to host at most once,
+    and only if some cell's checksum is actually read."""
+
+    def __init__(self, his, los):
+        self._his = his
+        self._los = los
+        self._np: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    def resolve(self, idx: int) -> int:
+        if self._np is None:
+            self._np = (np.asarray(self._his), np.asarray(self._los))
+        return combine_checksum(self._np[0][idx], self._np[1][idx])
+
+
+class TpuRollbackBackend:
+    """Request-fulfilling rollback backend over a device game.
+
+    Usage:
+        backend = TpuRollbackBackend(game, max_prediction=8, num_players=2)
+        requests = session.advance_frame()
+        backend.handle_requests(requests)
+    """
+
+    def __init__(self, game, max_prediction: int, num_players: int):
+        self.core = ResimCore(game, max_prediction, num_players)
+        self.num_players = num_players
+        self.input_size = game.input_size
+        self.current_frame: Frame = 0
+
+    # ------------------------------------------------------------------
+
+    def handle_requests(self, requests: List[Request]) -> None:
+        """A tick is usually one fused batch, but sparse-saving P2P ticks can
+        legally contain two rollback blocks (misprediction rollback + ring
+        keepalive rollback, p2p_session.rs:286+:792): split into one batch
+        per LoadGameState and fuse each."""
+        segment: List[Request] = []
+        for req in requests:
+            if isinstance(req, LoadGameState) and segment:
+                self._run_segment(segment)
+                segment = []
+            segment.append(req)
+        if segment:
+            self._run_segment(segment)
+
+    def _run_segment(self, requests: List[Request]) -> None:
+        load: Optional[LoadGameState] = None
+        slots: List[Tuple[Optional[SaveGameState], AdvanceFrame]] = []
+        pending_save: Optional[SaveGameState] = None
+
+        for req in requests:
+            if isinstance(req, LoadGameState):
+                assert load is None and not slots and pending_save is None, (
+                    "unsupported request pattern: Load must lead a segment"
+                )
+                load = req
+            elif isinstance(req, SaveGameState):
+                if pending_save is not None:
+                    # first-frame double save (p2p_session.rs:270-272 + :295)
+                    assert pending_save.frame == req.frame
+                pending_save = req
+            elif isinstance(req, AdvanceFrame):
+                slots.append((pending_save, req))
+                pending_save = None
+            else:
+                raise TypeError(f"unknown request {req!r}")
+        trailing_save = pending_save
+
+        core = self.core
+        W, P, I = core.window, self.num_players, self.input_size
+        count = len(slots)
+        assert count <= core.max_prediction + 1, "tick exceeds the fused window"
+        assert trailing_save is None or count < W
+
+        inputs = np.zeros((W, P, I), dtype=np.uint8)
+        statuses = np.zeros((W, P), dtype=np.int32)
+        save_slots = np.full((W,), core.scratch_slot, dtype=np.int32)
+
+        start_frame = load.frame if load is not None else self.current_frame
+        saves: List[Tuple[int, SaveGameState]] = []
+
+        for i, (save, adv) in enumerate(slots):
+            if save is not None:
+                assert save.frame == start_frame + i, (
+                    f"save of frame {save.frame} out of order (expected {start_frame + i})"
+                )
+                save_slots[i] = save.frame % core.ring_len
+                saves.append((i, save))
+            for p, (buf, status) in enumerate(adv.inputs):
+                inputs[i, p] = np.frombuffer(buf, dtype=np.uint8)
+                statuses[i, p] = int(status)
+        if trailing_save is not None:
+            assert trailing_save.frame == start_frame + count
+            save_slots[count] = trailing_save.frame % core.ring_len
+            saves.append((count, trailing_save))
+
+        with GLOBAL_TRACER.span("tpu/fused_tick"):
+            his, los = core.tick(
+                do_load=load is not None,
+                load_slot=(load.frame % core.ring_len) if load is not None else 0,
+                inputs=inputs,
+                statuses=statuses,
+                save_slots=save_slots,
+                advance_count=count,
+            )
+        self.current_frame = start_frame + count
+
+        batch = _ChecksumBatch(his, los)
+        for idx, save in saves:
+            ref = SnapshotRef(save.frame, save.frame % core.ring_len)
+            save.cell.save_lazy(
+                save.frame, ref, (lambda b=batch, i=idx: b.resolve(i))
+            )
+
+    # ------------------------------------------------------------------
+
+    def state_numpy(self):
+        """Host copy of the live game state (parity checks / rendering)."""
+        return self.core.fetch_state()
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self.core.state)

@@ -1,0 +1,131 @@
+"""The rollback hot loop as one compiled device program.
+
+The reference's rollback driver crosses the user boundary up to
+max_prediction times per tick — load a snapshot, then N x (save + advance)
+callbacks (src/sessions/p2p_session.rs:649-670). On TPU that many
+host<->device round trips would dwarf the math, so the entire block is one
+jit-compiled `lax.scan` over a device-resident snapshot ring:
+
+- the ring is a pytree of [R+1, ...] arrays, R = max_prediction + 2 (the
+  same capacity/addressing as the host SyncLayer ring,
+  src/sync_layer.rs:61-75); slot R is a scratch slot that masked-off saves
+  write into, so the scan stays branch-free.
+- one tick = optional load (dynamic ring index) + W fused
+  (save?, advance?) micro-slots, W = max_prediction + 2, with rollback
+  depth and save slots as traced scalars — a single compilation covers
+  every depth.
+- the per-save checksum is computed on device in the same scan.
+
+Buffers are donated, so the ring is updated in place across ticks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _tree_where(pred, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+class ResimCore:
+    """Device snapshot ring + fused (load, resimulate, save, checksum) tick.
+
+    `game` implements the DeviceGame interface: init_state() -> pytree,
+    step(state, inputs u8[P, input_size], statuses i32[P]) -> pytree,
+    checksum(state) -> (u32, u32). All pure jax.
+    """
+
+    def __init__(self, game, max_prediction: int, num_players: int):
+        self.game = game
+        self.num_players = num_players
+        self.max_prediction = max_prediction
+        self.ring_len = max_prediction + 2  # parity with SavedStates
+        self.scratch_slot = self.ring_len  # masked-off saves land here
+        self.window = max_prediction + 2  # advances + possible trailing save
+
+        state = game.init_state()
+        self.state = state
+        self.ring = jax.tree.map(
+            lambda x: jnp.zeros((self.ring_len + 1,) + x.shape, x.dtype), state
+        )
+        self._tick_fn = jax.jit(self._tick_impl, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+
+    def _tick_impl(
+        self,
+        ring,
+        state,
+        do_load,  # bool[]
+        load_slot,  # i32[]
+        inputs,  # u8[W, P, input_size]
+        statuses,  # i32[W, P]
+        save_slots,  # i32[W]; scratch_slot means "no save"
+        advance_count,  # i32[]
+    ):
+        loaded = jax.tree.map(
+            lambda r: jax.lax.dynamic_index_in_dim(r, load_slot, 0, keepdims=False),
+            ring,
+        )
+        state = _tree_where(do_load, loaded, state)
+
+        iota = jnp.arange(self.window, dtype=jnp.int32)
+
+        def body(carry, xs):
+            ring, state = carry
+            i, inp, stat, save_slot = xs
+            # save-then-advance: slot i snapshots the pre-advance state
+            hi, lo = self.game.checksum(state)
+            ring = jax.tree.map(
+                lambda r, s: jax.lax.dynamic_update_index_in_dim(r, s, save_slot, 0),
+                ring,
+                state,
+            )
+            nxt = self.game.step(state, inp, stat)
+            state = _tree_where(i < advance_count, nxt, state)
+            return (ring, state), (hi, lo)
+
+        (ring, state), (his, los) = jax.lax.scan(
+            body, (ring, state), (iota, inputs, statuses, save_slots)
+        )
+        return ring, state, his, los
+
+    # ------------------------------------------------------------------
+
+    def tick(
+        self,
+        do_load: bool,
+        load_slot: int,
+        inputs: np.ndarray,
+        statuses: np.ndarray,
+        save_slots: np.ndarray,
+        advance_count: int,
+    ) -> Tuple[Any, Any]:
+        """Run one fused tick; returns (checksum_hi[W], checksum_lo[W]) as
+        device arrays (no host sync)."""
+        self.ring, self.state, his, los = self._tick_fn(
+            self.ring,
+            self.state,
+            jnp.asarray(do_load),
+            jnp.asarray(load_slot, dtype=jnp.int32),
+            jnp.asarray(inputs),
+            jnp.asarray(statuses),
+            jnp.asarray(save_slots),
+            jnp.asarray(advance_count, dtype=jnp.int32),
+        )
+        return his, los
+
+    def fetch_state(self):
+        """Device -> host copy of the live state (test/debug aid)."""
+        return jax.device_get(self.state)
+
+    def fetch_ring_slot(self, slot: int):
+        return jax.device_get(
+            jax.tree.map(lambda r: r[slot], self.ring)
+        )

@@ -1,0 +1,162 @@
+"""Core types of the session layer.
+
+Behavioral parity notes reference GGRS (/root/reference): constants and enums
+mirror src/lib.rs:45-194, re-designed for Python + a device-resident rollback
+backend. Inputs are fixed-size byte strings (the POD contract of
+src/lib.rs:250-255): the only game data that ever crosses the wire.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Tuple
+
+# -1 represents no frame / invalid frame (src/lib.rs:46).
+NULL_FRAME: int = -1
+
+Frame = int
+PlayerHandle = int
+
+
+class SessionState(enum.Enum):
+    """State of a session (src/lib.rs:95-101)."""
+
+    SYNCHRONIZING = "synchronizing"
+    RUNNING = "running"
+
+
+class InputStatus(enum.IntEnum):
+    """Status delivered alongside every player input (src/lib.rs:103-112).
+
+    IntEnum so device code can embed it directly in int32 arrays.
+    """
+
+    CONFIRMED = 0
+    PREDICTED = 1
+    DISCONNECTED = 2
+
+
+class PlayerTypeKind(enum.Enum):
+    LOCAL = "local"
+    REMOTE = "remote"
+    SPECTATOR = "spectator"
+
+
+@dataclass(frozen=True)
+class PlayerType:
+    """Local player, remote player or spectator (src/lib.rs:73-90).
+
+    ``addr`` is the opaque, hashable transport address for remote
+    players/spectators; it is None for local players.
+    """
+
+    kind: PlayerTypeKind
+    addr: Any = None
+
+    @staticmethod
+    def local() -> "PlayerType":
+        return PlayerType(PlayerTypeKind.LOCAL)
+
+    @staticmethod
+    def remote(addr: Any) -> "PlayerType":
+        return PlayerType(PlayerTypeKind.REMOTE, addr)
+
+    @staticmethod
+    def spectator(addr: Any) -> "PlayerType":
+        return PlayerType(PlayerTypeKind.SPECTATOR, addr)
+
+
+@dataclass(frozen=True)
+class DesyncDetection:
+    """Checksum-exchange desync detection config (src/lib.rs:57-66)."""
+
+    enabled: bool = False
+    interval: int = 0
+
+    @staticmethod
+    def off() -> "DesyncDetection":
+        return DesyncDetection(False, 0)
+
+    @staticmethod
+    def on(interval: int) -> "DesyncDetection":
+        return DesyncDetection(True, interval)
+
+
+# ---------------------------------------------------------------------------
+# Events (src/lib.rs:114-167)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Synchronizing:
+    addr: Any
+    total: int
+    count: int
+
+
+@dataclass(frozen=True)
+class Synchronized:
+    addr: Any
+
+
+@dataclass(frozen=True)
+class Disconnected:
+    addr: Any
+
+
+@dataclass(frozen=True)
+class NetworkInterrupted:
+    addr: Any
+    disconnect_timeout_ms: int
+
+
+@dataclass(frozen=True)
+class NetworkResumed:
+    addr: Any
+
+
+@dataclass(frozen=True)
+class WaitRecommendation:
+    skip_frames: int
+
+
+@dataclass(frozen=True)
+class DesyncDetected:
+    frame: Frame
+    local_checksum: int
+    remote_checksum: int
+    addr: Any
+
+
+Event = Any  # union of the event dataclasses above
+
+
+# ---------------------------------------------------------------------------
+# Requests (src/lib.rs:169-194)
+#
+# Sessions never call user code. advance_frame() returns an order-sensitive
+# list of requests which the caller (or a rollback backend such as
+# ggrs_tpu.tpu.TpuRollbackBackend) must fulfill in the exact order given.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SaveGameState:
+    cell: "GameStateCell"  # noqa: F821 - defined in sync_layer
+    frame: Frame
+
+
+@dataclass
+class LoadGameState:
+    cell: "GameStateCell"  # noqa: F821
+    frame: Frame
+
+
+@dataclass
+class AdvanceFrame:
+    # one (input_bytes, status) pair per player, ascending handle order
+    inputs: Sequence[Tuple[bytes, InputStatus]]
+
+
+Request = Any  # union of the request dataclasses above

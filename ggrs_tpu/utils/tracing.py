@@ -1,0 +1,85 @@
+"""Lightweight per-phase tracing.
+
+The reference ships no tracing at all (SURVEY.md §5); its only perf
+instrumentation is byte counters in the protocol. Here every hot phase
+(save/load/advance/fused-tick/poll) can be timed with nested spans at
+near-zero cost when disabled. Device work is asynchronous under jax, so
+spans measure host-side dispatch unless the caller blocks; the fused-tick
+span in the backend brackets the dispatch + any forced sync, which is the
+latency the session actually observes.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+
+@dataclass
+class SpanStats:
+    count: int = 0
+    total_ns: int = 0
+    max_ns: int = 0
+
+    @property
+    def mean_ms(self) -> float:
+        return (self.total_ns / self.count) / 1e6 if self.count else 0.0
+
+    @property
+    def total_ms(self) -> float:
+        return self.total_ns / 1e6
+
+    @property
+    def max_ms(self) -> float:
+        return self.max_ns / 1e6
+
+
+class Tracer:
+    """Aggregating tracer; `span()` is a no-op context when disabled."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.stats: Dict[str, SpanStats] = defaultdict(SpanStats)
+        self._stack: List[str] = []
+
+    @contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        path = ("/".join(self._stack + [name])) if self._stack else name
+        self._stack.append(name)
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter_ns() - t0
+            self._stack.pop()
+            s = self.stats[path]
+            s.count += 1
+            s.total_ns += dt
+            s.max_ns = max(s.max_ns, dt)
+
+    def report(self) -> str:
+        lines = [f"{'span':40s} {'count':>8s} {'mean ms':>10s} {'max ms':>10s} {'total ms':>10s}"]
+        for name in sorted(self.stats):
+            s = self.stats[name]
+            lines.append(
+                f"{name:40s} {s.count:8d} {s.mean_ms:10.4f} {s.max_ms:10.4f} {s.total_ms:10.2f}"
+            )
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        self.stats.clear()
+
+
+# process-wide default tracer, disabled unless opted in
+GLOBAL_TRACER = Tracer(enabled=False)
+
+
+def enable_global_tracing() -> Tracer:
+    GLOBAL_TRACER.enabled = True
+    return GLOBAL_TRACER

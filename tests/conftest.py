@@ -1,0 +1,22 @@
+"""Test env: force jax onto a virtual 8-device CPU platform so multi-chip
+sharding tests run without TPU hardware.
+
+IMPORTANT: this image boots an `axon` TPU-tunnel PJRT plugin from
+sitecustomize, which programmatically sets jax_platforms="axon,cpu" —
+overriding any JAX_PLATFORMS env var. jax is therefore already imported by
+the time conftest runs, and the only effective override is jax.config.
+XLA_FLAGS is still read lazily at first CPU-client creation, so setting it
+here (before any backend init) works.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,85 @@
+"""Host-side fixture games for session tests.
+
+Mirrors the reference's test strategy (tests/stubs.rs): a tiny deterministic
+integer state machine that fulfills requests and hashes its state for
+checksums, plus a negative control whose checksums are intentionally
+nondeterministic (must trip SyncTest's MismatchedChecksum).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List
+
+from ggrs_tpu import AdvanceFrame, InputStatus, LoadGameState, SaveGameState
+
+INPUT_SIZE = 1
+
+
+@dataclass
+class StateStub:
+    frame: int = 0
+    state: int = 0
+
+    def advance(self, inputs) -> None:
+        self.frame += 1
+        for buf, status in inputs:
+            if status != InputStatus.DISCONNECTED:
+                self.state += buf[0] + 1
+            else:
+                self.state += 13
+
+
+def _hash_stub(s: StateStub) -> int:
+    # deterministic integer hash of (frame, state)
+    h = (s.frame * 2654435761 + s.state * 40503 + 7) % (1 << 64)
+    return h
+
+
+class GameStub:
+    """Fulfills the ordered request list against a StateStub."""
+
+    def __init__(self):
+        self.gs = StateStub()
+        self.saved_frames: List[int] = []
+        self.loaded_frames: List[int] = []
+        self.advanced = 0
+        # frame -> state after advancing INTO that frame; rollback
+        # resimulations overwrite entries with corrected values
+        self.history = {}
+
+    def checksum(self, s: StateStub) -> int:
+        return _hash_stub(s)
+
+    def handle_requests(self, requests) -> None:
+        for req in requests:
+            if isinstance(req, SaveGameState):
+                assert req.frame == self.gs.frame
+                self.saved_frames.append(req.frame)
+                req.cell.save(
+                    req.frame, StateStub(self.gs.frame, self.gs.state), self.checksum(self.gs)
+                )
+            elif isinstance(req, LoadGameState):
+                data = req.cell.load()
+                assert data is not None
+                self.loaded_frames.append(data.frame)
+                self.gs = StateStub(data.frame, data.state)
+            elif isinstance(req, AdvanceFrame):
+                self.gs.advance(req.inputs)
+                self.advanced += 1
+                self.history[self.gs.frame] = self.gs.state
+            else:
+                raise TypeError(req)
+
+
+class RandomChecksumGameStub(GameStub):
+    """Saves a random checksum each time: SyncTest must flag it
+    (tests/stubs.rs:67-106)."""
+
+    def __init__(self):
+        super().__init__()
+        self._rng = random.Random(1234)
+
+    def checksum(self, s: StateStub) -> int:
+        return self._rng.getrandbits(64)

@@ -1,0 +1,91 @@
+"""InputQueue behavior (parity with reference in-module tests,
+src/input_queue.rs:246-327)."""
+
+import pytest
+
+from ggrs_tpu.frame_info import PlayerInput
+from ggrs_tpu.input_queue import InputQueue
+from ggrs_tpu.types import NULL_FRAME, InputStatus
+
+
+def inp(frame, b):
+    return PlayerInput(frame, bytes([b]))
+
+
+def test_add_input_wrong_frame():
+    q = InputQueue(1)
+    q.add_input(inp(0, 0))
+    with pytest.raises(AssertionError):
+        q.add_input(inp(3, 0))  # not sequential
+
+
+def test_add_input_twice():
+    q = InputQueue(1)
+    q.add_input(inp(0, 0))
+    with pytest.raises(AssertionError):
+        q.add_input(inp(0, 0))
+
+
+def test_add_input_sequentially():
+    q = InputQueue(1)
+    for i in range(10):
+        q.add_input(inp(i, 0))
+        assert q.last_added_frame == i
+        assert q.length == i + 1
+
+
+def test_input_sequentially():
+    q = InputQueue(1)
+    for i in range(10):
+        q.add_input(inp(i, i))
+        buf, status = q.input(i)
+        assert status == InputStatus.CONFIRMED
+        assert buf[0] == i
+
+
+def test_delayed_inputs():
+    q = InputQueue(1)
+    delay = 2
+    q.set_frame_delay(delay)
+    for i in range(10):
+        q.add_input(inp(i, i))
+        assert q.last_added_frame == i + delay
+        assert q.length == i + delay + 1
+        buf, _status = q.input(i)
+        assert buf[0] == max(0, i - delay)
+
+
+def test_prediction_and_misprediction_detection():
+    q = InputQueue(1)
+    q.add_input(inp(0, 7))
+    # request beyond what's confirmed -> repeat-last prediction
+    buf, status = q.input(1)
+    assert status == InputStatus.PREDICTED
+    assert buf[0] == 7
+    buf, status = q.input(2)
+    assert status == InputStatus.PREDICTED
+    # real input for frame 1 disagrees with the prediction
+    q.add_input(inp(1, 9))
+    assert q.first_incorrect_frame == 1
+
+
+def test_prediction_correct_exits_prediction_mode():
+    q = InputQueue(1)
+    q.add_input(inp(0, 7))
+    q.input(1)  # predict 7
+    q.add_input(inp(1, 7))  # matches; caught up with last request
+    assert q.first_incorrect_frame == NULL_FRAME
+    buf, status = q.input(1)
+    assert status == InputStatus.CONFIRMED
+    assert buf[0] == 7
+
+
+def test_discard_confirmed_frames():
+    q = InputQueue(1)
+    for i in range(10):
+        q.add_input(inp(i, i))
+    q.input(9)
+    q.discard_confirmed_frames(5)
+    assert q.length == 5  # frames 5..9 remain
+    buf, status = q.input(9)
+    assert status == InputStatus.CONFIRMED and buf[0] == 9

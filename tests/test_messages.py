@@ -1,0 +1,62 @@
+"""Wire codec roundtrips for every message type."""
+
+import pytest
+
+from ggrs_tpu.network.messages import (
+    ChecksumReport,
+    DecodeError,
+    InputAck,
+    InputMsg,
+    KeepAlive,
+    Message,
+    QualityReply,
+    QualityReport,
+    SyncReply,
+    SyncRequest,
+    decode_message,
+    encode_message,
+)
+from ggrs_tpu.sync_layer import ConnectionStatus
+
+
+BODIES = [
+    SyncRequest(random_request=0xDEADBEEF),
+    SyncReply(random_reply=12345),
+    InputMsg(
+        peer_connect_status=[ConnectionStatus(False, 17), ConnectionStatus(True, -1)],
+        disconnect_requested=True,
+        start_frame=42,
+        ack_frame=-1,
+        bytes_=b"\x01\x02\x03\x00\x00",
+    ),
+    InputAck(ack_frame=99),
+    QualityReport(frame_advantage=-3, ping=123456789),
+    QualityReply(pong=987654321),
+    ChecksumReport(checksum=(1 << 100) + 17, frame=1000),
+    KeepAlive(),
+]
+
+
+@pytest.mark.parametrize("body", BODIES, ids=lambda b: type(b).__name__)
+def test_roundtrip(body):
+    msg = Message(magic=0xABCD, body=body)
+    out = decode_message(encode_message(msg))
+    assert out.magic == msg.magic
+    if isinstance(body, InputMsg):
+        got = out.body
+        assert got.start_frame == body.start_frame
+        assert got.ack_frame == body.ack_frame
+        assert got.disconnect_requested == body.disconnect_requested
+        assert got.bytes_ == body.bytes_
+        assert got.peer_connect_status == body.peer_connect_status
+    else:
+        assert out.body == body
+
+
+def test_garbage_rejected():
+    with pytest.raises(DecodeError):
+        decode_message(b"")
+    with pytest.raises(DecodeError):
+        decode_message(b"\x01\x02\xff")  # unknown body type
+    with pytest.raises(DecodeError):
+        decode_message(encode_message(Message(1, SyncRequest(5)))[:-2])  # truncated

@@ -1,0 +1,227 @@
+"""Two real P2P sessions in one process over the virtual network —
+multi-node-without-a-cluster, the reference's integration strategy
+(tests/test_p2p_session.rs) plus latency/loss scenarios it never covered."""
+
+import random
+
+import pytest
+
+from ggrs_tpu import (
+    DesyncDetected,
+    DesyncDetection,
+    NotSynchronized,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+)
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.utils.clock import FakeClock
+from stubs import GameStub, RandomChecksumGameStub
+
+
+def build_pair(clock, net, *, desync=None, input_delay=0, max_prediction=8):
+    def build(my_addr, other_addr, local_handle):
+        b = (
+            SessionBuilder(input_size=1)
+            .with_num_players(2)
+            .with_max_prediction_window(max_prediction)
+            .with_input_delay(input_delay)
+            .with_clock(clock)
+            .with_rng(random.Random(hash(my_addr) & 0xFFFF))
+        )
+        if desync is not None:
+            b = b.with_desync_detection_mode(desync)
+        b = b.add_player(PlayerType.local(), local_handle)
+        b = b.add_player(PlayerType.remote(other_addr), 1 - local_handle)
+        return b.start_p2p_session(net.socket(my_addr))
+
+    return build("a", "b", 0), build("b", "a", 1)
+
+
+def sync_sessions(sessions, clock, iterations=400):
+    for _ in range(iterations):
+        for s in sessions:
+            s.poll_remote_clients()
+            s.events()
+        clock.advance(20)
+        if all(s.current_state() == SessionState.RUNNING for s in sessions):
+            return
+    raise AssertionError("sessions failed to synchronize")
+
+
+def test_not_synchronized_before_handshake():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    s1, _s2 = build_pair(clock, net)
+    s1.add_local_input(0, b"\x00")
+    with pytest.raises(NotSynchronized):
+        s1.advance_frame()
+
+
+def test_lockstep_advance_zero_latency():
+    """(tests/test_p2p_session.rs:99-146)"""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    s1, s2 = build_pair(clock, net)
+    sync_sessions([s1, s2], clock)
+
+    g1, g2 = GameStub(), GameStub()
+    for frame in range(20):
+        s1.add_local_input(0, bytes([frame % 5]))
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, bytes([(frame * 3) % 5]))
+        g2.handle_requests(s2.advance_frame())
+        clock.advance(16)
+
+    assert s1.current_frame == 20 and s2.current_frame == 20
+    assert g1.gs.frame == 20 and g2.gs.frame == 20
+
+
+def finish_and_compare(s1, s2, g1, g2, clock, frames=60, latency_net=None):
+    """Drive both sessions with scripted inputs; verify both replicas settle
+    on identical confirmed state."""
+    for frame in range(frames):
+        s1.add_local_input(0, bytes([(frame * 7 + 1) % 16]))
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, bytes([(frame * 5 + 2) % 16]))
+        g2.handle_requests(s2.advance_frame())
+        s1.events()
+        s2.events()
+        clock.advance(16)
+
+    # drain the network so late inputs arrive, then advance one more frame on
+    # each side so rollbacks apply the corrections
+    for _ in range(10):
+        s1.poll_remote_clients()
+        s2.poll_remote_clients()
+        clock.advance(16)
+    s1.add_local_input(0, b"\x00")
+    g1.handle_requests(s1.advance_frame())
+    s2.add_local_input(1, b"\x00")
+    g2.handle_requests(s2.advance_frame())
+
+    # beyond the confirmed frame, states are still speculative; the corrected
+    # (confirmed) prefix of the two replicas must be identical
+    confirmed = min(s1.confirmed_frame(), s2.confirmed_frame())
+    assert confirmed > frames // 2, "sessions never confirmed enough frames"
+    for f in range(1, confirmed + 1):
+        assert g1.history[f] == g2.history[f], f"replicas diverged at frame {f}"
+
+
+def test_latency_forces_rollbacks_and_replicas_converge():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=50, jitter_ms=20, seed=5)
+    s1, s2 = build_pair(clock, net)
+    sync_sessions([s1, s2], clock)
+    g1, g2 = GameStub(), GameStub()
+    finish_and_compare(s1, s2, g1, g2, clock)
+    # with 50ms latency at 16ms frames, predictions MUST have missed sometimes
+    assert g1.loaded_frames or g2.loaded_frames, "expected rollbacks under latency"
+
+
+def test_loss_and_jitter_replicas_converge():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=30, jitter_ms=30, loss=0.2, duplicate=0.1, seed=11)
+    s1, s2 = build_pair(clock, net)
+    sync_sessions([s1, s2], clock)
+    g1, g2 = GameStub(), GameStub()
+    finish_and_compare(s1, s2, g1, g2, clock)
+
+
+def test_input_delay_replicas_converge():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=40, seed=3)
+    s1, s2 = build_pair(clock, net, input_delay=2)
+    sync_sessions([s1, s2], clock)
+    g1, g2 = GameStub(), GameStub()
+    finish_and_compare(s1, s2, g1, g2, clock)
+
+
+def test_no_desync_events_on_identical_games():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=40, jitter_ms=10, seed=13)
+    s1, s2 = build_pair(clock, net, desync=DesyncDetection.on(10))
+    sync_sessions([s1, s2], clock)
+    g1, g2 = GameStub(), GameStub()
+
+    events = []
+    for frame in range(120):
+        s1.add_local_input(0, bytes([frame % 4]))
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, bytes([frame % 6]))
+        g2.handle_requests(s2.advance_frame())
+        events += s1.events() + s2.events()
+        clock.advance(16)
+    assert not [e for e in events if isinstance(e, DesyncDetected)]
+
+
+def test_desync_detected_on_diverging_games():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, seed=17)
+    s1, s2 = build_pair(clock, net, desync=DesyncDetection.on(10))
+    sync_sessions([s1, s2], clock)
+    g1 = GameStub()
+    g2 = RandomChecksumGameStub()  # checksums will never agree
+
+    events = []
+    for frame in range(150):
+        s1.add_local_input(0, b"\x01")
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, b"\x01")
+        g2.handle_requests(s2.advance_frame())
+        events += s1.events() + s2.events()
+        clock.advance(16)
+    assert [e for e in events if isinstance(e, DesyncDetected)]
+
+
+def test_disconnect_player_and_continue():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    s1, s2 = build_pair(clock, net)
+    sync_sessions([s1, s2], clock)
+    g1 = GameStub()
+    for frame in range(5):
+        s1.add_local_input(0, b"\x01")
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, b"\x01")
+        s2.advance_frame()
+        clock.advance(16)
+
+    s1.disconnect_player(1)
+    # session keeps running; the dead player contributes dummy inputs
+    for frame in range(10):
+        s1.add_local_input(0, b"\x01")
+        g1.handle_requests(s1.advance_frame())
+        clock.advance(16)
+    assert s1.current_frame == 15
+
+
+def test_timeout_disconnect_via_silence():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    s1, s2 = build_pair(clock, net)
+    sync_sessions([s1, s2], clock)
+    g1 = GameStub()
+    for frame in range(3):
+        s1.add_local_input(0, b"\x01")
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, b"\x01")
+        s2.advance_frame()
+        clock.advance(16)
+
+    # s2 goes silent; s1 sees interruption then disconnect after 2000ms
+    from ggrs_tpu import Disconnected, NetworkInterrupted
+
+    events = []
+    for _ in range(30):
+        s1.poll_remote_clients()
+        events += s1.events()
+        clock.advance(100)
+    assert [e for e in events if isinstance(e, NetworkInterrupted)]
+    assert [e for e in events if isinstance(e, Disconnected)]
+
+    # and the session continues alone
+    for frame in range(5):
+        s1.add_local_input(0, b"\x01")
+        g1.handle_requests(s1.advance_frame())
+        clock.advance(16)

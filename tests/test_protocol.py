@@ -1,0 +1,221 @@
+"""PeerEndpoint state machine in isolation over the fault-injecting virtual
+network — the protocol-level coverage the reference lacks (SURVEY.md §4)."""
+
+import random
+
+from ggrs_tpu.frame_info import PlayerInput
+from ggrs_tpu.network.protocol import (
+    NUM_SYNC_PACKETS,
+    EvDisconnected,
+    EvInput,
+    EvNetworkInterrupted,
+    EvNetworkResumed,
+    EvSynchronized,
+    PeerEndpoint,
+)
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.sync_layer import ConnectionStatus
+from ggrs_tpu.utils.clock import FakeClock
+
+
+def make_pair(clock, net, **net_kwargs):
+    sock_a = net.socket("a")
+    sock_b = net.socket("b")
+    kwargs = dict(
+        num_players=2,
+        local_players=1,
+        max_prediction=8,
+        disconnect_timeout_ms=2000,
+        disconnect_notify_start_ms=500,
+        fps=60,
+        input_size=1,
+        clock=clock,
+    )
+    ep_a = PeerEndpoint(handles=[1], peer_addr="b", rng=random.Random(1), **kwargs)
+    ep_b = PeerEndpoint(handles=[0], peer_addr="a", rng=random.Random(2), **kwargs)
+    return (ep_a, sock_a), (ep_b, sock_b)
+
+
+def pump(pairs, status, clock, steps=1, advance_ms=10):
+    events = {id(ep): [] for ep, _ in pairs}
+    for _ in range(steps):
+        for ep, sock in pairs:
+            for _, msg in sock.receive_all_messages():
+                ep.handle_message(msg)
+            events[id(ep)].extend(ep.poll(status))
+            ep.send_all_messages(sock)
+        clock.advance(advance_ms)
+    return events
+
+
+def test_sync_handshake_completes():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair(clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    events = pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2 * NUM_SYNC_PACKETS)
+    assert ep_a.is_running() and ep_b.is_running()
+    assert any(isinstance(e, EvSynchronized) for e in events[id(ep_a)])
+    assert any(isinstance(e, EvSynchronized) for e in events[id(ep_b)])
+
+
+def test_sync_survives_heavy_loss():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, loss=0.5, seed=99)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair(clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    # retries happen on the 200ms sync timer; give it simulated seconds
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=200, advance_ms=50)
+    assert ep_a.is_running() and ep_b.is_running()
+
+
+def _sync(clock, net):
+    pair = make_pair(clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    pair[0][0].synchronize()
+    pair[1][0].synchronize()
+    for _ in range(100):
+        pump(list(pair), status, clock, steps=1, advance_ms=60)
+        if pair[0][0].is_running() and pair[1][0].is_running():
+            break
+    assert pair[0][0].is_running() and pair[1][0].is_running()
+    return pair, status
+
+
+def test_input_transmission_under_loss_recovers_by_resend():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, loss=0.4, seed=7)
+    ((ep_a, sock_a), (ep_b, sock_b)), status = _sync(clock, net)
+
+    sent = []
+    got = []
+    for frame in range(30):
+        inp = PlayerInput(frame, bytes([frame % 11]))
+        sent.append(inp.buf)
+        ep_a.send_input({1: inp}, status)
+        evs = pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2, advance_ms=120)
+        got.extend(e for e in evs[id(ep_b)] if isinstance(e, EvInput))
+    # tail resends: keep pumping until everything arrived
+    for _ in range(50):
+        evs = pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=1, advance_ms=120)
+        got.extend(e for e in evs[id(ep_b)] if isinstance(e, EvInput))
+        if len(got) == 30:
+            break
+
+    assert [e.input.frame for e in got] == list(range(30))  # in order, no gaps
+    assert [e.input.buf for e in got] == sent
+    # ep_b's endpoint represents remote player 0; inputs attribute to it
+    assert all(e.player == 0 for e in got)
+
+
+def test_rtt_estimation():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=40)
+    ((ep_a, sock_a), (ep_b, sock_b)), status = _sync(clock, net)
+    # quality reports fire on their 200ms timer; replies echo the ping time
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=20, advance_ms=50)
+    assert 40 <= ep_a.round_trip_time <= 200
+
+
+def test_interrupt_resume_and_disconnect():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    ((ep_a, sock_a), (ep_b, sock_b)), status = _sync(clock, net)
+
+    # silence from b: a must emit NetworkInterrupted after 500ms
+    evs_a = []
+    for _ in range(8):
+        for _, msg in sock_a.receive_all_messages():
+            pass  # drop everything b might have queued earlier
+        evs_a.extend(ep_a.poll(status))
+        clock.advance(100)
+    assert any(isinstance(e, EvNetworkInterrupted) for e in evs_a)
+    assert not any(isinstance(e, EvDisconnected) for e in evs_a)
+
+    # traffic resumes: NetworkResumed
+    ep_b.send_input({0: PlayerInput(0, b"\x01")}, status)
+    ep_b.send_all_messages(sock_b)
+    evs = pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=1)
+    assert any(isinstance(e, EvNetworkResumed) for e in evs[id(ep_a)])
+
+    # then full silence past the 2000ms timeout: Disconnected
+    evs_a = []
+    for _ in range(25):
+        sock_a.receive_all_messages()
+        evs_a.extend(ep_a.poll(status))
+        clock.advance(100)
+    assert any(isinstance(e, EvDisconnected) for e in evs_a)
+
+
+def test_keep_alive_prevents_disconnect():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    pair, status = _sync(clock, net)
+    # no game inputs at all, only timers: keep-alives must keep both sides up
+    evs = pump(list(pair), status, clock, steps=100, advance_ms=100)
+    for ep, _ in pair:
+        assert ep.is_running()
+        assert not any(isinstance(e, EvDisconnected) for e in evs[id(ep)])
+
+
+def test_magic_filter_rejects_forged_packets():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    ((ep_a, sock_a), (ep_b, sock_b)), status = _sync(clock, net)
+    from ggrs_tpu.network.messages import InputAck, Message
+
+    before = ep_a.pending_output.copy()
+    ep_a.send_input({1: PlayerInput(0, b"\x05")}, status)
+    assert len(ep_a.pending_output) == 1
+    # forged ack with a wrong magic must be ignored
+    ep_a.handle_message(Message(magic=ep_b.magic ^ 0x5555, body=InputAck(ack_frame=5)))
+    assert len(ep_a.pending_output) == 1
+
+
+def test_oversized_pending_window_sends_prefix_instead_of_crashing():
+    """A long un-acked window of incompressible inputs must not kill the
+    session: the endpoint sends the longest prefix fitting the UDP budget."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    sock_a = net.socket("a")
+    sock_b = net.socket("b")
+    kwargs = dict(
+        num_players=2,
+        local_players=2,
+        max_prediction=8,
+        disconnect_timeout_ms=2000,
+        disconnect_notify_start_ms=500,
+        fps=60,
+        input_size=8,  # 16 bytes/frame across both local players
+        clock=clock,
+    )
+    ep_a = PeerEndpoint(handles=[0, 1], peer_addr="b", rng=random.Random(3), **kwargs)
+    ep_b = PeerEndpoint(handles=[0, 1], peer_addr="a", rng=random.Random(4), **kwargs)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=12)
+    assert ep_a.is_running()
+
+    rng = random.Random(9)
+    # b never acks (we just don't pump it); push 100 incompressible frames
+    for frame in range(100):
+        buf = bytes(rng.randrange(256) for _ in range(8))
+        ep_a.send_input(
+            {0: PlayerInput(frame, buf), 1: PlayerInput(frame, buf)}, status
+        )
+    ep_a.send_all_messages(sock_a)  # must not raise
+    assert len(ep_a.pending_output) == 100
+    # now let b receive: it gets a clean prefix starting at frame 0
+    got = []
+    for _ in range(100):
+        evs = pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=1, advance_ms=250)
+        got.extend(e for e in evs[id(ep_b)] if isinstance(e, EvInput))
+        if got and got[-1].input.frame == 99:
+            break
+    frames = sorted({e.input.frame for e in got})
+    assert frames == list(range(100))  # everything eventually arrives

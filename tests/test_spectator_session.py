@@ -1,0 +1,132 @@
+"""Host + spectator over the virtual network
+(parity with tests/test_p2p_spectator_session.rs plus catch-up coverage)."""
+
+import random
+
+import pytest
+
+from ggrs_tpu import (
+    NotSynchronized,
+    PlayerType,
+    PredictionThreshold,
+    SessionBuilder,
+    SessionState,
+)
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.utils.clock import FakeClock
+from stubs import GameStub
+
+
+def build_host_and_spectator(clock, net, *, catchup_speed=1, max_frames_behind=10):
+    host = (
+        SessionBuilder(input_size=1)
+        .with_num_players(1)
+        .with_clock(clock)
+        .with_rng(random.Random(21))
+        .add_player(PlayerType.local(), 0)
+        .add_player(PlayerType.spectator("spec"), 1)
+        .start_p2p_session(net.socket("host"))
+    )
+    spec = (
+        SessionBuilder(input_size=1)
+        .with_num_players(1)
+        .with_clock(clock)
+        .with_rng(random.Random(22))
+        .with_max_frames_behind(max_frames_behind)
+        .with_catchup_speed(catchup_speed)
+        .start_spectator_session("host", net.socket("spec"))
+    )
+    return host, spec
+
+
+def sync_all(host, spec, clock):
+    for _ in range(60):
+        host.poll_remote_clients()
+        spec.poll_remote_clients()
+        host.events()
+        spec.events()
+        clock.advance(20)
+        if (
+            host.current_state() == SessionState.RUNNING
+            and spec.current_state() == SessionState.RUNNING
+        ):
+            return
+    raise AssertionError("host/spectator failed to synchronize")
+
+
+def test_spectator_not_synchronized_initially():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    _host, spec = build_host_and_spectator(clock, net)
+    with pytest.raises(NotSynchronized):
+        spec.advance_frame()
+
+
+def test_spectator_replays_host_inputs():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    host, spec = build_host_and_spectator(clock, net)
+    sync_all(host, spec, clock)
+
+    hg, sg = GameStub(), GameStub()
+    for frame in range(30):
+        host.add_local_input(0, bytes([frame % 9]))
+        hg.handle_requests(host.advance_frame())
+        try:
+            sg.handle_requests(spec.advance_frame())
+        except PredictionThreshold:
+            pass  # input not here yet; wait
+        clock.advance(16)
+
+    # let the spectator catch up on remaining confirmed inputs
+    for _ in range(30):
+        host.poll_remote_clients()
+        try:
+            sg.handle_requests(spec.advance_frame())
+        except PredictionThreshold:
+            break
+        clock.advance(16)
+
+    assert sg.gs.frame > 0
+    # the spectator's replica is a prefix of the host's trajectory: replaying
+    # the host's confirmed inputs yields the identical state machine
+    ref = GameStub()
+    host2, spec2 = sg.gs.frame, sg.gs.state
+    assert hg.gs.frame >= sg.gs.frame
+    # deterministic stub: same inputs => same state; spot-check via frames
+    assert spec2 == _stub_state_at(frame_inputs=[(f % 9) for f in range(host2)])
+
+
+def _stub_state_at(frame_inputs):
+    g = GameStub()
+    state = 0
+    for b in frame_inputs:
+        state += b + 1
+    return state
+
+
+def test_spectator_catchup_speed():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    host, spec = build_host_and_spectator(clock, net, catchup_speed=2, max_frames_behind=5)
+    sync_all(host, spec, clock)
+
+    hg, sg = GameStub(), GameStub()
+    # host runs ahead without the spectator advancing
+    for frame in range(20):
+        host.add_local_input(0, b"\x01")
+        hg.handle_requests(host.advance_frame())
+        spec.poll_remote_clients()
+        clock.advance(16)
+
+    assert spec.frames_behind_host() > 5
+    # now the spectator advances 2 frames per call until caught up
+    sg_frames = []
+    for _ in range(20):
+        try:
+            reqs = spec.advance_frame()
+        except PredictionThreshold:
+            break
+        sg.handle_requests(reqs)
+        sg_frames.append(len(reqs))
+    assert 2 in sg_frames  # catch-up kicked in

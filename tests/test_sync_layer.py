@@ -1,0 +1,60 @@
+"""SyncLayer behavior (parity with reference in-module tests,
+src/sync_layer.rs:280-344)."""
+
+import pytest
+
+from ggrs_tpu.errors import PredictionThreshold
+from ggrs_tpu.frame_info import PlayerInput
+from ggrs_tpu.sync_layer import ConnectionStatus, SyncLayer
+from ggrs_tpu.types import SaveGameState
+
+
+def test_reach_prediction_threshold():
+    sl = SyncLayer(2, 8, 1)
+    with pytest.raises(PredictionThreshold):
+        for i in range(20):
+            sl.add_local_input(0, PlayerInput(i, bytes([i])))
+            sl.advance_frame()
+
+
+def test_different_delays():
+    sl = SyncLayer(2, 8, 1)
+    p1_delay, p2_delay = 2, 0
+    sl.set_frame_delay(0, p1_delay)
+    sl.set_frame_delay(1, p2_delay)
+    status = [ConnectionStatus(), ConnectionStatus()]
+
+    for i in range(20):
+        gi = PlayerInput(i, bytes([i]))
+        # remote adds skip the prediction-threshold gate
+        sl.add_remote_input(0, gi)
+        sl.add_remote_input(1, gi)
+        status[0].last_frame = i
+        status[1].last_frame = i
+        if i >= 3:
+            sync_inputs = sl.synchronized_inputs(status)
+            assert sync_inputs[0][0][0] == i - p1_delay
+            assert sync_inputs[1][0][0] == i - p2_delay
+        sl.advance_frame()
+
+
+def test_snapshot_ring_save_load_roundtrip():
+    sl = SyncLayer(1, 8, 1)
+    req = sl.save_current_state()
+    assert isinstance(req, SaveGameState) and req.frame == 0
+    req.cell.save(0, {"x": 42}, 123)
+    sl.advance_frame()
+    load = sl.load_frame(0)
+    assert load.frame == 0
+    assert load.cell.load() == {"x": 42}
+    assert load.cell.checksum == 123
+    assert sl.current_frame == 0
+
+
+def test_load_frame_outside_window_fails():
+    sl = SyncLayer(1, 4, 1)
+    sl.save_current_state().cell.save(0, 0, None)
+    for _ in range(6):
+        sl.advance_frame()
+    with pytest.raises(AssertionError):
+        sl.load_frame(0)  # 6 frames back > max_prediction 4

@@ -1,0 +1,105 @@
+"""SyncTestSession request shapes + determinism checks (parity with
+tests/test_synctest_session.rs)."""
+
+import pytest
+
+from ggrs_tpu import (
+    AdvanceFrame,
+    InvalidRequest,
+    LoadGameState,
+    MismatchedChecksum,
+    SaveGameState,
+    SessionBuilder,
+)
+from stubs import GameStub, RandomChecksumGameStub
+
+
+def make_session(check_distance=2, players=2, input_delay=0):
+    return (
+        SessionBuilder(input_size=1)
+        .with_num_players(players)
+        .with_check_distance(check_distance)
+        .with_input_delay(input_delay)
+        .start_synctest_session()
+    )
+
+
+def test_check_distance_too_big_rejected():
+    with pytest.raises(InvalidRequest):
+        SessionBuilder(input_size=1).with_check_distance(8).start_synctest_session()
+
+
+def test_missing_input_rejected():
+    sess = make_session()
+    with pytest.raises(InvalidRequest):
+        sess.advance_frame()
+
+
+def test_request_shape_with_rollbacks():
+    """After passing check_distance frames, every tick is: load, adv,
+    (save, adv) x (dist-1), save, adv — 6 requests at distance 2
+    (tests/test_synctest_session.rs:46-58)."""
+    sess = make_session(check_distance=2)
+    stub = GameStub()
+    for frame in range(10):
+        for h in range(2):
+            sess.add_local_input(h, bytes([frame % 5]))
+        requests = sess.advance_frame()
+        if frame <= 2:
+            assert len(requests) == 2  # save, advance
+            assert isinstance(requests[0], SaveGameState)
+            assert isinstance(requests[1], AdvanceFrame)
+        else:
+            kinds = [type(r) for r in requests]
+            assert kinds == [
+                LoadGameState,
+                AdvanceFrame,
+                SaveGameState,
+                AdvanceFrame,
+                SaveGameState,
+                AdvanceFrame,
+            ]
+        stub.handle_requests(requests)
+
+
+def test_deterministic_stub_passes_long_run():
+    sess = make_session(check_distance=4)
+    stub = GameStub()
+    for frame in range(200):
+        for h in range(2):
+            sess.add_local_input(h, bytes([(frame * (h + 1)) % 7]))
+        stub.handle_requests(sess.advance_frame())
+    # resimulated 4 frames per tick after warmup
+    assert stub.advanced > 200
+
+
+def test_input_delay_works():
+    sess = make_session(check_distance=2, input_delay=3)
+    stub = GameStub()
+    for frame in range(50):
+        for h in range(2):
+            sess.add_local_input(h, bytes([frame % 3]))
+        stub.handle_requests(sess.advance_frame())
+
+
+def test_random_checksums_detected():
+    """Negative control: nondeterministic checksums must trip
+    MismatchedChecksum (tests/test_synctest_session.rs:87-103)."""
+    sess = make_session(check_distance=2)
+    stub = RandomChecksumGameStub()
+    with pytest.raises(MismatchedChecksum):
+        for frame in range(50):
+            for h in range(2):
+                sess.add_local_input(h, bytes([0]))
+            stub.handle_requests(sess.advance_frame())
+
+
+def test_check_distance_zero_never_saves():
+    sess = make_session(check_distance=0)
+    stub = GameStub()
+    for frame in range(20):
+        for h in range(2):
+            sess.add_local_input(h, bytes([1]))
+        requests = sess.advance_frame()
+        assert [type(r) for r in requests] == [AdvanceFrame]
+        stub.handle_requests(requests)

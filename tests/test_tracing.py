@@ -1,0 +1,22 @@
+"""Tracer span aggregation."""
+
+from ggrs_tpu.utils.tracing import Tracer
+
+
+def test_spans_aggregate_and_nest():
+    t = Tracer(enabled=True)
+    for _ in range(3):
+        with t.span("tick"):
+            with t.span("resim"):
+                pass
+    assert t.stats["tick"].count == 3
+    assert t.stats["tick/resim"].count == 3
+    assert t.stats["tick"].total_ns >= t.stats["tick/resim"].total_ns
+    assert "tick/resim" in t.report()
+
+
+def test_disabled_tracer_records_nothing():
+    t = Tracer(enabled=False)
+    with t.span("x"):
+        pass
+    assert not t.stats
