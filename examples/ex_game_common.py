@@ -1,0 +1,59 @@
+"""Shared driver glue for the example games (reference: examples/ex_game/).
+
+Headless: instead of rendering ships, the drivers print periodic state
+digests. The game itself is the framework's flagship device model
+(ggrs_tpu.models.ex_game) run through the fused TPU backend, or — for the
+host path — the numpy oracle fulfilling requests one by one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ggrs_tpu import AdvanceFrame, InputStatus, LoadGameState, SaveGameState
+from ggrs_tpu.models import ex_game
+from ggrs_tpu.ops.fixed_point import combine_checksum
+
+FPS = 60
+
+# scripted "keyboards": deterministic pseudo-input per player per frame
+def scripted_input(frame: int, handle: int) -> bytes:
+    x = (frame * (handle * 7 + 3)) >> 2
+    return bytes([(x ^ (x >> 3)) & 0xF])
+
+
+class HostGame:
+    """Fulfills requests against the numpy oracle (the reference-style user
+    side: save/load/advance callbacks on host, ex_game.rs:76-98)."""
+
+    def __init__(self, num_players: int, num_entities: int = 4096):
+        self.num_players = num_players
+        self.state = ex_game.init_oracle(num_players, num_entities)
+        self.last_checksum = (0, 0)
+
+    def handle_requests(self, requests) -> None:
+        for req in requests:
+            if isinstance(req, SaveGameState):
+                assert int(self.state["frame"]) == req.frame
+                req.cell.save(
+                    req.frame,
+                    {k: np.copy(v) for k, v in self.state.items()},
+                    combine_checksum(*ex_game.checksum_oracle(self.state)),
+                )
+            elif isinstance(req, LoadGameState):
+                self.state = {k: np.copy(v) for k, v in req.cell.load().items()}
+            elif isinstance(req, AdvanceFrame):
+                inputs = np.array([b[0] for b, _ in req.inputs], dtype=np.uint8)
+                statuses = np.array([int(s) for _, s in req.inputs], dtype=np.int32)
+                self.state = ex_game.step_oracle(
+                    self.state, inputs, statuses, self.num_players
+                )
+                self.last_checksum = (
+                    int(self.state["frame"]),
+                    combine_checksum(*ex_game.checksum_oracle(self.state)),
+                )
+
+    def digest(self) -> str:
+        f, cs = self.last_checksum
+        p0 = self.state["pos"][0]
+        return f"frame {f:5d} checksum {cs:#034x} entity0 @ ({int(p0[0])},{int(p0[1])})"

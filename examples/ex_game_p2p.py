@@ -1,0 +1,114 @@
+"""P2P driver (reference: examples/ex_game/ex_game_p2p.rs).
+
+Runs one side of a 2-player session over real UDP with a 60fps accumulator
+loop, slowing 10% when ahead of the remote (the reference's throttling,
+ex_game_p2p.rs:91-94). Start both sides:
+
+    python examples/ex_game_p2p.py --local-port 7000 --players localhost:7001 local --handle 0 &
+    python examples/ex_game_p2p.py --local-port 7001 --players local localhost:7000 --handle 1
+
+`--players` takes one entry per handle: `local` or `host:port`.
+Spectators attach with `--spectators host:port ...`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from examples.ex_game_common import FPS, HostGame, scripted_input
+from ggrs_tpu import (
+    NotSynchronized,
+    PlayerType,
+    PredictionThreshold,
+    SessionBuilder,
+    SessionState,
+)
+from ggrs_tpu.errors import GGRSError
+from ggrs_tpu.network.sockets import UdpNonBlockingSocket
+
+
+def parse_addr(s: str):
+    import socket
+
+    host, port = s.rsplit(":", 1)
+    # sessions route inbound packets by exact address equality, and UDP
+    # receive reports numeric IPs — so resolve hostnames up front
+    return (socket.gethostbyname(host), int(port))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--local-port", type=int, required=True)
+    ap.add_argument("--players", nargs="+", required=True)
+    ap.add_argument("--spectators", nargs="*", default=[])
+    ap.add_argument("--frames", type=int, default=600)
+    ap.add_argument("--input-delay", type=int, default=2)
+    ap.add_argument("--entities", type=int, default=4096)
+    args = ap.parse_args()
+
+    builder = (
+        SessionBuilder(input_size=1)
+        .with_num_players(len(args.players))
+        .with_input_delay(args.input_delay)
+        .with_fps(FPS)
+    )
+    local_handles = []
+    for handle, spec in enumerate(args.players):
+        if spec == "local":
+            builder = builder.add_player(PlayerType.local(), handle)
+            local_handles.append(handle)
+        else:
+            builder = builder.add_player(PlayerType.remote(parse_addr(spec)), handle)
+    for i, spec in enumerate(args.spectators):
+        builder = builder.add_player(
+            PlayerType.spectator(parse_addr(spec)), len(args.players) + i
+        )
+
+    sess = builder.start_p2p_session(UdpNonBlockingSocket(args.local_port))
+    game = HostGame(len(args.players), args.entities)
+
+    # accumulator loop (ex_game_p2p.rs:80-129)
+    frame = 0
+    last = time.perf_counter()
+    accumulator = 0.0
+    while frame < args.frames:
+        now = time.perf_counter()
+        accumulator += now - last
+        last = now
+
+        # run slower when ahead so remotes can catch up
+        fps_delta = 1.0 / FPS
+        if sess.frames_ahead_estimate() > 0:
+            fps_delta *= 1.1
+
+        sess.poll_remote_clients()
+        for event in sess.events():
+            print("event:", event)
+
+        while accumulator > fps_delta:
+            accumulator -= fps_delta
+            if sess.current_state() != SessionState.RUNNING:
+                continue
+            try:
+                for handle in local_handles:
+                    sess.add_local_input(handle, scripted_input(frame, handle))
+                game.handle_requests(sess.advance_frame())
+                frame += 1
+                if frame % 120 == 0:
+                    print(game.digest())
+            except PredictionThreshold:
+                pass  # skip a frame; remote is behind
+            except NotSynchronized:
+                pass
+        time.sleep(0.001)
+
+    print("done:", game.digest())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

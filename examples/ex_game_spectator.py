@@ -1,0 +1,89 @@
+"""Spectator driver (reference: examples/ex_game/ex_game_spectator.rs).
+
+Connects to a P2P host that registered us with --spectators and replays its
+confirmed inputs:
+
+    python examples/ex_game_spectator.py --local-port 7002 --host localhost:7000 --num-players 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from examples.ex_game_common import FPS, HostGame
+from ggrs_tpu import (
+    NotSynchronized,
+    PredictionThreshold,
+    SessionBuilder,
+    SpectatorTooFarBehind,
+)
+from ggrs_tpu.network.sockets import UdpNonBlockingSocket
+
+
+def parse_addr(s: str):
+    import socket
+
+    host, port = s.rsplit(":", 1)
+    # sessions route inbound packets by exact address equality, and UDP
+    # receive reports numeric IPs — so resolve hostnames up front
+    return (socket.gethostbyname(host), int(port))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--local-port", type=int, required=True)
+    ap.add_argument("--host", required=True)
+    ap.add_argument("--num-players", type=int, default=2)
+    ap.add_argument("--frames", type=int, default=600)
+    ap.add_argument("--entities", type=int, default=4096)
+    args = ap.parse_args()
+
+    sess = (
+        SessionBuilder(input_size=1)
+        .with_num_players(args.num_players)
+        .with_fps(FPS)
+        .with_max_frames_behind(10)
+        .with_catchup_speed(2)
+        .start_spectator_session(parse_addr(args.host), UdpNonBlockingSocket(args.local_port))
+    )
+    game = HostGame(args.num_players, args.entities)
+
+    frames = 0
+    last = time.perf_counter()
+    accumulator = 0.0
+    while frames < args.frames:
+        now = time.perf_counter()
+        accumulator += now - last
+        last = now
+
+        sess.poll_remote_clients()
+        for event in sess.events():
+            print("event:", event)
+
+        while accumulator > 1.0 / FPS:
+            accumulator -= 1.0 / FPS
+            try:
+                requests = sess.advance_frame()
+                frames += len(requests)
+                game.handle_requests(requests)
+                if frames % 120 == 0:
+                    print(game.digest(), f"(behind host: {sess.frames_behind_host()})")
+            except PredictionThreshold:
+                pass  # host input not here yet
+            except NotSynchronized:
+                pass
+            except SpectatorTooFarBehind:
+                print("fell too far behind the host; giving up")
+                return 1
+        time.sleep(0.001)
+
+    print("done:", game.digest())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,133 @@
+"""ctypes loader for the native (C++) runtime kernels.
+
+Build with `make -C native` (or `python -m ggrs_tpu.native.build`); the
+shared library lands next to this file. Loading is lazy and optional: when
+the library is absent the pure-Python implementations in
+ggrs_tpu.network.compression / ggrs_tpu.ops.fixed_point are used — they are
+the format oracle the native code must match (tests/test_native.py enforces
+byte-for-byte parity).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libggrs_native.so")
+_ABI_VERSION = 1
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (once) and return the native library, or None if unavailable."""
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.ggrs_native_abi_version.restype = ctypes.c_long
+    if lib.ggrs_native_abi_version() != _ABI_VERSION:
+        return None
+
+    lib.ggrs_rle_encode.restype = ctypes.c_long
+    lib.ggrs_rle_encode.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
+    ]
+    lib.ggrs_rle_decode.restype = ctypes.c_long
+    lib.ggrs_rle_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
+    ]
+    lib.ggrs_delta_encode.restype = None
+    lib.ggrs_delta_encode.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
+    ]
+    lib.ggrs_weighted_checksum.restype = None
+    lib.ggrs_weighted_checksum.argtypes = [
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# ---------------------------------------------------------------------------
+# Thin wrappers mirroring the pure-Python API
+# ---------------------------------------------------------------------------
+
+
+def rle_encode(data: bytes) -> bytes:
+    lib = load()
+    assert lib is not None
+    # worst case: all literals; one 4-byte header per 1MiB chunk + slack
+    cap = len(data) + 16 + 4 * (len(data) // (1 << 20) + 1)
+    out = ctypes.create_string_buffer(cap)
+    n = lib.ggrs_rle_encode(data, len(data), out, cap)
+    assert n >= 0, "native rle_encode overflow"
+    return out.raw[:n]
+
+
+def rle_decode(data: bytes, expected_len: Optional[int] = None) -> bytes:
+    lib = load()
+    assert lib is not None
+    cap = expected_len if expected_len is not None else max(64, len(data) * 512)
+    out = ctypes.create_string_buffer(cap)
+    n = lib.ggrs_rle_decode(data, len(data), out, cap)
+    if n == -2 and expected_len is None:
+        # decoded output exceeded the heuristic cap: retry with a hard cap
+        cap = 1 << 26
+        out = ctypes.create_string_buffer(cap)
+        n = lib.ggrs_rle_decode(data, len(data), out, cap)
+    if n < 0:
+        raise ValueError(f"malformed RLE stream (code {n})")
+    return out.raw[:n]
+
+
+def delta_encode(reference: bytes, pending: List[bytes]) -> bytes:
+    lib = load()
+    assert lib is not None
+    m = len(reference)
+    for p in pending:
+        assert len(p) == m, "input size mismatch"
+    blob = b"".join(pending)
+    out = ctypes.create_string_buffer(max(1, len(blob)))
+    lib.ggrs_delta_encode(reference, m, blob, len(pending), out)
+    return out.raw[: len(blob)]
+
+
+def delta_decode(reference: bytes, data: bytes) -> List[bytes]:
+    lib = load()
+    assert lib is not None
+    m = len(reference)
+    if m == 0 or len(data) % m != 0:
+        raise ValueError("delta payload not a multiple of the reference size")
+    k = len(data) // m
+    out = ctypes.create_string_buffer(max(1, len(data)))
+    lib.ggrs_delta_encode(reference, m, data, k, out)  # XOR is an involution
+    raw = out.raw[: len(data)]
+    return [raw[i * m : (i + 1) * m] for i in range(k)]
+
+
+def weighted_checksum_bytes(words_le: bytes) -> tuple[int, int]:
+    """Checksum of little-endian uint32 words; parity with
+    ggrs_tpu.ops.fixed_point.weighted_checksum."""
+    lib = load()
+    assert lib is not None
+    assert len(words_le) % 4 == 0
+    n = len(words_le) // 4
+    arr = (ctypes.c_uint32 * n).from_buffer_copy(words_le)
+    hi = ctypes.c_uint32(0)
+    lo = ctypes.c_uint32(0)
+    lib.ggrs_weighted_checksum(arr, n, ctypes.byref(hi), ctypes.byref(lo))
+    return hi.value, lo.value

@@ -1,0 +1,23 @@
+"""Build the native kernels: `python -m ggrs_tpu.native.build`."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+
+def build() -> bool:
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        print("native build skipped: make/g++ not available", file=sys.stderr)
+        return False
+    native_dir = os.path.normpath(
+        os.path.join(os.path.dirname(__file__), "..", "..", "native")
+    )
+    subprocess.run(["make", "-C", native_dir], check=True)
+    return True
+
+
+if __name__ == "__main__":
+    sys.exit(0 if build() else 1)

@@ -130,10 +130,19 @@ def delta_decode(reference: bytes, data: bytes) -> List[bytes]:
 
 
 def encode(reference: bytes, pending: Iterable[bytes]) -> bytes:
-    """delta + RLE (src/network/compression.rs:3-11)."""
+    """delta + RLE (src/network/compression.rs:3-11). Dispatches to the C++
+    kernels when built (native/); this module is the format oracle."""
+    from .. import native as _native
+
+    if _native.available():
+        return _native.rle_encode(_native.delta_encode(reference, list(pending)))
     return rle_encode(delta_encode(reference, pending))
 
 
 def decode(reference: bytes, data: bytes) -> List[bytes]:
     """(src/network/compression.rs:32-40)"""
+    from .. import native as _native
+
+    if _native.available():
+        return _native.delta_decode(reference, _native.rle_decode(data))
     return delta_decode(reference, rle_decode(data))

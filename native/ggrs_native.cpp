@@ -1,0 +1,168 @@
+// Native hot-path kernels for the ggrs_tpu host runtime.
+//
+// The reference implements its whole runtime natively (Rust); here the
+// per-packet codec hot path — XOR-delta + byte RLE input compression
+// (format-identical to ggrs_tpu/network/compression.py, which is the
+// oracle) — and the host-side snapshot checksum are C++, exposed through a
+// plain C ABI consumed via ctypes (ggrs_tpu/native/__init__.py).
+//
+// Every function is allocation-free: callers pass output buffers; functions
+// return the produced length or a negative error code.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int TOKEN_LITERAL = 0;
+constexpr int TOKEN_ZEROS = 1;
+constexpr int TOKEN_ONES = 2;
+constexpr long MIN_RUN = 3;           // runs shorter than this stay literal
+constexpr long MAX_CHUNK = 1L << 20;  // literal chunk cap (matches Python)
+
+// LEB128 varint append; returns new offset or -1 on overflow.
+inline long write_varint(uint8_t* out, long cap, long off, uint64_t v) {
+  while (true) {
+    if (off >= cap) return -1;
+    uint8_t b = v & 0x7F;
+    v >>= 7;
+    if (v) {
+      out[off++] = b | 0x80;
+    } else {
+      out[off++] = b;
+      return off;
+    }
+  }
+}
+
+// LEB128 varint read; returns new offset or -1 on truncation/overflow.
+inline long read_varint(const uint8_t* in, long n, long off, uint64_t* v) {
+  int shift = 0;
+  uint64_t acc = 0;
+  while (true) {
+    if (off >= n) return -1;
+    uint8_t b = in[off++];
+    acc |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *v = acc;
+      return off;
+    }
+    shift += 7;
+    if (shift > 35) return -1;
+  }
+}
+
+inline long flush_literal(const uint8_t* data, long lit_start, long end,
+                          uint8_t* out, long cap, long off) {
+  while (lit_start < end) {
+    long chunk = end - lit_start;
+    if (chunk > MAX_CHUNK) chunk = MAX_CHUNK;
+    off = write_varint(out, cap, off,
+                       (static_cast<uint64_t>(chunk) << 2) | TOKEN_LITERAL);
+    if (off < 0 || off + chunk > cap) return -1;
+    std::memcpy(out + off, data + lit_start, chunk);
+    off += chunk;
+    lit_start += chunk;
+  }
+  return off;
+}
+
+}  // namespace
+
+extern "C" {
+
+// RLE encode `n` bytes of `in` into `out` (capacity `cap`).
+// Returns encoded length, or -1 if out is too small.
+long ggrs_rle_encode(const uint8_t* in, long n, uint8_t* out, long cap) {
+  long off = 0;
+  long i = 0;
+  long lit_start = 0;
+  while (i < n) {
+    uint8_t b = in[i];
+    if (b == 0x00 || b == 0xFF) {
+      long j = i + 1;
+      while (j < n && in[j] == b) ++j;
+      long run = j - i;
+      if (run >= MIN_RUN) {
+        off = flush_literal(in, lit_start, i, out, cap, off);
+        if (off < 0) return -1;
+        int token = (b == 0x00) ? TOKEN_ZEROS : TOKEN_ONES;
+        off = write_varint(out, cap, off,
+                           (static_cast<uint64_t>(run) << 2) | token);
+        if (off < 0) return -1;
+        i = j;
+        lit_start = i;
+        continue;
+      }
+      i = j;
+    } else {
+      ++i;
+    }
+  }
+  off = flush_literal(in, lit_start, n, out, cap, off);
+  return off;
+}
+
+// RLE decode; returns decoded length, -1 on malformed input, -2 if out too small.
+long ggrs_rle_decode(const uint8_t* in, long n, uint8_t* out, long cap) {
+  long off = 0;
+  long w = 0;
+  while (off < n) {
+    uint64_t v;
+    off = read_varint(in, n, off, &v);
+    if (off < 0) return -1;
+    int kind = static_cast<int>(v & 3);
+    long length = static_cast<long>(v >> 2);
+    if (w + length > cap) return -2;
+    if (kind == TOKEN_LITERAL) {
+      if (off + length > n) return -1;
+      std::memcpy(out + w, in + off, length);
+      off += length;
+    } else if (kind == TOKEN_ZEROS) {
+      std::memset(out + w, 0x00, length);
+    } else if (kind == TOKEN_ONES) {
+      std::memset(out + w, 0xFF, length);
+    } else {
+      return -1;
+    }
+    w += length;
+  }
+  return w;
+}
+
+// XOR each of `k` consecutive inputs (each `m` bytes, concatenated in
+// `inputs`) against `ref` (m bytes) into `out` (k*m bytes).
+void ggrs_delta_encode(const uint8_t* ref, long m, const uint8_t* inputs,
+                       long k, uint8_t* out) {
+  for (long c = 0; c < k; ++c) {
+    const uint8_t* src = inputs + c * m;
+    uint8_t* dst = out + c * m;
+    for (long i = 0; i < m; ++i) dst[i] = src[i] ^ ref[i];
+  }
+}
+
+// Inverse of ggrs_delta_encode (XOR is an involution).
+void ggrs_delta_decode(const uint8_t* ref, long m, const uint8_t* data,
+                       long k, uint8_t* out) {
+  ggrs_delta_encode(ref, m, data, k, out);
+}
+
+// Order-invariant 64-bit checksum of a uint32 word vector; bit-identical to
+// ggrs_tpu.ops.fixed_point.weighted_checksum (Knuth-weighted modular sums).
+void ggrs_weighted_checksum(const uint32_t* words, long n, uint32_t* hi,
+                            uint32_t* lo) {
+  const uint32_t GOLDEN = 2654435761u;
+  uint32_t h = 0, l = 0;
+  for (long i = 0; i < n; ++i) {
+    uint32_t w = words[i];
+    h += w * (static_cast<uint32_t>(i + 1) * GOLDEN);
+    l += w;
+  }
+  *hi = h;
+  *lo = l;
+}
+
+// ABI version for the ctypes loader to sanity-check.
+long ggrs_native_abi_version() { return 1; }
+
+}  // extern "C"
