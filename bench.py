@@ -1,15 +1,22 @@
 """North-star benchmark: rollback-frames resimulated per second.
 
-Config (BASELINE.json configs[0-1]): the reference's SyncTest loop — every
-tick, roll back `check_distance` frames, resimulate them plus one new frame,
-checksum-compare against history — over the 4096-entity flagship world, with
-the rollback executed by the fused device backend (one dispatch per tick).
+Headline config (BASELINE.json configs[0-1]): the reference's SyncTest loop —
+every tick, roll back `check_distance` frames, resimulate them plus one new
+frame, checksum-compare against history — over the 4096-entity flagship
+world, fully fused on device (TpuSyncTestSession: 60 ticks per dispatch,
+snapshot ring / input history / checksum verdict device-resident).
 
-Baseline: the driver-set north star is an 8-frame rollback of the 4096-entity
-step in <1ms wall-clock, i.e. 8000 rollback-frames/sec. vs_baseline is
+Also reported for context:
+- the request-path rate (host SyncTestSession + TpuRollbackBackend, one
+  dispatch per tick) — the latency-bound interactive configuration;
+- the host-python oracle rate (reference-style per-request fulfillment);
+- bit-exact parity of the fused run against the numpy oracle;
+- the 16-way speculative input beam rate (BASELINE.json configs[2]).
+
+Baseline: the driver-set north star is an 8-frame rollback of the
+4096-entity step in <1ms, i.e. 8000 rollback-frames/sec. vs_baseline is
 measured_rate / 8000 (>1.0 beats the target). The reference itself publishes
-no numbers (BASELINE.md); a host-python execution of the identical SyncTest
-loop is also measured and reported for context.
+no numbers (BASELINE.md).
 
 Prints exactly one JSON line.
 """
@@ -25,111 +32,89 @@ ENTITIES = 4096
 PLAYERS = 2
 CHECK_DISTANCE = 8
 MAX_PREDICTION = 9  # check_distance must be < max_prediction
-WARMUP_TICKS = 30
-BENCH_TICKS = 400
+BATCH = 60  # fused ticks per dispatch
+WARMUP_BATCHES = 2
+BENCH_BATCHES = 50
+REQUEST_PATH_TICKS = 200
 PARITY_TICKS = 50
+BEAM_WIDTH = 16
 NORTH_STAR_FRAMES_PER_SEC = 8000.0  # 8 frames / 1 ms
 
 
-def make_session():
-    from ggrs_tpu import SessionBuilder
+def input_script(frames, start=0):
+    out = np.zeros((frames, PLAYERS, 1), dtype=np.uint8)
+    for f in range(frames):
+        for h in range(PLAYERS):
+            x = ((start + f) * (3 + h) + h) % 16
+            out[f, h, 0] = x
+    return out
 
-    return (
+
+def bench_fused():
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.tpu import TpuSyncTestSession
+
+    sess = TpuSyncTestSession(
+        ExGame(PLAYERS, ENTITIES),
+        num_players=PLAYERS,
+        check_distance=CHECK_DISTANCE,
+        flush_interval=10_000_000,  # verdict checked manually per phase
+    )
+    frame = 0
+    for _ in range(WARMUP_BATCHES):
+        sess.advance_frames(input_script(BATCH, frame))
+        frame += BATCH
+    sess.check()
+    sess.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(BENCH_BATCHES):
+        sess.advance_frames(input_script(BATCH, frame))
+        frame += BATCH
+    sess.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    sess.check()
+
+    ticks = BENCH_BATCHES * BATCH
+    resim = ticks * CHECK_DISTANCE
+    return resim / elapsed, (elapsed / ticks) * 1000.0, sess
+
+
+def bench_request_path():
+    from ggrs_tpu import SessionBuilder
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.tpu import TpuRollbackBackend
+
+    backend = TpuRollbackBackend(
+        ExGame(PLAYERS, ENTITIES), max_prediction=MAX_PREDICTION, num_players=PLAYERS
+    )
+    sess = (
         SessionBuilder(input_size=1)
         .with_num_players(PLAYERS)
         .with_max_prediction_window(MAX_PREDICTION)
         .with_check_distance(CHECK_DISTANCE)
         .start_synctest_session()
     )
+    script = input_script(REQUEST_PATH_TICKS + 30)
 
-
-def input_script(frame: int, handle: int) -> bytes:
-    return bytes([(frame * (3 + handle) + handle) % 16])
-
-
-def drive(handler, ticks, start=0):
-    sess = make_session()
-    for frame in range(start, start + ticks):
+    def tick(f):
         for h in range(PLAYERS):
-            sess.add_local_input(h, input_script(frame, h))
-        handler.handle_requests(sess.advance_frame())
-
-
-def bench_device():
-    import jax
-
-    from ggrs_tpu.models.ex_game import ExGame
-    from ggrs_tpu.tpu import TpuRollbackBackend
-
-    game = ExGame(num_players=PLAYERS, num_entities=ENTITIES)
-    backend = TpuRollbackBackend(game, max_prediction=MAX_PREDICTION, num_players=PLAYERS)
-
-    sess = make_session()
-
-    def tick(frame):
-        for h in range(PLAYERS):
-            sess.add_local_input(h, input_script(frame, h))
+            sess.add_local_input(h, bytes(script[f, h]))
         backend.handle_requests(sess.advance_frame())
 
-    for f in range(WARMUP_TICKS):
+    for f in range(30):
         tick(f)
     backend.block_until_ready()
-
     t0 = time.perf_counter()
-    for f in range(WARMUP_TICKS, WARMUP_TICKS + BENCH_TICKS):
+    for f in range(30, 30 + REQUEST_PATH_TICKS):
         tick(f)
     backend.block_until_ready()
     elapsed = time.perf_counter() - t0
-
-    # every tick past warmup resimulates CHECK_DISTANCE rolled-back frames
-    # plus advances one new frame
-    resim_frames = BENCH_TICKS * CHECK_DISTANCE
-    rate = resim_frames / elapsed
-    ms_per_rollback = (elapsed / BENCH_TICKS) * 1000.0
-    return rate, ms_per_rollback, backend
+    return (REQUEST_PATH_TICKS * CHECK_DISTANCE) / elapsed
 
 
-def parity_check(backend_cls, game):
-    """Bit-exact parity of the device SyncTest run vs the host numpy oracle."""
-    import jax
-
-    from ggrs_tpu.models.ex_game import checksum_oracle, init_oracle, step_oracle
-    from ggrs_tpu import AdvanceFrame, LoadGameState, SaveGameState
-
-    class OracleRunner:
-        def __init__(self):
-            self.state = init_oracle(PLAYERS, ENTITIES)
-
-        def handle_requests(self, requests):
-            for req in requests:
-                if isinstance(req, SaveGameState):
-                    req.cell.save(
-                        req.frame,
-                        {k: np.copy(v) for k, v in self.state.items()},
-                        None,
-                    )
-                elif isinstance(req, LoadGameState):
-                    self.state = {k: np.copy(v) for k, v in req.cell.load().items()}
-                elif isinstance(req, AdvanceFrame):
-                    inputs = np.array([b[0] for b, _ in req.inputs], dtype=np.uint8)
-                    statuses = np.array([int(s) for _, s in req.inputs], dtype=np.int32)
-                    self.state = step_oracle(self.state, inputs, statuses, PLAYERS)
-
-    backend = backend_cls(game, max_prediction=MAX_PREDICTION, num_players=PLAYERS)
-    oracle = OracleRunner()
-    drive(backend, PARITY_TICKS)
-    drive(oracle, PARITY_TICKS)
-    dev = backend.state_numpy()
-    for key in ("frame", "pos", "vel", "rot"):
-        if not np.array_equal(np.asarray(dev[key]), oracle.state[key]):
-            return False
-    return True
-
-
-def bench_host_python():
-    """The same SyncTest loop fulfilled on host with numpy — the unfused
-    reference-style execution, for context."""
-    from ggrs_tpu import AdvanceFrame, LoadGameState, SaveGameState
+def bench_host_python(ticks=40):
+    from ggrs_tpu import AdvanceFrame, LoadGameState, SaveGameState, SessionBuilder
     from ggrs_tpu.models.ex_game import checksum_oracle, init_oracle, step_oracle
     from ggrs_tpu.ops.fixed_point import combine_checksum
 
@@ -152,25 +137,85 @@ def bench_host_python():
                     statuses = np.array([int(s) for _, s in req.inputs], dtype=np.int32)
                     self.state = step_oracle(self.state, inputs, statuses, PLAYERS)
 
+    sess = (
+        SessionBuilder(input_size=1)
+        .with_num_players(PLAYERS)
+        .with_max_prediction_window(MAX_PREDICTION)
+        .with_check_distance(CHECK_DISTANCE)
+        .start_synctest_session()
+    )
     runner = HostRunner()
-    drive(runner, 10)
-    ticks = 60
+    script = input_script(ticks + 10)
+    for f in range(10):
+        for h in range(PLAYERS):
+            sess.add_local_input(h, bytes(script[f, h]))
+        runner.handle_requests(sess.advance_frame())
     t0 = time.perf_counter()
-    drive(runner, ticks, start=10)
+    for f in range(10, 10 + ticks):
+        for h in range(PLAYERS):
+            sess.add_local_input(h, bytes(script[f, h]))
+        runner.handle_requests(sess.advance_frame())
     elapsed = time.perf_counter() - t0
     return (ticks * CHECK_DISTANCE) / elapsed
+
+
+def parity_fused_vs_oracle():
+    from ggrs_tpu.models.ex_game import ExGame, init_oracle, step_oracle
+    from ggrs_tpu.tpu import TpuSyncTestSession
+
+    sess = TpuSyncTestSession(
+        ExGame(PLAYERS, ENTITIES), num_players=PLAYERS, check_distance=CHECK_DISTANCE
+    )
+    script = input_script(PARITY_TICKS)
+    sess.advance_frames(script)
+    dev = sess.state_numpy()
+
+    state = init_oracle(PLAYERS, ENTITIES)
+    statuses = np.zeros(PLAYERS, dtype=np.int32)
+    for f in range(PARITY_TICKS):
+        state = step_oracle(state, script[f].reshape(-1), statuses, PLAYERS)
+    return all(
+        np.array_equal(np.asarray(dev[k]), state[k])
+        for k in ("frame", "pos", "vel", "rot")
+    )
+
+
+def bench_beam():
+    """16-way speculative beam over the 8-frame window (configs[2])."""
+    import jax
+
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.tpu.beam import BeamSpeculator
+
+    game = ExGame(PLAYERS, ENTITIES)
+    spec = BeamSpeculator(game, window=CHECK_DISTANCE, beam_width=BEAM_WIDTH, num_players=PLAYERS)
+    state = game.init_state()
+    rng = np.random.default_rng(1)
+    beams = rng.integers(
+        0, 16, size=(8, BEAM_WIDTH, CHECK_DISTANCE, PLAYERS, 1), dtype=np.uint8
+    )
+    statuses = np.ones((BEAM_WIDTH, CHECK_DISTANCE, PLAYERS), dtype=np.int32)
+    out = spec.rollout(state, beams[0], statuses)
+    jax.block_until_ready(out)
+    iters = 40
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = spec.rollout(state, beams[i % 8], statuses)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    # each rollout resimulates window frames for every beam member
+    return (iters * BEAM_WIDTH * CHECK_DISTANCE) / elapsed
 
 
 def main():
     import jax
 
-    from ggrs_tpu.models.ex_game import ExGame
-    from ggrs_tpu.tpu import TpuRollbackBackend
-
     device = jax.devices()[0]
-    rate, ms_per_rollback, _backend = bench_device()
-    parity = parity_check(TpuRollbackBackend, ExGame(PLAYERS, ENTITIES))
+    rate, ms_per_tick, _sess = bench_fused()
+    request_rate = bench_request_path()
     host_rate = bench_host_python()
+    beam_rate = bench_beam()
+    parity = parity_fused_vs_oracle()
 
     print(
         json.dumps(
@@ -179,13 +224,15 @@ def main():
                 "value": round(rate, 1),
                 "unit": "frames/sec",
                 "vs_baseline": round(rate / NORTH_STAR_FRAMES_PER_SEC, 3),
-                "ms_per_8frame_rollback": round(ms_per_rollback, 4),
+                "ms_per_8frame_rollback_tick": round(ms_per_tick, 4),
+                "request_path_frames_per_sec": round(request_rate, 1),
                 "host_python_frames_per_sec": round(host_rate, 1),
+                "beam16_frames_per_sec": round(beam_rate, 1),
                 "parity_vs_oracle": parity,
                 "device": str(device),
                 "entities": ENTITIES,
                 "check_distance": CHECK_DISTANCE,
-                "ticks": BENCH_TICKS,
+                "batch_ticks": BATCH,
             }
         )
     )

@@ -5,5 +5,6 @@ Importing this subpackage imports jax.
 
 from .backend import SnapshotRef, TpuRollbackBackend
 from .resim import ResimCore
+from .sync_test import TpuSyncTestSession
 
-__all__ = ["ResimCore", "SnapshotRef", "TpuRollbackBackend"]
+__all__ = ["ResimCore", "SnapshotRef", "TpuRollbackBackend", "TpuSyncTestSession"]

@@ -1,0 +1,190 @@
+"""Fully-fused SyncTest: the determinism harness as a device-resident loop.
+
+The host SyncTestSession + TpuRollbackBackend pair already fuses each tick
+into one dispatch, but still returns to Python every frame and resolves
+checksums. This session goes further: T ticks per dispatch via `lax.scan`,
+with the snapshot ring, the input history, the checksum history and the
+mismatch verdict all living on device. Only (a) the input batch goes down
+and (b) a single mismatch flag comes back per batch.
+
+Semantics mirror src/sessions/sync_test_session.rs:85-146: each tick, once
+past `check_distance`, load the snapshot `check_distance` frames back,
+resimulate forward (re-saving each frame), then save + advance the new
+frame. The checksum history records the FIRST checksum seen for a frame and
+every later re-save is compared against it (equivalent to the reference's
+compare-then-rollback ordering); the first disagreement latches a mismatch
+flag + frame. Input delay follows the reference's clamp-at-zero behavior
+(input_queue.rs:313-326: frame f plays the input submitted at f-delay,
+frames < delay play input 0).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..errors import MismatchedChecksum
+from ..types import InputStatus
+
+
+class TpuSyncTestSession:
+    def __init__(
+        self,
+        game,
+        num_players: int,
+        check_distance: int,
+        input_delay: int = 0,
+        flush_interval: int = 1,
+    ):
+        assert check_distance >= 1
+        self.game = game
+        self.num_players = num_players
+        self.check_distance = check_distance
+        self.input_delay = input_delay
+        self.flush_interval = max(1, flush_interval)
+
+        d = check_distance
+        self.ring_len = d + 2
+        self.hist_len = d + 2
+
+        state = game.init_state()
+        zeros = lambda extra: jax.tree.map(
+            lambda x: jnp.zeros((extra,) + x.shape, x.dtype), state
+        )
+        self.carry = {
+            "state": state,
+            "ring": zeros(self.ring_len),
+            "input_ring": jnp.zeros(
+                (d + 2, num_players, game.input_size), dtype=jnp.uint8
+            ),
+            "h_tag": jnp.full((self.hist_len,), -1, dtype=jnp.int32),
+            "h_hi": jnp.zeros((self.hist_len,), dtype=jnp.uint32),
+            "h_lo": jnp.zeros((self.hist_len,), dtype=jnp.uint32),
+            "mismatch": jnp.zeros((), dtype=jnp.bool_),
+            "mismatch_frame": jnp.full((), -1, dtype=jnp.int32),
+            "frame": jnp.zeros((), dtype=jnp.int32),
+        }
+        self._batch_fn = jax.jit(self._batch_impl, donate_argnums=(0,))
+        self._raw_inputs: list = []  # host-side delay shift buffer
+        self._ticks_since_flush = 0
+        self.current_frame = 0
+
+    # ------------------------------------------------------------------
+
+    def _save_and_check(self, carry, state, frame):
+        """Write `state` (of frame `frame`) into the ring; record or compare
+        its checksum in the first-seen history."""
+        hi, lo = self.game.checksum(state)
+        slot = frame % self.ring_len
+        carry = dict(carry)
+        carry["ring"] = jax.tree.map(
+            lambda r, s: jax.lax.dynamic_update_index_in_dim(r, s, slot, 0),
+            carry["ring"],
+            state,
+        )
+        h = frame % self.hist_len
+        seen = carry["h_tag"][h] == frame
+        differs = seen & ((carry["h_hi"][h] != hi) | (carry["h_lo"][h] != lo))
+        first = differs & ~carry["mismatch"]
+        carry["mismatch"] = carry["mismatch"] | differs
+        carry["mismatch_frame"] = jnp.where(
+            first, frame, carry["mismatch_frame"]
+        )
+        carry["h_tag"] = carry["h_tag"].at[h].set(frame)
+        carry["h_hi"] = jnp.where(seen, carry["h_hi"], carry["h_hi"].at[h].set(hi))
+        carry["h_lo"] = jnp.where(seen, carry["h_lo"], carry["h_lo"].at[h].set(lo))
+        return carry
+
+    def _tick(self, carry, new_inputs):
+        d = self.check_distance
+        statuses = jnp.full((self.num_players,), int(InputStatus.CONFIRMED), jnp.int32)
+        c = carry["frame"]
+
+        # --- forced rollback once past check_distance
+        do_rollback = c > d
+        base = jnp.maximum(c - d, 0)
+        loaded = jax.tree.map(
+            lambda r: jax.lax.dynamic_index_in_dim(r, base % self.ring_len, 0, False),
+            carry["ring"],
+        )
+        state = jax.tree.map(
+            lambda a, b: jnp.where(do_rollback, a, b), loaded, carry["state"]
+        )
+        for i in range(d):
+            f = base + i
+            if i > 0:
+                rolled = self._save_and_check(carry, state, f)
+                carry = jax.tree.map(
+                    lambda a, b: jnp.where(do_rollback, a, b), rolled, carry
+                )
+            inp = jax.lax.dynamic_index_in_dim(
+                carry["input_ring"], f % (d + 2), 0, False
+            )
+            nxt = self.game.step(state, inp, statuses)
+            state = jax.tree.map(
+                lambda a, b: jnp.where(do_rollback, a, b), nxt, state
+            )
+
+        # --- save current frame, record input, advance
+        carry = self._save_and_check(carry, state, c)
+        carry["input_ring"] = jax.lax.dynamic_update_index_in_dim(
+            carry["input_ring"], new_inputs, c % (d + 2), 0
+        )
+        carry["state"] = self.game.step(state, new_inputs, statuses)
+        carry["frame"] = c + 1
+        return carry
+
+    def _batch_impl(self, carry, inputs):
+        def body(carry, inp):
+            return self._tick(carry, inp), None
+
+        carry, _ = jax.lax.scan(body, carry, inputs)
+        return carry
+
+    # ------------------------------------------------------------------
+
+    def advance_frames(self, raw_inputs: np.ndarray) -> None:
+        """Advance T frames in ONE device dispatch.
+
+        raw_inputs: u8[T, P, input_size] — the inputs submitted at each tick;
+        input delay shifts which frame actually plays them.
+        """
+        t = raw_inputs.shape[0]
+        start = self.current_frame
+        if self.input_delay:
+            # frame f plays the input submitted at f-delay; the first `delay`
+            # frames play the blank input (queue-head replication of the
+            # pristine slot, input_queue.rs:207-239). The raw history is tiny
+            # (bytes/frame), keep it whole.
+            self._raw_inputs.extend(np.asarray(raw_inputs, dtype=np.uint8))
+            blank = np.zeros_like(self._raw_inputs[0])
+            eff = np.stack(
+                [
+                    self._raw_inputs[f - self.input_delay]
+                    if f >= self.input_delay
+                    else blank
+                    for f in range(start, start + t)
+                ]
+            )
+        else:
+            eff = np.asarray(raw_inputs, dtype=np.uint8)
+        self.carry = self._batch_fn(self.carry, jnp.asarray(eff))
+        self.current_frame += t
+        self._ticks_since_flush += t
+        if self._ticks_since_flush >= self.flush_interval:
+            self.check()
+
+    def check(self) -> None:
+        """Fetch the device verdict; raises MismatchedChecksum on divergence."""
+        self._ticks_since_flush = 0
+        if bool(self.carry["mismatch"]):
+            raise MismatchedChecksum(int(self.carry["mismatch_frame"]))
+
+    def state_numpy(self):
+        return jax.device_get(self.carry["state"])
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self.carry["state"])

@@ -1,0 +1,85 @@
+"""Fully-fused device SyncTest vs the host session + backend pair."""
+
+import numpy as np
+import pytest
+
+from ggrs_tpu import MismatchedChecksum, SessionBuilder
+from ggrs_tpu.models import ex_game
+
+PLAYERS = 2
+ENTITIES = 128
+
+
+def scripted(frames):
+    rng = np.random.default_rng(17)
+    return rng.integers(0, 16, size=(frames, PLAYERS, 1), dtype=np.uint8)
+
+
+@pytest.mark.parametrize("input_delay", [0, 2])
+def test_fused_session_matches_host_path(input_delay):
+    from ggrs_tpu.tpu import TpuRollbackBackend
+    from ggrs_tpu.tpu.sync_test import TpuSyncTestSession
+
+    frames = 90
+    check_distance = 7
+    inputs = scripted(frames)
+
+    # host path: SyncTestSession emitting requests, fused per-tick backend
+    host_sess = (
+        SessionBuilder(input_size=1)
+        .with_num_players(PLAYERS)
+        .with_max_prediction_window(8)
+        .with_check_distance(check_distance)
+        .with_input_delay(input_delay)
+        .start_synctest_session()
+    )
+    backend = TpuRollbackBackend(
+        ex_game.ExGame(PLAYERS, ENTITIES), max_prediction=8, num_players=PLAYERS
+    )
+    for f in range(frames):
+        for h in range(PLAYERS):
+            host_sess.add_local_input(h, bytes(inputs[f, h]))
+        backend.handle_requests(host_sess.advance_frame())
+
+    # fused path: whole batches per dispatch
+    fused = TpuSyncTestSession(
+        ex_game.ExGame(PLAYERS, ENTITIES),
+        num_players=PLAYERS,
+        check_distance=check_distance,
+        input_delay=input_delay,
+        flush_interval=30,
+    )
+    fused.advance_frames(inputs[:40])
+    fused.advance_frames(inputs[40:])
+    fused.check()
+
+    a = backend.state_numpy()
+    b = fused.state_numpy()
+    for key in ("frame", "pos", "vel", "rot"):
+        np.testing.assert_array_equal(np.asarray(a[key]), np.asarray(b[key]))
+
+
+def test_fused_session_detects_ring_corruption():
+    import jax
+
+    from ggrs_tpu.tpu.sync_test import TpuSyncTestSession
+
+    fused = TpuSyncTestSession(
+        ex_game.ExGame(PLAYERS, 64),
+        num_players=PLAYERS,
+        check_distance=4,
+        flush_interval=1000,  # manual check()
+    )
+    inputs = scripted(80)
+    fused.advance_frames(inputs[:40])
+    fused.check()  # clean so far
+
+    # corrupt a snapshot the next rollback will load
+    slot = (fused.current_frame - 4) % fused.ring_len
+    fused.carry = dict(fused.carry)
+    fused.carry["ring"] = dict(fused.carry["ring"])
+    fused.carry["ring"]["pos"] = fused.carry["ring"]["pos"].at[slot, 0, 0].add(3)
+
+    fused.advance_frames(inputs[40:])
+    with pytest.raises(MismatchedChecksum):
+        fused.check()
