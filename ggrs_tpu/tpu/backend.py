@@ -161,3 +161,37 @@ class TpuRollbackBackend:
 
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.core.state)
+
+    # ------------------------------------------------------------------
+    # durable checkpoint/resume (beyond the reference, SURVEY.md §5)
+    # ------------------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        from ..utils.checkpoint import save_device_checkpoint
+
+        save_device_checkpoint(
+            path,
+            {"ring": self.core.ring, "state": self.core.state},
+            {
+                "kind": "TpuRollbackBackend",
+                "current_frame": self.current_frame,
+                "max_prediction": self.core.max_prediction,
+                "num_players": self.num_players,
+            },
+        )
+
+    @classmethod
+    def restore(cls, path: str, game) -> "TpuRollbackBackend":
+        from ..utils.checkpoint import load_device_checkpoint
+
+        tree, meta = load_device_checkpoint(path)
+        assert meta["kind"] == "TpuRollbackBackend"
+        backend = cls(
+            game,
+            max_prediction=meta["max_prediction"],
+            num_players=meta["num_players"],
+        )
+        backend.core.ring = jax.device_put(tree["ring"])
+        backend.core.state = jax.device_put(tree["state"])
+        backend.current_frame = meta["current_frame"]
+        return backend

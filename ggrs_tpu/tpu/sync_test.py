@@ -38,21 +38,48 @@ class TpuSyncTestSession:
         check_distance: int,
         input_delay: int = 0,
         flush_interval: int = 1,
+        mesh=None,
     ):
+        """`mesh`: optional jax Mesh with an `entity` axis — the world state
+        and snapshot ring shard across it (BASELINE.json configs[4]); GSPMD
+        partitions the fused scan, and the checksum reduction becomes the
+        only cross-shard collective."""
         assert check_distance >= 1
         self.game = game
         self.num_players = num_players
         self.check_distance = check_distance
         self.input_delay = input_delay
         self.flush_interval = max(1, flush_interval)
+        self.mesh = mesh
 
         d = check_distance
         self.ring_len = d + 2
         self.hist_len = d + 2
 
         state = game.init_state()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            state = jax.tree.map(
+                lambda x: jax.device_put(
+                    x,
+                    NamedSharding(mesh, P("entity") if x.ndim >= 1 else P()),
+                ),
+                state,
+            )
+            self._ring_shard = lambda x: jax.device_put(
+                x,
+                NamedSharding(
+                    mesh, P(None, "entity") if x.ndim >= 2 else P()
+                ),
+            )
+        else:
+            self._ring_shard = lambda x: x
         zeros = lambda extra: jax.tree.map(
-            lambda x: jnp.zeros((extra,) + x.shape, x.dtype), state
+            lambda x: self._ring_shard(
+                jnp.zeros((extra,) + x.shape, x.dtype)
+            ),
+            state,
         )
         self.carry = {
             "state": state,
@@ -188,3 +215,41 @@ class TpuSyncTestSession:
 
     def block_until_ready(self) -> None:
         jax.block_until_ready(self.carry["state"])
+
+    # ------------------------------------------------------------------
+    # durable checkpoint/resume (beyond the reference: its snapshots are
+    # memory-only and nothing survives process death, SURVEY.md §5)
+    # ------------------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        from ..utils.checkpoint import save_device_checkpoint
+
+        meta = {
+            "kind": "TpuSyncTestSession",
+            "num_players": self.num_players,
+            "check_distance": self.check_distance,
+            "input_delay": self.input_delay,
+            "current_frame": self.current_frame,
+            "raw_inputs": [r.tolist() for r in self._raw_inputs],
+        }
+        save_device_checkpoint(path, self.carry, meta)
+
+    @classmethod
+    def restore(cls, path: str, game, flush_interval: int = 1) -> "TpuSyncTestSession":
+        import jax as _jax
+
+        from ..utils.checkpoint import load_device_checkpoint
+
+        tree, meta = load_device_checkpoint(path)
+        assert meta["kind"] == "TpuSyncTestSession"
+        sess = cls(
+            game,
+            num_players=meta["num_players"],
+            check_distance=meta["check_distance"],
+            input_delay=meta["input_delay"],
+            flush_interval=flush_interval,
+        )
+        sess.carry = _jax.device_put(tree)
+        sess.current_frame = meta["current_frame"]
+        sess._raw_inputs = [np.asarray(r, dtype=np.uint8) for r in meta["raw_inputs"]]
+        return sess

@@ -1,0 +1,59 @@
+"""Durable checkpoint/resume for device-resident rollback state.
+
+The reference's snapshot system is memory-only — nothing survives process
+death (SURVEY.md §5). Here any device pytree (the fused session's carry, the
+backend's ring + live state) can be written to one .npz file and restored
+bit-exactly, so a determinism soak or a long-running session can stop and
+resume. Format: flattened key-path -> array pairs plus a JSON meta blob;
+integers/arrays only, so restores are exact by construction.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(_flatten(tree[k], f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1] if prefix.endswith("/") else prefix] = tree
+    return out
+
+
+def _unflatten(flat: Dict[str, Any]) -> Any:
+    root: Dict[str, Any] = {}
+    for path, value in flat.items():
+        parts = path.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return root
+
+
+def save_device_checkpoint(path: str, tree: Any, meta: Dict[str, Any]) -> None:
+    """Write a (nested-dict) pytree of arrays + JSON-serializable meta."""
+    import jax
+
+    host_tree = jax.device_get(tree)
+    flat = {f"t/{k}": np.asarray(v) for k, v in _flatten(host_tree).items()}
+    flat["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8
+    )
+    np.savez_compressed(path, **flat)
+
+
+def load_device_checkpoint(path: str) -> Tuple[Any, Dict[str, Any]]:
+    """Read back (tree, meta); arrays are host numpy (device_put as needed)."""
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+        flat = {
+            k[2:]: data[k] for k in data.files if k.startswith("t/")
+        }
+    return _unflatten(flat), meta

@@ -1,0 +1,210 @@
+"""Multi-player configurations: 4-player sessions with the 12-frame window
+on the device backend (BASELINE.json configs[3]), shared-address endpoints
+(several remote handles behind one peer), and time-sync wait
+recommendations."""
+
+import random
+
+import numpy as np
+
+from ggrs_tpu import (
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+    WaitRecommendation,
+)
+from ggrs_tpu.models import ex_game
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.utils.clock import FakeClock
+from stubs import GameStub
+
+
+def sync_sessions(sessions, clock):
+    for _ in range(400):
+        for s in sessions:
+            s.poll_remote_clients()
+            s.events()
+        clock.advance(20)
+        if all(s.current_state() == SessionState.RUNNING for s in sessions):
+            return
+    raise AssertionError("sessions failed to synchronize")
+
+
+def build_4p(clock, net, max_prediction=12):
+    """Four sessions, one local player each, full mesh."""
+    addrs = ["a", "b", "c", "d"]
+    sessions = []
+    for i, my in enumerate(addrs):
+        b = (
+            SessionBuilder(input_size=1)
+            .with_num_players(4)
+            .with_max_prediction_window(max_prediction)
+            .with_clock(clock)
+            .with_rng(random.Random(100 + i))
+        )
+        for h, addr in enumerate(addrs):
+            if h == i:
+                b = b.add_player(PlayerType.local(), h)
+            else:
+                b = b.add_player(PlayerType.remote(addr), h)
+        sessions.append(b.start_p2p_session(net.socket(my)))
+    return sessions
+
+
+def test_four_player_mesh_with_device_backend():
+    """configs[3]: 4-player session, 12-frame rollback window, one peer on
+    the TpuRollbackBackend, others on host stubs; confirmed prefixes agree."""
+    from ggrs_tpu.tpu import TpuRollbackBackend
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=40, jitter_ms=15, seed=33)
+    sessions = build_4p(clock, net, max_prediction=12)
+    sync_sessions(sessions, clock)
+
+    backend = TpuRollbackBackend(
+        ex_game.ExGame(num_players=4, num_entities=64),
+        max_prediction=12,
+        num_players=4,
+    )
+    stubs = [GameStub() for _ in range(3)]
+    handlers = [backend] + stubs
+
+    for frame in range(60):
+        for i, sess in enumerate(sessions):
+            sess.add_local_input(i, bytes([(frame * (i + 2) + i) % 16]))
+            handlers[i].handle_requests(sess.advance_frame())
+            sess.events()
+        clock.advance(16)
+
+    for _ in range(10):
+        for s in sessions:
+            s.poll_remote_clients()
+        clock.advance(16)
+    for i, sess in enumerate(sessions):
+        sess.add_local_input(i, b"\x00")
+        handlers[i].handle_requests(sess.advance_frame())
+
+    confirmed = min(s.confirmed_frame() for s in sessions)
+    assert confirmed > 30
+    # all three stub replicas agree on the confirmed prefix
+    for f in range(1, confirmed + 1):
+        vals = {g.history[f] for g in stubs}
+        assert len(vals) == 1, f"stub replicas diverged at frame {f}"
+    # the device peer reached the same frame count
+    assert int(backend.state_numpy()["frame"]) == 61
+    # rollbacks actually exercised the 12-frame window path
+    assert any(g.loaded_frames for g in stubs)
+
+
+def test_two_remote_players_share_one_endpoint():
+    """One machine hosts two players: the other session groups both handles
+    behind a single endpoint (builder.rs:276-293) and inputs for both arrive
+    interleaved from one address."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, seed=5)
+
+    # session A: locals 0,1; remote 2 at "b"
+    a = (
+        SessionBuilder(input_size=1)
+        .with_num_players(3)
+        .with_clock(clock)
+        .with_rng(random.Random(1))
+        .add_player(PlayerType.local(), 0)
+        .add_player(PlayerType.local(), 1)
+        .add_player(PlayerType.remote("b"), 2)
+        .start_p2p_session(net.socket("a"))
+    )
+    # session B: local 2; remotes 0,1 both at "a" -> ONE endpoint
+    b = (
+        SessionBuilder(input_size=1)
+        .with_num_players(3)
+        .with_clock(clock)
+        .with_rng(random.Random(2))
+        .add_player(PlayerType.remote("a"), 0)
+        .add_player(PlayerType.remote("a"), 1)
+        .add_player(PlayerType.local(), 2)
+        .start_p2p_session(net.socket("b"))
+    )
+    assert len(b.player_reg.remotes) == 1
+    assert b.player_reg.remotes["a"].handles == [0, 1]
+
+    sync_sessions([a, b], clock)
+    ga, gb = GameStub(), GameStub()
+    for frame in range(40):
+        a.add_local_input(0, bytes([frame % 4]))
+        a.add_local_input(1, bytes([frame % 6]))
+        ga.handle_requests(a.advance_frame())
+        b.add_local_input(2, bytes([frame % 5]))
+        gb.handle_requests(b.advance_frame())
+        clock.advance(16)
+
+    for _ in range(6):
+        a.poll_remote_clients()
+        b.poll_remote_clients()
+        clock.advance(16)
+    a.add_local_input(0, b"\x00")
+    a.add_local_input(1, b"\x00")
+    ga.handle_requests(a.advance_frame())
+    b.add_local_input(2, b"\x00")
+    gb.handle_requests(b.advance_frame())
+
+    confirmed = min(a.confirmed_frame(), b.confirmed_frame())
+    assert confirmed > 20
+    for f in range(1, confirmed + 1):
+        assert ga.history[f] == gb.history[f]
+
+
+def test_wait_recommendation_for_fast_peer():
+    """A session running far ahead of its remote gets WaitRecommendation
+    events (p2p_session.rs:763-776)."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, seed=6)
+    fast = (
+        SessionBuilder(input_size=1)
+        .with_num_players(2)
+        .with_max_prediction_window(8)
+        .with_clock(clock)
+        .with_rng(random.Random(11))
+        .add_player(PlayerType.local(), 0)
+        .add_player(PlayerType.remote("slow"), 1)
+        .start_p2p_session(net.socket("fast"))
+    )
+    slow = (
+        SessionBuilder(input_size=1)
+        .with_num_players(2)
+        .with_max_prediction_window(8)
+        .with_clock(clock)
+        .with_rng(random.Random(12))
+        .add_player(PlayerType.remote("fast"), 0)
+        .add_player(PlayerType.local(), 1)
+        .start_p2p_session(net.socket("slow"))
+    )
+    sync_sessions([fast, slow], clock)
+
+    from ggrs_tpu import PredictionThreshold
+
+    g_fast, g_slow = GameStub(), GameStub()
+    events = []
+    skipped = 0
+    slow_frame = 0
+    for frame in range(120):
+        try:
+            fast.add_local_input(0, b"\x01")
+            g_fast.handle_requests(fast.advance_frame())
+        except PredictionThreshold:
+            skipped += 1  # the app skips a frame (ex_game_p2p.rs:115-117)
+        events += fast.events()
+        # the slow peer advances every 4th frame only
+        if frame % 4 == 0:
+            slow.add_local_input(1, b"\x01")
+            g_slow.handle_requests(slow.advance_frame())
+            slow_frame += 1
+        else:
+            slow.poll_remote_clients()
+        clock.advance(16)
+
+    recs = [e for e in events if isinstance(e, WaitRecommendation)]
+    assert recs, "fast peer never told to wait"
+    assert all(r.skip_frames >= 3 for r in recs)
+    # the prediction-threshold backpressure also kicked in
+    assert skipped > 0

@@ -74,3 +74,46 @@ def test_sharded_beam_rollout_matches_oracle(jax_mod):
             np.testing.assert_array_equal(np.asarray(got[key]), s[key])
         ohi, olo = ex_game.checksum_oracle(s)
         assert int(hi[b]) == ohi and int(lo[b]) == olo
+
+
+def test_sharded_fused_synctest_64k_16frame(jax_mod):
+    """BASELINE configs[4]: 64k-component ECS state, 16-frame rollback,
+    entity-sharded over the mesh — bit-identical to the unsharded session."""
+    jax = jax_mod
+    import numpy as np
+
+    from ggrs_tpu.models import ex_game
+    from ggrs_tpu.parallel.mesh import make_mesh
+    from ggrs_tpu.tpu.sync_test import TpuSyncTestSession
+
+    players = 4
+    entities = 65536 // 5  # ~64k int32 components (5 words per entity)
+    entities -= entities % 4  # divisible by the 4-way entity axis
+    frames = 40
+    rng = np.random.default_rng(31)
+    inputs = rng.integers(0, 16, size=(frames, players, 1), dtype=np.uint8)
+
+    mesh = make_mesh(8)
+    sharded = TpuSyncTestSession(
+        ex_game.ExGame(players, entities),
+        num_players=players,
+        check_distance=16,
+        mesh=mesh,
+        flush_interval=1000,
+    )
+    sharded.advance_frames(inputs)
+    sharded.check()
+
+    plain = TpuSyncTestSession(
+        ex_game.ExGame(players, entities),
+        num_players=players,
+        check_distance=16,
+        flush_interval=1000,
+    )
+    plain.advance_frames(inputs)
+    plain.check()
+
+    a = sharded.state_numpy()
+    b = plain.state_numpy()
+    for key in ("frame", "pos", "vel", "rot"):
+        np.testing.assert_array_equal(np.asarray(a[key]), np.asarray(b[key]))
