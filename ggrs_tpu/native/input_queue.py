@@ -1,0 +1,118 @@
+"""ctypes binding for the native InputQueue — same interface as
+ggrs_tpu.input_queue.InputQueue (the behavioral oracle)."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Tuple
+
+from ..frame_info import PlayerInput
+from ..types import NULL_FRAME, Frame, InputStatus
+from . import load
+
+_ERRORS = {
+    -2: "inputs must be added sequentially",
+    -3: "frame outside queue constraints",
+    -4: "must not fetch inputs while a misprediction is pending",
+    -5: "no confirmed input for the requested frame",
+    -6: "input queue overflow",
+}
+
+
+class NativeQueueError(AssertionError):
+    """Mapped from native error codes; AssertionError so callers treating the
+    Python twin's asserts as the contract behave identically."""
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    if getattr(lib, "_iq_bound", False):
+        return lib
+    lib.ggrs_iq_new.restype = ctypes.c_void_p
+    lib.ggrs_iq_new.argtypes = [ctypes.c_int]
+    lib.ggrs_iq_free.argtypes = [ctypes.c_void_p]
+    lib.ggrs_iq_set_frame_delay.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ggrs_iq_first_incorrect_frame.restype = ctypes.c_int32
+    lib.ggrs_iq_first_incorrect_frame.argtypes = [ctypes.c_void_p]
+    lib.ggrs_iq_last_added_frame.restype = ctypes.c_int32
+    lib.ggrs_iq_last_added_frame.argtypes = [ctypes.c_void_p]
+    lib.ggrs_iq_length.restype = ctypes.c_int
+    lib.ggrs_iq_length.argtypes = [ctypes.c_void_p]
+    lib.ggrs_iq_reset_prediction.argtypes = [ctypes.c_void_p]
+    lib.ggrs_iq_confirmed_input.restype = ctypes.c_long
+    lib.ggrs_iq_confirmed_input.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p,
+    ]
+    lib.ggrs_iq_discard_confirmed_frames.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.ggrs_iq_input.restype = ctypes.c_long
+    lib.ggrs_iq_input.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p]
+    lib.ggrs_iq_add_input.restype = ctypes.c_long
+    lib.ggrs_iq_add_input.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p]
+    lib._iq_bound = True
+    return lib
+
+
+class NativeInputQueue:
+    """Drop-in replacement for ggrs_tpu.input_queue.InputQueue backed by the
+    C++ ring."""
+
+    def __init__(self, input_size: int):
+        lib = load()
+        assert lib is not None, "native library not built"
+        self._lib = _bind(lib)
+        self.input_size = input_size
+        self._h = self._lib.ggrs_iq_new(input_size)
+        assert self._h, f"unsupported input size {input_size}"
+        self._buf = ctypes.create_string_buffer(input_size)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.ggrs_iq_free(h)
+            self._h = None
+
+    # -- properties matching the Python twin ---------------------------------
+
+    @property
+    def first_incorrect_frame(self) -> Frame:
+        return self._lib.ggrs_iq_first_incorrect_frame(self._h)
+
+    @property
+    def last_added_frame(self) -> Frame:
+        return self._lib.ggrs_iq_last_added_frame(self._h)
+
+    @property
+    def length(self) -> int:
+        return self._lib.ggrs_iq_length(self._h)
+
+    # -- operations ----------------------------------------------------------
+
+    def set_frame_delay(self, delay: int) -> None:
+        self._lib.ggrs_iq_set_frame_delay(self._h, delay)
+
+    def reset_prediction(self) -> None:
+        self._lib.ggrs_iq_reset_prediction(self._h)
+
+    def confirmed_input(self, requested_frame: Frame) -> PlayerInput:
+        rc = self._lib.ggrs_iq_confirmed_input(self._h, requested_frame, self._buf)
+        if rc < 0:
+            raise NativeQueueError(_ERRORS.get(rc, f"native error {rc}"))
+        return PlayerInput(requested_frame, self._buf.raw[: self.input_size])
+
+    def discard_confirmed_frames(self, frame: Frame) -> None:
+        self._lib.ggrs_iq_discard_confirmed_frames(self._h, frame)
+
+    def input(self, requested_frame: Frame) -> Tuple[bytes, InputStatus]:
+        rc = self._lib.ggrs_iq_input(self._h, requested_frame, self._buf)
+        if rc < 0:
+            raise NativeQueueError(_ERRORS.get(rc, f"native error {rc}"))
+        status = InputStatus.CONFIRMED if rc == 0 else InputStatus.PREDICTED
+        return self._buf.raw[: self.input_size], status
+
+    def add_input(self, inp: PlayerInput) -> Frame:
+        assert len(inp.buf) == self.input_size, (
+            f"input must be exactly {self.input_size} bytes, got {len(inp.buf)}"
+        )
+        rc = self._lib.ggrs_iq_add_input(self._h, inp.frame, inp.buf)
+        if rc < NULL_FRAME:
+            raise NativeQueueError(_ERRORS.get(rc, f"native error {rc}"))
+        return rc

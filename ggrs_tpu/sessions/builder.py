@@ -47,6 +47,7 @@ class SessionBuilder:
         self._local_players = 0
         self.clock = None  # optional injected Clock for deterministic tests
         self.rng = None  # optional injected random.Random for endpoint magics
+        self.use_native_queues = False
 
     # ------------------------------------------------------------------
     # fluent setters (src/sessions/builder.rs:90-244)
@@ -141,6 +142,22 @@ class SessionBuilder:
         self.rng = rng
         return self
 
+    def with_native_input_queues(self, enabled: bool = True) -> "SessionBuilder":
+        """Back per-player input queues with the C++ ring (native/
+        input_queue.cpp) instead of the Python oracle. Requires the native
+        library to be built (make -C native); inputs are capped at 64 bytes
+        per player on this path."""
+        if enabled:
+            from ..native import NATIVE_MAX_INPUT_SIZE
+
+            if self.input_size > NATIVE_MAX_INPUT_SIZE:
+                raise InvalidRequest(
+                    f"Native input queues support at most {NATIVE_MAX_INPUT_SIZE}"
+                    f"-byte inputs (got {self.input_size})."
+                )
+        self.use_native_queues = enabled
+        return self
+
     # ------------------------------------------------------------------
     # session constructors
     # ------------------------------------------------------------------
@@ -155,6 +172,7 @@ class SessionBuilder:
             self.check_distance,
             self.input_delay,
             self.input_size,
+            use_native_queues=self.use_native_queues,
         )
 
     def start_p2p_session(self, socket: Any):
@@ -197,6 +215,7 @@ class SessionBuilder:
             desync_detection=self.desync_detection,
             input_delay=self.input_delay,
             input_size=self.input_size,
+            use_native_queues=self.use_native_queues,
         )
 
     def start_spectator_session(self, host_addr: Any, socket: Any):

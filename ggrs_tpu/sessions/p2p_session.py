@@ -104,6 +104,7 @@ class P2PSession:
         desync_detection: DesyncDetection,
         input_delay: int,
         input_size: int,
+        use_native_queues: bool = False,
     ):
         self.num_players = num_players
         self.max_prediction = max_prediction
@@ -114,7 +115,9 @@ class P2PSession:
         self.desync_detection = desync_detection
 
         self.local_connect_status = [ConnectionStatus() for _ in range(num_players)]
-        self.sync_layer = SyncLayer(num_players, max_prediction, input_size)
+        self.sync_layer = SyncLayer(
+            num_players, max_prediction, input_size, use_native_queues
+        )
         for handle, ptype in players.handles.items():
             if ptype.kind == PlayerTypeKind.LOCAL:
                 self.sync_layer.set_frame_delay(handle, input_delay)

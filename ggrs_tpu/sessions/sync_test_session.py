@@ -25,11 +25,14 @@ class SyncTestSession:
         check_distance: int,
         input_delay: int,
         input_size: int,
+        use_native_queues: bool = False,
     ):
         self.num_players = num_players
         self.max_prediction = max_prediction
         self.check_distance = check_distance
-        self.sync_layer = SyncLayer(num_players, max_prediction, input_size)
+        self.sync_layer = SyncLayer(
+            num_players, max_prediction, input_size, use_native_queues
+        )
         for handle in range(num_players):
             self.sync_layer.set_frame_delay(handle, input_delay)
         self.dummy_connect_status = [ConnectionStatus() for _ in range(num_players)]

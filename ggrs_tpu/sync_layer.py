@@ -111,7 +111,13 @@ class SavedStates:
 class SyncLayer:
     """(src/sync_layer.rs:78-273)"""
 
-    def __init__(self, num_players: int, max_prediction: int, input_size: int):
+    def __init__(
+        self,
+        num_players: int,
+        max_prediction: int,
+        input_size: int,
+        use_native_queues: bool = False,
+    ):
         self.num_players = num_players
         self.max_prediction = max_prediction
         self.input_size = input_size
@@ -119,7 +125,12 @@ class SyncLayer:
         self.last_confirmed_frame: Frame = NULL_FRAME
         self._last_saved_frame: Frame = NULL_FRAME
         self.current_frame: Frame = 0
-        self.input_queues = [InputQueue(input_size) for _ in range(num_players)]
+        if use_native_queues:
+            from .native.input_queue import NativeInputQueue
+
+            self.input_queues = [NativeInputQueue(input_size) for _ in range(num_players)]
+        else:
+            self.input_queues = [InputQueue(input_size) for _ in range(num_players)]
 
     def advance_frame(self) -> None:
         self.current_frame += 1

@@ -162,7 +162,8 @@ void ggrs_weighted_checksum(const uint32_t* words, long n, uint32_t* hi,
   *lo = l;
 }
 
-// ABI version for the ctypes loader to sanity-check.
-long ggrs_native_abi_version() { return 1; }
+// ABI version for the ctypes loader to sanity-check. Bump whenever exported
+// symbols change (v2: added the ggrs_iq_* input-queue family).
+long ggrs_native_abi_version() { return 2; }
 
 }  // extern "C"

@@ -1,0 +1,210 @@
+// Native per-player input queue: 128-slot ring with repeat-last-input
+// prediction and misprediction detection — the C++ twin of
+// ggrs_tpu/input_queue.py (which is the behavioral oracle; semantics follow
+// the reference's src/input_queue.rs). Exposed via a C ABI handle API;
+// ggrs_tpu/native/input_queue.py wraps it with the same Python interface so
+// the sync layer can swap implementations.
+//
+// Error handling: operations that the Python twin treats as assertion
+// failures return negative codes instead of aborting, so the binding can
+// raise.
+
+#include <cstdint>
+#include <cstring>
+#include <new>
+
+namespace {
+
+constexpr int QUEUE_LEN = 128;
+constexpr int NULL_FRAME = -1;
+constexpr int MAX_INPUT_SIZE = 64;
+
+constexpr long ERR_SEQUENCE = -2;   // inputs not added sequentially
+constexpr long ERR_BAD_FRAME = -3;  // frame outside queue constraints
+constexpr long ERR_PREDICTING = -4; // fetch while misprediction pending
+constexpr long ERR_NOT_CONFIRMED = -5;
+constexpr long ERR_OVERFLOW = -6;
+
+struct Slot {
+  int32_t frame;
+  uint8_t buf[MAX_INPUT_SIZE];
+};
+
+struct Queue {
+  int input_size;
+  int head;
+  int tail;
+  int length;
+  bool first_frame;
+  int32_t last_added_frame;
+  int32_t first_incorrect_frame;
+  int32_t last_requested_frame;
+  int frame_delay;
+  Slot inputs[QUEUE_LEN];
+  Slot prediction;
+};
+
+inline bool buf_equal(const Slot& a, const uint8_t* b, int n) {
+  return std::memcmp(a.buf, b, n) == 0;
+}
+
+long add_input_by_frame(Queue* q, const uint8_t* buf, int32_t frame_number) {
+  int prev = (q->head - 1 + QUEUE_LEN) % QUEUE_LEN;
+  if (!(q->last_added_frame == NULL_FRAME ||
+        frame_number == q->last_added_frame + 1))
+    return ERR_SEQUENCE;
+  if (!(frame_number == 0 || q->inputs[prev].frame == frame_number - 1))
+    return ERR_BAD_FRAME;
+
+  q->inputs[q->head].frame = frame_number;
+  std::memcpy(q->inputs[q->head].buf, buf, q->input_size);
+  q->head = (q->head + 1) % QUEUE_LEN;
+  q->length += 1;
+  if (q->length > QUEUE_LEN) return ERR_OVERFLOW;
+  q->first_frame = false;
+  q->last_added_frame = frame_number;
+
+  if (q->prediction.frame != NULL_FRAME) {
+    if (frame_number != q->prediction.frame) return ERR_BAD_FRAME;
+    if (q->first_incorrect_frame == NULL_FRAME &&
+        !buf_equal(q->prediction, buf, q->input_size)) {
+      q->first_incorrect_frame = frame_number;
+    }
+    if (q->prediction.frame == q->last_requested_frame &&
+        q->first_incorrect_frame == NULL_FRAME) {
+      q->prediction.frame = NULL_FRAME;
+    } else {
+      q->prediction.frame += 1;
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ggrs_iq_new(int input_size) {
+  if (input_size < 1 || input_size > MAX_INPUT_SIZE) return nullptr;
+  Queue* q = new (std::nothrow) Queue();
+  if (!q) return nullptr;
+  q->input_size = input_size;
+  q->head = q->tail = q->length = 0;
+  q->first_frame = true;
+  q->last_added_frame = NULL_FRAME;
+  q->first_incorrect_frame = NULL_FRAME;
+  q->last_requested_frame = NULL_FRAME;
+  q->frame_delay = 0;
+  for (auto& s : q->inputs) {
+    s.frame = NULL_FRAME;
+    std::memset(s.buf, 0, MAX_INPUT_SIZE);
+  }
+  q->prediction.frame = NULL_FRAME;
+  std::memset(q->prediction.buf, 0, MAX_INPUT_SIZE);
+  return q;
+}
+
+void ggrs_iq_free(void* h) { delete static_cast<Queue*>(h); }
+
+void ggrs_iq_set_frame_delay(void* h, int delay) {
+  static_cast<Queue*>(h)->frame_delay = delay;
+}
+
+int32_t ggrs_iq_first_incorrect_frame(void* h) {
+  return static_cast<Queue*>(h)->first_incorrect_frame;
+}
+
+int32_t ggrs_iq_last_added_frame(void* h) {
+  return static_cast<Queue*>(h)->last_added_frame;
+}
+
+int ggrs_iq_length(void* h) { return static_cast<Queue*>(h)->length; }
+
+void ggrs_iq_reset_prediction(void* h) {
+  Queue* q = static_cast<Queue*>(h);
+  q->prediction.frame = NULL_FRAME;
+  q->first_incorrect_frame = NULL_FRAME;
+  q->last_requested_frame = NULL_FRAME;
+}
+
+// Fetch confirmed input for a frame into out; 0 on success.
+long ggrs_iq_confirmed_input(void* h, int32_t frame, uint8_t* out) {
+  Queue* q = static_cast<Queue*>(h);
+  int offset = ((frame % QUEUE_LEN) + QUEUE_LEN) % QUEUE_LEN;
+  if (q->inputs[offset].frame != frame) return ERR_NOT_CONFIRMED;
+  std::memcpy(out, q->inputs[offset].buf, q->input_size);
+  return 0;
+}
+
+void ggrs_iq_discard_confirmed_frames(void* h, int32_t frame) {
+  Queue* q = static_cast<Queue*>(h);
+  if (q->last_requested_frame != NULL_FRAME && q->last_requested_frame < frame)
+    frame = q->last_requested_frame;
+  if (frame >= q->last_added_frame) {
+    q->tail = q->head;
+    q->length = 1;
+  } else if (frame <= q->inputs[q->tail].frame) {
+    // nothing to delete
+  } else {
+    int offset = frame - q->inputs[q->tail].frame;
+    q->tail = (q->tail + offset) % QUEUE_LEN;
+    q->length -= offset;
+  }
+}
+
+// Input (confirmed or predicted) for a frame. Writes input_size bytes to
+// out; returns 0 = confirmed, 1 = predicted, negative = error.
+long ggrs_iq_input(void* h, int32_t requested_frame, uint8_t* out) {
+  Queue* q = static_cast<Queue*>(h);
+  if (q->first_incorrect_frame != NULL_FRAME) return ERR_PREDICTING;
+  q->last_requested_frame = requested_frame;
+  if (requested_frame < q->inputs[q->tail].frame) return ERR_BAD_FRAME;
+
+  if (q->prediction.frame < 0) {
+    int offset = requested_frame - q->inputs[q->tail].frame;
+    if (offset < q->length) {
+      int pos = (offset + q->tail) % QUEUE_LEN;
+      if (q->inputs[pos].frame != requested_frame) return ERR_BAD_FRAME;
+      std::memcpy(out, q->inputs[pos].buf, q->input_size);
+      return 0;
+    }
+    if (requested_frame == 0 || q->last_added_frame == NULL_FRAME) {
+      std::memset(q->prediction.buf, 0, q->input_size);
+    } else {
+      int prev = (q->head - 1 + QUEUE_LEN) % QUEUE_LEN;
+      std::memcpy(q->prediction.buf, q->inputs[prev].buf, q->input_size);
+      q->prediction.frame = q->inputs[prev].frame;
+    }
+    q->prediction.frame += 1;
+  }
+  if (q->prediction.frame == NULL_FRAME) return ERR_BAD_FRAME;
+  std::memcpy(out, q->prediction.buf, q->input_size);
+  return 1;
+}
+
+// Add the next sequential input; returns the frame it landed on after frame
+// delay, NULL_FRAME (-1) if dropped, or a negative error < -1.
+long ggrs_iq_add_input(void* h, int32_t frame, const uint8_t* buf) {
+  Queue* q = static_cast<Queue*>(h);
+  if (!(q->last_added_frame == NULL_FRAME ||
+        frame + q->frame_delay == q->last_added_frame + 1))
+    return ERR_SEQUENCE;
+
+  // advance_queue_head (input_queue.rs:207-239)
+  int prev = (q->head - 1 + QUEUE_LEN) % QUEUE_LEN;
+  int32_t expected_frame = q->first_frame ? 0 : q->inputs[prev].frame + 1;
+  int32_t input_frame = frame + q->frame_delay;
+  if (expected_frame > input_frame) return NULL_FRAME;  // delay shrank: drop
+  while (expected_frame < input_frame) {
+    // delay grew: replicate the previous input to fill the gap
+    long rc = add_input_by_frame(q, q->inputs[prev].buf, expected_frame);
+    if (rc < 0) return rc;
+    expected_frame += 1;
+    prev = (q->head - 1 + QUEUE_LEN) % QUEUE_LEN;
+  }
+  long rc = add_input_by_frame(q, buf, input_frame);
+  if (rc < 0) return rc;
+  return input_frame;
+}
+
+}  // extern "C"
