@@ -35,9 +35,10 @@ MAX_PREDICTION = 9  # check_distance must be < max_prediction
 BATCH = 60  # fused ticks per dispatch
 WARMUP_BATCHES = 2
 BENCH_BATCHES = 50
-REQUEST_PATH_TICKS = 200
+REQUEST_PATH_TICKS = 600
 PARITY_TICKS = 50
 BEAM_WIDTH = 16
+DEFERRED_LAG = 60  # request-path checksum verification burst cadence
 NORTH_STAR_FRAMES_PER_SEC = 8000.0  # 8 frames / 1 ms
 
 
@@ -93,22 +94,26 @@ def bench_request_path():
         .with_num_players(PLAYERS)
         .with_max_prediction_window(MAX_PREDICTION)
         .with_check_distance(CHECK_DISTANCE)
+        .with_deferred_checksum_verification(DEFERRED_LAG)
         .start_synctest_session()
     )
-    script = input_script(REQUEST_PATH_TICKS + 30)
+    # cover the first two deferred drain bursts + tunnel dispatch ramp-up
+    warmup = 2 * DEFERRED_LAG + 50
+    script = input_script(REQUEST_PATH_TICKS + warmup)
 
     def tick(f):
         for h in range(PLAYERS):
             sess.add_local_input(h, bytes(script[f, h]))
         backend.handle_requests(sess.advance_frame())
 
-    for f in range(30):
+    for f in range(warmup):
         tick(f)
     backend.block_until_ready()
     t0 = time.perf_counter()
-    for f in range(30, 30 + REQUEST_PATH_TICKS):
+    for f in range(warmup, warmup + REQUEST_PATH_TICKS):
         tick(f)
     backend.block_until_ready()
+    sess.flush_checksum_checks()
     elapsed = time.perf_counter() - t0
     return (REQUEST_PATH_TICKS * CHECK_DISTANCE) / elapsed
 

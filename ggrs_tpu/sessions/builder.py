@@ -48,6 +48,7 @@ class SessionBuilder:
         self.clock = None  # optional injected Clock for deterministic tests
         self.rng = None  # optional injected random.Random for endpoint magics
         self.use_native_queues = False
+        self.deferred_checksum_lag = 0
 
     # ------------------------------------------------------------------
     # fluent setters (src/sessions/builder.rs:90-244)
@@ -142,6 +143,18 @@ class SessionBuilder:
         self.rng = rng
         return self
 
+    def with_deferred_checksum_verification(self, lag: int) -> "SessionBuilder":
+        """SyncTest extension for device backends: compare checksum
+        observations `lag` ticks late, in bursts of one batched
+        device->host transfer — the per-tick comparisons of the eager path
+        would each stall on a transfer (ruinous on a remote/tunneled
+        device). Mismatches still raise, at most `lag` ticks later. 0
+        restores the reference's eager semantics."""
+        if lag < 0:
+            raise InvalidRequest("Deferred checksum lag cannot be negative.")
+        self.deferred_checksum_lag = lag
+        return self
+
     def with_native_input_queues(self, enabled: bool = True) -> "SessionBuilder":
         """Back per-player input queues with the C++ ring (native/
         input_queue.cpp) instead of the Python oracle. Requires the native
@@ -173,6 +186,7 @@ class SessionBuilder:
             self.input_delay,
             self.input_size,
             use_native_queues=self.use_native_queues,
+            deferred_checksum_lag=self.deferred_checksum_lag,
         )
 
     def start_p2p_session(self, socket: Any):

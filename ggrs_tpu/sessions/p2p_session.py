@@ -135,6 +135,7 @@ class P2PSession:
         self.event_queue: Deque[Event] = deque()
         self.local_inputs: Dict[PlayerHandle, PlayerInput] = {}
         self.local_checksum_history: Dict[Frame, int] = {}
+        self._pending_checksum_report = None  # (frame, checksum getter)
 
     # ------------------------------------------------------------------
     # public API
@@ -509,16 +510,41 @@ class P2PSession:
             cell = self.sync_layer.saved_state_by_frame(frame_to_send)
             # the confirmed frame may have rotated out of the snapshot ring
             if cell is not None:
-                checksum = cell.checksum
-                if checksum is not None:
-                    for endpoint in self.player_reg.remotes.values():
-                        endpoint.send_checksum_report(frame_to_send, checksum)
-                    self.local_checksum_history[frame_to_send] = checksum
+                # Capture the observation now (ring slots are reused), but
+                # emit the report only once the checksum is materialized:
+                # on the device backend forcing it immediately would stall
+                # the tick on a device->host transfer. Reports are periodic
+                # and peers compare by frame number, so a few ticks of send
+                # latency is harmless.
+                getter = cell.checksum_getter()
+                prefetch = getattr(getter, "prefetch", None)
+                if callable(prefetch):
+                    prefetch()
+                self._pending_checksum_report = (frame_to_send, getter)
+        self._flush_pending_checksum_report(
+            force=current % interval == interval - 1
+        )
         if len(self.local_checksum_history) > MAX_CHECKSUM_HISTORY_SIZE:
             keep_after = current - MAX_CHECKSUM_HISTORY_SIZE
             self.local_checksum_history = {
                 f: c for f, c in self.local_checksum_history.items() if f > keep_after
             }
+
+    def _flush_pending_checksum_report(self, force: bool) -> None:
+        """Emit the captured checksum report once its value is host-ready;
+        `force` bounds the delay to one desync interval."""
+        pending = self._pending_checksum_report
+        if pending is None:
+            return
+        frame, getter = pending
+        if not force and not getattr(getter, "ready", True):
+            return
+        checksum = getter()
+        if checksum is not None:
+            for endpoint in self.player_reg.remotes.values():
+                endpoint.send_checksum_report(frame, checksum)
+            self.local_checksum_history[frame] = checksum
+        self._pending_checksum_report = None
 
     def _compare_local_checksums_against_peers(self) -> None:
         if self.sync_layer.current_frame % self.desync_detection.interval != 0:

@@ -9,7 +9,8 @@ device twin lives in ggrs_tpu.tpu.backend.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..errors import InvalidRequest, MismatchedChecksum
 from ..frame_info import PlayerInput
@@ -26,6 +27,7 @@ class SyncTestSession:
         input_delay: int,
         input_size: int,
         use_native_queues: bool = False,
+        deferred_checksum_lag: int = 0,
     ):
         self.num_players = num_players
         self.max_prediction = max_prediction
@@ -39,6 +41,14 @@ class SyncTestSession:
         # frame -> first recorded checksum (None allowed: user may omit them)
         self.checksum_history: Dict[Frame, Optional[int]] = {}
         self.local_inputs: Dict[PlayerHandle, PlayerInput] = {}
+        # Deferred verification (an extension over the reference): with
+        # lag > 0, each tick's checksum observations are captured as lazy
+        # getters and compared `lag` ticks later, so a device backend never
+        # stalls the tick on a device->host checksum transfer. Mismatches
+        # still raise MismatchedChecksum, at most `lag` ticks late.
+        self.deferred_checksum_lag = deferred_checksum_lag
+        self._pending_checks: Deque[Tuple[int, Frame, object]] = deque()
+        self._tick = 0
 
     def add_local_input(self, player_handle: PlayerHandle, buf: bytes) -> None:
         """All players are local in a sync test
@@ -55,11 +65,19 @@ class SyncTestSession:
 
         # Once deep enough into the game, compare checksums and force a
         # rollback of check_distance frames.
+        self._tick += 1
         if self.check_distance > 0 and self.sync_layer.current_frame > self.check_distance:
-            for i in range(self.check_distance + 1):
-                frame_to_check = self.sync_layer.current_frame - i
-                if not self._checksums_consistent(frame_to_check):
-                    raise MismatchedChecksum(frame_to_check)
+            if self.deferred_checksum_lag > 0:
+                self._schedule_checks()
+                # Drain in bursts (not every tick): one burst = one batched
+                # device->host transfer covering `lag` ticks of observations.
+                if self._tick % self.deferred_checksum_lag == 0:
+                    self._drain_due_checks()
+            else:
+                for i in range(self.check_distance + 1):
+                    frame_to_check = self.sync_layer.current_frame - i
+                    if not self._checksums_consistent(frame_to_check):
+                        raise MismatchedChecksum(frame_to_check)
 
             frame_to = self.sync_layer.current_frame - self.check_distance
             self._adjust_gamestate(frame_to, requests)
@@ -85,6 +103,51 @@ class SyncTestSession:
             status.last_frame = self.sync_layer.current_frame
 
         return requests
+
+    # ------------------------------------------------------------------
+    # deferred verification path
+    # ------------------------------------------------------------------
+
+    def _schedule_checks(self) -> None:
+        """Capture this tick's checksum observations (the same cells the
+        eager path would compare right now) for later verification."""
+        due = self._tick + self.deferred_checksum_lag
+        for i in range(self.check_distance + 1):
+            frame_to_check = self.sync_layer.current_frame - i
+            cell = self.sync_layer.saved_state_by_frame(frame_to_check)
+            if cell is None:
+                continue
+            # No prefetch here: per-tick async copies serialize with compute
+            # on a tunneled device; the drain burst's single batched
+            # device_get is strictly cheaper.
+            self._pending_checks.append((due, frame_to_check, cell.checksum_getter()))
+
+    def _drain_due_checks(self) -> None:
+        while self._pending_checks and self._pending_checks[0][0] <= self._tick:
+            _, frame, getter = self._pending_checks.popleft()
+            self._verify_observation(frame, getter)
+        # GC: no future observation can reference frames this old
+        oldest_live = self.sync_layer.current_frame - (
+            self.check_distance + self.deferred_checksum_lag + 1
+        )
+        if self.checksum_history and min(self.checksum_history) < oldest_live:
+            self.checksum_history = {
+                f: c for f, c in self.checksum_history.items() if f >= oldest_live
+            }
+
+    def _verify_observation(self, frame: Frame, getter) -> None:
+        checksum = getter()
+        if frame in self.checksum_history:
+            if self.checksum_history[frame] != checksum:
+                raise MismatchedChecksum(frame)
+        else:
+            self.checksum_history[frame] = checksum
+
+    def flush_checksum_checks(self) -> None:
+        """Force every deferred comparison now (end of run / tests)."""
+        while self._pending_checks:
+            _, frame, getter = self._pending_checks.popleft()
+            self._verify_observation(frame, getter)
 
     def _checksums_consistent(self, frame_to_check: Frame) -> bool:
         """(src/sessions/sync_test_session.rs:159-176)"""

@@ -92,6 +92,17 @@ class GameStateCell:
                 self._checksum_fn = None
             return self._state.checksum
 
+    def checksum_getter(self):
+        """Zero-arg callable producing this save's checksum, stable across
+        later overwrites of the cell (ring slots are reused every
+        ring_len frames). Lets callers defer the read — on the device
+        backend forcing `checksum` blocks on a device->host transfer."""
+        with self._lock:
+            if self._checksum_fn is not None:
+                return self._checksum_fn
+            value = self._state.checksum
+            return lambda: value
+
 
 class SavedStates:
     """Ring of snapshot cells; capacity max_prediction + 2 so the next frame

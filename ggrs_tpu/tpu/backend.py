@@ -38,19 +38,123 @@ class SnapshotRef:
     ring_slot: int
 
 
+def _array_is_ready(arr) -> bool:
+    is_ready = getattr(arr, "is_ready", None)
+    return bool(is_ready()) if callable(is_ready) else True
+
+
 class _ChecksumBatch:
     """One tick's worth of device checksums; fetched to host at most once,
-    and only if some cell's checksum is actually read."""
+    and only if some cell's checksum is actually read. Resolution goes
+    through the owning ChecksumLedger so every pending batch rides the same
+    device->host transfer — on a remote/tunneled device one round trip costs
+    ~100ms, so per-read transfers would dominate the whole tick."""
 
-    def __init__(self, his, los):
+    def __init__(self, his, los, ledger: "ChecksumLedger"):
         self._his = his
         self._los = los
         self._np: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._prefetched = False
+        self._ledger = ledger
+        ledger.register(self)
+
+    def prefetch(self) -> None:
+        """Start a background device->host copy (non-blocking)."""
+        if self._np is None and not self._prefetched:
+            self._prefetched = True
+            for arr in (self._his, self._los):
+                copy = getattr(arr, "copy_to_host_async", None)
+                if callable(copy):
+                    copy()
+
+    @property
+    def ready(self) -> bool:
+        """True when resolve() will not block on device work/transfer."""
+        return self._np is not None or (
+            _array_is_ready(self._his) and _array_is_ready(self._los)
+        )
 
     def resolve(self, idx: int) -> int:
+        if self._np is None and self._prefetched and self.ready:
+            # consume the async host copy directly; going through the
+            # ledger's packed transfer would re-fetch what already landed
+            self._store(self._his, self._los)
         if self._np is None:
-            self._np = (np.asarray(self._his), np.asarray(self._los))
+            self._ledger.flush()
+        if self._np is None:  # evicted from the ledger before this read
+            self._store(self._his, self._los)
         return combine_checksum(self._np[0][idx], self._np[1][idx])
+
+    def _store(self, his: np.ndarray, los: np.ndarray) -> None:
+        self._np = (np.asarray(his), np.asarray(los))
+
+
+class ChecksumLedger:
+    """Batches checksum transfers across ticks: the first read of ANY lazy
+    checksum fetches every pending batch in ONE jax.device_get. Bounded so
+    sessions that never read checksums (desync detection off) don't
+    accumulate stale batches; evicted batches resolve individually."""
+
+    MAX_PENDING = 128
+
+    def __init__(self):
+        self._pending: List[_ChecksumBatch] = []
+
+    def register(self, batch: _ChecksumBatch) -> None:
+        self._pending.append(batch)
+        if len(self._pending) > self.MAX_PENDING:
+            del self._pending[: -self.MAX_PENDING]
+
+    def flush(self) -> None:
+        todo = [b for b in self._pending if b._np is None]
+        self._pending.clear()
+        if not todo:
+            return
+        # Pack every pending value into ONE device array before fetching:
+        # on a tunneled device each transferred array pays ~10ms of latency
+        # regardless of size, so fetching 2N small arrays is ~2N round
+        # trips while one packed array is exactly one. The batch list is
+        # padded to a power-of-two so the eager concatenate only ever
+        # compiles for a handful of shapes, not one per drain size.
+        import jax.numpy as jnp
+
+        parts = [jnp.atleast_1d(b._his) for b in todo] + [
+            jnp.atleast_1d(b._los) for b in todo
+        ]
+        bucket = 1
+        while bucket < len(parts):
+            bucket *= 2
+        parts += [parts[0]] * (bucket - len(parts))
+        packed = np.asarray(jnp.concatenate(parts))
+
+        counts = [p.shape[0] for p in parts]
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        n = len(todo)
+        for i, b in enumerate(todo):
+            his = packed[offsets[i] : offsets[i + 1]]
+            los = packed[offsets[n + i] : offsets[n + i + 1]]
+            b._store(his, los)
+
+
+class _LazyChecksum:
+    """Zero-arg callable stored in a GameStateCell; supports non-blocking
+    readiness checks and background prefetch."""
+
+    __slots__ = ("_batch", "_idx")
+
+    def __init__(self, batch: _ChecksumBatch, idx: int):
+        self._batch = batch
+        self._idx = idx
+
+    def __call__(self) -> int:
+        return self._batch.resolve(self._idx)
+
+    def prefetch(self) -> None:
+        self._batch.prefetch()
+
+    @property
+    def ready(self) -> bool:
+        return self._batch.ready
 
 
 class TpuRollbackBackend:
@@ -67,6 +171,7 @@ class TpuRollbackBackend:
         self.num_players = num_players
         self.input_size = game.input_size
         self.current_frame: Frame = 0
+        self.ledger = ChecksumLedger()
 
     # ------------------------------------------------------------------
 
@@ -146,12 +251,10 @@ class TpuRollbackBackend:
             )
         self.current_frame = start_frame + count
 
-        batch = _ChecksumBatch(his, los)
+        batch = _ChecksumBatch(his, los, self.ledger)
         for idx, save in saves:
             ref = SnapshotRef(save.frame, save.frame % core.ring_len)
-            save.cell.save_lazy(
-                save.frame, ref, (lambda b=batch, i=idx: b.resolve(i))
-            )
+            save.cell.save_lazy(save.frame, ref, _LazyChecksum(batch, idx))
 
     # ------------------------------------------------------------------
 

@@ -109,15 +109,17 @@ class ResimCore:
     ) -> Tuple[Any, Any]:
         """Run one fused tick; returns (checksum_hi[W], checksum_lo[W]) as
         device arrays (no host sync)."""
+        # numpy scalars go straight into the jitted call — eager
+        # jnp.asarray would dispatch a convert primitive per argument
         self.ring, self.state, his, los = self._tick_fn(
             self.ring,
             self.state,
-            jnp.asarray(do_load),
-            jnp.asarray(load_slot, dtype=jnp.int32),
-            jnp.asarray(inputs),
-            jnp.asarray(statuses),
-            jnp.asarray(save_slots),
-            jnp.asarray(advance_count, dtype=jnp.int32),
+            np.bool_(do_load),
+            np.int32(load_slot),
+            inputs,
+            statuses,
+            save_slots,
+            np.int32(advance_count),
         )
         return his, los
 

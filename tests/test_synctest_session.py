@@ -103,3 +103,53 @@ def test_check_distance_zero_never_saves():
         requests = sess.advance_frame()
         assert [type(r) for r in requests] == [AdvanceFrame]
         stub.handle_requests(requests)
+
+
+def make_deferred_session(lag, check_distance=2):
+    return (
+        SessionBuilder(input_size=1)
+        .with_num_players(2)
+        .with_check_distance(check_distance)
+        .with_deferred_checksum_verification(lag)
+        .start_synctest_session()
+    )
+
+
+def test_deferred_verification_clean_run():
+    """Deferred mode on a deterministic stub: no mismatch ever raised and
+    the observation journal stays bounded."""
+    sess = make_deferred_session(lag=5)
+    stub = GameStub()
+    for frame in range(60):
+        for h in range(2):
+            sess.add_local_input(h, bytes([frame % 7]))
+        stub.handle_requests(sess.advance_frame())
+    sess.flush_checksum_checks()
+    assert not sess._pending_checks
+    assert stub.advanced > 60  # rollbacks still happened every tick
+
+
+def test_deferred_verification_detects_mismatch_within_lag():
+    """A nondeterministic game must still trip MismatchedChecksum, at most
+    `lag` ticks after the eager path would have."""
+    lag = 4
+    sess = make_deferred_session(lag=lag)
+    stub = RandomChecksumGameStub()
+    with pytest.raises(MismatchedChecksum):
+        for frame in range(60):
+            for h in range(2):
+                sess.add_local_input(h, bytes([0]))
+            stub.handle_requests(sess.advance_frame())
+        sess.flush_checksum_checks()
+
+
+def test_deferred_flush_detects_tail_mismatch():
+    """Mismatches still pending at the end of a run surface on flush."""
+    sess = make_deferred_session(lag=50)  # larger than the whole run
+    stub = RandomChecksumGameStub()
+    for frame in range(20):
+        for h in range(2):
+            sess.add_local_input(h, bytes([0]))
+        stub.handle_requests(sess.advance_frame())
+    with pytest.raises(MismatchedChecksum):
+        sess.flush_checksum_checks()
