@@ -1,0 +1,362 @@
+"""ctypes wrapper for the C++ reliability endpoint (native/endpoint.cpp).
+
+`NativePeerEndpoint` exposes the exact surface sessions consume from the
+Python `PeerEndpoint` (ggrs_tpu/network/protocol.py), so the two are
+interchangeable behind `SessionBuilder.with_native_endpoints()`. The wire
+format is byte-identical, so native and Python endpoints interoperate on
+the same network (tests/test_native_endpoint.py drives mixed pairs).
+
+Clock values are passed into every C call, preserving the injectable-clock
+determinism seam; randomness (magic + nonce seed) comes from the caller's
+rng, so seeded tests stay reproducible.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import random as _random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..errors import InvalidRequest, NotSynchronized
+from ..frame_info import PlayerInput
+from ..network.messages import Message, encode_message
+from ..network.network_stats import NetworkStats
+from ..network.protocol import (
+    EvDisconnected,
+    EvInput,
+    EvNetworkInterrupted,
+    EvNetworkResumed,
+    EvSynchronized,
+    EvSynchronizing,
+    ProtocolState,
+)
+from ..sync_layer import ConnectionStatus
+from ..types import NULL_FRAME, Frame, PlayerHandle
+from ..utils.clock import Clock
+from . import load
+
+_MAX_HANDLES = 16
+_MAX_INPUT = 64
+_SEND_BUF_CAP = 4096
+
+
+class _Config(ctypes.Structure):
+    _fields_ = [
+        ("handles", ctypes.c_int32 * _MAX_HANDLES),
+        ("num_handles", ctypes.c_long),
+        ("num_players", ctypes.c_long),
+        ("local_players", ctypes.c_long),
+        ("max_prediction", ctypes.c_long),
+        ("disconnect_timeout_ms", ctypes.c_long),
+        ("disconnect_notify_start_ms", ctypes.c_long),
+        ("fps", ctypes.c_long),
+        ("input_size", ctypes.c_long),
+        ("magic", ctypes.c_uint16),
+        ("rng_seed", ctypes.c_uint64),
+    ]
+
+
+class _Event(ctypes.Structure):
+    _fields_ = [
+        ("type", ctypes.c_int32),
+        ("a", ctypes.c_int32),
+        ("b", ctypes.c_int32),
+        ("frame", ctypes.c_int32),
+        ("player", ctypes.c_int32),
+        ("input_len", ctypes.c_int32),
+        ("input", ctypes.c_uint8 * _MAX_INPUT),
+    ]
+
+
+class _Stats(ctypes.Structure):
+    _fields_ = [
+        ("send_queue_len", ctypes.c_int32),
+        ("ping_ms", ctypes.c_uint32),
+        ("kbps_sent", ctypes.c_uint32),
+        ("local_frames_behind", ctypes.c_int32),
+        ("remote_frames_behind", ctypes.c_int32),
+    ]
+
+
+_configured = False
+
+
+def _lib():
+    global _configured
+    lib = load()
+    assert lib is not None, "native library not built (make -C native)"
+    if not _configured:
+        lib.ggrs_ep_new.restype = ctypes.c_void_p
+        lib.ggrs_ep_new.argtypes = [ctypes.POINTER(_Config), ctypes.c_uint64]
+        lib.ggrs_ep_free.argtypes = [ctypes.c_void_p]
+        lib.ggrs_ep_state.restype = ctypes.c_long
+        lib.ggrs_ep_state.argtypes = [ctypes.c_void_p]
+        lib.ggrs_ep_synchronize.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ggrs_ep_disconnect.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.ggrs_ep_poll.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_long, ctypes.c_uint64,
+        ]
+        lib.ggrs_ep_send_input.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_long,
+            ctypes.c_uint64,
+        ]
+        lib.ggrs_ep_send_checksum_report.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.ggrs_ep_handle_message.restype = ctypes.c_long
+        lib.ggrs_ep_handle_message.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_uint64,
+        ]
+        lib.ggrs_ep_update_local_frame_advantage.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+        ]
+        lib.ggrs_ep_average_frame_advantage.restype = ctypes.c_long
+        lib.ggrs_ep_average_frame_advantage.argtypes = [ctypes.c_void_p]
+        lib.ggrs_ep_next_send.restype = ctypes.c_long
+        lib.ggrs_ep_next_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+        ]
+        lib.ggrs_ep_next_event.restype = ctypes.c_long
+        lib.ggrs_ep_next_event.argtypes = [ctypes.c_void_p, ctypes.POINTER(_Event)]
+        lib.ggrs_ep_network_stats.restype = ctypes.c_long
+        lib.ggrs_ep_network_stats.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(_Stats),
+        ]
+        lib.ggrs_ep_peer_connect_status.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_long,
+        ]
+        lib.ggrs_ep_checksum_history.restype = ctypes.c_long
+        lib.ggrs_ep_checksum_history.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_char_p,
+            ctypes.c_long,
+        ]
+        _configured = True
+    return lib
+
+
+class NativePeerEndpoint:
+    """Drop-in replacement for PeerEndpoint backed by the C++ state machine."""
+
+    def __init__(
+        self,
+        handles: Sequence[PlayerHandle],
+        peer_addr: Any,
+        num_players: int,
+        local_players: int,
+        max_prediction: int,
+        disconnect_timeout_ms: int,
+        disconnect_notify_start_ms: int,
+        fps: int,
+        input_size: int,
+        clock: Optional[Clock] = None,
+        rng: Optional[_random.Random] = None,
+    ):
+        if len(handles) > _MAX_HANDLES:
+            raise InvalidRequest(
+                f"Native endpoints support at most {_MAX_HANDLES} handles "
+                f"per address (got {len(handles)})."
+            )
+        if input_size > _MAX_INPUT:
+            raise InvalidRequest(
+                f"Native endpoints support at most {_MAX_INPUT}-byte inputs "
+                f"(got {input_size})."
+            )
+        self.clock = clock or Clock()
+        rng = rng or _random.Random()
+        magic = 0
+        while magic == 0:
+            magic = rng.randrange(1, 1 << 16)
+        self.magic = magic
+
+        self.handles = sorted(handles)
+        self.peer_addr = peer_addr
+        self.num_players = num_players
+        self.input_size = input_size
+
+        cfg = _Config()
+        for i, h in enumerate(self.handles):
+            cfg.handles[i] = h
+        cfg.num_handles = len(self.handles)
+        cfg.num_players = num_players
+        cfg.local_players = local_players
+        cfg.max_prediction = max_prediction
+        cfg.disconnect_timeout_ms = disconnect_timeout_ms
+        cfg.disconnect_notify_start_ms = disconnect_notify_start_ms
+        cfg.fps = fps
+        cfg.input_size = input_size
+        cfg.magic = magic
+        cfg.rng_seed = rng.getrandbits(64)
+
+        lib = _lib()
+        self._lib = lib  # before ggrs_ep_new so __del__ is safe on failure
+        self._ep = None
+        self._send_buf = ctypes.create_string_buffer(_SEND_BUF_CAP)
+        ep = lib.ggrs_ep_new(ctypes.byref(cfg), self.clock.now_ms())
+        if not ep:
+            raise InvalidRequest("native endpoint rejected the configuration")
+        self._ep = ep
+
+    def __del__(self):
+        ep = getattr(self, "_ep", None)
+        if ep:
+            self._lib.ggrs_ep_free(ep)
+            self._ep = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    @property
+    def state(self) -> ProtocolState:
+        return ProtocolState(self._lib.ggrs_ep_state(self._ep))
+
+    def synchronize(self) -> None:
+        self._lib.ggrs_ep_synchronize(self._ep, self.clock.now_ms())
+
+    def disconnect(self) -> None:
+        self._lib.ggrs_ep_disconnect(self._ep, self.clock.now_ms())
+
+    def is_synchronized(self) -> bool:
+        return self.state in (
+            ProtocolState.RUNNING,
+            ProtocolState.DISCONNECTED,
+            ProtocolState.SHUTDOWN,
+        )
+
+    def is_running(self) -> bool:
+        return self.state == ProtocolState.RUNNING
+
+    def is_handling_message(self, addr: Any) -> bool:
+        return self.peer_addr == addr
+
+    def average_frame_advantage(self) -> int:
+        return self._lib.ggrs_ep_average_frame_advantage(self._ep)
+
+    # -- data plane -----------------------------------------------------
+
+    @staticmethod
+    def _pack_status(
+        connect_status: Sequence[ConnectionStatus],
+    ) -> Tuple[bytes, Any, int]:
+        n = len(connect_status)
+        disc = bytes(1 if s.disconnected else 0 for s in connect_status)
+        last = (ctypes.c_int32 * n)(*[s.last_frame for s in connect_status])
+        return disc, last, n
+
+    def poll(self, connect_status: Sequence[ConnectionStatus]) -> List[Any]:
+        disc, last, n = self._pack_status(connect_status)
+        self._lib.ggrs_ep_poll(self._ep, disc, last, n, self.clock.now_ms())
+        return self._drain_events()
+
+    def send_input(
+        self,
+        inputs: Dict[PlayerHandle, PlayerInput],
+        connect_status: Sequence[ConnectionStatus],
+    ) -> None:
+        # ascending-handle concatenation (protocol.py _inputs_to_bytes)
+        frame = NULL_FRAME
+        chunks = []
+        for handle in sorted(inputs):
+            pi = inputs[handle]
+            if pi.frame != NULL_FRAME:
+                assert frame in (NULL_FRAME, pi.frame)
+                frame = pi.frame
+            chunks.append(pi.buf)
+        data = b"".join(chunks)
+        disc, last, n = self._pack_status(connect_status)
+        self._lib.ggrs_ep_send_input(
+            self._ep, frame, data, len(data), disc, last, n, self.clock.now_ms()
+        )
+
+    def send_checksum_report(self, frame_to_send: Frame, checksum: int) -> None:
+        self._lib.ggrs_ep_send_checksum_report(
+            self._ep, frame_to_send, checksum.to_bytes(16, "little"),
+            self.clock.now_ms(),
+        )
+
+    def handle_message(self, msg: Message) -> None:
+        self.handle_wire(encode_message(msg))
+
+    def handle_wire(self, wire: bytes) -> None:
+        """Raw-bytes receive fast path: sessions feed datagrams straight to
+        the C++ state machine, skipping the Python codec entirely."""
+        self._lib.ggrs_ep_handle_message(
+            self._ep, wire, len(wire), self.clock.now_ms()
+        )
+
+    def send_all_messages(self, socket: Any) -> None:
+        send_wire = getattr(socket, "send_wire", None)
+        while True:
+            n = self._lib.ggrs_ep_next_send(self._ep, self._send_buf, _SEND_BUF_CAP)
+            assert n >= 0, "native send buffer too small"
+            if n == 0:
+                return
+            wire = self._send_buf.raw[:n]
+            if send_wire is not None:
+                send_wire(wire, self.peer_addr)
+            else:
+                from ..network.messages import decode_message
+
+                socket.send_to(decode_message(wire), self.peer_addr)
+
+    def _drain_events(self) -> List[Any]:
+        events: List[Any] = []
+        ev = _Event()
+        while self._lib.ggrs_ep_next_event(self._ep, ctypes.byref(ev)):
+            t = ev.type
+            if t == 1:
+                events.append(EvSynchronizing(total=ev.a, count=ev.b))
+            elif t == 2:
+                events.append(EvSynchronized())
+            elif t == 3:
+                buf = bytes(ev.input[: ev.input_len])
+                events.append(EvInput(input=PlayerInput(ev.frame, buf), player=ev.player))
+            elif t == 4:
+                events.append(EvDisconnected())
+            elif t == 5:
+                events.append(EvNetworkInterrupted(disconnect_timeout_ms=ev.a))
+            elif t == 6:
+                events.append(EvNetworkResumed())
+        return events
+
+    # -- observability ----------------------------------------------------
+
+    def update_local_frame_advantage(self, local_frame: Frame) -> None:
+        self._lib.ggrs_ep_update_local_frame_advantage(self._ep, local_frame)
+
+    def network_stats(self) -> NetworkStats:
+        out = _Stats()
+        rc = self._lib.ggrs_ep_network_stats(
+            self._ep, self.clock.now_ms(), ctypes.byref(out)
+        )
+        if rc != 0:
+            raise NotSynchronized()
+        return NetworkStats(
+            send_queue_len=out.send_queue_len,
+            ping_ms=out.ping_ms,
+            kbps_sent=out.kbps_sent,
+            local_frames_behind=out.local_frames_behind,
+            remote_frames_behind=out.remote_frames_behind,
+        )
+
+    @property
+    def peer_connect_status(self) -> List[ConnectionStatus]:
+        n = self.num_players
+        disc = ctypes.create_string_buffer(n)
+        last = (ctypes.c_int32 * n)()
+        self._lib.ggrs_ep_peer_connect_status(self._ep, disc, last, n)
+        return [
+            ConnectionStatus(bool(disc.raw[i]), last[i]) for i in range(n)
+        ]
+
+    @property
+    def checksum_history(self) -> Dict[Frame, int]:
+        cap = 64
+        frames = (ctypes.c_int32 * cap)()
+        sums = ctypes.create_string_buffer(cap * 16)
+        count = self._lib.ggrs_ep_checksum_history(self._ep, frames, sums, cap)
+        return {
+            frames[i]: int.from_bytes(sums.raw[i * 16 : (i + 1) * 16], "little")
+            for i in range(count)
+        }

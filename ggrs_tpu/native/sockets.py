@@ -1,0 +1,114 @@
+"""ctypes wrapper for the C++ nonblocking UDP transport
+(native/udp_socket.cpp); drop-in for UdpNonBlockingSocket. Addresses are
+(host, port) tuples like the Python socket; only IPv4 dotted quads and
+"localhost" are resolved (the reference's examples use the same)."""
+
+from __future__ import annotations
+
+import ctypes
+import socket as _socket
+from typing import Any, List, Tuple
+
+from ..network.messages import DecodeError, Message, decode_message, encode_message
+from . import load
+
+RECV_BUFFER_SIZE = 4096
+
+_configured = False
+
+
+def _lib():
+    global _configured
+    lib = load()
+    assert lib is not None, "native library not built (make -C native)"
+    if not _configured:
+        lib.ggrs_udp_bind.restype = ctypes.c_long
+        lib.ggrs_udp_bind.argtypes = [ctypes.c_long]
+        lib.ggrs_udp_local_port.restype = ctypes.c_long
+        lib.ggrs_udp_local_port.argtypes = [ctypes.c_long]
+        lib.ggrs_udp_close.argtypes = [ctypes.c_long]
+        lib.ggrs_udp_send.restype = ctypes.c_long
+        lib.ggrs_udp_send.argtypes = [
+            ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_uint32, ctypes.c_uint16,
+        ]
+        lib.ggrs_udp_recv.restype = ctypes.c_long
+        lib.ggrs_udp_recv.argtypes = [
+            ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint16),
+        ]
+        _configured = True
+    return lib
+
+
+_resolve_cache: dict = {}
+
+
+def _ip_to_int(host: str) -> int:
+    # gethostbyname can block (resolver); never pay it twice for a peer we
+    # talk to every frame
+    ip = _resolve_cache.get(host)
+    if ip is None:
+        ip = int.from_bytes(_socket.inet_aton(_socket.gethostbyname(host)), "big")
+        _resolve_cache[host] = ip
+    return ip
+
+
+def _int_to_ip(ip: int) -> str:
+    return _socket.inet_ntoa(ip.to_bytes(4, "big"))
+
+
+class NativeUdpNonBlockingSocket:
+    """C++-backed UDP socket satisfying the NonBlockingSocket protocol, plus
+    a `send_wire` fast path native endpoints use to skip re-encoding."""
+
+    def __init__(self, port: int):
+        lib = _lib()
+        fd = lib.ggrs_udp_bind(port)
+        if fd < 0:
+            raise OSError(f"could not bind UDP port {port}")
+        self._lib = lib
+        self._fd = fd
+        self._buf = ctypes.create_string_buffer(RECV_BUFFER_SIZE)
+
+    @property
+    def local_port(self) -> int:
+        return self._lib.ggrs_udp_local_port(self._fd)
+
+    def send_wire(self, wire: bytes, addr: Any) -> None:
+        host, port = addr
+        self._lib.ggrs_udp_send(self._fd, wire, len(wire), _ip_to_int(host), port)
+
+    def send_to(self, msg: Message, addr: Any) -> None:
+        self.send_wire(encode_message(msg), addr)
+
+    def receive_all_wire(self) -> List[Tuple[Any, bytes]]:
+        """Raw datagrams; native endpoints consume these without ever
+        touching the Python codec."""
+        received: List[Tuple[Any, bytes]] = []
+        ip = ctypes.c_uint32()
+        port = ctypes.c_uint16()
+        while True:
+            n = self._lib.ggrs_udp_recv(
+                self._fd, self._buf, RECV_BUFFER_SIZE,
+                ctypes.byref(ip), ctypes.byref(port),
+            )
+            if n == -1:  # drained
+                return received
+            if n == -2:  # transient (e.g. ICMP port unreachable), skip
+                continue
+            received.append(((_int_to_ip(ip.value), port.value), self._buf.raw[:n]))
+
+    def receive_all_messages(self) -> List[Tuple[Any, Message]]:
+        received: List[Tuple[Any, Message]] = []
+        for addr, wire in self.receive_all_wire():
+            try:
+                received.append((addr, decode_message(wire)))
+            except DecodeError:
+                continue  # drop garbage, like the reference's bincode filter
+        return received
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            self._lib.ggrs_udp_close(self._fd)
+            self._fd = -1

@@ -45,6 +45,10 @@ class UdpNonBlockingSocket:
     def send_to(self, msg: Message, addr: Any) -> None:
         self.sock.sendto(encode_message(msg), addr)
 
+    def send_wire(self, wire: bytes, addr: Any) -> None:
+        """Pre-encoded fast path used by native endpoints."""
+        self.sock.sendto(wire, addr)
+
     def receive_all_messages(self) -> List[Tuple[Any, Message]]:
         received: List[Tuple[Any, Message]] = []
         while True:
@@ -127,6 +131,10 @@ class InMemorySocket:
     def send_to(self, msg: Message, addr: Any) -> None:
         # serialize through the real wire codec so fault tests cover it
         self.net._deliver(self.addr, addr, encode_message(msg))
+
+    def send_wire(self, wire: bytes, addr: Any) -> None:
+        """Pre-encoded fast path used by native endpoints."""
+        self.net._deliver(self.addr, addr, wire)
 
     def receive_all_messages(self) -> List[Tuple[Any, Message]]:
         return self.net._drain(self.addr)

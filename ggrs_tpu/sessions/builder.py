@@ -48,6 +48,7 @@ class SessionBuilder:
         self.clock = None  # optional injected Clock for deterministic tests
         self.rng = None  # optional injected random.Random for endpoint magics
         self.use_native_queues = False
+        self.use_native_endpoints = False
         self.deferred_checksum_lag = 0
 
     # ------------------------------------------------------------------
@@ -171,6 +172,26 @@ class SessionBuilder:
         self.use_native_queues = enabled
         return self
 
+    def with_native_endpoints(self, enabled: bool = True) -> "SessionBuilder":
+        """Back per-peer reliability endpoints with the C++ state machine
+        (native/endpoint.cpp) instead of the Python implementation. Same
+        wire format, so native and Python peers interoperate. Requires the
+        native library (make -C native); inputs are capped at 64 bytes."""
+        if enabled:
+            from ..native import NATIVE_MAX_INPUT_SIZE, available
+
+            if not available():
+                raise InvalidRequest(
+                    "Native endpoints require the native library (make -C native)."
+                )
+            if self.input_size > NATIVE_MAX_INPUT_SIZE:
+                raise InvalidRequest(
+                    f"Native endpoints support at most {NATIVE_MAX_INPUT_SIZE}"
+                    f"-byte inputs (got {self.input_size})."
+                )
+        self.use_native_endpoints = enabled
+        return self
+
     # ------------------------------------------------------------------
     # session constructors
     # ------------------------------------------------------------------
@@ -232,12 +253,20 @@ class SessionBuilder:
             use_native_queues=self.use_native_queues,
         )
 
+    def _endpoint_cls(self):
+        if self.use_native_endpoints:
+            from ..native.endpoint import NativePeerEndpoint
+
+            return NativePeerEndpoint
+        from ..network.protocol import PeerEndpoint
+
+        return PeerEndpoint
+
     def start_spectator_session(self, host_addr: Any, socket: Any):
         """(src/sessions/builder.rs:310-334)"""
-        from ..network.protocol import PeerEndpoint
         from .spectator_session import SpectatorSession
 
-        host = PeerEndpoint(
+        host = self._endpoint_cls()(
             handles=list(range(self.num_players)),
             peer_addr=host_addr,
             num_players=self.num_players,
@@ -261,9 +290,7 @@ class SessionBuilder:
         )
 
     def _create_endpoint(self, handles, peer_addr, local_players):
-        from ..network.protocol import PeerEndpoint
-
-        endpoint = PeerEndpoint(
+        endpoint = self._endpoint_cls()(
             handles=handles,
             peer_addr=peer_addr,
             num_players=self.num_players,

@@ -136,6 +136,7 @@ class P2PSession:
         self.local_inputs: Dict[PlayerHandle, PlayerInput] = {}
         self.local_checksum_history: Dict[Frame, int] = {}
         self._pending_checksum_report = None  # (frame, checksum getter)
+        self._wire_dispatch = None  # decided on first poll (socket+endpoints)
 
     # ------------------------------------------------------------------
     # public API
@@ -224,13 +225,30 @@ class P2PSession:
 
     def poll_remote_clients(self) -> None:
         """Message pump (src/sessions/p2p_session.rs:375-423)."""
-        for from_addr, msg in self.socket.receive_all_messages():
-            endpoint = self.player_reg.remotes.get(from_addr)
-            if endpoint is not None:
-                endpoint.handle_message(msg)
-            endpoint = self.player_reg.spectators.get(from_addr)
-            if endpoint is not None:
-                endpoint.handle_message(msg)
+        if self._wire_dispatch is None:
+            # all-native fast path: raw datagrams flow socket -> C++ endpoint
+            # without touching the Python codec
+            self._wire_dispatch = hasattr(self.socket, "receive_all_wire") and all(
+                hasattr(ep, "handle_wire")
+                for ep in list(self.player_reg.remotes.values())
+                + list(self.player_reg.spectators.values())
+            )
+        if self._wire_dispatch:
+            for from_addr, wire in self.socket.receive_all_wire():
+                endpoint = self.player_reg.remotes.get(from_addr)
+                if endpoint is not None:
+                    endpoint.handle_wire(wire)
+                endpoint = self.player_reg.spectators.get(from_addr)
+                if endpoint is not None:
+                    endpoint.handle_wire(wire)
+        else:
+            for from_addr, msg in self.socket.receive_all_messages():
+                endpoint = self.player_reg.remotes.get(from_addr)
+                if endpoint is not None:
+                    endpoint.handle_message(msg)
+                endpoint = self.player_reg.spectators.get(from_addr)
+                if endpoint is not None:
+                    endpoint.handle_message(msg)
 
         for endpoint in self.player_reg.remotes.values():
             if endpoint.is_running():

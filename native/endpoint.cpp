@@ -1,0 +1,789 @@
+// Native per-peer reliability endpoint: the C++ twin of
+// ggrs_tpu/network/protocol.py (which mirrors the reference's UdpProtocol,
+// src/network/protocol.rs:127-743). Wire format is byte-identical to
+// ggrs_tpu/network/messages.py; compression reuses the delta+RLE kernels in
+// ggrs_native.cpp. The Python wrapper (ggrs_tpu/native/endpoint.py) supplies
+// wall-clock timestamps on every call, so injectable/fake clocks keep
+// working and the state machine itself stays deterministic.
+//
+// Sessions interact through a small C ABI: queue-drain calls for outgoing
+// wire packets and protocol events, byte-in for incoming packets.
+
+#include <algorithm>
+#include <array>
+#include <cassert>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <set>
+#include <vector>
+
+extern "C" {
+long ggrs_rle_encode(const uint8_t* in, long n, uint8_t* out, long cap);
+long ggrs_rle_decode(const uint8_t* in, long n, uint8_t* out, long cap);
+void ggrs_delta_encode(const uint8_t* ref, long m, const uint8_t* inputs,
+                       long k, uint8_t* out);
+}
+
+namespace {
+
+constexpr int32_t NULL_FRAME = -1;
+constexpr int UDP_HEADER_SIZE = 28;
+constexpr int NUM_SYNC_PACKETS = 5;
+constexpr uint64_t UDP_SHUTDOWN_TIMER_MS = 5000;
+constexpr size_t PENDING_OUTPUT_SIZE = 128;
+constexpr uint64_t SYNC_RETRY_INTERVAL_MS = 200;
+constexpr uint64_t RUNNING_RETRY_INTERVAL_MS = 200;
+constexpr uint64_t KEEP_ALIVE_INTERVAL_MS = 200;
+constexpr uint64_t QUALITY_REPORT_INTERVAL_MS = 200;
+constexpr size_t MAX_PAYLOAD = 467;
+constexpr size_t MAX_CHECKSUM_HISTORY_SIZE = 32;
+constexpr int FRAME_WINDOW_SIZE = 30;
+constexpr int MAX_HANDLES = 16;
+constexpr int MAX_INPUT_SIZE = 64;
+
+// message body type tags (ggrs_tpu/network/messages.py:22-29)
+constexpr uint8_t MSG_SYNC_REQUEST = 0;
+constexpr uint8_t MSG_SYNC_REPLY = 1;
+constexpr uint8_t MSG_INPUT = 2;
+constexpr uint8_t MSG_INPUT_ACK = 3;
+constexpr uint8_t MSG_QUALITY_REPORT = 4;
+constexpr uint8_t MSG_QUALITY_REPLY = 5;
+constexpr uint8_t MSG_CHECKSUM_REPORT = 6;
+constexpr uint8_t MSG_KEEP_ALIVE = 7;
+
+enum class State : int32_t {
+  kInitializing = 0,
+  kSynchronizing = 1,
+  kRunning = 2,
+  kDisconnected = 3,
+  kShutdown = 4,
+};
+
+// event type tags shared with the ctypes wrapper
+constexpr int32_t EV_SYNCHRONIZING = 1;
+constexpr int32_t EV_SYNCHRONIZED = 2;
+constexpr int32_t EV_INPUT = 3;
+constexpr int32_t EV_DISCONNECTED = 4;
+constexpr int32_t EV_INTERRUPTED = 5;
+constexpr int32_t EV_RESUMED = 6;
+
+struct Event {
+  int32_t type = 0;
+  int32_t a = 0;  // Synchronizing: total; Interrupted: remaining timeout ms
+  int32_t b = 0;  // Synchronizing: count
+  int32_t frame = NULL_FRAME;
+  int32_t player = 0;
+  int32_t input_len = 0;
+  uint8_t input[MAX_INPUT_SIZE] = {0};
+};
+
+struct ConnStatus {
+  bool disconnected = false;
+  int32_t last_frame = NULL_FRAME;
+};
+
+// ggrs_tpu/time_sync.py (reference src/time_sync.rs:3-39)
+struct TimeSync {
+  int32_t local[FRAME_WINDOW_SIZE] = {0};
+  int32_t remote[FRAME_WINDOW_SIZE] = {0};
+
+  void advance_frame(int32_t frame, int32_t local_adv, int32_t remote_adv) {
+    int idx = ((frame % FRAME_WINDOW_SIZE) + FRAME_WINDOW_SIZE) % FRAME_WINDOW_SIZE;
+    local[idx] = local_adv;
+    remote[idx] = remote_adv;
+  }
+
+  int32_t average_frame_advantage() const {
+    double local_sum = 0, remote_sum = 0;
+    for (int i = 0; i < FRAME_WINDOW_SIZE; ++i) {
+      local_sum += local[i];
+      remote_sum += remote[i];
+    }
+    double local_avg = local_sum / FRAME_WINDOW_SIZE;
+    double remote_avg = remote_sum / FRAME_WINDOW_SIZE;
+    // truncation toward zero matches the reference's `as i32` cast
+    return static_cast<int32_t>((remote_avg - local_avg) / 2.0);
+  }
+};
+
+// little-endian scalar writers/readers
+inline void put_u16(std::vector<uint8_t>& o, uint16_t v) {
+  o.push_back(v & 0xFF);
+  o.push_back(v >> 8);
+}
+inline void put_u32(std::vector<uint8_t>& o, uint32_t v) {
+  for (int i = 0; i < 4; ++i) o.push_back((v >> (8 * i)) & 0xFF);
+}
+inline void put_u64(std::vector<uint8_t>& o, uint64_t v) {
+  for (int i = 0; i < 8; ++i) o.push_back((v >> (8 * i)) & 0xFF);
+}
+inline void put_i32(std::vector<uint8_t>& o, int32_t v) {
+  put_u32(o, static_cast<uint32_t>(v));
+}
+
+struct Reader {
+  const uint8_t* p;
+  long n;
+  long off = 0;
+  bool ok = true;
+
+  uint8_t u8() {
+    if (off + 1 > n) { ok = false; return 0; }
+    return p[off++];
+  }
+  uint16_t u16() {
+    if (off + 2 > n) { ok = false; return 0; }
+    uint16_t v = p[off] | (p[off + 1] << 8);
+    off += 2;
+    return v;
+  }
+  uint32_t u32() {
+    if (off + 4 > n) { ok = false; return 0; }
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) v |= static_cast<uint32_t>(p[off + i]) << (8 * i);
+    off += 4;
+    return v;
+  }
+  uint64_t u64() {
+    if (off + 8 > n) { ok = false; return 0; }
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v |= static_cast<uint64_t>(p[off + i]) << (8 * i);
+    off += 8;
+    return v;
+  }
+  int32_t i32() { return static_cast<int32_t>(u32()); }
+};
+
+// xorshift64* nonce generator (seeded by the caller for determinism)
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ? seed : 0x9E3779B97F4A7C15ull) {}
+  uint64_t next() {
+    s ^= s >> 12;
+    s ^= s << 25;
+    s ^= s >> 27;
+    return s * 0x2545F4914F6CDD1Dull;
+  }
+};
+
+struct Endpoint {
+  // config
+  int32_t handles[MAX_HANDLES];
+  long num_handles;
+  long num_players;
+  long local_players;
+  long max_prediction;
+  uint64_t disconnect_timeout_ms;
+  uint64_t disconnect_notify_start_ms;
+  long fps;
+  long input_size;
+  uint16_t magic;
+  Rng rng;
+
+  // state (field-for-field with PeerEndpoint.__init__)
+  State state = State::kInitializing;
+  int sync_remaining_roundtrips = NUM_SYNC_PACKETS;
+  std::set<uint32_t> sync_random_requests;
+  uint64_t running_last_quality_report;
+  uint64_t running_last_input_recv;
+  bool disconnect_notify_sent = false;
+  bool disconnect_event_sent = false;
+  uint64_t shutdown_timeout;
+  uint16_t remote_magic = 0;
+  std::vector<ConnStatus> peer_connect_status;
+
+  std::deque<std::pair<int32_t, std::vector<uint8_t>>> pending_output;
+  int32_t last_acked_frame = NULL_FRAME;
+  std::vector<uint8_t> last_acked_bytes;
+  std::map<int32_t, std::vector<uint8_t>> recv_inputs;
+
+  TimeSync time_sync;
+  int32_t local_frame_advantage = 0;
+  int32_t remote_frame_advantage = 0;
+
+  uint64_t stats_start_time = 0;
+  uint64_t packets_sent = 0;
+  uint64_t bytes_sent = 0;
+  uint64_t round_trip_time = 0;
+  uint64_t last_send_time;
+  uint64_t last_recv_time;
+
+  std::map<int32_t, std::array<uint8_t, 16>> checksum_history;
+  int32_t last_added_checksum_frame = NULL_FRAME;
+
+  std::deque<std::vector<uint8_t>> send_queue;
+  std::deque<Event> event_queue;
+
+  Endpoint(const int32_t* h, long nh, long np, long lp, long maxp,
+           uint64_t dt, uint64_t dn, long fps_, long isz, uint16_t m,
+           uint64_t seed, uint64_t now)
+      : num_handles(nh),
+        num_players(np),
+        local_players(lp),
+        max_prediction(maxp),
+        disconnect_timeout_ms(dt),
+        disconnect_notify_start_ms(dn),
+        fps(fps_),
+        input_size(isz),
+        magic(m),
+        rng(seed),
+        running_last_quality_report(now),
+        running_last_input_recv(now),
+        shutdown_timeout(now),
+        last_send_time(now),
+        last_recv_time(now) {
+    std::copy(h, h + nh, handles);
+    std::sort(handles, handles + nh);
+    peer_connect_status.resize(np);
+    last_acked_bytes.assign(isz * lp, 0);
+    recv_inputs[NULL_FRAME] = std::vector<uint8_t>(isz * nh, 0);
+  }
+
+  int32_t last_recv_frame() const { return recv_inputs.rbegin()->first; }
+
+  // ---- sending ------------------------------------------------------
+
+  void queue_wire(std::vector<uint8_t> wire, uint64_t now) {
+    packets_sent += 1;
+    last_send_time = now;
+    bytes_sent += wire.size();
+    send_queue.push_back(std::move(wire));
+  }
+
+  std::vector<uint8_t> header(uint8_t body_type) const {
+    std::vector<uint8_t> o;
+    o.reserve(32);
+    put_u16(o, magic);
+    o.push_back(body_type);
+    return o;
+  }
+
+  void send_sync_request(uint64_t now) {
+    uint32_t nonce = static_cast<uint32_t>(rng.next());
+    sync_random_requests.insert(nonce);
+    auto o = header(MSG_SYNC_REQUEST);
+    put_u32(o, nonce);
+    queue_wire(std::move(o), now);
+  }
+
+  void send_quality_report(uint64_t now) {
+    running_last_quality_report = now;
+    int32_t adv = std::max<int32_t>(-128, std::min<int32_t>(127, local_frame_advantage));
+    auto o = header(MSG_QUALITY_REPORT);
+    o.push_back(static_cast<uint8_t>(static_cast<int8_t>(adv)));
+    put_u64(o, now);
+    queue_wire(std::move(o), now);
+  }
+
+  void send_keep_alive(uint64_t now) {
+    queue_wire(header(MSG_KEEP_ALIVE), now);
+  }
+
+  void send_input_ack(uint64_t now) {
+    auto o = header(MSG_INPUT_ACK);
+    put_i32(o, last_recv_frame());
+    queue_wire(std::move(o), now);
+  }
+
+  void send_checksum_report(int32_t frame, const uint8_t csum[16], uint64_t now) {
+    auto o = header(MSG_CHECKSUM_REPORT);
+    put_i32(o, frame);
+    o.insert(o.end(), csum, csum + 16);
+    queue_wire(std::move(o), now);
+  }
+
+  void send_pending_output(const ConnStatus* status, long n_status, uint64_t now) {
+    // (protocol.py _send_pending_output; reference protocol.rs:468-493)
+    if (pending_output.empty()) return;
+    int32_t first_frame = pending_output.front().first;
+    assert(last_acked_frame == NULL_FRAME || last_acked_frame + 1 == first_frame);
+
+    size_t count = pending_output.size();
+    std::vector<uint8_t> payload = encode_window(count);
+    while (payload.size() > MAX_PAYLOAD && count > 1) {
+      count = std::max<size_t>(1, count / 2);
+      payload = encode_window(count);
+    }
+
+    auto o = header(MSG_INPUT);
+    put_i32(o, first_frame);
+    put_i32(o, last_recv_frame());
+    o.push_back(state == State::kDisconnected ? 1 : 0);
+    o.push_back(static_cast<uint8_t>(n_status));
+    for (long i = 0; i < n_status; ++i) {
+      o.push_back(status[i].disconnected ? 1 : 0);
+      put_i32(o, status[i].last_frame);
+    }
+    assert(payload.size() <= 0xFFFF);
+    put_u16(o, static_cast<uint16_t>(payload.size()));
+    o.insert(o.end(), payload.begin(), payload.end());
+    queue_wire(std::move(o), now);
+  }
+
+  std::vector<uint8_t> encode_window(size_t count) {
+    // delta vs last acked input, then RLE (compression.py encode)
+    const long m = static_cast<long>(last_acked_bytes.size());
+    std::vector<uint8_t> blob(m * count);
+    size_t i = 0;
+    for (auto it = pending_output.begin(); i < count; ++it, ++i) {
+      assert(static_cast<long>(it->second.size()) == m);
+      std::memcpy(blob.data() + i * m, it->second.data(), m);
+    }
+    std::vector<uint8_t> delta(std::max<size_t>(1, blob.size()));
+    ggrs_delta_encode(last_acked_bytes.data(), m, blob.data(),
+                      static_cast<long>(count), delta.data());
+    std::vector<uint8_t> out(blob.size() + 32);
+    long len = ggrs_rle_encode(delta.data(), static_cast<long>(blob.size()),
+                               out.data(), static_cast<long>(out.size()));
+    assert(len >= 0);
+    out.resize(len);
+    return out;
+  }
+
+  void send_input(int32_t frame, const uint8_t* data, long len,
+                  const ConnStatus* status, long n_status, uint64_t now) {
+    // (protocol.py send_input; reference protocol.rs:439-466)
+    if (state != State::kRunning) return;
+    time_sync.advance_frame(frame, local_frame_advantage, remote_frame_advantage);
+    pending_output.emplace_back(frame, std::vector<uint8_t>(data, data + len));
+    if (pending_output.size() > PENDING_OUTPUT_SIZE) {
+      Event ev;
+      ev.type = EV_DISCONNECTED;
+      event_queue.push_back(ev);
+    }
+    send_pending_output(status, n_status, now);
+  }
+
+  // ---- timers -------------------------------------------------------
+
+  void poll(const ConnStatus* status, long n_status, uint64_t now) {
+    // (protocol.py poll; reference protocol.rs:351-404)
+    if (state == State::kSynchronizing) {
+      if (last_send_time + SYNC_RETRY_INTERVAL_MS < now) send_sync_request(now);
+    } else if (state == State::kRunning) {
+      if (running_last_input_recv + RUNNING_RETRY_INTERVAL_MS < now) {
+        send_pending_output(status, n_status, now);
+        running_last_input_recv = now;
+      }
+      if (running_last_quality_report + QUALITY_REPORT_INTERVAL_MS < now) {
+        send_quality_report(now);
+      }
+      if (last_send_time + KEEP_ALIVE_INTERVAL_MS < now) send_keep_alive(now);
+      if (!disconnect_notify_sent &&
+          last_recv_time + disconnect_notify_start_ms < now) {
+        Event ev;
+        ev.type = EV_INTERRUPTED;
+        ev.a = static_cast<int32_t>(disconnect_timeout_ms - disconnect_notify_start_ms);
+        event_queue.push_back(ev);
+        disconnect_notify_sent = true;
+      }
+      if (!disconnect_event_sent && last_recv_time + disconnect_timeout_ms < now) {
+        Event ev;
+        ev.type = EV_DISCONNECTED;
+        event_queue.push_back(ev);
+        disconnect_event_sent = true;
+      }
+    } else if (state == State::kDisconnected) {
+      if (shutdown_timeout < now) state = State::kShutdown;
+    }
+  }
+
+  // ---- receiving ----------------------------------------------------
+
+  long handle_message(const uint8_t* buf, long n, uint64_t now) {
+    // (protocol.py handle_message; reference protocol.rs:544-575)
+    if (state == State::kShutdown) return 0;
+    Reader r{buf, n};
+    uint16_t msg_magic = r.u16();
+    uint8_t body_type = r.u8();
+    if (!r.ok) return -1;
+    if (remote_magic != 0 && msg_magic != remote_magic) return 0;
+    last_recv_time = now;
+    if (disconnect_notify_sent && state == State::kRunning) {
+      disconnect_notify_sent = false;
+      Event ev;
+      ev.type = EV_RESUMED;
+      event_queue.push_back(ev);
+    }
+
+    switch (body_type) {
+      case MSG_SYNC_REQUEST: {
+        uint32_t nonce = r.u32();
+        if (!r.ok) return -1;
+        auto o = header(MSG_SYNC_REPLY);
+        put_u32(o, nonce);
+        queue_wire(std::move(o), now);
+        return 0;
+      }
+      case MSG_SYNC_REPLY:
+        return on_sync_reply(msg_magic, r, now);
+      case MSG_INPUT:
+        return on_input(r, now);
+      case MSG_INPUT_ACK: {
+        int32_t ack = r.i32();
+        if (!r.ok) return -1;
+        pop_pending_output(ack);
+        return 0;
+      }
+      case MSG_QUALITY_REPORT: {
+        int8_t adv = static_cast<int8_t>(r.u8());
+        uint64_t ping = r.u64();
+        if (!r.ok) return -1;
+        remote_frame_advantage = adv;
+        auto o = header(MSG_QUALITY_REPLY);
+        put_u64(o, ping);
+        queue_wire(std::move(o), now);
+        return 0;
+      }
+      case MSG_QUALITY_REPLY: {
+        uint64_t pong = r.u64();
+        if (!r.ok) return -1;
+        // network-controlled value: a pong from the future (clock skew or a
+        // crafted packet) must not wrap the RTT or crash the process
+        round_trip_time = now >= pong ? now - pong : 0;
+        return 0;
+      }
+      case MSG_CHECKSUM_REPORT: {
+        int32_t frame = r.i32();
+        std::array<uint8_t, 16> csum;
+        for (int i = 0; i < 16; ++i) csum[i] = r.u8();
+        if (!r.ok) return -1;
+        on_checksum_report(frame, csum);
+        return 0;
+      }
+      case MSG_KEEP_ALIVE:
+        return 0;  // nothing beyond the recv-time update
+      default:
+        return -1;
+    }
+  }
+
+  long on_sync_reply(uint16_t msg_magic, Reader& r, uint64_t now) {
+    uint32_t nonce = r.u32();
+    if (!r.ok) return -1;
+    if (state != State::kSynchronizing) return 0;
+    if (!sync_random_requests.count(nonce)) return 0;
+    sync_random_requests.erase(nonce);
+    sync_remaining_roundtrips -= 1;
+    if (sync_remaining_roundtrips > 0) {
+      Event ev;
+      ev.type = EV_SYNCHRONIZING;
+      ev.a = NUM_SYNC_PACKETS;
+      ev.b = NUM_SYNC_PACKETS - sync_remaining_roundtrips;
+      event_queue.push_back(ev);
+      send_sync_request(now);
+    } else {
+      state = State::kRunning;
+      Event ev;
+      ev.type = EV_SYNCHRONIZED;
+      event_queue.push_back(ev);
+      remote_magic = msg_magic;  // peer is now authorized
+    }
+    return 0;
+  }
+
+  long on_input(Reader& r, uint64_t now) {
+    // (protocol.py _on_input; reference protocol.rs:616-689)
+    int32_t start_frame = r.i32();
+    int32_t ack_frame = r.i32();
+    uint8_t flags = r.u8();
+    uint8_t n_status = r.u8();
+    if (!r.ok) return -1;
+    std::vector<ConnStatus> statuses(n_status);
+    for (int i = 0; i < n_status; ++i) {
+      statuses[i].disconnected = r.u8() != 0;
+      statuses[i].last_frame = r.i32();
+    }
+    uint16_t blen = r.u16();
+    if (!r.ok || r.off + blen > r.n) return -1;
+    const uint8_t* payload = r.p + r.off;
+
+    pop_pending_output(ack_frame);
+
+    if (flags & 1) {  // disconnect_requested
+      if (state != State::kDisconnected && !disconnect_event_sent) {
+        Event ev;
+        ev.type = EV_DISCONNECTED;
+        event_queue.push_back(ev);
+        disconnect_event_sent = true;
+      }
+    } else {
+      for (size_t i = 0; i < statuses.size() && i < peer_connect_status.size(); ++i) {
+        auto& mine = peer_connect_status[i];
+        mine.disconnected = statuses[i].disconnected || mine.disconnected;
+        mine.last_frame = std::max(mine.last_frame, statuses[i].last_frame);
+      }
+    }
+
+    int32_t last_recv = last_recv_frame();
+    // a start_frame beyond last_recv+1 means the peer encoded against an
+    // input we never received — unrecoverable for this packet, but it must
+    // not abort the process (the value is network-controlled)
+    if (last_recv != NULL_FRAME && start_frame > last_recv + 1) return -1;
+
+    int32_t decode_frame = last_recv == NULL_FRAME ? NULL_FRAME : start_frame - 1;
+    auto ref_it = recv_inputs.find(decode_frame);
+    if (ref_it == recv_inputs.end()) return 0;
+    running_last_input_recv = now;
+
+    const std::vector<uint8_t>& ref = ref_it->second;
+    const long m = static_cast<long>(ref.size());
+    std::vector<uint8_t> decoded(std::max<long>(m, 1) * 256);
+    long dlen = ggrs_rle_decode(payload, blen, decoded.data(),
+                                static_cast<long>(decoded.size()));
+    if (dlen < 0 || m == 0 || dlen % m != 0) return -1;
+    long k = dlen / m;
+    std::vector<uint8_t> plain(std::max<long>(dlen, 1));
+    ggrs_delta_encode(ref.data(), m, decoded.data(), k, plain.data());
+
+    const long per_player = input_size;
+    for (long i = 0; i < k; ++i) {
+      int32_t inp_frame = start_frame + static_cast<int32_t>(i);
+      if (inp_frame <= last_recv_frame()) continue;  // already have it
+      const uint8_t* frame_bytes = plain.data() + i * m;
+      recv_inputs[inp_frame].assign(frame_bytes, frame_bytes + m);
+      assert(m == per_player * num_handles);
+      for (long j = 0; j < num_handles; ++j) {
+        Event ev;
+        ev.type = EV_INPUT;
+        ev.frame = inp_frame;
+        ev.player = handles[j];
+        ev.input_len = static_cast<int32_t>(per_player);
+        std::memcpy(ev.input, frame_bytes + j * per_player, per_player);
+        event_queue.push_back(ev);
+      }
+    }
+
+    send_input_ack(now);
+
+    // GC received inputs beyond 2x the prediction window
+    int32_t horizon = last_recv_frame() - 2 * static_cast<int32_t>(max_prediction);
+    for (auto it = recv_inputs.begin(); it != recv_inputs.end();) {
+      if (it->first < horizon && it->first != NULL_FRAME) {
+        it = recv_inputs.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    return 0;
+  }
+
+  void pop_pending_output(int32_t ack_frame) {
+    while (!pending_output.empty() && pending_output.front().first <= ack_frame) {
+      last_acked_frame = pending_output.front().first;
+      last_acked_bytes = std::move(pending_output.front().second);
+      pending_output.pop_front();
+    }
+  }
+
+  void on_checksum_report(int32_t frame, const std::array<uint8_t, 16>& csum) {
+    // (protocol.py _on_checksum_report; reference protocol.rs:711-722)
+    if (last_added_checksum_frame < frame) {
+      if (checksum_history.size() > MAX_CHECKSUM_HISTORY_SIZE) {
+        int32_t keep_after = last_added_checksum_frame -
+                             static_cast<int32_t>(MAX_CHECKSUM_HISTORY_SIZE);
+        for (auto it = checksum_history.begin(); it != checksum_history.end();) {
+          if (it->first <= keep_after) {
+            it = checksum_history.erase(it);
+          } else {
+            ++it;
+          }
+        }
+      }
+      last_added_checksum_frame = frame;
+      checksum_history[frame] = csum;
+    }
+  }
+
+  // ---- stats --------------------------------------------------------
+
+  void update_local_frame_advantage(int32_t local_frame) {
+    // (protocol.py; reference protocol.rs:268-277)
+    if (local_frame == NULL_FRAME || last_recv_frame() == NULL_FRAME) return;
+    uint64_t ping = round_trip_time / 2;
+    int32_t remote_frame =
+        last_recv_frame() + static_cast<int32_t>((ping * fps) / 1000);
+    local_frame_advantage = remote_frame - local_frame;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+struct ggrs_ep_config {
+  int32_t handles[MAX_HANDLES];
+  long num_handles;
+  long num_players;
+  long local_players;
+  long max_prediction;
+  long disconnect_timeout_ms;
+  long disconnect_notify_start_ms;
+  long fps;
+  long input_size;
+  uint16_t magic;
+  uint64_t rng_seed;
+};
+
+struct ggrs_ep_event {
+  int32_t type;
+  int32_t a;
+  int32_t b;
+  int32_t frame;
+  int32_t player;
+  int32_t input_len;
+  uint8_t input[MAX_INPUT_SIZE];
+};
+
+struct ggrs_ep_stats {
+  int32_t send_queue_len;
+  uint32_t ping_ms;
+  uint32_t kbps_sent;
+  int32_t local_frames_behind;
+  int32_t remote_frames_behind;
+};
+
+void* ggrs_ep_new(const ggrs_ep_config* cfg, uint64_t now_ms) {
+  if (cfg->num_handles < 1 || cfg->num_handles > MAX_HANDLES) return nullptr;
+  if (cfg->input_size < 1 || cfg->input_size > MAX_INPUT_SIZE) return nullptr;
+  return new Endpoint(cfg->handles, cfg->num_handles, cfg->num_players,
+                      cfg->local_players, cfg->max_prediction,
+                      cfg->disconnect_timeout_ms, cfg->disconnect_notify_start_ms,
+                      cfg->fps, cfg->input_size, cfg->magic, cfg->rng_seed,
+                      now_ms);
+}
+
+void ggrs_ep_free(void* ep) { delete static_cast<Endpoint*>(ep); }
+
+long ggrs_ep_state(void* ep) {
+  return static_cast<long>(static_cast<Endpoint*>(ep)->state);
+}
+
+void ggrs_ep_synchronize(void* ep, uint64_t now_ms) {
+  auto* e = static_cast<Endpoint*>(ep);
+  assert(e->state == State::kInitializing);
+  e->state = State::kSynchronizing;
+  e->sync_remaining_roundtrips = NUM_SYNC_PACKETS;
+  e->stats_start_time = now_ms;
+  e->send_sync_request(now_ms);
+}
+
+void ggrs_ep_disconnect(void* ep, uint64_t now_ms) {
+  auto* e = static_cast<Endpoint*>(ep);
+  if (e->state == State::kShutdown) return;
+  e->state = State::kDisconnected;
+  e->shutdown_timeout = now_ms + UDP_SHUTDOWN_TIMER_MS;
+}
+
+void ggrs_ep_poll(void* ep, const uint8_t* disc, const int32_t* last, long n,
+                  uint64_t now_ms) {
+  std::vector<ConnStatus> status(n);
+  for (long i = 0; i < n; ++i) {
+    status[i].disconnected = disc[i] != 0;
+    status[i].last_frame = last[i];
+  }
+  static_cast<Endpoint*>(ep)->poll(status.data(), n, now_ms);
+}
+
+void ggrs_ep_send_input(void* ep, int32_t frame, const uint8_t* data, long len,
+                        const uint8_t* disc, const int32_t* last, long n,
+                        uint64_t now_ms) {
+  std::vector<ConnStatus> status(n);
+  for (long i = 0; i < n; ++i) {
+    status[i].disconnected = disc[i] != 0;
+    status[i].last_frame = last[i];
+  }
+  static_cast<Endpoint*>(ep)->send_input(frame, data, len, status.data(), n,
+                                         now_ms);
+}
+
+void ggrs_ep_send_checksum_report(void* ep, int32_t frame,
+                                  const uint8_t* csum16, uint64_t now_ms) {
+  static_cast<Endpoint*>(ep)->send_checksum_report(frame, csum16, now_ms);
+}
+
+long ggrs_ep_handle_message(void* ep, const uint8_t* buf, long len,
+                            uint64_t now_ms) {
+  return static_cast<Endpoint*>(ep)->handle_message(buf, len, now_ms);
+}
+
+void ggrs_ep_update_local_frame_advantage(void* ep, int32_t local_frame) {
+  static_cast<Endpoint*>(ep)->update_local_frame_advantage(local_frame);
+}
+
+long ggrs_ep_average_frame_advantage(void* ep) {
+  return static_cast<Endpoint*>(ep)->time_sync.average_frame_advantage();
+}
+
+// Drain one outgoing wire packet; returns its length, 0 when the queue is
+// empty, or -1 if `cap` is too small. A SHUTDOWN endpoint drops its queue.
+long ggrs_ep_next_send(void* ep, uint8_t* out, long cap) {
+  auto* e = static_cast<Endpoint*>(ep);
+  if (e->state == State::kShutdown) {
+    e->send_queue.clear();
+    return 0;
+  }
+  if (e->send_queue.empty()) return 0;
+  const auto& wire = e->send_queue.front();
+  if (static_cast<long>(wire.size()) > cap) return -1;
+  std::memcpy(out, wire.data(), wire.size());
+  long n = static_cast<long>(wire.size());
+  e->send_queue.pop_front();
+  return n;
+}
+
+long ggrs_ep_next_event(void* ep, ggrs_ep_event* out) {
+  auto* e = static_cast<Endpoint*>(ep);
+  if (e->event_queue.empty()) return 0;
+  const Event& ev = e->event_queue.front();
+  out->type = ev.type;
+  out->a = ev.a;
+  out->b = ev.b;
+  out->frame = ev.frame;
+  out->player = ev.player;
+  out->input_len = ev.input_len;
+  std::memcpy(out->input, ev.input, MAX_INPUT_SIZE);
+  e->event_queue.pop_front();
+  return 1;
+}
+
+long ggrs_ep_network_stats(void* ep, uint64_t now_ms, ggrs_ep_stats* out) {
+  auto* e = static_cast<Endpoint*>(ep);
+  if (e->state != State::kSynchronizing && e->state != State::kRunning) return -1;
+  uint64_t seconds = (now_ms - e->stats_start_time) / 1000;
+  if (seconds == 0) return -1;
+  uint64_t total_bytes = e->bytes_sent + e->packets_sent * UDP_HEADER_SIZE;
+  out->send_queue_len = static_cast<int32_t>(e->pending_output.size());
+  out->ping_ms = static_cast<uint32_t>(e->round_trip_time);
+  out->kbps_sent = static_cast<uint32_t>((total_bytes / seconds) / 1024);
+  out->local_frames_behind = e->local_frame_advantage;
+  out->remote_frames_behind = e->remote_frame_advantage;
+  return 0;
+}
+
+void ggrs_ep_peer_connect_status(void* ep, uint8_t* disc, int32_t* last, long n) {
+  auto* e = static_cast<Endpoint*>(ep);
+  for (long i = 0; i < n && i < static_cast<long>(e->peer_connect_status.size());
+       ++i) {
+    disc[i] = e->peer_connect_status[i].disconnected ? 1 : 0;
+    last[i] = e->peer_connect_status[i].last_frame;
+  }
+}
+
+// Copy up to `cap` (frame, u128 checksum) entries; returns the count.
+long ggrs_ep_checksum_history(void* ep, int32_t* frames, uint8_t* sums16,
+                              long cap) {
+  auto* e = static_cast<Endpoint*>(ep);
+  long i = 0;
+  for (const auto& [frame, csum] : e->checksum_history) {
+    if (i >= cap) break;
+    frames[i] = frame;
+    std::memcpy(sums16 + i * 16, csum.data(), 16);
+    ++i;
+  }
+  return i;
+}
+
+}  // extern "C"

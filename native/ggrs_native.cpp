@@ -163,7 +163,8 @@ void ggrs_weighted_checksum(const uint32_t* words, long n, uint32_t* hi,
 }
 
 // ABI version for the ctypes loader to sanity-check. Bump whenever exported
-// symbols change (v2: added the ggrs_iq_* input-queue family).
-long ggrs_native_abi_version() { return 2; }
+// symbols change (v2: added the ggrs_iq_* input-queue family; v3: the
+// ggrs_ep_* reliability endpoint and ggrs_udp_* socket families).
+long ggrs_native_abi_version() { return 3; }
 
 }  // extern "C"

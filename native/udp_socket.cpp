@@ -1,0 +1,80 @@
+// Nonblocking UDP transport: the C++ twin of UdpNonBlockingSocket
+// (ggrs_tpu/network/sockets.py; reference src/network/udp_socket.rs:17-55).
+// Plain POSIX sockets behind a C ABI; the Python wrapper drains datagrams
+// in a loop until EWOULDBLOCK, mirroring the reference's recv loop.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Bind 0.0.0.0:port nonblocking; returns the fd or -1.
+long ggrs_udp_bind(long port) {
+  int fd = ::socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) return -1;
+  int flags = ::fcntl(fd, F_GETFL, 0);
+  if (flags < 0 || ::fcntl(fd, F_SETFL, flags | O_NONBLOCK) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+long ggrs_udp_local_port(long fd) {
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(static_cast<int>(fd), reinterpret_cast<sockaddr*>(&addr),
+                    &len) < 0) {
+    return -1;
+  }
+  return ntohs(addr.sin_port);
+}
+
+void ggrs_udp_close(long fd) { ::close(static_cast<int>(fd)); }
+
+// Send one datagram to ipv4 (host byte order) : port. Returns bytes sent
+// or -1 on error (nonblocking sends on UDP effectively never block).
+long ggrs_udp_send(long fd, const uint8_t* buf, long len, uint32_t ip_host,
+                   uint16_t port) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(ip_host);
+  addr.sin_port = htons(port);
+  long n = ::sendto(static_cast<int>(fd), buf, len, 0,
+                    reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  return n;
+}
+
+// Receive one datagram. Returns its length, -1 when the queue is drained
+// (EWOULDBLOCK), or -2 on a transient error the caller should skip
+// (e.g. ECONNRESET from a peer's ICMP port-unreachable).
+long ggrs_udp_recv(long fd, uint8_t* buf, long cap, uint32_t* ip_host,
+                   uint16_t* port) {
+  sockaddr_in src{};
+  socklen_t slen = sizeof(src);
+  long n = ::recvfrom(static_cast<int>(fd), buf, cap, 0,
+                      reinterpret_cast<sockaddr*>(&src), &slen);
+  if (n < 0) {
+    if (errno == EWOULDBLOCK || errno == EAGAIN) return -1;
+    return -2;
+  }
+  *ip_host = ntohl(src.sin_addr.s_addr);
+  *port = ntohs(src.sin_port);
+  return n;
+}
+
+}  // extern "C"

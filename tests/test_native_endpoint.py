@@ -1,0 +1,332 @@
+"""C++ reliability endpoint (native/endpoint.cpp) driven through the same
+scenarios as the Python PeerEndpoint, including MIXED pairs (one native, one
+Python peer on the same virtual network) — the wire format is the contract.
+"""
+
+import random
+
+import pytest
+
+from ggrs_tpu.frame_info import PlayerInput
+from ggrs_tpu.native import available
+from ggrs_tpu.network.protocol import (
+    NUM_SYNC_PACKETS,
+    EvDisconnected,
+    EvInput,
+    EvNetworkInterrupted,
+    EvNetworkResumed,
+    EvSynchronized,
+    PeerEndpoint,
+)
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.sync_layer import ConnectionStatus
+from ggrs_tpu.utils.clock import FakeClock
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native library not built (make -C native)"
+)
+
+
+def make_endpoint(kind, handles, peer_addr, clock, seed, **overrides):
+    if kind == "native":
+        from ggrs_tpu.native.endpoint import NativePeerEndpoint as cls
+    else:
+        cls = PeerEndpoint
+    kwargs = dict(
+        num_players=2,
+        local_players=1,
+        max_prediction=8,
+        disconnect_timeout_ms=2000,
+        disconnect_notify_start_ms=500,
+        fps=60,
+        input_size=1,
+        clock=clock,
+        rng=random.Random(seed),
+    )
+    kwargs.update(overrides)
+    return cls(handles=handles, peer_addr=peer_addr, **kwargs)
+
+
+def pump(pairs, status, clock, steps=1, advance_ms=10):
+    events = {id(ep): [] for ep, _ in pairs}
+    for _ in range(steps):
+        for ep, sock in pairs:
+            for _, msg in sock.receive_all_messages():
+                ep.handle_message(msg)
+            events[id(ep)].extend(ep.poll(status))
+            ep.send_all_messages(sock)
+        clock.advance(advance_ms)
+    return events
+
+
+def make_pair(kind_a, kind_b, clock, net, **overrides):
+    sock_a, sock_b = net.socket("a"), net.socket("b")
+    ep_a = make_endpoint(kind_a, [1], "b", clock, seed=1, **overrides)
+    ep_b = make_endpoint(kind_b, [0], "a", clock, seed=2, **overrides)
+    return (ep_a, sock_a), (ep_b, sock_b)
+
+
+PAIRINGS = [("native", "native"), ("native", "python"), ("python", "native")]
+
+
+@pytest.mark.parametrize("kind_a,kind_b", PAIRINGS)
+def test_handshake_all_pairings(kind_a, kind_b):
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair(kind_a, kind_b, clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    events = pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock,
+                  steps=2 * NUM_SYNC_PACKETS)
+    assert ep_a.is_running() and ep_b.is_running()
+    for ep in (ep_a, ep_b):
+        assert any(isinstance(e, EvSynchronized) for e in events[id(ep)])
+
+
+@pytest.mark.parametrize("kind_a,kind_b", PAIRINGS)
+def test_input_exchange_all_pairings(kind_a, kind_b):
+    """Inputs flow both ways with correct frames/bytes across implementations."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair(kind_a, kind_b, clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2 * NUM_SYNC_PACKETS)
+    assert ep_a.is_running() and ep_b.is_running()
+
+    # ep_a's remote is player 1 (b's local player); ep_b's remote is player 0
+    got_a, got_b = [], []
+    for frame in range(20):
+        ep_a.send_input({0: PlayerInput(frame, bytes([frame % 11]))}, status)
+        ep_b.send_input({1: PlayerInput(frame, bytes([(frame * 3) % 11]))}, status)
+        ev = pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock)
+        got_a += [e for e in ev[id(ep_a)] if isinstance(e, EvInput)]
+        got_b += [e for e in ev[id(ep_b)] if isinstance(e, EvInput)]
+
+    # a's received inputs are attributed to its remote handle (1), b's to 0
+    assert [e.player for e in got_a] == [1] * len(got_a)
+    assert [e.player for e in got_b] == [0] * len(got_b)
+    assert [e.input.frame for e in got_a] == list(range(len(got_a)))
+    assert len(got_a) >= 19 and len(got_b) >= 19
+    for e in got_a:
+        assert e.input.buf == bytes([(e.input.frame * 3) % 11])
+    for e in got_b:
+        assert e.input.buf == bytes([e.input.frame % 11])
+
+
+def test_native_reliability_under_loss_and_jitter():
+    """30% loss + jitter + duplicates: the resend protocol must still deliver
+    every input to a native receiver."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=20, jitter_ms=15, loss=0.3,
+                          duplicate=0.2, seed=7)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair("native", "native", clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    # lossy handshake: each retry costs a 200ms timer tick, so give it time
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=200, advance_ms=25)
+    assert ep_a.is_running() and ep_b.is_running()
+
+    got_b = []
+    for frame in range(40):
+        ep_a.send_input({1: PlayerInput(frame, bytes([frame % 13]))}, status)
+        ev = pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2)
+        got_b += [e for e in ev[id(ep_b)] if isinstance(e, EvInput)]
+    # drain stragglers
+    ev = pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=30)
+    got_b += [e for e in ev[id(ep_b)] if isinstance(e, EvInput)]
+
+    frames = [e.input.frame for e in got_b]
+    assert frames == sorted(frames)  # in order, no gaps skipped
+    assert frames == list(range(40))
+    for e in got_b:
+        assert e.input.buf == bytes([e.input.frame % 13])
+
+
+def test_native_disconnect_detection_timers():
+    """Silence after sync: interrupted at notify_start, disconnected at
+    timeout — exact FakeClock semantics as the Python endpoint."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair("native", "native", clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2 * NUM_SYNC_PACKETS)
+    assert ep_a.is_running()
+
+    # b goes silent; a keeps polling
+    events = []
+    for _ in range(60):
+        for _, msg in sock_a.receive_all_messages():
+            ep_a.handle_message(msg)
+        events += ep_a.poll(status)
+        ep_a.send_all_messages(sock_a)
+        clock.advance(50)
+    assert any(isinstance(e, EvNetworkInterrupted) for e in events)
+    assert any(isinstance(e, EvDisconnected) for e in events)
+
+    # traffic resumes -> NetworkResumed (before the disconnect timeout only;
+    # here we just check the resumed event fires on any new packet)
+    ep_b.send_all_messages(sock_b)
+
+
+def test_native_network_resumed_event():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair("native", "native", clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2 * NUM_SYNC_PACKETS)
+
+    # silence past notify_start but before timeout
+    events = []
+    for _ in range(12):
+        events += ep_a.poll(status)
+        clock.advance(50)
+    assert any(isinstance(e, EvNetworkInterrupted) for e in events)
+    assert not any(isinstance(e, EvDisconnected) for e in events)
+
+    # b speaks again
+    ep_b.send_input({0: PlayerInput(0, b"\x05")}, status)
+    ep_b.send_all_messages(sock_b)
+    clock.advance(10)
+    for _, msg in sock_a.receive_all_messages():
+        ep_a.handle_message(msg)
+    events = ep_a.poll(status)
+    assert any(isinstance(e, EvNetworkResumed) for e in events)
+
+
+def test_native_checksum_report_intake():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair("native", "python", clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2 * NUM_SYNC_PACKETS)
+
+    big = (1 << 100) + 12345  # u128-range checksum survives the wire
+    ep_a.send_checksum_report(50, big)
+    ep_a.send_checksum_report(60, 7)
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock)
+    assert ep_b.checksum_history == {50: big, 60: 7}
+
+    ep_b.send_checksum_report(70, big * 2 + 1)
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2)
+    assert ep_a.checksum_history == {70: big * 2 + 1}
+
+
+def test_native_network_stats_and_frame_advantage():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=30)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair("native", "native", clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=30, advance_ms=40)
+    assert ep_a.is_running()
+
+    for frame in range(10):
+        ep_a.send_input({1: PlayerInput(frame, b"\x01")}, status)
+        ep_b.send_input({0: PlayerInput(frame, b"\x02")}, status)
+        ep_a.update_local_frame_advantage(frame)
+        ep_b.update_local_frame_advantage(frame)
+        pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, advance_ms=220)
+
+    stats = ep_a.network_stats()
+    assert stats.ping_ms > 0  # RTT measured via quality report/reply
+    assert stats.kbps_sent >= 0
+    assert isinstance(ep_a.average_frame_advantage(), int)
+
+
+def test_native_pending_overflow_disconnects():
+    """129 unacked inputs (peer silent) => EvDisconnected, like the
+    reference's spectator-overflow rule (protocol.rs:459-463)."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair("native", "native", clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2 * NUM_SYNC_PACKETS)
+
+    events = []
+    for frame in range(130):
+        ep_a.send_input({1: PlayerInput(frame, bytes([frame % 5]))}, status)
+        events += ep_a.poll(status)
+        # never deliver to b, never ack
+    assert any(isinstance(e, EvDisconnected) for e in events)
+
+
+def test_native_magic_filter_rejects_strangers():
+    """After sync, packets with a foreign magic must be ignored."""
+    from ggrs_tpu.network.messages import ChecksumReport, Message
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair("native", "native", clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2 * NUM_SYNC_PACKETS)
+
+    stranger_magic = (ep_b.magic + 1) % 65536 or 1
+    ep_a.handle_message(Message(stranger_magic, ChecksumReport(checksum=1, frame=5)))
+    assert ep_a.checksum_history == {}
+    ep_a.handle_message(Message(ep_b.magic, ChecksumReport(checksum=1, frame=5)))
+    assert ep_a.checksum_history == {5: 1}
+
+
+def test_native_survives_crafted_packets():
+    """Network-controlled fields must never abort the process: a pong from
+    the future, an input window starting beyond last_recv+1, and truncated
+    bodies are all dropped or clamped."""
+    from ggrs_tpu.network.messages import (
+        InputMsg, Message, QualityReply, encode_message,
+    )
+
+    clock = FakeClock(start_ms=1000)
+    net = InMemoryNetwork(clock)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair("native", "native", clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2 * NUM_SYNC_PACKETS)
+    assert ep_a.is_running()
+
+    # pong far in the future -> RTT clamps to 0, no crash
+    ep_a.handle_message(Message(ep_b.magic, QualityReply(pong=(1 << 63))))
+    assert ep_a.network_stats is not None  # still alive
+
+    # input window starting far ahead -> dropped, no crash
+    ep_a.handle_message(
+        Message(ep_b.magic, InputMsg(start_frame=1000, ack_frame=-1, bytes_=b""))
+    )
+
+    # truncated wire bytes -> decode rejected, no crash
+    wire = encode_message(Message(ep_b.magic, QualityReply(pong=5)))
+    ep_a.handle_wire(wire[:4])
+    assert ep_a.is_running()
+
+
+def test_native_endpoint_rejects_over_limit_config():
+    from ggrs_tpu.errors import InvalidRequest
+    from ggrs_tpu.native.endpoint import NativePeerEndpoint
+
+    with pytest.raises(InvalidRequest):
+        NativePeerEndpoint(
+            handles=list(range(17)), peer_addr="x", num_players=17,
+            local_players=1, max_prediction=8, disconnect_timeout_ms=2000,
+            disconnect_notify_start_ms=500, fps=60, input_size=1,
+        )
+    with pytest.raises(InvalidRequest):
+        NativePeerEndpoint(
+            handles=[0], peer_addr="x", num_players=2, local_players=1,
+            max_prediction=8, disconnect_timeout_ms=2000,
+            disconnect_notify_start_ms=500, fps=60, input_size=65,
+        )

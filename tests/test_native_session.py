@@ -1,0 +1,103 @@
+"""Session-level runs on the native C++ stack: P2P over real loopback UDP
+with C++ endpoints and C++ sockets, including a mixed pair (one session
+native, the other pure Python) — wire-format interop is the contract."""
+
+import pytest
+
+from ggrs_tpu import (
+    AdvanceFrame,
+    LoadGameState,
+    PlayerType,
+    SaveGameState,
+    SessionBuilder,
+    SessionState,
+)
+from ggrs_tpu.native import available
+from stubs import GameStub
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native library not built (make -C native)"
+)
+
+PORT_A, PORT_B = 7921, 7922
+
+
+def make_session(port, remote_port, local_handle, native):
+    b = SessionBuilder(input_size=1).with_num_players(2)
+    if native:
+        from ggrs_tpu.native.sockets import NativeUdpNonBlockingSocket
+
+        b = b.with_native_endpoints(True)
+        sock = NativeUdpNonBlockingSocket(port)
+    else:
+        from ggrs_tpu.network.sockets import UdpNonBlockingSocket
+
+        sock = UdpNonBlockingSocket(port)
+    b.add_player(PlayerType.local(), local_handle)
+    b.add_player(PlayerType.remote(("127.0.0.1", remote_port)), 1 - local_handle)
+    return b.start_p2p_session(sock)
+
+
+def run_lockstep(s0, s1, frames=12):
+    for _ in range(80):
+        s0.poll_remote_clients()
+        s1.poll_remote_clients()
+        if (
+            s0.current_state() == SessionState.RUNNING
+            and s1.current_state() == SessionState.RUNNING
+        ):
+            break
+    assert s0.current_state() == SessionState.RUNNING
+    assert s1.current_state() == SessionState.RUNNING
+
+    g0, g1 = GameStub(), GameStub()
+    for f in range(frames):
+        s0.poll_remote_clients()
+        s1.poll_remote_clients()
+        s0.add_local_input(0, bytes([f % 5]))
+        s1.add_local_input(1, bytes([(f * 2) % 5]))
+        g0.handle_requests(s0.advance_frame())
+        g1.handle_requests(s1.advance_frame())
+    # settle: let the tail inputs arrive and corrections roll back
+    for f in range(frames, frames + 4):
+        s0.poll_remote_clients()
+        s1.poll_remote_clients()
+        s0.add_local_input(0, bytes([f % 5]))
+        s1.add_local_input(1, bytes([(f * 2) % 5]))
+        g0.handle_requests(s0.advance_frame())
+        g1.handle_requests(s1.advance_frame())
+    # confirmed prefixes must agree exactly
+    confirmed = min(max(g0.history) - 2, max(g1.history) - 2, frames)
+    for f in range(1, confirmed + 1):
+        assert g0.history[f] == g1.history[f], f"divergence at frame {f}"
+    return g0, g1
+
+
+def test_native_p2p_session_over_native_udp():
+    s0 = make_session(PORT_A, PORT_B, 0, native=True)
+    s1 = make_session(PORT_B, PORT_A, 1, native=True)
+    run_lockstep(s0, s1)
+
+
+def test_mixed_native_python_session_interop():
+    s0 = make_session(PORT_A + 10, PORT_B + 10, 0, native=True)
+    s1 = make_session(PORT_B + 10, PORT_A + 10, 1, native=False)
+    run_lockstep(s0, s1)
+
+
+def test_native_session_reports_network_stats():
+    import time
+
+    from ggrs_tpu import NotSynchronized
+
+    s0 = make_session(PORT_A + 20, PORT_B + 20, 0, native=True)
+    s1 = make_session(PORT_B + 20, PORT_A + 20, 1, native=True)
+    start = time.monotonic()
+    run_lockstep(s0, s1)
+    try:
+        stats = s0.network_stats(1)  # remote player handle for session 0
+        assert stats.send_queue_len >= 0
+    except NotSynchronized:
+        # parity with the Python endpoint: stats are unavailable within the
+        # first second of a session (kbps denominator would be zero)
+        assert time.monotonic() - start < 1.5
