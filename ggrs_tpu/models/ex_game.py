@@ -102,10 +102,12 @@ def _step_generic(state: State, inputs, statuses, num_players: int, xp) -> State
     # friction (ex_game.rs:277-278): arithmetic shift == floor(v * 251 / 256)
     vel = (vel * FRICTION_NUM) >> 8
 
-    # thrust/brake along current heading (ex_game.rs:281-289)
+    # thrust/brake along current heading (ex_game.rs:281-289). Heading trig
+    # is computed arithmetically (fx.sin16) rather than via a table gather:
+    # dynamic gathers are the single most expensive op in this step on TPU.
     thrust = xp.where(up & ~down, 1, 0) + xp.where(down & ~up, -1, 0)
-    cos_t = xp.asarray(fx.COS_TABLE)[fx.angle_index(rot)]
-    sin_t = xp.asarray(fx.SIN_TABLE)[fx.angle_index(rot)]
+    cos_t = fx.cos16(rot, xp)
+    sin_t = fx.sin16(rot, xp)
     dvx = (MOVE_SPEED * cos_t) >> fx.TRIG_SCALE_BITS
     dvy = (MOVE_SPEED * sin_t) >> fx.TRIG_SCALE_BITS
     vel = vel + xp.stack([thrust * dvx, thrust * dvy], axis=1)

@@ -46,6 +46,29 @@ def angle_index(rot):
     return rot >> (ANGLE_BITS - TABLE_BITS)
 
 
+def sin16(rot, xp):
+    """Branch-free integer sine: sin(2*pi*rot/2^16) in Q14, int32 only.
+
+    A parabolic half-wave with one Hastings-style refinement term (~0.1%
+    max error). Replaces a trig-table gather in the hot step: a 4096-wide
+    dynamic gather costs ~50us/frame on TPU (v5e) while this is ~10
+    elementwise VPU ops. Purely integer, so the jax and numpy paths stay
+    bit-identical — the property rollback correctness rests on. Intermediate
+    products are bounded by 2^28 < 2^31, so int32 never overflows.
+    """
+    a = rot & (ANGLE_MOD - 1)
+    h = a & 0x7FFF  # half-wave phase
+    p = (h * (0x8000 - h)) >> 14  # parabola, peak 16384 at the quarter wave
+    refined = p + ((225 * ((p * p >> 14) - p)) >> 10)
+    neg = (a >> 15) & 1  # second half-wave is the mirror
+    return xp.where(neg == 1, -refined, refined).astype(xp.int32)
+
+
+def cos16(rot, xp):
+    """cos(2*pi*rot/2^16) in Q14 (quarter-turn phase shift of sin16)."""
+    return sin16(rot + (ANGLE_MOD // 4), xp)
+
+
 def isqrt24(n, xp):
     """Integer sqrt for 0 <= n < 2^24, branch-free (12 unrolled
     digit-by-digit iterations), exact floor(sqrt(n)).

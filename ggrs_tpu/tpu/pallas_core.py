@@ -1,0 +1,403 @@
+"""Pallas TPU kernel for the fused SyncTest hot loop.
+
+The XLA scan in TpuSyncTestSession spends most of each tick on per-op
+overhead: the world state is only ~80KB, so the ~60 small int ops per step
+plus ring/history bookkeeping cost far more than the math. This kernel runs
+the ENTIRE batch — T ticks, each with its forced `check_distance`-frame
+rollback, resimulation, snapshot-ring writes, on-device checksums and
+first-seen history comparison — as ONE pallas_call with every carry buffer
+resident in VMEM/SMEM, written in place via input/output aliasing.
+
+Semantics are bit-identical to TpuSyncTestSession._tick (tests enforce
+carry-level parity): same masked rollback, same first-seen checksum history,
+same mismatch latch, and the same step math (ggrs_tpu/models/ex_game
+_step_generic with all-CONFIRMED statuses — the only configuration the
+fused SyncTest uses).
+
+Layout: entity arrays are packed to (N/128, 128) int32 tiles (px, py, vx,
+vy, rot), the snapshot ring to (ring_len, N/128, 128); inputs, the input
+ring, the checksum history and frame/mismatch scalars live in SMEM.
+Unsigned checksum math is done in int32 (two's-complement wraparound is
+bit-identical) and bitcast back to uint32 at the boundary.
+
+Supported configuration: input_size == 1, N % 128 == 0, unsharded. The XLA
+path remains the fallback (and the sharded/multi-chip implementation).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import ex_game
+from ..ops import fixed_point as fx
+from ..types import InputStatus
+
+GOLDEN = np.int32(np.uint32(fx.GOLDEN32).view(np.int32))
+
+
+def _exact_floor_div(a, b):
+    """floor(a / b) for int32 a (|a| < 2^24), b in [1, 2^12], branch-free.
+
+    TPU vector units have no integer divide; a float32 estimate is within a
+    few ULP (even with reciprocal-based division), and three integer fixup
+    rounds make the result the exact floor regardless of rounding mode —
+    the determinism contract requires exactness, not speed of convergence.
+    """
+    q = jnp.floor(a.astype(jnp.float32) / b.astype(jnp.float32)).astype(jnp.int32)
+    for _ in range(3):
+        r = a - q * b
+        q = q + (r >= b).astype(jnp.int32) - (r < 0).astype(jnp.int32)
+    return q
+
+
+def _isqrt24(n):
+    """fx.isqrt24 verbatim (12 unrolled digit iterations), jnp ops."""
+    x = n
+    c = jnp.zeros_like(n)
+    d = 1 << 22
+    for _ in range(12):
+        cd = c + d
+        cond = x >= cd
+        x = jnp.where(cond, x - cd, x)
+        c = jnp.where(cond, (c >> 1) + d, c >> 1)
+        d >>= 2
+    return c
+
+
+def _step_packed(px, py, vx, vy, rot, owner, inp_scalars, num_players):
+    """ex_game._step_generic on packed (rows,128) tiles, all-CONFIRMED.
+
+    inp_scalars: length-num_players list of scalar int32 input bytes.
+    """
+    inp = jnp.zeros_like(px)
+    for p in range(num_players):
+        inp = jnp.where(owner == p, inp_scalars[p], inp)
+
+    up = (inp & ex_game.INPUT_UP) != 0
+    down = (inp & ex_game.INPUT_DOWN) != 0
+    left = (inp & ex_game.INPUT_LEFT) != 0
+    right = (inp & ex_game.INPUT_RIGHT) != 0
+
+    vx = (vx * ex_game.FRICTION_NUM) >> 8
+    vy = (vy * ex_game.FRICTION_NUM) >> 8
+
+    thrust = jnp.where(up & ~down, 1, 0) + jnp.where(down & ~up, -1, 0)
+    cos_t = fx.cos16(rot, jnp)
+    sin_t = fx.sin16(rot, jnp)
+    dvx = (ex_game.MOVE_SPEED * cos_t) >> fx.TRIG_SCALE_BITS
+    dvy = (ex_game.MOVE_SPEED * sin_t) >> fx.TRIG_SCALE_BITS
+    vx = vx + thrust * dvx
+    vy = vy + thrust * dvy
+
+    turn = jnp.where(left & ~right, -ex_game.ROT_SPEED, 0) + jnp.where(
+        right & ~left, ex_game.ROT_SPEED, 0
+    )
+    rot = (rot + turn) & (fx.ANGLE_MOD - 1)
+
+    m2 = vx * vx + vy * vy
+    mag = _isqrt24(m2)
+    over = m2 > ex_game.MAX_SPEED * ex_game.MAX_SPEED
+    safe = jnp.where(mag == 0, 1, mag)
+    vx = jnp.where(over, _exact_floor_div(vx * ex_game.MAX_SPEED, safe), vx)
+    vy = jnp.where(over, _exact_floor_div(vy * ex_game.MAX_SPEED, safe), vy)
+
+    px = jnp.clip(px + vx, 0, ex_game.MAX_X)
+    py = jnp.clip(py + vy, 0, ex_game.MAX_Y)
+    return px, py, vx, vy, rot
+
+
+def _checksum_packed(px, py, vx, vy, rot, gi, frame, n_entities):
+    """_checksum_generic bit-for-bit on the packed layout (int32 wraparound
+    == uint32): word order is pos interleaved, vel interleaved, rot, frame;
+    `frame` is the state's frame field (the word at index 5N)."""
+    g = GOLDEN
+    n = np.int32(n_entities)
+    hi = (
+        jnp.sum(px * ((2 * gi + 1) * g))
+        + jnp.sum(py * ((2 * gi + 2) * g))
+        + jnp.sum(vx * ((2 * n + 2 * gi + 1) * g))
+        + jnp.sum(vy * ((2 * n + 2 * gi + 2) * g))
+        + jnp.sum(rot * ((4 * n + gi + 1) * g))
+        + frame * ((5 * n + 1) * g)
+    )
+    lo = (
+        jnp.sum(px) + jnp.sum(py) + jnp.sum(vx) + jnp.sum(vy) + jnp.sum(rot)
+        + frame
+    )
+    return hi, lo
+
+
+class PallasSyncTestCore:
+    """Drop-in batch executor for TpuSyncTestSession's carry (unsharded)."""
+
+    def __init__(self, game, num_players: int, check_distance: int,
+                 interpret: bool = False):
+        assert game.input_size == 1, "pallas core supports 1-byte inputs"
+        assert game.num_entities % 128 == 0, "entity count must be 128-aligned"
+        self.game = game
+        self.num_players = num_players
+        self.d = check_distance
+        self.ring_len = check_distance + 2
+        self.hist_len = check_distance + 2
+        self.n_rows = game.num_entities // 128
+        self.interpret = interpret
+        self._batch = functools.lru_cache(maxsize=4)(self._build)
+
+    # -- carry packing ---------------------------------------------------
+
+    def pack(self, carry: Dict[str, Any]):
+        rows = self.n_rows
+
+        def comp(a, i):  # [..., N, 2] -> [..., rows, 128] per component
+            return a[..., i].reshape(a.shape[:-2] + (rows, 128))
+
+        s, r = carry["state"], carry["ring"]
+        return {
+            "px": comp(s["pos"], 0), "py": comp(s["pos"], 1),
+            "vx": comp(s["vel"], 0), "vy": comp(s["vel"], 1),
+            "rot": s["rot"].reshape(rows, 128),
+            "r_px": comp(r["pos"], 0), "r_py": comp(r["pos"], 1),
+            "r_vx": comp(r["vel"], 0), "r_vy": comp(r["vel"], 1),
+            "r_rot": r["rot"].reshape(-1, rows, 128),
+            "r_frame": r["frame"].astype(jnp.int32),
+            "iring": carry["input_ring"][:, :, 0].astype(jnp.int32),
+            "h_tag": carry["h_tag"],
+            "h_hi": jax.lax.bitcast_convert_type(carry["h_hi"], jnp.int32),
+            "h_lo": jax.lax.bitcast_convert_type(carry["h_lo"], jnp.int32),
+            "meta": jnp.stack(
+                [
+                    carry["frame"],
+                    carry["mismatch"].astype(jnp.int32),
+                    carry["mismatch_frame"],
+                    jnp.int32(0),
+                ]
+            ),
+        }
+
+    def unpack(self, p, frame_scalar_state) -> Dict[str, Any]:
+        n = self.game.num_entities
+
+        def merge(x, y):  # packed components -> [..., N, 2]
+            lead = x.shape[:-2]
+            return jnp.stack(
+                [x.reshape(lead + (n,)), y.reshape(lead + (n,))], axis=-1
+            )
+
+        state = {
+            "frame": p["meta"][0],  # state frame == tick frame by invariant
+            "pos": merge(p["px"], p["py"]),
+            "vel": merge(p["vx"], p["vy"]),
+            "rot": p["rot"].reshape(n),
+        }
+        ring = {
+            "frame": p["r_frame"],
+            "pos": merge(p["r_px"], p["r_py"]),
+            "vel": merge(p["r_vx"], p["r_vy"]),
+            "rot": p["r_rot"].reshape(-1, n),
+        }
+        return {
+            "state": state,
+            "ring": ring,
+            "input_ring": p["iring"].astype(jnp.uint8)[:, :, None],
+            "h_tag": p["h_tag"],
+            "h_hi": jax.lax.bitcast_convert_type(p["h_hi"], jnp.uint32),
+            "h_lo": jax.lax.bitcast_convert_type(p["h_lo"], jnp.uint32),
+            "mismatch": p["meta"][1].astype(jnp.bool_),
+            "mismatch_frame": p["meta"][2],
+            "frame": p["meta"][0],
+        }
+
+    # -- kernel ----------------------------------------------------------
+
+    def _build(self, t_ticks: int):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        d, ring_len, hist_len = self.d, self.ring_len, self.hist_len
+        rows, P = self.n_rows, self.num_players
+        n_entities = self.game.num_entities
+
+        # loop-invariant entity-index planes (numpy: _build may run under jit
+        # tracing via the lru_cache miss)
+        gi = (
+            np.arange(rows, dtype=np.int32)[:, None] * 128
+            + np.arange(128, dtype=np.int32)[None, :]
+        )
+        owner_np = gi % P
+
+        # VMEM carries are updated in place via input/output aliasing. SMEM
+        # carries are NOT aliased: on real TPUs input_output_aliases does not
+        # propagate input bytes into an SMEM output buffer (verified
+        # empirically; interpret mode hides it) — so the small state flows
+        # input ref -> SMEM scratch (mutated through the loop) -> output ref.
+        vmem_names = ["px", "py", "vx", "vy", "rot",
+                      "r_px", "r_py", "r_vx", "r_vy", "r_rot"]
+        smem_names = ["r_frame", "iring", "h_tag", "h_hi", "h_lo", "meta"]
+        carry_names = vmem_names + smem_names
+        smem_shapes = {
+            "r_frame": (ring_len,),
+            "iring": (d + 2, P),
+            "h_tag": (hist_len,),
+            "h_hi": (hist_len,),
+            "h_lo": (hist_len,),
+            "meta": (4,),
+        }
+
+        def kernel(inputs_ref, gi_ref, owner_ref, *refs):
+            n_in = len(carry_names)
+            ins = dict(zip(carry_names, refs[:n_in]))
+            outs = dict(zip(carry_names, refs[n_in : 2 * n_in]))
+            scratch = dict(zip(smem_names, refs[2 * n_in :]))
+            # VMEM: out refs are aliased to the inputs; SMEM: copy in->scratch
+            out = {**{n_: outs[n_] for n_ in vmem_names}, **scratch}
+            for name in smem_names:
+                shape = smem_shapes[name]
+                if len(shape) == 1:
+                    for i in range(shape[0]):
+                        scratch[name][i] = ins[name][i]
+                else:
+                    for i in range(shape[0]):
+                        for j in range(shape[1]):
+                            scratch[name][i, j] = ins[name][i, j]
+            gi_v = gi_ref[:]
+            owner_v = owner_ref[:]
+
+            def read_state():
+                return (out["px"][:], out["py"][:], out["vx"][:],
+                        out["vy"][:], out["rot"][:])
+
+            def ring_slot(name, slot):
+                return out[name][pl.ds(slot, 1)][0]
+
+            def save_and_check(state, frame, mask):
+                """Masked ring write + first-seen history compare, matching
+                TpuSyncTestSession._save_and_check under a tree-where."""
+                px, py, vx, vy, rot = state
+                hi, lo = _checksum_packed(px, py, vx, vy, rot, gi_v, frame,
+                                          n_entities)
+                slot = frame % ring_len
+                for name, val in (("r_px", px), ("r_py", py), ("r_vx", vx),
+                                  ("r_vy", vy), ("r_rot", rot)):
+                    old = ring_slot(name, slot)
+                    out[name][pl.ds(slot, 1)] = jnp.where(mask, val, old)[None]
+                old_f = out["r_frame"][slot]
+                # ring "frame" component records the state's frame field
+                out["r_frame"][slot] = jnp.where(mask, frame, old_f)
+
+                h = frame % hist_len
+                tag, ohi, olo = out["h_tag"][h], out["h_hi"][h], out["h_lo"][h]
+                seen = tag == frame
+                differs = mask & seen & ((ohi != hi) | (olo != lo))
+                mm, mmf = out["meta"][1], out["meta"][2]
+                first = differs & (mm == 0)
+                out["meta"][1] = jnp.where(differs, 1, mm)
+                out["meta"][2] = jnp.where(first, frame, mmf)
+                out["h_tag"][h] = jnp.where(mask, frame, tag)
+                out["h_hi"][h] = jnp.where(mask & ~seen, hi, ohi)
+                out["h_lo"][h] = jnp.where(mask & ~seen, lo, olo)
+
+            def step(state, inp_scalars):
+                return _step_packed(*state, owner_v, inp_scalars, P)
+
+            def tick(t, _):
+                c = out["meta"][0]
+                do_rb = c > d
+                base = jnp.maximum(c - d, 0)
+
+                # load the rollback base snapshot (masked)
+                bslot = base % ring_len
+                loaded = tuple(
+                    ring_slot(n_, bslot)
+                    for n_ in ("r_px", "r_py", "r_vx", "r_vy", "r_rot")
+                )
+                cur = read_state()
+                state = tuple(
+                    jnp.where(do_rb, l, s) for l, s in zip(loaded, cur)
+                )
+
+                for i in range(d):
+                    f = base + i
+                    if i > 0:
+                        save_and_check(state, f, do_rb)
+                    islot = f % (d + 2)
+                    inps = [out["iring"][islot, p] for p in range(P)]
+                    nxt = step(state, inps)
+                    state = tuple(
+                        jnp.where(do_rb, n_, s) for n_, s in zip(nxt, state)
+                    )
+
+                # save current frame, record input, advance
+                save_and_check(state, c, jnp.bool_(True))
+                cslot = c % (d + 2)
+                new_inps = [inputs_ref[t, p] for p in range(P)]
+                for p in range(P):
+                    out["iring"][cslot, p] = new_inps[p]
+                state = step(state, new_inps)
+                out["px"][:], out["py"][:] = state[0], state[1]
+                out["vx"][:], out["vy"][:] = state[2], state[3]
+                out["rot"][:] = state[4]
+                out["meta"][0] = c + 1
+                return 0
+
+            jax.lax.fori_loop(0, t_ticks, tick, 0)
+
+            # SMEM carries: scratch -> (non-aliased) output refs
+            for name in smem_names:
+                shape = smem_shapes[name]
+                if len(shape) == 1:
+                    for i in range(shape[0]):
+                        outs[name][i] = scratch[name][i]
+                else:
+                    for i in range(shape[0]):
+                        for j in range(shape[1]):
+                            outs[name][i, j] = scratch[name][i, j]
+
+        def spec_of(name):
+            space = pltpu.VMEM if name in vmem_names else pltpu.SMEM
+            return pl.BlockSpec(memory_space=space)
+
+        def run(packed, inputs_i32):
+            in_specs = (
+                [pl.BlockSpec(memory_space=pltpu.SMEM),
+                 pl.BlockSpec(memory_space=pltpu.VMEM),
+                 pl.BlockSpec(memory_space=pltpu.VMEM)]
+                + [spec_of(n) for n in carry_names]
+            )
+            out_specs = [spec_of(n) for n in carry_names]
+            out_shapes = [
+                jax.ShapeDtypeStruct(packed[n].shape, packed[n].dtype)
+                for n in carry_names
+            ]
+            # alias only the VMEM carries (they lead carry_names)
+            aliases = {3 + i: i for i in range(len(vmem_names))}
+            results = pl.pallas_call(
+                kernel,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                out_shape=out_shapes,
+                input_output_aliases=aliases,
+                scratch_shapes=[
+                    pltpu.SMEM(smem_shapes[n], jnp.int32) for n in smem_names
+                ],
+                interpret=self.interpret,
+            )(inputs_i32, jnp.asarray(gi), jnp.asarray(owner_np),
+              *[packed[n] for n in carry_names])
+            return dict(zip(carry_names, results))
+
+        return run
+
+    # -- public ----------------------------------------------------------
+
+    def batch(self, carry: Dict[str, Any], inputs) -> Dict[str, Any]:
+        """Run T ticks; carry/in/out use TpuSyncTestSession's pytree."""
+        t = inputs.shape[0]
+        run = self._batch(t)
+        packed = self.pack(carry)
+        inputs_i32 = inputs[:, :, 0].astype(jnp.int32)
+        out = run(packed, inputs_i32)
+        return self.unpack(out, None)

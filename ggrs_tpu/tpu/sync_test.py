@@ -39,12 +39,21 @@ class TpuSyncTestSession:
         input_delay: int = 0,
         flush_interval: int = 1,
         mesh=None,
+        backend: str = "xla",
     ):
         """`mesh`: optional jax Mesh with an `entity` axis — the world state
         and snapshot ring shard across it (BASELINE.json configs[4]); GSPMD
         partitions the fused scan, and the checksum reduction becomes the
-        only cross-shard collective."""
+        only cross-shard collective.
+
+        `backend`: "xla" (lax.scan; works everywhere, required for mesh) or
+        "pallas" (whole batch as one TPU kernel, state resident in VMEM —
+        see ggrs_tpu.tpu.pallas_core; bit-identical carries, much faster on
+        small worlds where per-op overhead dominates). "pallas-interpret"
+        runs the same kernel in interpreter mode (CPU tests)."""
         assert check_distance >= 1
+        assert backend in ("xla", "pallas", "pallas-interpret")
+        assert backend == "xla" or mesh is None, "pallas path is unsharded"
         self.game = game
         self.num_players = num_players
         self.check_distance = check_distance
@@ -94,7 +103,18 @@ class TpuSyncTestSession:
             "mismatch_frame": jnp.full((), -1, dtype=jnp.int32),
             "frame": jnp.zeros((), dtype=jnp.int32),
         }
-        self._batch_fn = jax.jit(self._batch_impl, donate_argnums=(0,))
+        if backend == "xla":
+            self._batch_fn = jax.jit(self._batch_impl, donate_argnums=(0,))
+        else:
+            from .pallas_core import PallasSyncTestCore
+
+            core = PallasSyncTestCore(
+                game,
+                num_players,
+                check_distance,
+                interpret=backend == "pallas-interpret",
+            )
+            self._batch_fn = jax.jit(core.batch, donate_argnums=(0,))
         self._raw_inputs: list = []  # host-side delay shift buffer
         self._ticks_since_flush = 0
         self.current_frame = 0

@@ -1,0 +1,87 @@
+"""Pallas fused-SyncTest kernel vs the XLA scan: full-carry bit parity.
+
+Runs the kernel in interpreter mode (tests execute on the CPU mesh); the
+real-TPU execution of the same kernel is exercised by bench.py and the
+driver's hardware runs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.tree_util as jtu
+
+from ggrs_tpu.models.ex_game import ExGame
+from ggrs_tpu.tpu import TpuSyncTestSession
+
+P = 2
+
+
+def drive(backend, script, entities, check_distance, batches):
+    sess = TpuSyncTestSession(
+        ExGame(P, entities),
+        num_players=P,
+        check_distance=check_distance,
+        flush_interval=10_000,
+        backend=backend,
+    )
+    t = script.shape[0] // batches
+    for i in range(batches):
+        sess.advance_frames(script[i * t : (i + 1) * t])
+    return sess
+
+
+def assert_carry_equal(a, b):
+    la = jtu.tree_leaves_with_path(jax.device_get(a))
+    lb = jtu.tree_leaves(jax.device_get(b))
+    assert len(la) == len(lb)
+    for (path, x), y in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=jtu.keystr(path)
+        )
+
+
+@pytest.mark.parametrize("check_distance,entities", [(2, 256), (8, 512)])
+def test_pallas_carry_parity_with_xla(check_distance, entities):
+    rng = np.random.default_rng(5)
+    script = rng.integers(0, 16, size=(60, P, 1), dtype=np.uint8)
+    xla = drive("xla", script, entities, check_distance, batches=3)
+    pls = drive("pallas-interpret", script, entities, check_distance, batches=3)
+    assert_carry_equal(xla.carry, pls.carry)
+    xla.check()
+    pls.check()
+
+
+def test_pallas_detects_injected_divergence():
+    """Corrupt a ring snapshot between batches: the in-kernel first-seen
+    history must latch a mismatch, like the XLA path."""
+    from ggrs_tpu.errors import MismatchedChecksum
+
+    rng = np.random.default_rng(6)
+    script = rng.integers(0, 16, size=(40, P, 1), dtype=np.uint8)
+    sess = TpuSyncTestSession(
+        ExGame(P, 256),
+        num_players=P,
+        check_distance=4,
+        flush_interval=10_000,
+        backend="pallas-interpret",
+    )
+    sess.advance_frames(script[:20])
+    sess.check()  # clean so far
+    ring = dict(sess.carry["ring"])
+    slot = (sess.current_frame - 4) % sess.ring_len
+    ring["pos"] = ring["pos"].at[slot, 0, 0].add(7)
+    sess.carry = {**sess.carry, "ring": ring}
+    sess.advance_frames(script[20:])
+    with pytest.raises(MismatchedChecksum):
+        sess.check()
+
+
+def test_pallas_rejects_unsupported_configs():
+    with pytest.raises(AssertionError):
+        TpuSyncTestSession(
+            ExGame(P, 100),  # not 128-aligned
+            num_players=P,
+            check_distance=2,
+            backend="pallas-interpret",
+        )

@@ -130,3 +130,72 @@ def test_spectator_catchup_speed():
         sg.handle_requests(reqs)
         sg_frames.append(len(reqs))
     assert 2 in sg_frames  # catch-up kicked in
+
+
+def test_spectator_waits_when_input_not_arrived():
+    """PredictionThreshold when the host's input for the next frame hasn't
+    arrived (src/sessions/p2p_spectator_session.rs:179-182); the spectator's
+    frame must NOT advance, and the same frame replays once it arrives."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    host, spec = build_host_and_spectator(clock, net)
+    sync_all(host, spec, clock)
+
+    stub_h = GameStub()
+    # host advances a couple frames; spectator consumes them all
+    for f in range(3):
+        host.poll_remote_clients()
+        host.add_local_input(0, bytes([f + 1]))
+        stub_h.handle_requests(host.advance_frame())
+        spec.poll_remote_clients()
+        clock.advance(16)
+    stub_s = GameStub()
+    consumed = 0
+    for _ in range(10):
+        try:
+            reqs = spec.advance_frame()
+        except PredictionThreshold:
+            break
+        stub_s.handle_requests(reqs)
+        consumed += len(reqs)
+    assert consumed == 3
+    before = spec.current_frame
+    with pytest.raises(PredictionThreshold):
+        spec.advance_frame()
+    assert spec.current_frame == before  # no partial advance
+
+    # host produces one more frame -> spectator resumes where it stopped
+    host.poll_remote_clients()
+    host.add_local_input(0, bytes([9]))
+    stub_h.handle_requests(host.advance_frame())
+    clock.advance(16)
+    spec.poll_remote_clients()
+    reqs = spec.advance_frame()
+    stub_s.handle_requests(reqs)
+    assert spec.current_frame == before + 1
+    assert stub_s.history == stub_h.history
+
+
+def test_spectator_too_far_behind_is_unrecoverable():
+    """If the spectator stalls for > SPECTATOR_BUFFER_SIZE frames, the ring
+    slot for its next frame has been overwritten by a newer frame
+    (src/sessions/p2p_spectator_session.rs:184-187)."""
+    from ggrs_tpu import SpectatorTooFarBehind
+    from ggrs_tpu.sessions.builder import SPECTATOR_BUFFER_SIZE
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    host, spec = build_host_and_spectator(clock, net)
+    sync_all(host, spec, clock)
+
+    stub_h = GameStub()
+    # host runs far ahead while the spectator never advances
+    for f in range(SPECTATOR_BUFFER_SIZE + 10):
+        host.poll_remote_clients()
+        host.add_local_input(0, bytes([f % 7]))
+        stub_h.handle_requests(host.advance_frame())
+        spec.poll_remote_clients()
+        clock.advance(16)
+    with pytest.raises(SpectatorTooFarBehind):
+        for _ in range(SPECTATOR_BUFFER_SIZE + 10):
+            spec.advance_frame()
