@@ -187,7 +187,9 @@ class P2PSession:
         else:
             requests.append(self.sync_layer.save_current_state())
 
-        # --- ship confirmed inputs to spectators, then GC them
+        # --- ship confirmed inputs to spectators, then GC them (reference
+        # ordering: broadcast precedes GC with the same watermark, so GC can
+        # never discard a frame the spectators haven't been sent)
         self._send_confirmed_inputs_to_spectators(confirmed_frame)
         self.sync_layer.set_last_confirmed_frame(confirmed_frame, self.sparse_saving)
 
@@ -216,6 +218,16 @@ class P2PSession:
             endpoint.send_input(self.local_inputs, self.local_connect_status)
             endpoint.send_all_messages(self.socket)
         self.local_inputs.clear()
+
+        # --- second spectator broadcast: the watermark recomputed after the
+        # local inputs landed covers the current frame, so a host's spectators
+        # see frame f's confirmed input at tick f (the reference only ships it
+        # from tick f+1, p2p_session.rs:278,303). Queues are flushed here so
+        # the packet leaves this tick; GC stays with the earlier broadcast.
+        if self.num_spectators() > 0:
+            self._send_confirmed_inputs_to_spectators(self.confirmed_frame())
+            for endpoint in self.player_reg.spectators.values():
+                endpoint.send_all_messages(self.socket)
 
         # --- advance
         inputs = self.sync_layer.synchronized_inputs(self.local_connect_status)

@@ -40,6 +40,13 @@ from ..types import InputStatus
 GOLDEN = np.int32(np.uint32(fx.GOLDEN32).view(np.int32))
 
 
+def _wrap_i32(x: int) -> np.int32:
+    """Two's-complement int32 wrap of a Python int (numpy scalar overflow
+    wraps too, but emits RuntimeWarning; this is exact and silent)."""
+    x &= 0xFFFFFFFF
+    return np.int32(x - (1 << 32) if x >= (1 << 31) else x)
+
+
 def _exact_floor_div(a, b):
     """floor(a / b) for int32 a (|a| < 2^24), b in [1, 2^12], branch-free.
 
@@ -123,7 +130,7 @@ def _checksum_packed(px, py, vx, vy, rot, gi, frame, n_entities):
         + jnp.sum(vx * ((2 * n + 2 * gi + 1) * g))
         + jnp.sum(vy * ((2 * n + 2 * gi + 2) * g))
         + jnp.sum(rot * ((4 * n + gi + 1) * g))
-        + frame * ((5 * n + 1) * g)
+        + frame * _wrap_i32((5 * int(n) + 1) * int(g))
     )
     lo = (
         jnp.sum(px) + jnp.sum(py) + jnp.sum(vx) + jnp.sum(vy) + jnp.sum(rot)
