@@ -15,7 +15,7 @@ import os
 from typing import List, Optional
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libggrs_native.so")
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 # native/input_queue.cpp MAX_INPUT_SIZE — builder validates against this
 NATIVE_MAX_INPUT_SIZE = 64
 

@@ -49,6 +49,7 @@ class SessionBuilder:
         self.rng = None  # optional injected random.Random for endpoint magics
         self.use_native_queues = False
         self.use_native_endpoints = False
+        self.use_native_sessions = False
         self.deferred_checksum_lag = 0
 
     # ------------------------------------------------------------------
@@ -192,6 +193,29 @@ class SessionBuilder:
         self.use_native_endpoints = enabled
         return self
 
+    def with_native_sessions(self, enabled: bool = True) -> "SessionBuilder":
+        """Back the whole session layer — sync layer, per-frame pipeline,
+        rollback driver, message pump — with the C++ session core
+        (native/session.cpp) instead of the Python sessions. The session
+        composes the C++ input queues and C++ endpoints natively, so a full
+        tick runs without touching Python; the request/cell contract, wire
+        format and event surface are unchanged. Requires the native library
+        (make -C native); inputs are capped at 64 bytes, players at 16."""
+        if enabled:
+            from ..native import NATIVE_MAX_INPUT_SIZE, available
+
+            if not available():
+                raise InvalidRequest(
+                    "Native sessions require the native library (make -C native)."
+                )
+            if self.input_size > NATIVE_MAX_INPUT_SIZE:
+                raise InvalidRequest(
+                    f"Native sessions support at most {NATIVE_MAX_INPUT_SIZE}"
+                    f"-byte inputs (got {self.input_size})."
+                )
+        self.use_native_sessions = enabled
+        return self
+
     # ------------------------------------------------------------------
     # session constructors
     # ------------------------------------------------------------------
@@ -200,6 +224,17 @@ class SessionBuilder:
         """(src/sessions/builder.rs:342-354)"""
         if self.check_distance >= self.max_prediction:
             raise InvalidRequest("Check distance too big.")
+        if self.use_native_sessions:
+            from ..native.session import NativeSyncTestSession
+
+            return NativeSyncTestSession(
+                self.num_players,
+                self.max_prediction,
+                self.check_distance,
+                self.input_delay,
+                self.input_size,
+                deferred_checksum_lag=self.deferred_checksum_lag,
+            )
         return SyncTestSession(
             self.num_players,
             self.max_prediction,
@@ -220,6 +255,25 @@ class SessionBuilder:
                     "Not enough players have been added. Keep registering players "
                     "up to the defined player number."
                 )
+
+        if self.use_native_sessions:
+            from ..native.session import NativeP2PSession
+
+            return NativeP2PSession(
+                num_players=self.num_players,
+                max_prediction=self.max_prediction,
+                socket=socket,
+                handles=dict(self.handles),
+                sparse_saving=self.sparse_saving,
+                desync_detection=self.desync_detection,
+                input_delay=self.input_delay,
+                input_size=self.input_size,
+                fps=self.fps,
+                disconnect_timeout_ms=self.disconnect_timeout_ms,
+                disconnect_notify_start_ms=self.disconnect_notify_start_ms,
+                clock=self.clock,
+                rng=self.rng,
+            )
 
         registry = PlayerRegistry(dict(self.handles))
         # group handles by unique remote address; one endpoint per address
@@ -265,6 +319,24 @@ class SessionBuilder:
     def start_spectator_session(self, host_addr: Any, socket: Any):
         """(src/sessions/builder.rs:310-334)"""
         from .spectator_session import SpectatorSession
+
+        if self.use_native_sessions:
+            from ..native.session import NativeSpectatorSession
+
+            return NativeSpectatorSession(
+                num_players=self.num_players,
+                socket=socket,
+                host_addr=host_addr,
+                max_prediction=self.max_prediction,
+                max_frames_behind=self.max_frames_behind,
+                catchup_speed=self.catchup_speed,
+                input_size=self.input_size,
+                fps=self.fps,
+                disconnect_timeout_ms=self.disconnect_timeout_ms,
+                disconnect_notify_start_ms=self.disconnect_notify_start_ms,
+                clock=self.clock,
+                rng=self.rng,
+            )
 
         host = self._endpoint_cls()(
             handles=list(range(self.num_players)),

@@ -135,7 +135,9 @@ class P2PSession:
         self.event_queue: Deque[Event] = deque()
         self.local_inputs: Dict[PlayerHandle, PlayerInput] = {}
         self.local_checksum_history: Dict[Frame, int] = {}
-        self._pending_checksum_report = None  # (frame, checksum getter)
+        # (frame, cell, getter-or-None); the getter binds on the first flush
+        # attempt, one tick after capture — see _flush_pending_checksum_report
+        self._pending_checksum_report = None
         self._wire_dispatch = None  # decided on first poll (socket+endpoints)
 
     # ------------------------------------------------------------------
@@ -530,6 +532,16 @@ class P2PSession:
     def _check_checksum_send_interval(self, confirmed_frame: Frame) -> None:
         interval = self.desync_detection.interval
         current = self.sync_layer.current_frame
+        # Flush BEFORE capturing this tick's observation: a report captured
+        # at tick t may cover a frame whose *correcting* rollback is still in
+        # tick t's (unfulfilled) request list — its cell only becomes final
+        # after the caller fulfills those requests. Reading it on a later
+        # tick guarantees the reported value is the converged one; reading
+        # it in the same tick can publish a mid-correction checksum and
+        # raise false desyncs.
+        self._flush_pending_checksum_report(
+            force=current % interval == interval - 1
+        )
         # Deliberate divergence from the reference (p2p_session.rs:903): it
         # reports last_saved-1, which under misprediction is a *speculative*
         # frame — both peers would checksum half-predicted states and raise
@@ -540,20 +552,13 @@ class P2PSession:
             cell = self.sync_layer.saved_state_by_frame(frame_to_send)
             # the confirmed frame may have rotated out of the snapshot ring
             if cell is not None:
-                # Capture the observation now (ring slots are reused), but
-                # emit the report only once the checksum is materialized:
-                # on the device backend forcing it immediately would stall
-                # the tick on a device->host transfer. Reports are periodic
+                # Capture the cell, not its value: the checksum is read at
+                # flush time (next tick at the earliest), after the caller
+                # fulfilled this tick's requests. On the device backend the
+                # value may also materialize lazily — reports are periodic
                 # and peers compare by frame number, so a few ticks of send
                 # latency is harmless.
-                getter = cell.checksum_getter()
-                prefetch = getattr(getter, "prefetch", None)
-                if callable(prefetch):
-                    prefetch()
-                self._pending_checksum_report = (frame_to_send, getter)
-        self._flush_pending_checksum_report(
-            force=current % interval == interval - 1
-        )
+                self._pending_checksum_report = (frame_to_send, cell, None)
         if len(self.local_checksum_history) > MAX_CHECKSUM_HISTORY_SIZE:
             keep_after = current - MAX_CHECKSUM_HISTORY_SIZE
             self.local_checksum_history = {
@@ -561,13 +566,28 @@ class P2PSession:
             }
 
     def _flush_pending_checksum_report(self, force: bool) -> None:
-        """Emit the captured checksum report once its value is host-ready;
-        `force` bounds the delay to one desync interval."""
+        """Emit the captured checksum report once its cell is final and its
+        value host-ready; `force` bounds the delay to one desync interval.
+
+        The getter is bound on the FIRST flush attempt — one tick after
+        capture, when the caller has fulfilled the capturing tick's requests
+        and the cell holds the converged value — and then kept, because
+        getters are stable across later overwrites of the (reused) ring slot
+        (sync_layer.py:95-104) while the cell itself is not."""
         pending = self._pending_checksum_report
         if pending is None:
             return
-        frame, getter = pending
+        frame, cell, getter = pending
+        if getter is None:
+            if cell.frame != frame:  # ring slot reused before the first read
+                self._pending_checksum_report = None
+                return
+            getter = cell.checksum_getter()
+            self._pending_checksum_report = (frame, cell, getter)
         if not force and not getattr(getter, "ready", True):
+            prefetch = getattr(getter, "prefetch", None)
+            if callable(prefetch):
+                prefetch()
             return
         checksum = getter()
         if checksum is not None:

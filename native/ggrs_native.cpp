@@ -164,7 +164,8 @@ void ggrs_weighted_checksum(const uint32_t* words, long n, uint32_t* hi,
 
 // ABI version for the ctypes loader to sanity-check. Bump whenever exported
 // symbols change (v2: added the ggrs_iq_* input-queue family; v3: the
-// ggrs_ep_* reliability endpoint and ggrs_udp_* socket families).
-long ggrs_native_abi_version() { return 3; }
+// ggrs_ep_* reliability endpoint and ggrs_udp_* socket families; v4: the
+// ggrs_sess_* session core family).
+long ggrs_native_abi_version() { return 4; }
 
 }  // extern "C"

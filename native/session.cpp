@@ -1,0 +1,1360 @@
+// Native session core: the C++ twin of the session layer —
+// SyncLayer (ggrs_tpu/sync_layer.py; reference src/sync_layer.rs),
+// P2PSession (ggrs_tpu/sessions/p2p_session.py; reference
+// src/sessions/p2p_session.rs), SyncTestSession
+// (ggrs_tpu/sessions/sync_test_session.py; reference
+// src/sessions/sync_test_session.rs) and SpectatorSession
+// (ggrs_tpu/sessions/spectator_session.py; reference
+// src/sessions/p2p_spectator_session.rs). The Python twins are the
+// behavioral oracles; tests drive native and Python sessions in lockstep.
+//
+// Composition happens natively: the session owns C++ input queues
+// (input_queue.cpp) and C++ reliability endpoints (endpoint.cpp) through
+// their C ABI, so a full tick — message intake, rollback bookkeeping,
+// input send — runs without touching Python. The boundaries that stay
+// host-side, exposed through the C ABI below:
+//   * wire I/O: the wrapper routes datagrams addr<->endpoint-index and owns
+//     the socket (UDP or the fault-injecting in-memory net),
+//   * game state: requests reference snapshot-ring cell indices; the
+//     wrapper owns the GameStateCells (user objects or device ring slots),
+//   * checksums: opaque to the core; the wrapper materializes them
+//     (possibly lazily off-device) and feeds them back for desync
+//     detection / SyncTest verification,
+//   * clocks: every stateful call takes now_ms, preserving the injectable
+//     fake-clock determinism seam.
+//
+// Error handling: operations the Python twins treat as exceptions return
+// negative codes (GGRS_SERR_*) so the binding can raise the same types.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <new>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// C ABI of the sibling translation units (input_queue.cpp, endpoint.cpp)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* ggrs_iq_new(int input_size);
+void ggrs_iq_free(void* h);
+void ggrs_iq_set_frame_delay(void* h, int delay);
+int32_t ggrs_iq_first_incorrect_frame(void* h);
+int32_t ggrs_iq_last_added_frame(void* h);
+void ggrs_iq_reset_prediction(void* h);
+long ggrs_iq_confirmed_input(void* h, int32_t frame, uint8_t* out);
+void ggrs_iq_discard_confirmed_frames(void* h, int32_t frame);
+long ggrs_iq_input(void* h, int32_t requested_frame, uint8_t* out);
+long ggrs_iq_add_input(void* h, int32_t frame, const uint8_t* buf);
+
+struct ggrs_ep_config {
+  int32_t handles[16];
+  long num_handles;
+  long num_players;
+  long local_players;
+  long max_prediction;
+  long disconnect_timeout_ms;
+  long disconnect_notify_start_ms;
+  long fps;
+  long input_size;
+  uint16_t magic;
+  uint64_t rng_seed;
+};
+
+struct ggrs_ep_event {
+  int32_t type;
+  int32_t a;
+  int32_t b;
+  int32_t frame;
+  int32_t player;
+  int32_t input_len;
+  uint8_t input[64];
+};
+
+struct ggrs_ep_stats {
+  int32_t send_queue_len;
+  uint32_t ping_ms;
+  uint32_t kbps_sent;
+  int32_t local_frames_behind;
+  int32_t remote_frames_behind;
+};
+
+void* ggrs_ep_new(const ggrs_ep_config* cfg, uint64_t now_ms);
+void ggrs_ep_free(void* ep);
+long ggrs_ep_state(void* ep);
+void ggrs_ep_synchronize(void* ep, uint64_t now_ms);
+void ggrs_ep_disconnect(void* ep, uint64_t now_ms);
+void ggrs_ep_poll(void* ep, const uint8_t* disc, const int32_t* last, long n,
+                  uint64_t now_ms);
+void ggrs_ep_send_input(void* ep, int32_t frame, const uint8_t* data, long len,
+                        const uint8_t* disc, const int32_t* last, long n,
+                        uint64_t now_ms);
+void ggrs_ep_send_checksum_report(void* ep, int32_t frame,
+                                  const uint8_t* csum16, uint64_t now_ms);
+long ggrs_ep_handle_message(void* ep, const uint8_t* buf, long len,
+                            uint64_t now_ms);
+void ggrs_ep_update_local_frame_advantage(void* ep, int32_t local_frame);
+long ggrs_ep_average_frame_advantage(void* ep);
+long ggrs_ep_next_send(void* ep, uint8_t* out, long cap);
+long ggrs_ep_next_event(void* ep, ggrs_ep_event* out);
+long ggrs_ep_network_stats(void* ep, uint64_t now_ms, ggrs_ep_stats* out);
+void ggrs_ep_peer_connect_status(void* ep, uint8_t* disc, int32_t* last, long n);
+long ggrs_ep_checksum_history(void* ep, int32_t* frames, uint8_t* sums16,
+                              long cap);
+
+}  // extern "C"
+
+namespace {
+
+constexpr int32_t NULL_FRAME = -1;
+constexpr int32_t INT32_MAX_FRAME = 0x7FFFFFFF;
+constexpr int MAX_PLAYERS = 16;
+constexpr int MAX_TOTAL_HANDLES = 32;
+constexpr int MAX_EPS = 32;
+constexpr int MAX_INPUT_SIZE = 64;
+constexpr size_t MAX_EVENT_QUEUE = 100;  // builder.py MAX_EVENT_QUEUE_SIZE
+constexpr int SPECTATOR_BUFFER = 60;     // builder.py SPECTATOR_BUFFER_SIZE
+constexpr int RECOMMENDATION_INTERVAL = 60;  // p2p_session.py:54
+constexpr int MIN_RECOMMENDATION = 3;        // p2p_session.py:55
+constexpr size_t MAX_CHECKSUM_HISTORY = 32;  // protocol MAX_CHECKSUM_HISTORY_SIZE
+
+// session types
+constexpr int32_t SESS_P2P = 0;
+constexpr int32_t SESS_SYNCTEST = 1;
+constexpr int32_t SESS_SPECTATOR = 2;
+
+// player kinds (types.py PlayerTypeKind)
+constexpr int32_t KIND_LOCAL = 0;
+constexpr int32_t KIND_REMOTE = 1;
+constexpr int32_t KIND_SPECTATOR = 2;
+
+// endpoint protocol states (endpoint.cpp State)
+constexpr long EP_RUNNING = 2;
+constexpr long EP_DISCONNECTED = 3;
+constexpr long EP_SHUTDOWN = 4;
+
+// endpoint event tags (endpoint.cpp EV_*)
+constexpr int32_t EP_EV_SYNCHRONIZING = 1;
+constexpr int32_t EP_EV_SYNCHRONIZED = 2;
+constexpr int32_t EP_EV_INPUT = 3;
+constexpr int32_t EP_EV_DISCONNECTED = 4;
+constexpr int32_t EP_EV_INTERRUPTED = 5;
+constexpr int32_t EP_EV_RESUMED = 6;
+
+// session event tags (shared with ggrs_tpu/native/session.py)
+constexpr int32_t SEV_SYNCHRONIZING = 1;
+constexpr int32_t SEV_SYNCHRONIZED = 2;
+constexpr int32_t SEV_DISCONNECTED = 3;
+constexpr int32_t SEV_INTERRUPTED = 4;
+constexpr int32_t SEV_RESUMED = 5;
+constexpr int32_t SEV_WAIT_RECOMMENDATION = 6;
+constexpr int32_t SEV_DESYNC_DETECTED = 7;
+
+// request tags (types.py SaveGameState/LoadGameState/AdvanceFrame)
+constexpr int32_t REQ_SAVE = 0;
+constexpr int32_t REQ_LOAD = 1;
+constexpr int32_t REQ_ADVANCE = 2;
+
+// input statuses (types.py InputStatus)
+constexpr int32_t STATUS_CONFIRMED = 0;
+constexpr int32_t STATUS_PREDICTED = 1;
+constexpr int32_t STATUS_DISCONNECTED = 2;
+
+// error codes (errors.py via ggrs_tpu/native/session.py)
+constexpr long SERR_NOT_SYNCHRONIZED = -2;
+constexpr long SERR_PREDICTION_THRESHOLD = -3;
+constexpr long SERR_MISSING_INPUT = -4;
+constexpr long SERR_MISMATCHED_CHECKSUM = -5;
+constexpr long SERR_SPECTATOR_TOO_FAR_BEHIND = -6;
+constexpr long SERR_INVALID_HANDLE = -7;
+constexpr long SERR_LOCAL_PLAYER = -8;
+constexpr long SERR_ALREADY_DISCONNECTED = -9;
+constexpr long SERR_INTERNAL = -10;
+constexpr long SERR_CAPACITY = -11;
+
+struct ConnStatus {
+  bool disconnected = false;
+  int32_t last_frame = NULL_FRAME;
+};
+
+struct Checksum {
+  bool has = false;  // user may save without a checksum (None in Python)
+  uint8_t bytes[16] = {0};
+
+  bool operator==(const Checksum& o) const {
+    return has == o.has && std::memcmp(bytes, o.bytes, 16) == 0;
+  }
+};
+
+struct Req {
+  int32_t type;
+  int32_t frame;
+  int32_t cell;
+  int32_t statuses[MAX_PLAYERS];
+  uint8_t inputs[MAX_PLAYERS * MAX_INPUT_SIZE];
+};
+
+struct SessEvent {
+  int32_t type = 0;
+  int32_t ep = -1;
+  int32_t a = 0;
+  int32_t b = 0;
+  uint8_t local_checksum[16] = {0};
+  uint8_t remote_checksum[16] = {0};
+};
+
+// xorshift64* (same generator as endpoint.cpp, independently seeded)
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ? seed : 0x9E3779B97F4A7C15ull) {}
+  uint64_t next() {
+    s ^= s >> 12;
+    s ^= s << 25;
+    s ^= s >> 27;
+    return s * 0x2545F4914F6CDD1Dull;
+  }
+};
+
+// The C4 twin: snapshot-ring bookkeeping + per-player queues
+// (ggrs_tpu/sync_layer.py SyncLayer; reference src/sync_layer.rs:78-273).
+// Cells hold only the frame stamp; snapshot data lives with the caller.
+struct NativeSyncLayer {
+  int num_players = 0;
+  int max_prediction = 0;
+  int input_size = 0;
+  std::vector<int32_t> ring_frames;  // frame % (max_prediction + 2) addressing
+  int32_t last_confirmed_frame = NULL_FRAME;
+  int32_t last_saved_frame = NULL_FRAME;
+  int32_t current_frame = 0;
+  void* queues[MAX_PLAYERS] = {nullptr};
+
+  bool init(int np, int maxp, int isz) {
+    num_players = np;
+    max_prediction = maxp;
+    input_size = isz;
+    ring_frames.assign(maxp + 2, NULL_FRAME);
+    for (int i = 0; i < np; ++i) {
+      queues[i] = ggrs_iq_new(isz);
+      if (!queues[i]) return false;
+    }
+    return true;
+  }
+
+  ~NativeSyncLayer() {
+    for (auto*& q : queues) {
+      if (q) ggrs_iq_free(q);
+      q = nullptr;
+    }
+  }
+
+  int cell_of(int32_t frame) const {
+    return static_cast<int>(frame % static_cast<int32_t>(ring_frames.size()));
+  }
+
+  void save_current_state(Req* r) {
+    last_saved_frame = current_frame;
+    int cell = cell_of(current_frame);
+    ring_frames[cell] = current_frame;
+    r->type = REQ_SAVE;
+    r->frame = current_frame;
+    r->cell = cell;
+  }
+
+  // (sync_layer.py load_frame; reference src/sync_layer.rs:139-155)
+  long load_frame(int32_t frame_to_load, Req* r) {
+    if (frame_to_load == NULL_FRAME || frame_to_load >= current_frame ||
+        frame_to_load < current_frame - max_prediction)
+      return SERR_INTERNAL;
+    int cell = cell_of(frame_to_load);
+    if (ring_frames[cell] != frame_to_load) return SERR_INTERNAL;
+    current_frame = frame_to_load;
+    r->type = REQ_LOAD;
+    r->frame = frame_to_load;
+    r->cell = cell;
+    return 0;
+  }
+
+  // prediction-threshold gate + queue insert (sync_layer.py add_local_input;
+  // reference src/sync_layer.rs:159-174). Returns the landed frame or error.
+  long add_local_input(int handle, const uint8_t* buf) {
+    int32_t frames_ahead = current_frame - last_confirmed_frame;
+    if (current_frame >= max_prediction && frames_ahead >= max_prediction)
+      return SERR_PREDICTION_THRESHOLD;
+    long rc = ggrs_iq_add_input(queues[handle], current_frame, buf);
+    if (rc < 0) return SERR_INTERNAL;  // dropped or sequence violation
+    return rc;
+  }
+
+  void reset_prediction() {
+    for (int i = 0; i < num_players; ++i) ggrs_iq_reset_prediction(queues[i]);
+  }
+
+  // (sync_layer.py synchronized_inputs; reference src/sync_layer.rs:187-200)
+  long synchronized_inputs(const ConnStatus* status, Req* r) {
+    r->type = REQ_ADVANCE;
+    r->frame = current_frame;
+    r->cell = -1;
+    std::memset(r->inputs, 0, sizeof(r->inputs));
+    for (int i = 0; i < num_players; ++i) {
+      uint8_t* out = r->inputs + i * input_size;
+      if (status[i].disconnected && status[i].last_frame < current_frame) {
+        r->statuses[i] = STATUS_DISCONNECTED;  // zeroed dummy
+      } else {
+        long rc = ggrs_iq_input(queues[i], current_frame, out);
+        if (rc < 0) return SERR_INTERNAL;
+        r->statuses[i] = rc == 0 ? STATUS_CONFIRMED : STATUS_PREDICTED;
+      }
+    }
+    return 0;
+  }
+
+  // (sync_layer.py confirmed_inputs; reference src/sync_layer.rs:203-217)
+  long confirmed_inputs(int32_t frame, const ConnStatus* status, uint8_t* out) {
+    for (int i = 0; i < num_players; ++i) {
+      uint8_t* dst = out + i * input_size;
+      if (status[i].disconnected && status[i].last_frame < frame) {
+        std::memset(dst, 0, input_size);
+      } else {
+        long rc = ggrs_iq_confirmed_input(queues[i], frame, dst);
+        if (rc < 0) return SERR_INTERNAL;
+      }
+    }
+    return 0;
+  }
+
+  // (sync_layer.py set_last_confirmed_frame; reference src/sync_layer.rs:220-244)
+  long set_last_confirmed_frame(int32_t frame, bool sparse_saving) {
+    int32_t first_incorrect = NULL_FRAME;
+    for (int i = 0; i < num_players; ++i)
+      first_incorrect =
+          std::max(first_incorrect, ggrs_iq_first_incorrect_frame(queues[i]));
+
+    if (sparse_saving) frame = std::min(frame, last_saved_frame);
+
+    if (!(first_incorrect == NULL_FRAME || first_incorrect >= frame))
+      return SERR_INTERNAL;  // would discard inputs still needed for rollback
+    last_confirmed_frame = frame;
+    if (last_confirmed_frame > 0)
+      for (int i = 0; i < num_players; ++i)
+        ggrs_iq_discard_confirmed_frames(queues[i], frame - 1);
+    return 0;
+  }
+
+  // (sync_layer.py check_simulation_consistency; reference src/sync_layer.rs:247-257)
+  int32_t check_simulation_consistency(int32_t first_incorrect) const {
+    for (int i = 0; i < num_players; ++i) {
+      int32_t incorrect = ggrs_iq_first_incorrect_frame(queues[i]);
+      if (incorrect != NULL_FRAME &&
+          (first_incorrect == NULL_FRAME || incorrect < first_incorrect))
+        first_incorrect = incorrect;
+    }
+    return first_incorrect;
+  }
+
+  bool has_saved_frame(int32_t frame) const {
+    return frame >= 0 &&
+           ring_frames[frame % static_cast<int32_t>(ring_frames.size())] == frame;
+  }
+};
+
+struct EndpointSlot {
+  void* ep = nullptr;
+  std::vector<int32_t> handles;  // sorted player handles behind this address
+  bool is_spectator = false;     // spectator endpoint of a P2P host
+};
+
+struct Session {
+  // config
+  int32_t type = SESS_P2P;
+  int num_players = 0;
+  int max_prediction = 0;
+  int input_size = 0;
+  bool sparse_saving = false;
+  int desync_interval = 0;  // 0 = off
+  int check_distance = 0;
+  int max_frames_behind = 10;
+  int catchup_speed = 1;
+  int total_handles = 0;
+  int32_t kinds[MAX_TOTAL_HANDLES];
+  int32_t ep_of_handle[MAX_TOTAL_HANDLES];
+
+  // shared state
+  bool running = false;  // SessionState: false = SYNCHRONIZING
+  NativeSyncLayer sync;
+  std::vector<EndpointSlot> eps;
+  std::deque<SessEvent> events;
+  std::vector<Req> reqs;
+  int32_t last_error_frame = NULL_FRAME;
+
+  // p2p state (p2p_session.py __init__)
+  ConnStatus local_connect_status[MAX_PLAYERS];
+  int32_t disconnect_frame = NULL_FRAME;
+  int32_t next_recommended_sleep = 0;
+  int32_t next_spectator_frame = 0;
+  int32_t frames_ahead = 0;
+  bool staged_valid[MAX_PLAYERS] = {false};
+  uint8_t staged_inputs[MAX_PLAYERS][MAX_INPUT_SIZE];
+  int32_t pending_checksum_request = NULL_FRAME;
+  std::map<int32_t, Checksum> local_checksum_history;
+
+  // synctest state
+  ConnStatus dummy_status[MAX_PLAYERS];
+  std::map<int32_t, Checksum> st_history;
+
+  // spectator state (spectator_session.py __init__)
+  int32_t spec_current_frame = NULL_FRAME;
+  int32_t spec_last_recv_frame = NULL_FRAME;
+  struct SpecSlot {
+    int32_t frame = NULL_FRAME;
+    uint8_t buf[MAX_INPUT_SIZE] = {0};
+  };
+  std::vector<SpecSlot> spec_inputs;  // SPECTATOR_BUFFER * num_players
+  ConnStatus host_connect_status[MAX_PLAYERS];
+
+  // wire drain cursor
+  size_t drain_ep = 0;
+
+  void push_event(const SessEvent& ev) {
+    events.push_back(ev);
+    while (events.size() > MAX_EVENT_QUEUE) events.pop_front();
+  }
+
+  bool ep_synchronized(const EndpointSlot& slot) const {
+    long s = ggrs_ep_state(slot.ep);
+    return s == EP_RUNNING || s == EP_DISCONNECTED || s == EP_SHUTDOWN;
+  }
+
+  // (p2p_session.py _check_initial_sync)
+  void check_initial_sync() {
+    if (running) return;
+    for (const auto& slot : eps)
+      if (!ep_synchronized(slot)) return;
+    running = true;
+  }
+
+  void pack_status(uint8_t* disc, int32_t* last) const {
+    const ConnStatus* src =
+        type == SESS_SPECTATOR ? host_connect_status : local_connect_status;
+    for (int i = 0; i < num_players; ++i) {
+      disc[i] = src[i].disconnected ? 1 : 0;
+      last[i] = src[i].last_frame;
+    }
+  }
+
+  // ---- P2P internals --------------------------------------------------
+
+  // (p2p_session.py confirmed_frame; reference p2p_session.rs:487-498)
+  int32_t confirmed_frame() const {
+    int32_t confirmed = INT32_MAX_FRAME;
+    for (int i = 0; i < num_players; ++i)
+      if (!local_connect_status[i].disconnected)
+        confirmed = std::min(confirmed, local_connect_status[i].last_frame);
+    return confirmed;  // INT32_MAX_FRAME = every player disconnected
+  }
+
+  // (p2p_session.py _disconnect_player_at_frame; reference p2p_session.rs:555-595)
+  void disconnect_player_at_frame(int handle, int32_t last_frame, uint64_t now) {
+    int32_t kind = kinds[handle];
+    int ep_idx = ep_of_handle[handle];
+    if (kind == KIND_REMOTE && ep_idx >= 0) {
+      EndpointSlot& slot = eps[ep_idx];
+      for (int32_t h : slot.handles)
+        if (h < num_players) local_connect_status[h].disconnected = true;
+      ggrs_ep_disconnect(slot.ep, now);
+      if (sync.current_frame > last_frame)
+        // resimulate from the disconnect so predictions made for the dead
+        // player are redone with Disconnected dummy inputs
+        disconnect_frame = last_frame + 1;
+    } else if (kind == KIND_SPECTATOR && ep_idx >= 0) {
+      ggrs_ep_disconnect(eps[ep_idx].ep, now);
+    }
+    check_initial_sync();
+  }
+
+  // (p2p_session.py _update_player_disconnects; reference p2p_session.rs:707-742)
+  void update_player_disconnects(uint64_t now) {
+    // one status fetch per running endpoint, reused across all handles (the
+    // statuses cannot change mid-loop — no packets are processed here); the
+    // running check stays per-iteration because an earlier handle's
+    // disconnect can stop an endpoint, and the Python twin re-evaluates it
+    uint8_t disc[MAX_EPS][MAX_PLAYERS];
+    int32_t last[MAX_EPS][MAX_PLAYERS];
+    bool fetched[MAX_EPS];
+    for (size_t e = 0; e < eps.size(); ++e) {
+      fetched[e] = !eps[e].is_spectator && ggrs_ep_state(eps[e].ep) == EP_RUNNING;
+      if (fetched[e])
+        ggrs_ep_peer_connect_status(eps[e].ep, disc[e], last[e], num_players);
+    }
+    for (int handle = 0; handle < num_players; ++handle) {
+      bool queue_connected = true;
+      int32_t queue_min_confirmed = INT32_MAX_FRAME;
+      for (size_t e = 0; e < eps.size(); ++e) {
+        if (!fetched[e] || ggrs_ep_state(eps[e].ep) != EP_RUNNING) continue;
+        queue_connected = queue_connected && !disc[e][handle];
+        queue_min_confirmed = std::min(queue_min_confirmed, last[e][handle]);
+      }
+
+      bool local_connected = !local_connect_status[handle].disconnected;
+      int32_t local_min_confirmed = local_connect_status[handle].last_frame;
+      if (local_connected)
+        queue_min_confirmed = std::min(queue_min_confirmed, local_min_confirmed);
+
+      if (!queue_connected &&
+          (local_connected || local_min_confirmed > queue_min_confirmed))
+        disconnect_player_at_frame(handle, queue_min_confirmed, now);
+    }
+  }
+
+  // (p2p_session.py _adjust_gamestate; reference p2p_session.rs:621-673)
+  long adjust_gamestate(int32_t first_incorrect, int32_t min_confirmed) {
+    int32_t current_frame = sync.current_frame;
+    int32_t frame_to_load =
+        sparse_saving ? sync.last_saved_frame : first_incorrect;
+    if (frame_to_load > first_incorrect) return SERR_INTERNAL;
+    int32_t count = current_frame - frame_to_load;
+
+    reqs.emplace_back();
+    long rc = sync.load_frame(frame_to_load, &reqs.back());
+    if (rc < 0) return rc;
+    sync.reset_prediction();
+
+    for (int32_t i = 0; i < count; ++i) {
+      Req advance;
+      rc = sync.synchronized_inputs(
+          type == SESS_SYNCTEST ? dummy_status : local_connect_status, &advance);
+      if (rc < 0) return rc;
+      if (type == SESS_P2P && sparse_saving) {
+        if (sync.current_frame == min_confirmed) {
+          reqs.emplace_back();
+          sync.save_current_state(&reqs.back());
+        }
+      } else {
+        if (i > 0) {
+          reqs.emplace_back();
+          sync.save_current_state(&reqs.back());
+        }
+      }
+      sync.current_frame += 1;
+      reqs.push_back(advance);
+    }
+    return sync.current_frame == current_frame ? 0 : SERR_INTERNAL;
+  }
+
+  // sparse-saving keepalive of the snapshot ring
+  // (p2p_session.py _check_last_saved_state; reference p2p_session.rs:778-802)
+  long check_last_saved_state(int32_t last_saved, int32_t confirmed) {
+    if (sync.current_frame - last_saved >= max_prediction) {
+      if (confirmed >= sync.current_frame) {
+        reqs.emplace_back();
+        sync.save_current_state(&reqs.back());
+      } else {
+        long rc = adjust_gamestate(last_saved, confirmed);
+        if (rc < 0) return rc;
+      }
+    }
+    return 0;
+  }
+
+  // (p2p_session.py _send_confirmed_inputs_to_spectators; reference
+  // p2p_session.rs:676-703)
+  long send_confirmed_inputs_to_spectators(int32_t confirmed, uint64_t now) {
+    bool have_spectators = false;
+    for (const auto& slot : eps) have_spectators |= slot.is_spectator;
+    if (!have_spectators) return 0;
+
+    uint8_t disc[MAX_PLAYERS];
+    int32_t last[MAX_PLAYERS];
+    uint8_t data[MAX_PLAYERS * MAX_INPUT_SIZE];
+    while (next_spectator_frame <= confirmed) {
+      long rc = sync.confirmed_inputs(next_spectator_frame, local_connect_status,
+                                      data);
+      if (rc < 0) return rc;
+      pack_status(disc, last);
+      for (auto& slot : eps) {
+        if (!slot.is_spectator) continue;
+        if (ggrs_ep_state(slot.ep) != EP_RUNNING) continue;
+        ggrs_ep_send_input(slot.ep, next_spectator_frame, data,
+                           num_players * input_size, disc, last, num_players,
+                           now);
+      }
+      next_spectator_frame += 1;
+    }
+    return 0;
+  }
+
+  // (p2p_session.py _max_frame_advantage / _check_wait_recommendation)
+  void check_wait_recommendation() {
+    bool any = false;
+    int32_t interval = 0;
+    for (const auto& slot : eps) {
+      if (slot.is_spectator) continue;
+      for (int32_t h : slot.handles) {
+        if (h < num_players && !local_connect_status[h].disconnected) {
+          int32_t adv =
+              static_cast<int32_t>(ggrs_ep_average_frame_advantage(slot.ep));
+          interval = any ? std::max(interval, adv) : adv;
+          any = true;
+        }
+      }
+    }
+    frames_ahead = any ? interval : 0;
+
+    if (sync.current_frame > next_recommended_sleep &&
+        frames_ahead >= MIN_RECOMMENDATION) {
+      next_recommended_sleep = sync.current_frame + RECOMMENDATION_INTERVAL;
+      SessEvent ev;
+      ev.type = SEV_WAIT_RECOMMENDATION;
+      ev.a = frames_ahead;
+      push_event(ev);
+    }
+  }
+
+  // desync detection (p2p_session.py _check_checksum_send_interval; the
+  // materialization/flush policy lives in the Python wrapper, which answers
+  // pending_checksum_request via ggrs_sess_provide_checksum)
+  void check_checksum_send_interval(int32_t confirmed) {
+    int32_t current = sync.current_frame;
+    // only frames <= confirmed are bit-identical across peers (deliberate
+    // divergence from the reference, see p2p_session.py:530-538)
+    int32_t frame_to_send = std::min(sync.last_saved_frame - 1, confirmed);
+    if (current % desync_interval == 0 && frame_to_send > max_prediction &&
+        sync.has_saved_frame(frame_to_send))
+      pending_checksum_request = frame_to_send;
+
+    if (local_checksum_history.size() > MAX_CHECKSUM_HISTORY) {
+      int32_t keep_after = current - static_cast<int32_t>(MAX_CHECKSUM_HISTORY);
+      for (auto it = local_checksum_history.begin();
+           it != local_checksum_history.end();) {
+        if (it->first <= keep_after)
+          it = local_checksum_history.erase(it);
+        else
+          ++it;
+      }
+    }
+  }
+
+  // (p2p_session.py _compare_local_checksums_against_peers)
+  void compare_checksums_against_peers() {
+    if (sync.current_frame % desync_interval != 0) return;
+    int32_t frames[64];
+    uint8_t sums[64 * 16];
+    for (size_t e = 0; e < eps.size(); ++e) {
+      if (eps[e].is_spectator) continue;
+      long n = ggrs_ep_checksum_history(eps[e].ep, frames, sums, 64);
+      for (long i = 0; i < n; ++i) {
+        auto it = local_checksum_history.find(frames[i]);
+        if (it == local_checksum_history.end() || !it->second.has) continue;
+        if (std::memcmp(it->second.bytes, sums + i * 16, 16) != 0) {
+          SessEvent ev;
+          ev.type = SEV_DESYNC_DETECTED;
+          ev.ep = static_cast<int32_t>(e);
+          ev.a = frames[i];
+          std::memcpy(ev.local_checksum, it->second.bytes, 16);
+          std::memcpy(ev.remote_checksum, sums + i * 16, 16);
+          push_event(ev);
+        }
+      }
+    }
+  }
+
+  // (p2p_session.py _handle_event; reference p2p_session.rs:805-871)
+  void handle_ep_event(const ggrs_ep_event& ev, size_t ep_idx, uint64_t now) {
+    const EndpointSlot& slot = eps[ep_idx];
+    SessEvent out;
+    out.ep = static_cast<int32_t>(ep_idx);
+    switch (ev.type) {
+      case EP_EV_SYNCHRONIZING:
+        out.type = SEV_SYNCHRONIZING;
+        out.a = ev.a;
+        out.b = ev.b;
+        push_event(out);
+        break;
+      case EP_EV_SYNCHRONIZED:
+        if (type == SESS_SPECTATOR)
+          running = true;
+        else
+          check_initial_sync();
+        out.type = SEV_SYNCHRONIZED;
+        push_event(out);
+        break;
+      case EP_EV_INTERRUPTED:
+        out.type = SEV_INTERRUPTED;
+        out.a = ev.a;
+        push_event(out);
+        break;
+      case EP_EV_RESUMED:
+        out.type = SEV_RESUMED;
+        push_event(out);
+        break;
+      case EP_EV_DISCONNECTED:
+        if (type == SESS_P2P) {
+          for (int32_t h : slot.handles) {
+            int32_t last_frame = h < num_players
+                                     ? local_connect_status[h].last_frame
+                                     : NULL_FRAME;  // spectator
+            disconnect_player_at_frame(h, last_frame, now);
+          }
+        }
+        out.type = SEV_DISCONNECTED;
+        push_event(out);
+        break;
+      case EP_EV_INPUT:
+        if (type == SESS_P2P) {
+          int32_t player = ev.player;
+          if (player < 0 || player >= num_players) break;
+          if (local_connect_status[player].disconnected) break;
+          int32_t current_remote = local_connect_status[player].last_frame;
+          // remote inputs must arrive in sequence; the endpoint guarantees
+          // this, so a violation is a protocol bug — drop defensively where
+          // the Python twin asserts
+          if (!(current_remote == NULL_FRAME || current_remote + 1 == ev.frame))
+            break;
+          local_connect_status[player].last_frame = ev.frame;
+          ggrs_iq_add_input(sync.queues[player], ev.frame, ev.input);
+        } else if (type == SESS_SPECTATOR) {
+          // (spectator_session.py _handle_event EvInput branch)
+          if (ev.frame < spec_last_recv_frame) break;  // defensive
+          SpecSlot& cell =
+              spec_inputs[(ev.frame % SPECTATOR_BUFFER) * num_players +
+                          ev.player];
+          cell.frame = ev.frame;
+          std::memcpy(cell.buf, ev.input, input_size);
+          spec_last_recv_frame = ev.frame;
+          ggrs_ep_update_local_frame_advantage(slot.ep, ev.frame);
+          uint8_t disc[MAX_PLAYERS];
+          int32_t last[MAX_PLAYERS];
+          ggrs_ep_peer_connect_status(slot.ep, disc, last, num_players);
+          for (int i = 0; i < num_players; ++i) {
+            host_connect_status[i].disconnected = disc[i] != 0;
+            host_connect_status[i].last_frame = last[i];
+          }
+        }
+        break;
+      default:
+        break;
+    }
+  }
+
+  // (p2p_session.py poll_remote_clients minus socket I/O, which the wrapper
+  // does around this; reference p2p_session.rs:375-423)
+  void poll(uint64_t now) {
+    if (type != SESS_SPECTATOR) {
+      for (const auto& slot : eps) {
+        if (slot.is_spectator) continue;
+        if (ggrs_ep_state(slot.ep) == EP_RUNNING)
+          ggrs_ep_update_local_frame_advantage(slot.ep, sync.current_frame);
+      }
+    }
+
+    uint8_t disc[MAX_PLAYERS];
+    int32_t last[MAX_PLAYERS];
+    pack_status(disc, last);
+
+    // collect all events first, then handle — matches the Python twin's
+    // two-phase loop so a disconnect triggered by one endpoint's event
+    // doesn't change which events later endpoints emit this poll
+    std::vector<std::pair<ggrs_ep_event, size_t>> collected;
+    for (size_t e = 0; e < eps.size(); ++e) {
+      ggrs_ep_poll(eps[e].ep, disc, last, num_players, now);
+      ggrs_ep_event ev;
+      while (ggrs_ep_next_event(eps[e].ep, &ev)) collected.emplace_back(ev, e);
+    }
+    for (const auto& [ev, e] : collected) handle_ep_event(ev, e, now);
+  }
+
+  // ---- per-session-type advance ---------------------------------------
+
+  // (p2p_session.py advance_frame; reference p2p_session.rs:253-371)
+  long advance_p2p(uint64_t now) {
+    if (!running) return SERR_NOT_SYNCHRONIZED;
+    reqs.clear();
+
+    if (sync.current_frame == 0) {
+      reqs.emplace_back();
+      sync.save_current_state(&reqs.back());
+    }
+
+    update_player_disconnects(now);
+    int32_t confirmed = confirmed_frame();
+    if (confirmed == INT32_MAX_FRAME) return SERR_INTERNAL;
+
+    int32_t first_incorrect = sync.check_simulation_consistency(disconnect_frame);
+    if (first_incorrect != NULL_FRAME) {
+      // a disconnect recorded at exactly the current frame needs no rollback
+      // (see p2p_session.py:176-182)
+      if (first_incorrect < sync.current_frame) {
+        long rc = adjust_gamestate(first_incorrect, confirmed);
+        if (rc < 0) return rc;
+      }
+      disconnect_frame = NULL_FRAME;
+    }
+
+    int32_t last_saved = sync.last_saved_frame;
+    if (sparse_saving) {
+      long rc = check_last_saved_state(last_saved, confirmed);
+      if (rc < 0) return rc;
+    } else {
+      reqs.emplace_back();
+      sync.save_current_state(&reqs.back());
+    }
+
+    // broadcast precedes GC with the same watermark, so GC can never discard
+    // a frame the spectators haven't been sent
+    long rc = send_confirmed_inputs_to_spectators(confirmed, now);
+    if (rc < 0) return rc;
+    rc = sync.set_last_confirmed_frame(confirmed, sparse_saving);
+    if (rc < 0) return rc;
+
+    if (desync_interval > 0) {
+      check_checksum_send_interval(confirmed);
+      compare_checksums_against_peers();
+    }
+
+    check_wait_recommendation();
+
+    // register local inputs (stamped with the current frame at staging time)
+    int32_t actual_frame = NULL_FRAME;
+    uint8_t local_blob[MAX_PLAYERS * MAX_INPUT_SIZE];
+    long local_len = 0;
+    for (int h = 0; h < num_players; ++h) {
+      if (kinds[h] != KIND_LOCAL) continue;
+      if (!staged_valid[h]) return SERR_MISSING_INPUT;
+      long landed = sync.add_local_input(h, staged_inputs[h]);
+      if (landed < 0) return landed;
+      if (landed == NULL_FRAME) return SERR_INTERNAL;
+      actual_frame = static_cast<int32_t>(landed);  // input delay may shift it
+      local_connect_status[h].last_frame = actual_frame;
+      std::memcpy(local_blob + local_len, staged_inputs[h], input_size);
+      local_len += input_size;
+    }
+
+    uint8_t disc[MAX_PLAYERS];
+    int32_t last[MAX_PLAYERS];
+    pack_status(disc, last);
+    for (auto& slot : eps) {
+      if (slot.is_spectator) continue;
+      ggrs_ep_send_input(slot.ep, actual_frame, local_blob, local_len, disc,
+                         last, num_players, now);
+    }
+    for (int h = 0; h < num_players; ++h) staged_valid[h] = false;
+
+    // second spectator broadcast: the watermark recomputed after the local
+    // inputs landed covers the current frame (see p2p_session.py:222-231)
+    bool have_spectators = false;
+    for (const auto& slot : eps) have_spectators |= slot.is_spectator;
+    if (have_spectators) {
+      rc = send_confirmed_inputs_to_spectators(confirmed_frame(), now);
+      if (rc < 0) return rc;
+    }
+
+    Req advance;
+    rc = sync.synchronized_inputs(local_connect_status, &advance);
+    if (rc < 0) return rc;
+    sync.current_frame += 1;
+    reqs.push_back(advance);
+    return static_cast<long>(reqs.size());
+  }
+
+  // (sync_test_session.py advance_frame minus the checksum comparisons,
+  // which the wrapper drives via ggrs_sess_st_verify; reference
+  // src/sessions/sync_test_session.rs:85-146)
+  long advance_synctest() {
+    reqs.clear();
+
+    if (check_distance > 0 && sync.current_frame > check_distance) {
+      long rc = adjust_gamestate_synctest(sync.current_frame - check_distance);
+      if (rc < 0) return rc;
+    }
+
+    for (int h = 0; h < num_players; ++h)
+      if (!staged_valid[h]) return SERR_MISSING_INPUT;
+    for (int h = 0; h < num_players; ++h) {
+      long landed = sync.add_local_input(h, staged_inputs[h]);
+      if (landed < 0) return landed;
+      staged_valid[h] = false;
+    }
+
+    if (check_distance > 0) {
+      reqs.emplace_back();
+      sync.save_current_state(&reqs.back());
+    }
+
+    Req advance;
+    long rc = sync.synchronized_inputs(dummy_status, &advance);
+    if (rc < 0) return rc;
+    reqs.push_back(advance);
+    sync.current_frame += 1;
+
+    // fake confirmation at current - check_distance so the sync layer never
+    // hits the prediction threshold
+    int32_t safe_frame = sync.current_frame - check_distance;
+    rc = sync.set_last_confirmed_frame(safe_frame, false);
+    if (rc < 0) return rc;
+    for (int i = 0; i < num_players; ++i)
+      dummy_status[i].last_frame = sync.current_frame;
+
+    return static_cast<long>(reqs.size());
+  }
+
+  // (sync_test_session.py _adjust_gamestate; reference
+  // src/sessions/sync_test_session.rs:178-203)
+  long adjust_gamestate_synctest(int32_t frame_to) {
+    int32_t start_frame = sync.current_frame;
+    int32_t count = start_frame - frame_to;
+
+    reqs.emplace_back();
+    long rc = sync.load_frame(frame_to, &reqs.back());
+    if (rc < 0) return rc;
+    sync.reset_prediction();
+
+    for (int32_t i = 0; i < count; ++i) {
+      Req advance;
+      rc = sync.synchronized_inputs(dummy_status, &advance);
+      if (rc < 0) return rc;
+      if (i > 0) {
+        reqs.emplace_back();
+        sync.save_current_state(&reqs.back());
+      }
+      sync.current_frame += 1;
+      reqs.push_back(advance);
+    }
+    return sync.current_frame == start_frame ? 0 : SERR_INTERNAL;
+  }
+
+  // SyncTest checksum bookkeeping (sync_test_session.py
+  // _checksums_consistent / _verify_observation): prune history older than
+  // oldest_allowed, then compare-or-record. The wrapper reads the cell
+  // checksums (it owns the cells) and calls this per observed frame.
+  long st_verify(int32_t frame, const Checksum& csum, int32_t oldest_allowed) {
+    for (auto it = st_history.begin(); it != st_history.end();) {
+      if (it->first < oldest_allowed)
+        it = st_history.erase(it);
+      else
+        ++it;
+    }
+    auto it = st_history.find(frame);
+    if (it != st_history.end()) {
+      if (!(it->second == csum)) {
+        last_error_frame = frame;
+        return SERR_MISMATCHED_CHECKSUM;
+      }
+      return 0;
+    }
+    st_history.emplace(frame, csum);
+    return 0;
+  }
+
+  // (spectator_session.py advance_frame; reference
+  // src/sessions/p2p_spectator_session.rs:109-138)
+  long advance_spectator() {
+    if (!running) return SERR_NOT_SYNCHRONIZED;
+    reqs.clear();
+
+    int32_t behind = spec_last_recv_frame - spec_current_frame;
+    int32_t frames_to_advance = behind > max_frames_behind ? catchup_speed : 1;
+    for (int32_t i = 0; i < frames_to_advance; ++i) {
+      int32_t frame_to_grab = spec_current_frame + 1;
+      long rc = inputs_at_frame(frame_to_grab);
+      if (rc < 0) return rc;
+      // only advance if grabbing the inputs succeeded
+      spec_current_frame += 1;
+    }
+    return static_cast<long>(reqs.size());
+  }
+
+  // (spectator_session.py _inputs_at_frame; reference
+  // src/sessions/p2p_spectator_session.rs:173-202)
+  long inputs_at_frame(int32_t frame_to_grab) {
+    SpecSlot* row = &spec_inputs[(frame_to_grab % SPECTATOR_BUFFER) * num_players];
+    if (row[0].frame < frame_to_grab)
+      return SERR_PREDICTION_THRESHOLD;  // host input not here yet; wait
+    if (row[0].frame > frame_to_grab)
+      return SERR_SPECTATOR_TOO_FAR_BEHIND;  // ring overwritten; unrecoverable
+
+    reqs.emplace_back();
+    Req& r = reqs.back();
+    r.type = REQ_ADVANCE;
+    r.frame = frame_to_grab;
+    r.cell = -1;
+    std::memset(r.inputs, 0, sizeof(r.inputs));
+    for (int h = 0; h < num_players; ++h) {
+      std::memcpy(r.inputs + h * input_size, row[h].buf, input_size);
+      bool disconnected = host_connect_status[h].disconnected &&
+                          host_connect_status[h].last_frame < frame_to_grab;
+      r.statuses[h] = disconnected ? STATUS_DISCONNECTED : STATUS_CONFIRMED;
+    }
+    return 0;
+  }
+
+  ~Session() {
+    for (auto& slot : eps)
+      if (slot.ep) ggrs_ep_free(slot.ep);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+struct ggrs_sess_config {
+  int32_t session_type;  // 0 p2p, 1 synctest, 2 spectator
+  int32_t num_players;
+  int32_t max_prediction;
+  int32_t input_size;
+  int32_t input_delay;
+  int32_t sparse_saving;
+  int32_t desync_interval;  // 0 = off
+  int32_t check_distance;
+  int32_t max_frames_behind;
+  int32_t catchup_speed;
+  int32_t fps;
+  int32_t disconnect_timeout_ms;
+  int32_t disconnect_notify_start_ms;
+  int32_t total_handles;                        // players + spectators
+  int32_t num_endpoints;                        // unique remote addresses
+  int32_t player_kinds[MAX_TOTAL_HANDLES];      // KIND_* per handle
+  int32_t player_endpoints[MAX_TOTAL_HANDLES];  // endpoint index or -1
+  uint64_t rng_seed;
+};
+
+struct ggrs_sess_req {
+  int32_t type;  // 0 save, 1 load, 2 advance
+  int32_t frame;
+  int32_t cell;  // snapshot ring slot for save/load, -1 otherwise
+  int32_t statuses[MAX_PLAYERS];
+  uint8_t inputs[MAX_PLAYERS * MAX_INPUT_SIZE];
+};
+
+struct ggrs_sess_event {
+  int32_t type;
+  int32_t ep;  // endpoint index, -1 when not applicable
+  int32_t a;   // total / timeout_ms / skip_frames / frame
+  int32_t b;   // count
+  uint8_t local_checksum[16];
+  uint8_t remote_checksum[16];
+};
+
+void* ggrs_sess_new(const ggrs_sess_config* cfg, uint64_t now_ms) {
+  if (cfg->num_players < 1 || cfg->num_players > MAX_PLAYERS) return nullptr;
+  if (cfg->input_size < 1 || cfg->input_size > MAX_INPUT_SIZE) return nullptr;
+  if (cfg->total_handles < cfg->num_players ||
+      cfg->total_handles > MAX_TOTAL_HANDLES)
+    return nullptr;
+  if (cfg->num_endpoints < 0 || cfg->num_endpoints > MAX_EPS) return nullptr;
+
+  Session* s = new (std::nothrow) Session();
+  if (!s) return nullptr;
+  s->type = cfg->session_type;
+  s->num_players = cfg->num_players;
+  s->max_prediction = cfg->max_prediction;
+  s->input_size = cfg->input_size;
+  s->sparse_saving = cfg->sparse_saving != 0;
+  s->desync_interval = cfg->desync_interval;
+  s->check_distance = cfg->check_distance;
+  s->max_frames_behind = cfg->max_frames_behind;
+  s->catchup_speed = cfg->catchup_speed;
+  s->total_handles = cfg->total_handles;
+  std::copy(cfg->player_kinds, cfg->player_kinds + cfg->total_handles, s->kinds);
+  std::copy(cfg->player_endpoints, cfg->player_endpoints + cfg->total_handles,
+            s->ep_of_handle);
+
+  if (!s->sync.init(cfg->num_players, cfg->max_prediction, cfg->input_size)) {
+    delete s;
+    return nullptr;
+  }
+
+  Rng rng(cfg->rng_seed);
+
+  if (cfg->session_type == SESS_SPECTATOR) {
+    // one endpoint carrying every player handle (builder.py
+    // start_spectator_session)
+    s->eps.resize(1);
+    EndpointSlot& slot = s->eps[0];
+    for (int h = 0; h < cfg->num_players; ++h) slot.handles.push_back(h);
+    ggrs_ep_config ec{};
+    for (size_t i = 0; i < slot.handles.size(); ++i)
+      ec.handles[i] = slot.handles[i];
+    ec.num_handles = static_cast<long>(slot.handles.size());
+    ec.num_players = cfg->num_players;
+    ec.local_players = 1;  // irrelevant: spectators never send inputs
+    ec.max_prediction = cfg->max_prediction;
+    ec.disconnect_timeout_ms = cfg->disconnect_timeout_ms;
+    ec.disconnect_notify_start_ms = cfg->disconnect_notify_start_ms;
+    ec.fps = cfg->fps;
+    ec.input_size = cfg->input_size;
+    ec.magic = static_cast<uint16_t>(rng.next() % 0xFFFF) + 1;  // nonzero
+    ec.rng_seed = rng.next();
+    slot.ep = ggrs_ep_new(&ec, now_ms);
+    if (!slot.ep) {
+      delete s;
+      return nullptr;
+    }
+    ggrs_ep_synchronize(slot.ep, now_ms);
+    s->spec_inputs.resize(SPECTATOR_BUFFER * cfg->num_players);
+    s->running = false;
+    return s;
+  }
+
+  // synctest: every handle local, frame delay applies to all players
+  if (cfg->session_type == SESS_SYNCTEST) {
+    for (int h = 0; h < cfg->num_players; ++h)
+      ggrs_iq_set_frame_delay(s->sync.queues[h], cfg->input_delay);
+    s->running = true;
+    return s;
+  }
+
+  // P2P: one endpoint per unique remote address, grouped by the caller
+  // (builder.py start_p2p_session)
+  int local_players = 0;
+  for (int h = 0; h < cfg->num_players; ++h)
+    if (cfg->player_kinds[h] == KIND_LOCAL) {
+      ++local_players;
+      ggrs_iq_set_frame_delay(s->sync.queues[h], cfg->input_delay);
+    }
+
+  s->eps.resize(cfg->num_endpoints);
+  for (int h = 0; h < cfg->total_handles; ++h) {
+    int e = cfg->player_endpoints[h];
+    if (e < 0) continue;
+    if (e >= cfg->num_endpoints) {
+      delete s;
+      return nullptr;
+    }
+    s->eps[e].handles.push_back(h);
+    if (cfg->player_kinds[h] == KIND_SPECTATOR) s->eps[e].is_spectator = true;
+  }
+  for (auto& slot : s->eps) {
+    if (slot.handles.empty() || slot.handles.size() > 16) {
+      delete s;
+      return nullptr;
+    }
+    std::sort(slot.handles.begin(), slot.handles.end());
+    ggrs_ep_config ec{};
+    for (size_t i = 0; i < slot.handles.size(); ++i)
+      ec.handles[i] = slot.handles[i];
+    ec.num_handles = static_cast<long>(slot.handles.size());
+    ec.num_players = cfg->num_players;
+    // the host of a spectator sends inputs for all players
+    ec.local_players = slot.is_spectator ? cfg->num_players : local_players;
+    ec.max_prediction = cfg->max_prediction;
+    ec.disconnect_timeout_ms = cfg->disconnect_timeout_ms;
+    ec.disconnect_notify_start_ms = cfg->disconnect_notify_start_ms;
+    ec.fps = cfg->fps;
+    ec.input_size = cfg->input_size;
+    ec.magic = static_cast<uint16_t>(rng.next() % 0xFFFF) + 1;
+    ec.rng_seed = rng.next();
+    slot.ep = ggrs_ep_new(&ec, now_ms);
+    if (!slot.ep) {
+      delete s;
+      return nullptr;
+    }
+    ggrs_ep_synchronize(slot.ep, now_ms);
+  }
+
+  // no remotes -> no synchronization phase needed (p2p_session.py:125-129)
+  s->running = s->eps.empty();
+  return s;
+}
+
+void ggrs_sess_free(void* h) { delete static_cast<Session*>(h); }
+
+long ggrs_sess_state(void* h) {
+  return static_cast<Session*>(h)->running ? 1 : 0;
+}
+
+int32_t ggrs_sess_current_frame(void* h) {
+  Session* s = static_cast<Session*>(h);
+  return s->type == SESS_SPECTATOR ? s->spec_current_frame
+                                   : s->sync.current_frame;
+}
+
+int32_t ggrs_sess_confirmed_frame(void* h) {
+  return static_cast<Session*>(h)->confirmed_frame();
+}
+
+int32_t ggrs_sess_last_saved_frame(void* h) {
+  return static_cast<Session*>(h)->sync.last_saved_frame;
+}
+
+long ggrs_sess_frames_ahead(void* h) {
+  return static_cast<Session*>(h)->frames_ahead;
+}
+
+int32_t ggrs_sess_frames_behind_host(void* h) {
+  Session* s = static_cast<Session*>(h);
+  return s->spec_last_recv_frame - s->spec_current_frame;
+}
+
+int32_t ggrs_sess_last_error_frame(void* h) {
+  return static_cast<Session*>(h)->last_error_frame;
+}
+
+void ggrs_sess_connect_status(void* h, uint8_t* disc, int32_t* last, long n) {
+  Session* s = static_cast<Session*>(h);
+  const ConnStatus* src = s->type == SESS_SPECTATOR ? s->host_connect_status
+                                                    : s->local_connect_status;
+  for (long i = 0; i < n && i < s->num_players; ++i) {
+    disc[i] = src[i].disconnected ? 1 : 0;
+    last[i] = src[i].last_frame;
+  }
+}
+
+// Feed one incoming datagram, already routed to the endpoint by the wrapper.
+void ggrs_sess_handle_wire(void* h, long ep, const uint8_t* buf, long len,
+                           uint64_t now_ms) {
+  Session* s = static_cast<Session*>(h);
+  if (ep < 0 || ep >= static_cast<long>(s->eps.size())) return;
+  ggrs_ep_handle_message(s->eps[ep].ep, buf, len, now_ms);
+}
+
+// Drain one outgoing datagram across all endpoints; returns its length and
+// endpoint index, or 0 when every queue is empty.
+long ggrs_sess_drain_wire(void* h, int32_t* ep_out, uint8_t* buf, long cap) {
+  Session* s = static_cast<Session*>(h);
+  size_t n = s->eps.size();
+  if (n == 0) return 0;
+  for (size_t step = 0; step < n; ++step) {
+    size_t e = (s->drain_ep + step) % n;
+    long len = ggrs_ep_next_send(s->eps[e].ep, buf, cap);
+    if (len > 0) {
+      *ep_out = static_cast<int32_t>(e);
+      s->drain_ep = e;  // keep draining this endpoint before moving on
+      return len;
+    }
+  }
+  return 0;
+}
+
+void ggrs_sess_poll(void* h, uint64_t now_ms) {
+  static_cast<Session*>(h)->poll(now_ms);
+}
+
+long ggrs_sess_add_local_input(void* h, long handle, const uint8_t* buf) {
+  Session* s = static_cast<Session*>(h);
+  if (handle < 0 || handle >= s->num_players) return SERR_INVALID_HANDLE;
+  if (s->type == SESS_P2P && s->kinds[handle] != KIND_LOCAL)
+    return SERR_INVALID_HANDLE;
+  std::memcpy(s->staged_inputs[handle], buf, s->input_size);
+  s->staged_valid[handle] = true;
+  return 0;
+}
+
+long ggrs_sess_advance_frame(void* h, uint64_t now_ms, ggrs_sess_req* out,
+                             long cap) {
+  Session* s = static_cast<Session*>(h);
+  long rc;
+  switch (s->type) {
+    case SESS_P2P:
+      rc = s->advance_p2p(now_ms);
+      break;
+    case SESS_SYNCTEST:
+      rc = s->advance_synctest();
+      break;
+    case SESS_SPECTATOR:
+      rc = s->advance_spectator();
+      break;
+    default:
+      rc = SERR_INTERNAL;
+  }
+  if (rc < 0) return rc;
+  if (rc > cap) return SERR_CAPACITY;  // recoverable: ggrs_sess_copy_requests
+  for (long i = 0; i < rc; ++i)
+    std::memcpy(&out[i], &s->reqs[i], sizeof(ggrs_sess_req));
+  return rc;
+}
+
+int32_t ggrs_sess_request_count(void* h) {
+  return static_cast<int32_t>(static_cast<Session*>(h)->reqs.size());
+}
+
+// Re-copy the last advance's request list (still held by the session) into a
+// larger buffer after a SERR_CAPACITY — the advance itself already ran, so
+// no state is lost.
+long ggrs_sess_copy_requests(void* h, ggrs_sess_req* out, long cap) {
+  Session* s = static_cast<Session*>(h);
+  long n = static_cast<long>(s->reqs.size());
+  if (n > cap) return SERR_CAPACITY;
+  for (long i = 0; i < n; ++i)
+    std::memcpy(&out[i], &s->reqs[i], sizeof(ggrs_sess_req));
+  return n;
+}
+
+long ggrs_sess_next_event(void* h, ggrs_sess_event* out) {
+  Session* s = static_cast<Session*>(h);
+  if (s->events.empty()) return 0;
+  const SessEvent& ev = s->events.front();
+  out->type = ev.type;
+  out->ep = ev.ep;
+  out->a = ev.a;
+  out->b = ev.b;
+  std::memcpy(out->local_checksum, ev.local_checksum, 16);
+  std::memcpy(out->remote_checksum, ev.remote_checksum, 16);
+  s->events.pop_front();
+  return 1;
+}
+
+// (p2p_session.py disconnect_player; reference p2p_session.rs:430-456).
+// The wrapper validates the handle refers to a non-local player.
+long ggrs_sess_disconnect_player(void* h, long handle, uint64_t now_ms) {
+  Session* s = static_cast<Session*>(h);
+  if (handle < 0 || handle >= s->total_handles) return SERR_INVALID_HANDLE;
+  if (s->kinds[handle] == KIND_LOCAL) return SERR_LOCAL_PLAYER;
+  if (s->kinds[handle] == KIND_REMOTE) {
+    if (s->local_connect_status[handle].disconnected)
+      return SERR_ALREADY_DISCONNECTED;
+    s->disconnect_player_at_frame(
+        static_cast<int>(handle), s->local_connect_status[handle].last_frame,
+        now_ms);
+  } else {
+    s->disconnect_player_at_frame(static_cast<int>(handle), NULL_FRAME, now_ms);
+  }
+  return 0;
+}
+
+long ggrs_sess_network_stats(void* h, long ep, uint64_t now_ms,
+                             ggrs_ep_stats* out) {
+  Session* s = static_cast<Session*>(h);
+  if (ep < 0 || ep >= static_cast<long>(s->eps.size())) return -1;
+  return ggrs_ep_network_stats(s->eps[ep].ep, now_ms, out);
+}
+
+// Desync detection: which confirmed frame needs its checksum materialized.
+// Clears the request; the wrapper answers via ggrs_sess_provide_checksum.
+int32_t ggrs_sess_take_checksum_request(void* h) {
+  Session* s = static_cast<Session*>(h);
+  int32_t f = s->pending_checksum_request;
+  s->pending_checksum_request = NULL_FRAME;
+  return f;
+}
+
+// Record + broadcast a materialized local checksum (p2p_session.py
+// _flush_pending_checksum_report, native half).
+void ggrs_sess_provide_checksum(void* h, int32_t frame, const uint8_t* csum16,
+                                uint64_t now_ms) {
+  Session* s = static_cast<Session*>(h);
+  Checksum c;
+  c.has = true;
+  std::memcpy(c.bytes, csum16, 16);
+  s->local_checksum_history[frame] = c;
+  for (auto& slot : s->eps) {
+    if (slot.is_spectator) continue;
+    ggrs_ep_send_checksum_report(slot.ep, frame, csum16, now_ms);
+  }
+}
+
+// SyncTest checksum observation (compare-or-record vs the first-recorded
+// history). has == 0 models a save with no checksum (None in Python).
+long ggrs_sess_st_verify(void* h, int32_t frame, int has, const uint8_t* csum16,
+                         int32_t oldest_allowed) {
+  Session* s = static_cast<Session*>(h);
+  Checksum c;
+  c.has = has != 0;
+  if (c.has) std::memcpy(c.bytes, csum16, 16);
+  return s->st_verify(frame, c, oldest_allowed);
+}
+
+}  // extern "C"

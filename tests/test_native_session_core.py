@@ -1,0 +1,514 @@
+"""Parity tests for the native C++ session core (native/session.cpp).
+
+The Python sessions are the behavioral oracles: identical input scripts over
+identical (deterministic, fault-injecting) virtual networks must produce
+identical ordered request streams and identical replica histories from the
+native and Python stacks. Wire compatibility is also exercised with mixed
+native/Python peers on one network.
+"""
+
+import random
+
+import pytest
+
+from ggrs_tpu import (
+    AdvanceFrame,
+    DesyncDetected,
+    DesyncDetection,
+    Disconnected,
+    LoadGameState,
+    MismatchedChecksum,
+    NetworkInterrupted,
+    NotSynchronized,
+    PlayerType,
+    SaveGameState,
+    SessionBuilder,
+    SessionState,
+)
+from ggrs_tpu.native import available
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.utils.clock import FakeClock
+from stubs import GameStub, RandomChecksumGameStub
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native library not built (make -C native)"
+)
+
+
+def req_sig(requests):
+    """Comparable signature of an ordered request list."""
+    sig = []
+    for r in requests:
+        if isinstance(r, SaveGameState):
+            sig.append(("save", r.frame))
+        elif isinstance(r, LoadGameState):
+            sig.append(("load", r.frame))
+        elif isinstance(r, AdvanceFrame):
+            sig.append(
+                ("advance", tuple((bytes(b), int(s)) for b, s in r.inputs))
+            )
+        else:
+            raise TypeError(r)
+    return sig
+
+
+# ---------------------------------------------------------------------------
+# SyncTest
+# ---------------------------------------------------------------------------
+
+
+def make_synctest(native, check_distance=4, input_delay=0, num_players=2):
+    b = (
+        SessionBuilder(input_size=1)
+        .with_num_players(num_players)
+        .with_check_distance(check_distance)
+        .with_input_delay(input_delay)
+    )
+    if native:
+        b = b.with_native_sessions(True)
+    return b.start_synctest_session()
+
+
+@pytest.mark.parametrize("input_delay", [0, 2])
+def test_native_synctest_request_parity(input_delay):
+    py = make_synctest(native=False, input_delay=input_delay)
+    nat = make_synctest(native=True, input_delay=input_delay)
+    g_py, g_nat = GameStub(), GameStub()
+    for frame in range(40):
+        for handle in range(2):
+            inp = bytes([(frame * (handle + 3) + handle) % 7])
+            py.add_local_input(handle, inp)
+            nat.add_local_input(handle, inp)
+        r_py = py.advance_frame()
+        r_nat = nat.advance_frame()
+        assert req_sig(r_py) == req_sig(r_nat), f"tick {frame} diverged"
+        g_py.handle_requests(r_py)
+        g_nat.handle_requests(r_nat)
+    assert g_py.history == g_nat.history
+    assert g_py.gs == g_nat.gs
+
+
+def test_native_synctest_detects_random_checksums():
+    nat = make_synctest(native=True, check_distance=2)
+    g = RandomChecksumGameStub()
+    with pytest.raises(MismatchedChecksum):
+        for frame in range(20):
+            nat.add_local_input(0, b"\x01")
+            nat.add_local_input(1, b"\x02")
+            g.handle_requests(nat.advance_frame())
+
+
+def test_native_synctest_deferred_verification():
+    b = (
+        SessionBuilder(input_size=1)
+        .with_num_players(2)
+        .with_check_distance(2)
+        .with_deferred_checksum_verification(4)
+        .with_native_sessions(True)
+    )
+    nat = b.start_synctest_session()
+    g = GameStub()
+    for frame in range(30):
+        nat.add_local_input(0, bytes([frame % 3]))
+        nat.add_local_input(1, bytes([frame % 5]))
+        g.handle_requests(nat.advance_frame())
+    nat.flush_checksum_checks()
+
+    # negative control: mismatches surface, at most `lag` ticks late
+    b2 = (
+        SessionBuilder(input_size=1)
+        .with_num_players(2)
+        .with_check_distance(2)
+        .with_deferred_checksum_verification(4)
+        .with_native_sessions(True)
+    )
+    bad = b2.start_synctest_session()
+    g2 = RandomChecksumGameStub()
+    with pytest.raises(MismatchedChecksum):
+        for frame in range(30):
+            bad.add_local_input(0, b"\x01")
+            bad.add_local_input(1, b"\x02")
+            g2.handle_requests(bad.advance_frame())
+        bad.flush_checksum_checks()
+
+
+# ---------------------------------------------------------------------------
+# P2P
+# ---------------------------------------------------------------------------
+
+
+def build_pair(clock, net, *, native=(True, True), desync=None, input_delay=0,
+               sparse=False, max_prediction=8):
+    def build(my_addr, other_addr, local_handle, use_native):
+        b = (
+            SessionBuilder(input_size=1)
+            .with_num_players(2)
+            .with_max_prediction_window(max_prediction)
+            .with_input_delay(input_delay)
+            .with_sparse_saving_mode(sparse)
+            .with_clock(clock)
+            .with_rng(random.Random(hash(my_addr) & 0xFFFF))
+        )
+        if desync is not None:
+            b = b.with_desync_detection_mode(desync)
+        if use_native:
+            b = b.with_native_sessions(True)
+        b = b.add_player(PlayerType.local(), local_handle)
+        b = b.add_player(PlayerType.remote(other_addr), 1 - local_handle)
+        return b.start_p2p_session(net.socket(my_addr))
+
+    return build("a", "b", 0, native[0]), build("b", "a", 1, native[1])
+
+
+def sync_sessions(sessions, clock, iterations=400):
+    for _ in range(iterations):
+        for s in sessions:
+            s.poll_remote_clients()
+            s.events()
+        clock.advance(20)
+        if all(s.current_state() == SessionState.RUNNING for s in sessions):
+            return
+    raise AssertionError("sessions failed to synchronize")
+
+
+def drive_pair(s1, s2, g1, g2, clock, frames):
+    for frame in range(frames):
+        s1.add_local_input(0, bytes([(frame * 7 + 1) % 16]))
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, bytes([(frame * 5 + 2) % 16]))
+        g2.handle_requests(s2.advance_frame())
+        s1.events()
+        s2.events()
+        clock.advance(16)
+    for _ in range(10):
+        s1.poll_remote_clients()
+        s2.poll_remote_clients()
+        clock.advance(16)
+    s1.add_local_input(0, b"\x00")
+    g1.handle_requests(s1.advance_frame())
+    s2.add_local_input(1, b"\x00")
+    g2.handle_requests(s2.advance_frame())
+
+
+def assert_confirmed_prefix_equal(s1, s2, g1, g2, frames):
+    confirmed = min(s1.confirmed_frame(), s2.confirmed_frame())
+    assert confirmed > frames // 2, "sessions never confirmed enough frames"
+    for f in range(1, confirmed + 1):
+        assert g1.history[f] == g2.history[f], f"replicas diverged at frame {f}"
+
+
+def test_native_p2p_not_synchronized_before_handshake():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    s1, _s2 = build_pair(clock, net)
+    s1.add_local_input(0, b"\x00")
+    with pytest.raises(NotSynchronized):
+        s1.advance_frame()
+
+
+@pytest.mark.parametrize(
+    "latency,jitter,loss,seed",
+    [(0, 0, 0.0, 1), (50, 20, 0.0, 5), (30, 30, 0.2, 11)],
+)
+def test_native_p2p_replicas_converge(latency, jitter, loss, seed):
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=latency, jitter_ms=jitter,
+                          loss=loss, seed=seed)
+    s1, s2 = build_pair(clock, net)
+    sync_sessions([s1, s2], clock)
+    g1, g2 = GameStub(), GameStub()
+    drive_pair(s1, s2, g1, g2, clock, 60)
+    assert_confirmed_prefix_equal(s1, s2, g1, g2, 60)
+    if latency >= 50:
+        assert g1.loaded_frames or g2.loaded_frames, "expected rollbacks"
+
+
+def test_native_python_mixed_pair_interop():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=40, jitter_ms=10, seed=7)
+    s1, s2 = build_pair(clock, net, native=(True, False))
+    sync_sessions([s1, s2], clock)
+    g1, g2 = GameStub(), GameStub()
+    drive_pair(s1, s2, g1, g2, clock, 60)
+    assert_confirmed_prefix_equal(s1, s2, g1, g2, 60)
+
+
+@pytest.mark.parametrize(
+    "latency,jitter,loss,seed,input_delay,sparse",
+    [
+        (0, 0, 0.0, 1, 0, False),
+        (50, 20, 0.0, 5, 0, False),
+        (30, 30, 0.2, 11, 0, False),
+        (40, 0, 0.0, 3, 2, False),
+        (50, 20, 0.0, 9, 0, True),
+    ],
+)
+def test_native_p2p_request_stream_parity_vs_python(
+    latency, jitter, loss, seed, input_delay, sparse
+):
+    """The strongest oracle: the same deterministic world (clock, fault
+    seeds, inputs) must yield the exact same ordered request stream from the
+    native pair as from the Python pair, tick for tick."""
+    streams = []
+    for use_native in (False, True):
+        clock = FakeClock()
+        net = InMemoryNetwork(clock, latency_ms=latency, jitter_ms=jitter,
+                              loss=loss, seed=seed)
+        s1, s2 = build_pair(clock, net, native=(use_native, use_native),
+                            input_delay=input_delay, sparse=sparse)
+        sync_sessions([s1, s2], clock)
+        g1, g2 = GameStub(), GameStub()
+        stream = []
+        for frame in range(50):
+            s1.add_local_input(0, bytes([(frame * 7 + 1) % 16]))
+            r1 = s1.advance_frame()
+            s2.add_local_input(1, bytes([(frame * 5 + 2) % 16]))
+            r2 = s2.advance_frame()
+            stream.append((req_sig(r1), req_sig(r2)))
+            g1.handle_requests(r1)
+            g2.handle_requests(r2)
+            clock.advance(16)
+        streams.append(stream)
+
+    py_stream, nat_stream = streams
+    for tick, (py_t, nat_t) in enumerate(zip(py_stream, nat_stream)):
+        assert py_t == nat_t, f"request streams diverged at tick {tick}"
+
+
+def test_native_p2p_desync_detection():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, seed=17)
+    s1, s2 = build_pair(clock, net, desync=DesyncDetection.on(10))
+    sync_sessions([s1, s2], clock)
+    g1 = GameStub()
+    g2 = RandomChecksumGameStub()  # checksums will never agree
+
+    events = []
+    for frame in range(150):
+        s1.add_local_input(0, b"\x01")
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, b"\x01")
+        g2.handle_requests(s2.advance_frame())
+        events += s1.events() + s2.events()
+        clock.advance(16)
+    assert [e for e in events if isinstance(e, DesyncDetected)]
+
+
+def test_native_p2p_no_false_desyncs():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=40, jitter_ms=10, seed=13)
+    s1, s2 = build_pair(clock, net, desync=DesyncDetection.on(10))
+    sync_sessions([s1, s2], clock)
+    g1, g2 = GameStub(), GameStub()
+    events = []
+    for frame in range(120):
+        s1.add_local_input(0, bytes([frame % 4]))
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, bytes([frame % 6]))
+        g2.handle_requests(s2.advance_frame())
+        events += s1.events() + s2.events()
+        clock.advance(16)
+    assert not [e for e in events if isinstance(e, DesyncDetected)]
+
+
+def test_native_p2p_disconnect_player_and_continue():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    s1, s2 = build_pair(clock, net)
+    sync_sessions([s1, s2], clock)
+    g1 = GameStub()
+    for frame in range(5):
+        s1.add_local_input(0, b"\x01")
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, b"\x01")
+        s2.advance_frame()
+        clock.advance(16)
+
+    s1.disconnect_player(1)
+    from ggrs_tpu import InvalidRequest
+
+    with pytest.raises(InvalidRequest):
+        s1.disconnect_player(1)  # already disconnected
+    with pytest.raises(InvalidRequest):
+        s1.disconnect_player(0)  # local player
+
+    for frame in range(10):
+        s1.add_local_input(0, b"\x01")
+        g1.handle_requests(s1.advance_frame())
+        clock.advance(16)
+    assert s1.current_frame == 15
+
+
+def test_native_p2p_timeout_disconnect_via_silence():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    s1, s2 = build_pair(clock, net)
+    sync_sessions([s1, s2], clock)
+    g1 = GameStub()
+    for frame in range(3):
+        s1.add_local_input(0, b"\x01")
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, b"\x01")
+        s2.advance_frame()
+        clock.advance(16)
+
+    events = []
+    for _ in range(30):
+        s1.poll_remote_clients()
+        events += s1.events()
+        clock.advance(100)
+    assert [e for e in events if isinstance(e, NetworkInterrupted)]
+    assert [e for e in events if isinstance(e, Disconnected)]
+
+    for frame in range(5):
+        s1.add_local_input(0, b"\x01")
+        g1.handle_requests(s1.advance_frame())
+        clock.advance(16)
+
+
+def test_native_p2p_network_stats_shape():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    s1, s2 = build_pair(clock, net)
+    sync_sessions([s1, s2], clock)
+    g1, g2 = GameStub(), GameStub()
+    for frame in range(10):
+        s1.add_local_input(0, b"\x01")
+        g1.handle_requests(s1.advance_frame())
+        s2.add_local_input(1, b"\x01")
+        g2.handle_requests(s2.advance_frame())
+        clock.advance(200)
+    stats = s1.network_stats(1)
+    assert stats.send_queue_len >= 0
+    assert stats.ping_ms >= 0
+
+
+def test_native_p2p_remote_and_spectator_sharing_address_get_separate_endpoints():
+    """A remote player and a spectator at the same address must be backed by
+    separate endpoints, like the Python builder (builder.py:280-296) — a
+    merged endpoint would mark the remote player's endpoint as spectator and
+    never send it local inputs."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    b = (
+        SessionBuilder(input_size=1)
+        .with_num_players(2)
+        .with_clock(clock)
+        .with_rng(random.Random(3))
+        .with_native_sessions(True)
+        .add_player(PlayerType.local(), 0)
+        .add_player(PlayerType.remote("b"), 1)
+        .add_player(PlayerType.spectator("b"), 2)
+    )
+    s = b.start_p2p_session(net.socket("a"))
+    assert len(s._addr_of_ep) == 2
+    assert s._remote_ep_of_addr["b"] != s._spec_ep_of_addr["b"]
+    assert s._eps_of_addr["b"] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# Spectator
+# ---------------------------------------------------------------------------
+
+
+def build_host_and_spectator(clock, net, *, native=(True, True),
+                             catchup_speed=1, max_frames_behind=10):
+    hb = (
+        SessionBuilder(input_size=1)
+        .with_num_players(1)
+        .with_clock(clock)
+        .with_rng(random.Random(21))
+        .add_player(PlayerType.local(), 0)
+        .add_player(PlayerType.spectator("spec"), 1)
+    )
+    if native[0]:
+        hb = hb.with_native_sessions(True)
+    host = hb.start_p2p_session(net.socket("host"))
+    sb = (
+        SessionBuilder(input_size=1)
+        .with_num_players(1)
+        .with_clock(clock)
+        .with_rng(random.Random(22))
+        .with_max_frames_behind(max_frames_behind)
+        .with_catchup_speed(catchup_speed)
+    )
+    if native[1]:
+        sb = sb.with_native_sessions(True)
+    spec = sb.start_spectator_session("host", net.socket("spec"))
+    return host, spec
+
+
+def sync_host_spec(host, spec, clock):
+    for _ in range(60):
+        host.poll_remote_clients()
+        spec.poll_remote_clients()
+        host.events()
+        spec.events()
+        clock.advance(20)
+        if (
+            host.current_state() == SessionState.RUNNING
+            and spec.current_state() == SessionState.RUNNING
+        ):
+            return
+    raise AssertionError("host/spectator failed to synchronize")
+
+
+def test_native_spectator_large_catchup_burst():
+    """catchup_speed larger than the default request buffer must not drop
+    requests (regression: SERR_CAPACITY after the native advance had
+    already moved spec_current_frame silently skipped frames)."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    host, spec = build_host_and_spectator(
+        clock, net, native=(True, True), catchup_speed=40, max_frames_behind=50
+    )
+    sync_host_spec(host, spec, clock)
+
+    g_host, g_spec = GameStub(), GameStub()
+    # host races 55 frames ahead while the spectator sits idle
+    for frame in range(55):
+        host.add_local_input(0, bytes([frame % 9]))
+        g_host.handle_requests(host.advance_frame())
+        clock.advance(16)
+    spec.poll_remote_clients()
+    assert spec.frames_behind_host() > 50
+    # one catch-up advance yields catchup_speed requests, none lost
+    requests = spec.advance_frame()
+    assert len(requests) == 40
+    g_spec.handle_requests(requests)
+    assert spec.current_frame == 39
+    for f, v in g_spec.history.items():
+        assert g_host.history[f] == v
+
+
+@pytest.mark.parametrize("native", [(True, True), (True, False), (False, True)])
+def test_native_spectator_follows_host(native):
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    host, spec = build_host_and_spectator(clock, net, native=native)
+    sync_host_spec(host, spec, clock)
+
+    g_host, g_spec = GameStub(), GameStub()
+    from ggrs_tpu import PredictionThreshold
+
+    for frame in range(30):
+        host.add_local_input(0, bytes([frame % 9]))
+        g_host.handle_requests(host.advance_frame())
+        try:
+            g_spec.handle_requests(spec.advance_frame())
+        except PredictionThreshold:
+            pass  # host input not here yet; wait
+        clock.advance(16)
+
+    # settle: spectator catches up on everything confirmed
+    for _ in range(40):
+        host.poll_remote_clients()
+        try:
+            g_spec.handle_requests(spec.advance_frame())
+        except PredictionThreshold:
+            break
+        clock.advance(16)
+
+    assert g_spec.history, "spectator never advanced"
+    for f, v in g_spec.history.items():
+        assert g_host.history[f] == v, f"spectator diverged at frame {f}"
