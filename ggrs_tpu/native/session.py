@@ -38,7 +38,8 @@ from ..errors import (
 )
 from ..network.messages import decode_message, encode_message
 from ..network.network_stats import NetworkStats
-from ..sync_layer import GameStateCell, SavedStates
+from ..sessions.sync_test_session import DeferredChecks
+from ..sync_layer import GameStateCell, PendingChecksumReport, SavedStates
 from ..types import (
     NULL_FRAME,
     AdvanceFrame,
@@ -183,6 +184,10 @@ def _lib():
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
         lib.ggrs_sess_frames_ahead.restype = ctypes.c_long
         lib.ggrs_sess_frames_ahead.argtypes = [ctypes.c_void_p]
+        lib.ggrs_sess_connect_status.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_long,
+        ]
         lib.ggrs_sess_copy_requests.restype = ctypes.c_long
         lib.ggrs_sess_copy_requests.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(_SessReq), ctypes.c_long,
@@ -512,7 +517,7 @@ class NativeP2PSession(_NativeNetworkedSession):
             num_players, max_prediction, input_size, socket, addr_of_ep, clock
         )
         self.desync_detection = desync_detection
-        self._pending_checksum_report: Optional[Tuple[Frame, Any]] = None
+        self._pending_checksum_report = PendingChecksumReport()
 
         rng = rng or _random.Random()
         cfg = _SessConfig()
@@ -561,13 +566,12 @@ class NativeP2PSession(_NativeNetworkedSession):
         self.poll_remote_clients()
         if self.desync_detection.enabled:
             # flush BEFORE this tick's advance: a report captured at tick t
-            # may cover a frame whose correcting rollback was in tick t's
-            # request list — its cell only became final once the caller
-            # fulfilled those requests, i.e. by now (same reasoning as
-            # p2p_session.py _check_checksum_send_interval)
+            # covers a frame whose correcting rollback may have been in tick
+            # t's request list — PendingChecksumReport reads the value only
+            # once the caller fulfilled those requests, i.e. by now
             interval = self.desync_detection.interval
             force = self.current_frame % interval == interval - 1
-            self._flush_pending_checksum_report(force)
+            self._pending_checksum_report.flush(force, self._emit_checksum_report)
         requests = self._advance_native(self.clock.now_ms())
         if self.desync_detection.enabled:
             self._capture_checksum_request()
@@ -578,37 +582,14 @@ class NativeP2PSession(_NativeNetworkedSession):
         frame = self._lib.ggrs_sess_take_checksum_request(self._h)
         if frame == NULL_FRAME:
             return
-        # capture the cell, not its value: the checksum is read at flush
-        # time (next tick), after the caller fulfilled this tick's requests
-        self._pending_checksum_report = (
-            frame, self.cells[frame % len(self.cells)], None
+        self._pending_checksum_report.capture(
+            frame, self.cells[frame % len(self.cells)]
         )
 
-    def _flush_pending_checksum_report(self, force: bool) -> None:
-        # getter bound on the first flush attempt (value final by then) and
-        # kept: getters are stable across later ring-slot reuse, the cell
-        # is not (same policy as p2p_session.py _flush_pending_checksum_report)
-        pending = self._pending_checksum_report
-        if pending is None:
-            return
-        frame, cell, getter = pending
-        if getter is None:
-            if cell.frame != frame:  # ring slot reused before the first read
-                self._pending_checksum_report = None
-                return
-            getter = cell.checksum_getter()
-            self._pending_checksum_report = (frame, cell, getter)
-        if not force and not getattr(getter, "ready", True):
-            prefetch = getattr(getter, "prefetch", None)
-            if callable(prefetch):
-                prefetch()
-            return
-        checksum = getter()
-        if checksum is not None:
-            self._lib.ggrs_sess_provide_checksum(
-                self._h, frame, _csum_bytes(checksum), self.clock.now_ms()
-            )
-        self._pending_checksum_report = None
+    def _emit_checksum_report(self, frame: Frame, checksum: int) -> None:
+        self._lib.ggrs_sess_provide_checksum(
+            self._h, frame, _csum_bytes(checksum), self.clock.now_ms()
+        )
 
     def disconnect_player(self, player_handle: PlayerHandle) -> None:
         if player_handle not in self.handles:
@@ -638,6 +619,22 @@ class NativeP2PSession(_NativeNetworkedSession):
     @property
     def current_frame(self) -> Frame:
         return self._lib.ggrs_sess_current_frame(self._h)
+
+    @property
+    def last_saved_frame(self) -> Frame:
+        return self._lib.ggrs_sess_last_saved_frame(self._h)
+
+    @property
+    def local_connect_status(self):
+        """Per-player (disconnected, last_frame) view, parity with
+        P2PSession.local_connect_status."""
+        from ..sync_layer import ConnectionStatus
+
+        n = self.num_players
+        disc = (ctypes.c_uint8 * n)()
+        last = (ctypes.c_int32 * n)()
+        self._lib.ggrs_sess_connect_status(self._h, disc, last, n)
+        return [ConnectionStatus(bool(disc[i]), last[i]) for i in range(n)]
 
     def frames_ahead_estimate(self) -> int:
         return self._lib.ggrs_sess_frames_ahead(self._h)
@@ -683,7 +680,7 @@ class NativeSyncTestSession(_NativeSessionBase):
         super().__init__(num_players, max_prediction, input_size)
         self.check_distance = check_distance
         self.deferred_checksum_lag = deferred_checksum_lag
-        self._pending_checks: Deque[Tuple[int, Frame, Any]] = deque()
+        self._pending_checks = DeferredChecks(deferred_checksum_lag)
         self._tick = 0
 
         cfg = _SessConfig()
@@ -740,25 +737,26 @@ class NativeSyncTestSession(_NativeSessionBase):
             self._raise(rc)
 
     def _schedule_checks(self, current: Frame) -> None:
-        due = self._tick + self.deferred_checksum_lag
         for i in range(self.check_distance + 1):
             frame_to_check = current - i
             cell = self.cells[frame_to_check % len(self.cells)]
             if cell.frame != frame_to_check:
                 continue
-            self._pending_checks.append((due, frame_to_check, cell.checksum_getter()))
+            self._pending_checks.schedule(
+                self._tick, frame_to_check, cell.checksum_getter()
+            )
 
     def _drain_due_checks(self, current: Frame) -> None:
         oldest_live = current - (self.check_distance + self.deferred_checksum_lag + 1)
-        while self._pending_checks and self._pending_checks[0][0] <= self._tick:
-            _, frame, getter = self._pending_checks.popleft()
-            self._verify(frame, getter(), oldest_live)
+        self._pending_checks.drain_due(
+            self._tick, lambda frame, getter: self._verify(frame, getter(), oldest_live)
+        )
 
     def flush_checksum_checks(self) -> None:
         """Force every deferred comparison now (end of run / tests)."""
-        while self._pending_checks:
-            _, frame, getter = self._pending_checks.popleft()
-            self._verify(frame, getter(), _INT32_MIN)
+        self._pending_checks.flush(
+            lambda frame, getter: self._verify(frame, getter(), _INT32_MIN)
+        )
 
 
 class NativeSpectatorSession(_NativeNetworkedSession):

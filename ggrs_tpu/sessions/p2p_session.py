@@ -28,7 +28,7 @@ from ..network.protocol import (
     EvSynchronizing,
     PeerEndpoint,
 )
-from ..sync_layer import ConnectionStatus, SyncLayer
+from ..sync_layer import ConnectionStatus, PendingChecksumReport, SyncLayer
 from ..types import (
     NULL_FRAME,
     AdvanceFrame,
@@ -135,9 +135,7 @@ class P2PSession:
         self.event_queue: Deque[Event] = deque()
         self.local_inputs: Dict[PlayerHandle, PlayerInput] = {}
         self.local_checksum_history: Dict[Frame, int] = {}
-        # (frame, cell, getter-or-None); the getter binds on the first flush
-        # attempt, one tick after capture — see _flush_pending_checksum_report
-        self._pending_checksum_report = None
+        self._pending_checksum_report = PendingChecksumReport()
         self._wire_dispatch = None  # decided on first poll (socket+endpoints)
 
     # ------------------------------------------------------------------
@@ -330,6 +328,10 @@ class P2PSession:
     @property
     def current_frame(self) -> Frame:
         return self.sync_layer.current_frame
+
+    @property
+    def last_saved_frame(self) -> Frame:
+        return self.sync_layer.last_saved_frame
 
     def current_state(self) -> SessionState:
         return self.state
@@ -533,14 +535,11 @@ class P2PSession:
         interval = self.desync_detection.interval
         current = self.sync_layer.current_frame
         # Flush BEFORE capturing this tick's observation: a report captured
-        # at tick t may cover a frame whose *correcting* rollback is still in
-        # tick t's (unfulfilled) request list — its cell only becomes final
-        # after the caller fulfills those requests. Reading it on a later
-        # tick guarantees the reported value is the converged one; reading
-        # it in the same tick can publish a mid-correction checksum and
-        # raise false desyncs.
-        self._flush_pending_checksum_report(
-            force=current % interval == interval - 1
+        # at tick t covers a frame whose *correcting* rollback may still be
+        # in tick t's (unfulfilled) request list — PendingChecksumReport
+        # reads the value on a later tick, once the cell is final.
+        self._pending_checksum_report.flush(
+            force=current % interval == interval - 1, emit=self._emit_checksum_report
         )
         # Deliberate divergence from the reference (p2p_session.rs:903): it
         # reports last_saved-1, which under misprediction is a *speculative*
@@ -552,49 +551,17 @@ class P2PSession:
             cell = self.sync_layer.saved_state_by_frame(frame_to_send)
             # the confirmed frame may have rotated out of the snapshot ring
             if cell is not None:
-                # Capture the cell, not its value: the checksum is read at
-                # flush time (next tick at the earliest), after the caller
-                # fulfilled this tick's requests. On the device backend the
-                # value may also materialize lazily — reports are periodic
-                # and peers compare by frame number, so a few ticks of send
-                # latency is harmless.
-                self._pending_checksum_report = (frame_to_send, cell, None)
+                self._pending_checksum_report.capture(frame_to_send, cell)
         if len(self.local_checksum_history) > MAX_CHECKSUM_HISTORY_SIZE:
             keep_after = current - MAX_CHECKSUM_HISTORY_SIZE
             self.local_checksum_history = {
                 f: c for f, c in self.local_checksum_history.items() if f > keep_after
             }
 
-    def _flush_pending_checksum_report(self, force: bool) -> None:
-        """Emit the captured checksum report once its cell is final and its
-        value host-ready; `force` bounds the delay to one desync interval.
-
-        The getter is bound on the FIRST flush attempt — one tick after
-        capture, when the caller has fulfilled the capturing tick's requests
-        and the cell holds the converged value — and then kept, because
-        getters are stable across later overwrites of the (reused) ring slot
-        (sync_layer.py:95-104) while the cell itself is not."""
-        pending = self._pending_checksum_report
-        if pending is None:
-            return
-        frame, cell, getter = pending
-        if getter is None:
-            if cell.frame != frame:  # ring slot reused before the first read
-                self._pending_checksum_report = None
-                return
-            getter = cell.checksum_getter()
-            self._pending_checksum_report = (frame, cell, getter)
-        if not force and not getattr(getter, "ready", True):
-            prefetch = getattr(getter, "prefetch", None)
-            if callable(prefetch):
-                prefetch()
-            return
-        checksum = getter()
-        if checksum is not None:
-            for endpoint in self.player_reg.remotes.values():
-                endpoint.send_checksum_report(frame, checksum)
-            self.local_checksum_history[frame] = checksum
-        self._pending_checksum_report = None
+    def _emit_checksum_report(self, frame: Frame, checksum: int) -> None:
+        for endpoint in self.player_reg.remotes.values():
+            endpoint.send_checksum_report(frame, checksum)
+        self.local_checksum_history[frame] = checksum
 
     def _compare_local_checksums_against_peers(self) -> None:
         if self.sync_layer.current_frame % self.desync_detection.interval != 0:

@@ -18,6 +18,37 @@ from ..sync_layer import ConnectionStatus, SyncLayer
 from ..types import AdvanceFrame, Frame, PlayerHandle, Request
 
 
+class DeferredChecks:
+    """Deferred checksum observations, shared by the Python and native
+    SyncTest sessions: capture lazy getters at tick t, verify them `lag`
+    ticks later in bursts — one batched device->host transfer covering
+    `lag` ticks of observations instead of a per-tick stall."""
+
+    __slots__ = ("lag", "_pending")
+
+    def __init__(self, lag: int):
+        self.lag = lag
+        self._pending: Deque[Tuple[int, Frame, object]] = deque()
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def schedule(self, tick: int, frame: Frame, getter) -> None:
+        self._pending.append((tick + self.lag, frame, getter))
+
+    def drain_due(self, tick: int, verify) -> None:
+        """verify(frame, getter) for every observation due by `tick`."""
+        while self._pending and self._pending[0][0] <= tick:
+            _, frame, getter = self._pending.popleft()
+            verify(frame, getter)
+
+    def flush(self, verify) -> None:
+        """Force every deferred comparison now (end of run / tests)."""
+        while self._pending:
+            _, frame, getter = self._pending.popleft()
+            verify(frame, getter)
+
+
 class SyncTestSession:
     def __init__(
         self,
@@ -47,7 +78,7 @@ class SyncTestSession:
         # stalls the tick on a device->host checksum transfer. Mismatches
         # still raise MismatchedChecksum, at most `lag` ticks late.
         self.deferred_checksum_lag = deferred_checksum_lag
-        self._pending_checks: Deque[Tuple[int, Frame, object]] = deque()
+        self._pending_checks = DeferredChecks(deferred_checksum_lag)
         self._tick = 0
 
     def add_local_input(self, player_handle: PlayerHandle, buf: bytes) -> None:
@@ -111,7 +142,6 @@ class SyncTestSession:
     def _schedule_checks(self) -> None:
         """Capture this tick's checksum observations (the same cells the
         eager path would compare right now) for later verification."""
-        due = self._tick + self.deferred_checksum_lag
         for i in range(self.check_distance + 1):
             frame_to_check = self.sync_layer.current_frame - i
             cell = self.sync_layer.saved_state_by_frame(frame_to_check)
@@ -120,12 +150,12 @@ class SyncTestSession:
             # No prefetch here: per-tick async copies serialize with compute
             # on a tunneled device; the drain burst's single batched
             # device_get is strictly cheaper.
-            self._pending_checks.append((due, frame_to_check, cell.checksum_getter()))
+            self._pending_checks.schedule(
+                self._tick, frame_to_check, cell.checksum_getter()
+            )
 
     def _drain_due_checks(self) -> None:
-        while self._pending_checks and self._pending_checks[0][0] <= self._tick:
-            _, frame, getter = self._pending_checks.popleft()
-            self._verify_observation(frame, getter)
+        self._pending_checks.drain_due(self._tick, self._verify_observation)
         # GC: no future observation can reference frames this old
         oldest_live = self.sync_layer.current_frame - (
             self.check_distance + self.deferred_checksum_lag + 1
@@ -145,9 +175,7 @@ class SyncTestSession:
 
     def flush_checksum_checks(self) -> None:
         """Force every deferred comparison now (end of run / tests)."""
-        while self._pending_checks:
-            _, frame, getter = self._pending_checks.popleft()
-            self._verify_observation(frame, getter)
+        self._pending_checks.flush(self._verify_observation)
 
     def _checksums_consistent(self, frame_to_check: Frame) -> bool:
         """(src/sessions/sync_test_session.rs:159-176)"""

@@ -104,6 +104,50 @@ class GameStateCell:
             return lambda: value
 
 
+class PendingChecksumReport:
+    """Deferred desync-detection report, shared by the Python and native P2P
+    sessions (p2p_session.py / native/session.py).
+
+    Capture the *cell* at tick t; bind its checksum getter on the first
+    flush attempt — one tick later at the earliest, once the capturing
+    tick's requests are fulfilled and the cell holds the converged value
+    (reading it in the same tick can publish a mid-correction checksum and
+    raise false desyncs); then keep the getter, because getters are stable
+    across later overwrites of the reused ring slot (GameStateCell
+    .checksum_getter) while the cell itself is not. Emit once the value is
+    host-ready; `force` bounds the delay to one desync interval."""
+
+    __slots__ = ("_pending",)
+
+    def __init__(self) -> None:
+        self._pending = None
+
+    def capture(self, frame: Frame, cell: GameStateCell) -> None:
+        self._pending = (frame, cell, None)
+
+    def flush(self, force: bool, emit) -> None:
+        """emit(frame, checksum) is called at most once per captured report."""
+        pending = self._pending
+        if pending is None:
+            return
+        frame, cell, getter = pending
+        if getter is None:
+            if cell.frame != frame:  # ring slot reused before the first read
+                self._pending = None
+                return
+            getter = cell.checksum_getter()
+            self._pending = (frame, cell, getter)
+        if not force and not getattr(getter, "ready", True):
+            prefetch = getattr(getter, "prefetch", None)
+            if callable(prefetch):
+                prefetch()
+            return
+        checksum = getter()
+        if checksum is not None:
+            emit(frame, checksum)
+        self._pending = None
+
+
 class SavedStates:
     """Ring of snapshot cells; capacity max_prediction + 2 so the next frame
     has a slot while the full rollback distance stays loadable

@@ -264,7 +264,12 @@ def test_native_p2p_request_stream_parity_vs_python(
             r1 = s1.advance_frame()
             s2.add_local_input(1, bytes([(frame * 5 + 2) % 16]))
             r2 = s2.advance_frame()
-            stream.append((req_sig(r1), req_sig(r2)))
+            status_sig = tuple(
+                (st.disconnected, st.last_frame) for st in s1.local_connect_status
+            )
+            stream.append(
+                (req_sig(r1), req_sig(r2), status_sig, s1.last_saved_frame)
+            )
             g1.handle_requests(r1)
             g2.handle_requests(r2)
             clock.advance(16)
