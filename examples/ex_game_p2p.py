@@ -48,6 +48,11 @@ def main() -> int:
     ap.add_argument("--frames", type=int, default=600)
     ap.add_argument("--input-delay", type=int, default=2)
     ap.add_argument("--entities", type=int, default=4096)
+    ap.add_argument(
+        "--native",
+        action="store_true",
+        help="run on the C++ session core (requires `make -C native`)",
+    )
     args = ap.parse_args()
 
     builder = (
@@ -56,6 +61,8 @@ def main() -> int:
         .with_input_delay(args.input_delay)
         .with_fps(FPS)
     )
+    if args.native:
+        builder = builder.with_native_sessions(True)
     local_handles = []
     for handle, spec in enumerate(args.players):
         if spec == "local":

@@ -40,15 +40,24 @@ def main() -> int:
     ap.add_argument("--num-players", type=int, default=2)
     ap.add_argument("--frames", type=int, default=600)
     ap.add_argument("--entities", type=int, default=4096)
+    ap.add_argument(
+        "--native",
+        action="store_true",
+        help="run on the C++ session core (requires `make -C native`)",
+    )
     args = ap.parse_args()
 
-    sess = (
+    builder = (
         SessionBuilder(input_size=1)
         .with_num_players(args.num_players)
         .with_fps(FPS)
         .with_max_frames_behind(10)
         .with_catchup_speed(2)
-        .start_spectator_session(parse_addr(args.host), UdpNonBlockingSocket(args.local_port))
+    )
+    if args.native:
+        builder = builder.with_native_sessions(True)
+    sess = builder.start_spectator_session(
+        parse_addr(args.host), UdpNonBlockingSocket(args.local_port)
     )
     game = HostGame(args.num_players, args.entities)
 

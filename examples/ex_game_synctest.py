@@ -29,16 +29,23 @@ def main() -> int:
     ap.add_argument("--input-delay", type=int, default=0)
     ap.add_argument("--entities", type=int, default=4096)
     ap.add_argument("--host", action="store_true", help="numpy host path instead of TPU")
+    ap.add_argument(
+        "--native",
+        action="store_true",
+        help="run on the C++ session core (requires `make -C native`)",
+    )
     args = ap.parse_args()
 
-    sess = (
+    builder = (
         SessionBuilder(input_size=1)
         .with_num_players(args.players)
         .with_max_prediction_window(args.max_prediction)
         .with_check_distance(args.check_distance)
         .with_input_delay(args.input_delay)
-        .start_synctest_session()
     )
+    if args.native:
+        builder = builder.with_native_sessions(True)
+    sess = builder.start_synctest_session()
 
     if args.host:
         game = HostGame(args.players, args.entities)

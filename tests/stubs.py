@@ -83,3 +83,41 @@ class RandomChecksumGameStub(GameStub):
 
     def checksum(self, s: StateStub) -> int:
         return self._rng.getrandbits(64)
+
+
+class EnumInput:
+    """Fieldless-enum input contract (tests/stubs_enum.rs:18-29): the valid
+    encodings are sparse, non-contiguous byte patterns, and decoding
+    anything else is an error — the CheckedBitPattern analog for the
+    byte-string input POD."""
+
+    UP, DOWN, LEFT, RIGHT = 0x00, 0x01, 0x40, 0xFA  # deliberately sparse
+    VALUES = (UP, DOWN, LEFT, RIGHT)
+
+    @staticmethod
+    def encode(value: int) -> bytes:
+        assert value in EnumInput.VALUES
+        return bytes([value])
+
+    @staticmethod
+    def decode(buf: bytes) -> int:
+        value = buf[0]
+        if value not in EnumInput.VALUES:
+            raise ValueError(f"invalid EnumInput bit pattern 0x{value:02x}")
+        return value
+
+
+class GameStubEnum(GameStub):
+    """GameStub over enum inputs (tests/stubs_enum.rs): every confirmed or
+    predicted input must decode to a valid enum member after crossing the
+    queue/compression/wire machinery byte-exactly. Blank predictions decode
+    to UP (0x00), like the reference's zeroed default. Decoding raises on
+    any corrupted pattern; the state march itself is GameStub's."""
+
+    def handle_requests(self, requests) -> None:
+        for req in requests:
+            if isinstance(req, AdvanceFrame):
+                for buf, status in req.inputs:
+                    if status != InputStatus.DISCONNECTED:
+                        EnumInput.decode(buf)
+        super().handle_requests(requests)
