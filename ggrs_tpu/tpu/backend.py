@@ -166,12 +166,26 @@ class TpuRollbackBackend:
         backend.handle_requests(requests)
     """
 
-    def __init__(self, game, max_prediction: int, num_players: int):
+    def __init__(self, game, max_prediction: int, num_players: int,
+                 beam_width: int = 0):
         self.core = ResimCore(game, max_prediction, num_players)
         self.num_players = num_players
         self.input_size = game.input_size
         self.current_frame: Frame = 0
         self.ledger = ChecksumLedger()
+        # Speculative input beam (north star: the rollback becomes a select).
+        # With beam_width > 0, every tick additionally rolls out B candidate
+        # input futures from the frame the NEXT rollback is expected to load
+        # (steady-state rollback depth shifts by one per tick); when the
+        # rollback arrives and its corrected input script matches a member,
+        # the precomputed trajectory is adopted — no resimulation. Correct
+        # for any game whose step branches on statuses only to zero out
+        # DISCONNECTED players (candidates are speculated as CONFIRMED).
+        self.beam_width = beam_width
+        self._spec = None  # (anchor_frame, beam_inputs, device results)
+        self._last_segment = None  # launch args, deferred to end of tick
+        self.beam_hits = 0
+        self.beam_misses = 0
 
     # ------------------------------------------------------------------
 
@@ -188,6 +202,12 @@ class TpuRollbackBackend:
             segment.append(req)
         if segment:
             self._run_segment(segment)
+        # one speculation per tick, from the final segment's frontier — an
+        # earlier segment's beam could never be matched (only the last
+        # segment defines the next tick's expected rollback anchor)
+        if self.beam_width and self._last_segment is not None:
+            self._launch_speculation(*self._last_segment)
+            self._last_segment = None
 
     def _run_segment(self, requests: List[Request]) -> None:
         load: Optional[LoadGameState] = None
@@ -240,21 +260,85 @@ class TpuRollbackBackend:
             save_slots[count] = trailing_save.frame % core.ring_len
             saves.append((count, trailing_save))
 
-        with GLOBAL_TRACER.span("tpu/fused_tick"):
-            his, los = core.tick(
-                do_load=load is not None,
-                load_slot=(load.frame % core.ring_len) if load is not None else 0,
-                inputs=inputs,
-                statuses=statuses,
-                save_slots=save_slots,
-                advance_count=count,
-            )
+        his = los = None
+        if load is not None and self._spec is not None:
+            member = self._match_speculation(load.frame, inputs, statuses, count)
+            if member is not None:
+                self.beam_hits += 1
+                with GLOBAL_TRACER.span("tpu/beam_adopt"):
+                    his, los = core.adopt(
+                        self._spec[2],
+                        member,
+                        load.frame % core.ring_len,
+                        save_slots,
+                        count,
+                    )
+            else:
+                self.beam_misses += 1
+        if his is None:
+            with GLOBAL_TRACER.span("tpu/fused_tick"):
+                his, los = core.tick(
+                    do_load=load is not None,
+                    load_slot=(load.frame % core.ring_len) if load is not None else 0,
+                    inputs=inputs,
+                    statuses=statuses,
+                    save_slots=save_slots,
+                    advance_count=count,
+                )
         self.current_frame = start_frame + count
 
         batch = _ChecksumBatch(his, los, self.ledger)
         for idx, save in saves:
             ref = SnapshotRef(save.frame, save.frame % core.ring_len)
             save.cell.save_lazy(save.frame, ref, _LazyChecksum(batch, idx))
+
+        if self.beam_width:
+            # invalidate immediately (the ring just changed under the old
+            # spec); the one speculation per tick launches in handle_requests
+            self._spec = None
+            self._last_segment = (load, start_frame, count, inputs, statuses)
+
+    # ------------------------------------------------------------------
+    # speculative beam
+    # ------------------------------------------------------------------
+
+    def _match_speculation(self, load_frame: Frame, inputs: np.ndarray,
+                           statuses: np.ndarray, count: int) -> Optional[int]:
+        from .beam import match_beam
+
+        anchor_frame, beam_inputs, _ = self._spec
+        if load_frame != anchor_frame or count > beam_inputs.shape[1]:
+            return None
+        # a disconnected player's dummy inputs were not speculated
+        if (statuses[:count] >= 2).any():
+            return None
+        return match_beam(beam_inputs, inputs[:count])
+
+    def _launch_speculation(self, load: Optional[LoadGameState],
+                            start_frame: Frame, count: int,
+                            inputs: np.ndarray, statuses: np.ndarray) -> None:
+        """Anchor at the frame the next rollback is expected to load: one
+        past this tick's load under a steady rollback depth, else the frame
+        just saved (current - 1). Both are in the ring by construction of
+        the dense-saving request grammar. Candidate scripts extend this
+        tick's last used inputs (the reference's repeat-last prediction is
+        member 0; the rest perturb one player each)."""
+        from .beam import repeat_last_beam
+
+        core = self.core
+        if count == 0:
+            return
+        anchor = load.frame + 1 if load is not None else start_frame + count - 1
+        if anchor < 0 or anchor >= start_frame + count:
+            return
+        base = inputs[count - 1]
+        beam_inputs = repeat_last_beam(base, core.window, self.beam_width)
+        beam_statuses = np.zeros(
+            (self.beam_width, core.window, self.num_players), dtype=np.int32
+        )
+        with GLOBAL_TRACER.span("tpu/beam_speculate"):
+            spec = core.speculate(anchor % core.ring_len, beam_inputs, beam_statuses)
+        self._spec = (anchor, beam_inputs, spec)
 
     # ------------------------------------------------------------------
 
@@ -280,6 +364,7 @@ class TpuRollbackBackend:
                 "current_frame": self.current_frame,
                 "max_prediction": self.core.max_prediction,
                 "num_players": self.num_players,
+                "beam_width": self.beam_width,
             },
         )
 
@@ -293,6 +378,7 @@ class TpuRollbackBackend:
             game,
             max_prediction=meta["max_prediction"],
             num_players=meta["num_players"],
+            beam_width=meta.get("beam_width", 0),
         )
         backend.core.ring = jax.device_put(tree["ring"])
         backend.core.state = jax.device_put(tree["state"])

@@ -55,6 +55,8 @@ class ResimCore:
             lambda x: jnp.zeros((self.ring_len + 1,) + x.shape, x.dtype), state
         )
         self._tick_fn = jax.jit(self._tick_impl, donate_argnums=(0, 1))
+        self._speculate_fn = jax.jit(self._speculate_impl)
+        self._adopt_fn = jax.jit(self._adopt_impl, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
 
@@ -119,6 +121,109 @@ class ResimCore:
             inputs,
             statuses,
             save_slots,
+            np.int32(advance_count),
+        )
+        return his, los
+
+    # ------------------------------------------------------------------
+    # speculative beam (the north-star "rollback becomes a select"):
+    # evaluate B candidate input futures from a ring snapshot ahead of
+    # input confirmation; a later rollback whose corrected script matches a
+    # member adopts its precomputed trajectory instead of resimulating.
+    # ------------------------------------------------------------------
+
+    def _speculate_impl(self, ring, anchor_slot, beam_inputs, beam_statuses):
+        """beam_inputs u8[B, W, P, I], beam_statuses i32[B, W, P] ->
+        per-member per-frame trajectories [B, W, ...], per-frame checksums
+        [B, W] (of the state AFTER each step), and the anchor's checksum."""
+        anchor = jax.tree.map(
+            lambda r: jax.lax.dynamic_index_in_dim(r, anchor_slot, 0, keepdims=False),
+            ring,
+        )
+        a_hi, a_lo = self.game.checksum(anchor)
+
+        def rollout_one(inputs, statuses):
+            def body(s, xs):
+                inp, stat = xs
+                nxt = self.game.step(s, inp, stat)
+                hi, lo = self.game.checksum(nxt)
+                return nxt, (nxt, hi, lo)
+
+            _, (traj, his, los) = jax.lax.scan(body, anchor, (inputs, statuses))
+            return traj, his, los
+
+        traj, his, los = jax.vmap(rollout_one)(beam_inputs, beam_statuses)
+        return traj, his, los, a_hi, a_lo
+
+    def speculate(self, anchor_slot: int, beam_inputs: np.ndarray,
+                  beam_statuses: np.ndarray):
+        """Dispatch a beam rollout from ring slot `anchor_slot` (async)."""
+        return self._speculate_fn(
+            self.ring, np.int32(anchor_slot), beam_inputs, beam_statuses
+        )
+
+    def _adopt_impl(self, ring, traj, member, load_slot, save_slots,
+                    spec_his, spec_los, a_hi, a_lo, advance_count):
+        """Commit beam member `member`'s trajectory as this tick's result:
+        fill the requested ring slots with its per-frame states (slot i =
+        state at load_frame + i, exactly what _tick_impl's resim would have
+        saved) and set the live state to the final frame. Checksums come
+        from the speculation (slot 0 = anchor's, slot i>0 = member's step
+        i-1), so no step or checksum math reruns here."""
+        loaded = jax.tree.map(
+            lambda r: jax.lax.dynamic_index_in_dim(r, load_slot, 0, keepdims=False),
+            ring,
+        )
+        mtraj = jax.tree.map(
+            lambda t: jax.lax.dynamic_index_in_dim(t, member, 0, keepdims=False),
+            traj,
+        )
+        iota = jnp.arange(self.window, dtype=jnp.int32)
+
+        def body(ring, xs):
+            i, save_slot = xs
+            prev = jax.tree.map(
+                lambda t: jax.lax.dynamic_index_in_dim(
+                    t, jnp.maximum(i - 1, 0), 0, keepdims=False
+                ),
+                mtraj,
+            )
+            s_i = _tree_where(i == 0, loaded, prev)
+            ring = jax.tree.map(
+                lambda r, s: jax.lax.dynamic_update_index_in_dim(r, s, save_slot, 0),
+                ring,
+                s_i,
+            )
+            return ring, None
+
+        ring, _ = jax.lax.scan(body, ring, (iota, save_slots))
+        state = jax.tree.map(
+            lambda t: jax.lax.dynamic_index_in_dim(
+                t, jnp.maximum(advance_count - 1, 0), 0, keepdims=False
+            ),
+            mtraj,
+        )
+        mhis = jax.lax.dynamic_index_in_dim(spec_his, member, 0, keepdims=False)
+        mlos = jax.lax.dynamic_index_in_dim(spec_los, member, 0, keepdims=False)
+        his = jnp.concatenate([a_hi[None], mhis[: self.window - 1]])
+        los = jnp.concatenate([a_lo[None], mlos[: self.window - 1]])
+        return ring, state, his, los
+
+    def adopt(self, spec, member: int, load_slot: int, save_slots: np.ndarray,
+              advance_count: int) -> Tuple[Any, Any]:
+        """Fulfill a rollback tick from a matching speculation; returns
+        (checksum_hi[W], checksum_lo[W]) like tick()."""
+        traj, spec_his, spec_los, a_hi, a_lo = spec
+        self.ring, self.state, his, los = self._adopt_fn(
+            self.ring,
+            traj,
+            np.int32(member),
+            np.int32(load_slot),
+            save_slots,
+            spec_his,
+            spec_los,
+            a_hi,
+            a_lo,
             np.int32(advance_count),
         )
         return his, los

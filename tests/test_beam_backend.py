@@ -1,0 +1,166 @@
+"""The integrated speculative beam: rollback-as-select inside
+TpuRollbackBackend (the north star's 'InputQueue prediction fans out into a
+beam of candidate input sequences evaluated in parallel on-device').
+
+The plain (resimulating) backend is the oracle: driving the same
+deterministic request streams through a beam backend must produce
+bit-identical states and checksums, whether the beam hits (trajectory
+adopted) or misses (fallback resim).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from ggrs_tpu import PlayerType, SessionBuilder, SessionState
+from ggrs_tpu.models.ex_game import ExGame
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.tpu import TpuRollbackBackend
+from ggrs_tpu.utils.clock import FakeClock
+
+ENTITIES = 64
+PLAYERS = 2
+
+
+def make_backend(beam_width, max_prediction=6):
+    return TpuRollbackBackend(
+        ExGame(num_players=PLAYERS, num_entities=ENTITIES),
+        max_prediction=max_prediction,
+        num_players=PLAYERS,
+        beam_width=beam_width,
+    )
+
+
+def make_synctest(check_distance=4, max_prediction=6):
+    return (
+        SessionBuilder(input_size=1)
+        .with_num_players(PLAYERS)
+        .with_max_prediction_window(max_prediction)
+        .with_check_distance(check_distance)
+        .start_synctest_session()
+    )
+
+
+def assert_states_equal(a, b, context):
+    sa, sb = a.state_numpy(), b.state_numpy()
+    for k in sa:
+        assert np.array_equal(np.asarray(sa[k]), np.asarray(sb[k])), (
+            f"state[{k}] diverged {context}"
+        )
+
+
+def drive_synctest_pair(beam, plain, inputs_for, ticks):
+    """Two identical sessions, one per backend; compare states every tick
+    and saved checksums at the end."""
+    sess_beam, sess_plain = make_synctest(), make_synctest()
+    beam_cells, plain_cells = [], []
+    for t in range(ticks):
+        for h in range(PLAYERS):
+            buf = inputs_for(t, h)
+            sess_beam.add_local_input(h, buf)
+            sess_plain.add_local_input(h, buf)
+        rb = sess_beam.advance_frame()
+        rp = sess_plain.advance_frame()
+        beam.handle_requests(rb)
+        plain.handle_requests(rp)
+        beam_cells += [r.cell for r in rb if hasattr(r, "cell")]
+        plain_cells += [r.cell for r in rp if hasattr(r, "cell")]
+        assert_states_equal(beam, plain, f"at tick {t}")
+    for cb, cp in zip(beam_cells, plain_cells):
+        assert cb.frame == cp.frame
+        assert cb.checksum == cp.checksum, f"checksum diverged at frame {cb.frame}"
+
+
+def test_beam_hits_on_steady_inputs_and_matches_resim():
+    """Constant inputs: every forced SyncTest rollback's script equals the
+    repeat-last beam member, so after the first speculation every tick is
+    an adopted trajectory — and must be bit-identical to resimulation."""
+    beam, plain = make_backend(beam_width=8), make_backend(beam_width=0)
+    drive_synctest_pair(
+        beam, plain, lambda t, h: bytes([3 + 2 * h]), ticks=25
+    )
+    # rollbacks begin once current_frame > check_distance; the very first
+    # one misses (the anchor heuristic assumes a steady rollback depth, and
+    # the depth jumps from 0 to check_distance there), every later one
+    # must adopt
+    assert beam.beam_hits >= 18 and beam.beam_misses <= 1, (
+        beam.beam_hits, beam.beam_misses,
+    )
+    assert plain.beam_hits == 0
+
+
+def test_beam_misses_on_varying_inputs_and_matches_resim():
+    """Per-frame-varying inputs never match repeat-based candidates: every
+    rollback falls back to resimulation, still bit-identical."""
+    beam, plain = make_backend(beam_width=8), make_backend(beam_width=0)
+    drive_synctest_pair(
+        beam, plain, lambda t, h: bytes([(t * (h + 3) + h) % 16]), ticks=25
+    )
+    assert beam.beam_misses >= 15
+    assert beam.beam_hits == 0
+
+
+def test_beam_perturbed_member_hits_in_p2p():
+    """The P2P case the beam exists for: the blank first-frame prediction
+    for the remote player is wrong, but the remote's real (constant) input
+    matches a perturbed beam member, so the correcting rollback is adopted.
+    Two identical session pairs (deterministic net) — the beam pair's
+    backend states must track the plain pair's exactly."""
+
+    def build_pair():
+        clock = FakeClock()
+        net = InMemoryNetwork(clock)
+
+        def build(my_addr, other_addr, local_handle):
+            return (
+                SessionBuilder(input_size=1)
+                .with_num_players(PLAYERS)
+                .with_max_prediction_window(6)
+                .with_clock(clock)
+                .with_rng(random.Random(hash(my_addr) & 0xFFFF))
+                .add_player(PlayerType.local(), local_handle)
+                .add_player(PlayerType.remote(other_addr), 1 - local_handle)
+                .start_p2p_session(net.socket(my_addr))
+            )
+
+        s0, s1 = build("a", "b", 0), build("b", "a", 1)
+        for _ in range(400):
+            s0.poll_remote_clients()
+            s1.poll_remote_clients()
+            clock.advance(20)
+            if (
+                s0.current_state() == SessionState.RUNNING
+                and s1.current_state() == SessionState.RUNNING
+            ):
+                break
+        return clock, s0, s1
+
+    # local constant 5, remote constant 2: the remote's value equals the
+    # XOR-2 perturbation of the blank prediction, so member (pattern 2,
+    # player 1) covers the corrected script
+    results = []
+    for beam_width in (8, 0):
+        clock, s0, s1 = build_pair()
+        backend0 = make_backend(beam_width)
+        backend1 = make_backend(0)
+        states = []
+        for frame in range(20):
+            s0.add_local_input(0, bytes([5]))
+            backend0.handle_requests(s0.advance_frame())
+            s1.add_local_input(1, bytes([2]))
+            backend1.handle_requests(s1.advance_frame())
+            states.append(backend0.state_numpy())
+            clock.advance(16)
+        results.append((backend0, states))
+
+    beam_backend, beam_states = results[0]
+    _plain_backend, plain_states = results[1]
+    assert beam_backend.beam_hits >= 1, (
+        beam_backend.beam_hits, beam_backend.beam_misses,
+    )
+    for t, (sa, sb) in enumerate(zip(beam_states, plain_states)):
+        for k in sa:
+            assert np.array_equal(np.asarray(sa[k]), np.asarray(sb[k])), (
+                f"state[{k}] diverged at tick {t}"
+            )

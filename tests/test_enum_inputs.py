@@ -12,12 +12,7 @@ import random
 
 import pytest
 
-from ggrs_tpu import (
-    MismatchedChecksum,
-    PlayerType,
-    SessionBuilder,
-    SessionState,
-)
+from ggrs_tpu import PlayerType, SessionBuilder, SessionState
 from ggrs_tpu.native import available
 from ggrs_tpu.network.sockets import InMemoryNetwork
 from ggrs_tpu.utils.clock import FakeClock
