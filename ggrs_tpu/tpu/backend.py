@@ -24,7 +24,14 @@ import jax
 import numpy as np
 
 from ..ops.fixed_point import combine_checksum
-from ..types import AdvanceFrame, Frame, LoadGameState, Request, SaveGameState
+from ..types import (
+    AdvanceFrame,
+    Frame,
+    InputStatus,
+    LoadGameState,
+    Request,
+    SaveGameState,
+)
 from ..utils.tracing import GLOBAL_TRACER
 from .resim import ResimCore
 
@@ -310,7 +317,7 @@ class TpuRollbackBackend:
         if load_frame != anchor_frame or count > beam_inputs.shape[1]:
             return None
         # a disconnected player's dummy inputs were not speculated
-        if (statuses[:count] >= 2).any():
+        if (statuses[:count] >= int(InputStatus.DISCONNECTED)).any():
             return None
         return match_beam(beam_inputs, inputs[:count])
 

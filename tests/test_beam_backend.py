@@ -11,7 +11,6 @@ adopted) or misses (fallback resim).
 import random
 
 import numpy as np
-import pytest
 
 from ggrs_tpu import PlayerType, SessionBuilder, SessionState
 from ggrs_tpu.models.ex_game import ExGame
