@@ -37,10 +37,22 @@ class DeferredChecks:
         self._pending.append((tick + self.lag, frame, getter))
 
     def drain_due(self, tick: int, verify) -> None:
-        """verify(frame, getter) for every observation due by `tick`."""
+        """verify(frame, getter) for every observation due by `tick`, then
+        start background device->host copies for the observations due at
+        the NEXT burst: a synchronous fetch on a tunneled device costs a
+        ~100ms round trip, but a burst period (lag ticks) from now the
+        async copies will long since have landed, so steady-state drains
+        resolve from host memory."""
         while self._pending and self._pending[0][0] <= tick:
             _, frame, getter = self._pending.popleft()
             verify(frame, getter)
+        self.prefetch_pending()
+
+    def prefetch_pending(self) -> None:
+        for _, _, getter in self._pending:
+            prefetch = getattr(getter, "prefetch", None)
+            if callable(prefetch):
+                prefetch()
 
     def flush(self, verify) -> None:
         """Force every deferred comparison now (end of run / tests)."""

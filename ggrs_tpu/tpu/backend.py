@@ -66,13 +66,19 @@ class _ChecksumBatch:
         ledger.register(self)
 
     def prefetch(self) -> None:
-        """Start a background device->host copy (non-blocking)."""
+        """Start a background device->host copy (non-blocking). Only marked
+        prefetched when a copy actually started: resolve() trusts the flag
+        to read per-batch without a fresh round trip, which would otherwise
+        turn into per-batch blocking transfers on array types without
+        copy_to_host_async (those keep the packed ledger-flush path)."""
         if self._np is None and not self._prefetched:
-            self._prefetched = True
+            started = False
             for arr in (self._his, self._los):
                 copy = getattr(arr, "copy_to_host_async", None)
                 if callable(copy):
                     copy()
+                    started = True
+            self._prefetched = started
 
     @property
     def ready(self) -> bool:
@@ -82,9 +88,13 @@ class _ChecksumBatch:
         )
 
     def resolve(self, idx: int) -> int:
-        if self._np is None and self._prefetched and self.ready:
+        if self._np is None and self._prefetched:
             # consume the async host copy directly; going through the
-            # ledger's packed transfer would re-fetch what already landed
+            # ledger's packed transfer would re-fetch what the prefetch
+            # already moved. Callers prefetch a full drain period before
+            # resolving, so this conversion is a host-memory read in steady
+            # state (and at worst waits on the in-flight copy — still
+            # cheaper than a fresh packed round trip).
             self._store(self._his, self._los)
         if self._np is None:
             self._ledger.flush()

@@ -54,11 +54,40 @@ class ResimCore:
         self.ring = jax.tree.map(
             lambda x: jnp.zeros((self.ring_len + 1,) + x.shape, x.dtype), state
         )
-        self._tick_fn = jax.jit(self._tick_impl, donate_argnums=(0, 1))
+        self._tick_fn = jax.jit(self._tick_packed_impl, donate_argnums=(0, 1))
         self._speculate_fn = jax.jit(self._speculate_impl)
         self._adopt_fn = jax.jit(self._adopt_impl, donate_argnums=(0,))
+        # packed control-word layout, shared by the pack sites (tick, adopt)
+        # and unpack sites (_tick_packed_impl, _adopt_impl): 3 header words,
+        # then save_slots[W], statuses[W*P], inputs[W*P*I]
+        p, i = num_players, game.input_size
+        self._off_save = 3
+        self._off_status = self._off_save + self.window
+        self._off_input = self._off_status + self.window * p
+        self._packed_len = self._off_input + self.window * p * i
 
     # ------------------------------------------------------------------
+
+    def _tick_packed_impl(self, ring, state, packed):
+        """Unpack the single control-word array (see tick()) and run the
+        fused tick. One argument means ONE host->device transfer per tick —
+        on a tunneled device every transferred buffer pays a latency floor
+        regardless of size, so 7 small args cost ~7 floors."""
+        W, P, I = self.window, self.num_players, self.game.input_size
+        do_load = packed[0] != 0
+        load_slot = packed[1]
+        advance_count = packed[2]
+        save_slots = packed[self._off_save : self._off_status]
+        statuses = packed[self._off_status : self._off_input].reshape(W, P)
+        inputs = (
+            packed[self._off_input : self._packed_len]
+            .astype(jnp.uint8)
+            .reshape(W, P, I)
+        )
+        return self._tick_impl(
+            ring, state, do_load, load_slot, inputs, statuses, save_slots,
+            advance_count,
+        )
 
     def _tick_impl(
         self,
@@ -111,17 +140,15 @@ class ResimCore:
     ) -> Tuple[Any, Any]:
         """Run one fused tick; returns (checksum_hi[W], checksum_lo[W]) as
         device arrays (no host sync)."""
-        # numpy scalars go straight into the jitted call — eager
-        # jnp.asarray would dispatch a convert primitive per argument
+        packed = np.empty((self._packed_len,), dtype=np.int32)
+        packed[0] = 1 if do_load else 0
+        packed[1] = load_slot
+        packed[2] = advance_count
+        packed[self._off_save : self._off_status] = save_slots
+        packed[self._off_status : self._off_input] = statuses.reshape(-1)
+        packed[self._off_input :] = inputs.reshape(-1)
         self.ring, self.state, his, los = self._tick_fn(
-            self.ring,
-            self.state,
-            np.bool_(do_load),
-            np.int32(load_slot),
-            inputs,
-            statuses,
-            save_slots,
-            np.int32(advance_count),
+            self.ring, self.state, packed
         )
         return his, los
 
@@ -162,14 +189,18 @@ class ResimCore:
             self.ring, np.int32(anchor_slot), beam_inputs, beam_statuses
         )
 
-    def _adopt_impl(self, ring, traj, member, load_slot, save_slots,
-                    spec_his, spec_los, a_hi, a_lo, advance_count):
-        """Commit beam member `member`'s trajectory as this tick's result:
-        fill the requested ring slots with its per-frame states (slot i =
-        state at load_frame + i, exactly what _tick_impl's resim would have
-        saved) and set the live state to the final frame. Checksums come
-        from the speculation (slot 0 = anchor's, slot i>0 = member's step
-        i-1), so no step or checksum math reruns here."""
+    def _adopt_impl(self, ring, traj, spec_his, spec_los, a_hi, a_lo, packed):
+        """Commit a beam member's trajectory as this tick's result: fill the
+        requested ring slots with its per-frame states (slot i = state at
+        load_frame + i, exactly what _tick_impl's resim would have saved)
+        and set the live state to the final frame. Checksums come from the
+        speculation (slot 0 = anchor's, slot i>0 = member's step i-1), so
+        no step or checksum math reruns here. Control words ride one packed
+        array for the same one-transfer reason as _tick_packed_impl."""
+        member = packed[0]
+        load_slot = packed[1]
+        advance_count = packed[2]
+        save_slots = packed[self._off_save : self._off_status]
         loaded = jax.tree.map(
             lambda r: jax.lax.dynamic_index_in_dim(r, load_slot, 0, keepdims=False),
             ring,
@@ -214,17 +245,13 @@ class ResimCore:
         """Fulfill a rollback tick from a matching speculation; returns
         (checksum_hi[W], checksum_lo[W]) like tick()."""
         traj, spec_his, spec_los, a_hi, a_lo = spec
+        packed = np.empty((self._off_status,), dtype=np.int32)
+        packed[0] = member
+        packed[1] = load_slot
+        packed[2] = advance_count
+        packed[self._off_save :] = save_slots
         self.ring, self.state, his, los = self._adopt_fn(
-            self.ring,
-            traj,
-            np.int32(member),
-            np.int32(load_slot),
-            save_slots,
-            spec_his,
-            spec_los,
-            a_hi,
-            a_lo,
-            np.int32(advance_count),
+            self.ring, traj, spec_his, spec_los, a_hi, a_lo, packed
         )
         return his, los
 
