@@ -19,12 +19,7 @@
 #include <set>
 #include <vector>
 
-extern "C" {
-long ggrs_rle_encode(const uint8_t* in, long n, uint8_t* out, long cap);
-long ggrs_rle_decode(const uint8_t* in, long n, uint8_t* out, long cap);
-void ggrs_delta_encode(const uint8_t* ref, long m, const uint8_t* inputs,
-                       long k, uint8_t* out);
-}
+#include "ggrs_native.h"
 
 namespace {
 
@@ -611,39 +606,12 @@ struct Endpoint {
 
 }  // namespace
 
+// struct layouts (ggrs_ep_config/_event/_stats) live in ggrs_native.h; the
+// local tuning constants must stay in lockstep with its fixed array sizes
+static_assert(MAX_HANDLES == 16, "ggrs_native.h pins handles[16]");
+static_assert(MAX_INPUT_SIZE == 64, "ggrs_native.h pins input[64]");
+
 extern "C" {
-
-struct ggrs_ep_config {
-  int32_t handles[MAX_HANDLES];
-  long num_handles;
-  long num_players;
-  long local_players;
-  long max_prediction;
-  long disconnect_timeout_ms;
-  long disconnect_notify_start_ms;
-  long fps;
-  long input_size;
-  uint16_t magic;
-  uint64_t rng_seed;
-};
-
-struct ggrs_ep_event {
-  int32_t type;
-  int32_t a;
-  int32_t b;
-  int32_t frame;
-  int32_t player;
-  int32_t input_len;
-  uint8_t input[MAX_INPUT_SIZE];
-};
-
-struct ggrs_ep_stats {
-  int32_t send_queue_len;
-  uint32_t ping_ms;
-  uint32_t kbps_sent;
-  int32_t local_frames_behind;
-  int32_t remote_frames_behind;
-};
 
 void* ggrs_ep_new(const ggrs_ep_config* cfg, uint64_t now_ms) {
   if (cfg->num_handles < 1 || cfg->num_handles > MAX_HANDLES) return nullptr;

@@ -12,6 +12,8 @@
 #include <cstdint>
 #include <cstring>
 
+#include "ggrs_native.h"
+
 namespace {
 
 constexpr int TOKEN_LITERAL = 0;

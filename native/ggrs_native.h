@@ -1,0 +1,261 @@
+// Public C ABI of the ggrs_tpu native runtime (the C1 "crate root" analog;
+// reference src/lib.rs:45-279). Everything here is exported from
+// libggrs_native.so with C linkage and plain-old-data arguments, so the
+// runtime is consumable from C/C++ directly as well as via the ctypes
+// bindings in ggrs_tpu/native/.
+//
+// Families:
+//   ggrs_rle_* / ggrs_delta_* / ggrs_weighted_checksum  codec kernels
+//       (ggrs_native.cpp; format oracle: ggrs_tpu/network/compression.py)
+//   ggrs_iq_*    per-player input queue (input_queue.cpp; oracle:
+//                ggrs_tpu/input_queue.py; reference src/input_queue.rs)
+//   ggrs_ep_*    per-peer reliability endpoint incl. TimeSync + stats
+//                (endpoint.cpp; oracle: ggrs_tpu/network/protocol.py;
+//                reference src/network/protocol.rs)
+//   ggrs_udp_*   nonblocking UDP socket (udp_socket.cpp; reference
+//                src/network/udp_socket.rs)
+//   ggrs_sess_*  session core: SyncLayer + P2P / SyncTest / Spectator
+//                state machines (session.cpp; oracles: ggrs_tpu/sessions/;
+//                reference src/sessions/, src/sync_layer.rs)
+//
+// Conventions:
+//   * handles are opaque void*; every ggrs_X_new has a ggrs_X_free
+//   * all clock-dependent calls take now_ms (caller-supplied monotonic
+//     milliseconds) — the library never reads a clock, so hosts can drive
+//     deterministic fake time
+//   * functions return 0/length on success; negative codes are errors
+//     (see the GGRS_SERR_* values below for the session family)
+//   * frames are int32 with -1 = NULL_FRAME (reference src/lib.rs:46)
+//
+// ggrs_native_abi_version() must match the consumer's expectation (the
+// ctypes loader pins it); bump it whenever this surface changes.
+
+#ifndef GGRS_NATIVE_H_
+#define GGRS_NATIVE_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---------------------------------------------------------------------------
+// versioning
+// ---------------------------------------------------------------------------
+
+long ggrs_native_abi_version(void);
+
+// ---------------------------------------------------------------------------
+// codec kernels (XOR-delta + byte RLE input compression, state checksum)
+// ---------------------------------------------------------------------------
+
+long ggrs_rle_encode(const uint8_t* in, long n, uint8_t* out, long cap);
+long ggrs_rle_decode(const uint8_t* in, long n, uint8_t* out, long cap);
+void ggrs_delta_encode(const uint8_t* ref, long m, const uint8_t* inputs,
+                       long k, uint8_t* out);
+void ggrs_delta_decode(const uint8_t* ref, long m, const uint8_t* data,
+                       long k, uint8_t* out);
+void ggrs_weighted_checksum(const uint32_t* words, long n, uint32_t* hi,
+                            uint32_t* lo);
+
+// ---------------------------------------------------------------------------
+// input queue (128-slot ring, repeat-last prediction, misprediction detect)
+// ---------------------------------------------------------------------------
+
+void* ggrs_iq_new(int input_size);  // input_size in [1, 64]
+void ggrs_iq_free(void* q);
+void ggrs_iq_set_frame_delay(void* q, int delay);
+int32_t ggrs_iq_first_incorrect_frame(void* q);
+int32_t ggrs_iq_last_added_frame(void* q);
+int ggrs_iq_length(void* q);
+void ggrs_iq_reset_prediction(void* q);
+long ggrs_iq_confirmed_input(void* q, int32_t frame, uint8_t* out);
+void ggrs_iq_discard_confirmed_frames(void* q, int32_t frame);
+long ggrs_iq_input(void* q, int32_t frame, uint8_t* out);  // 0 confirmed, 1 predicted
+long ggrs_iq_add_input(void* q, int32_t frame, const uint8_t* buf);
+
+// ---------------------------------------------------------------------------
+// reliability endpoint (sync handshake, delta+RLE input send/ack, timers,
+// disconnect detection, RTT/quality, checksum reports, TimeSync)
+// ---------------------------------------------------------------------------
+
+struct ggrs_ep_config {
+  int32_t handles[16];
+  long num_handles;
+  long num_players;
+  long local_players;
+  long max_prediction;
+  long disconnect_timeout_ms;
+  long disconnect_notify_start_ms;
+  long fps;
+  long input_size;
+  uint16_t magic;
+  uint64_t rng_seed;
+};
+
+// event types: 1 Synchronizing(a=total,b=count), 2 Synchronized,
+// 3 Input(frame,player,input), 4 Disconnected, 5 Interrupted(a=timeout_ms),
+// 6 Resumed
+struct ggrs_ep_event {
+  int32_t type;
+  int32_t a;
+  int32_t b;
+  int32_t frame;
+  int32_t player;
+  int32_t input_len;
+  uint8_t input[64];
+};
+
+struct ggrs_ep_stats {
+  int32_t send_queue_len;
+  uint32_t ping_ms;
+  uint32_t kbps_sent;
+  int32_t local_frames_behind;
+  int32_t remote_frames_behind;
+};
+
+void* ggrs_ep_new(const struct ggrs_ep_config* cfg, uint64_t now_ms);
+void ggrs_ep_free(void* ep);
+long ggrs_ep_state(void* ep);  // 0 init, 1 syncing, 2 running, 3 disc, 4 shutdown
+void ggrs_ep_synchronize(void* ep, uint64_t now_ms);
+void ggrs_ep_disconnect(void* ep, uint64_t now_ms);
+void ggrs_ep_poll(void* ep, const uint8_t* disc, const int32_t* last, long n,
+                  uint64_t now_ms);
+void ggrs_ep_send_input(void* ep, int32_t frame, const uint8_t* data, long len,
+                        const uint8_t* disc, const int32_t* last, long n,
+                        uint64_t now_ms);
+void ggrs_ep_send_checksum_report(void* ep, int32_t frame,
+                                  const uint8_t* csum16, uint64_t now_ms);
+long ggrs_ep_handle_message(void* ep, const uint8_t* buf, long len,
+                            uint64_t now_ms);
+void ggrs_ep_update_local_frame_advantage(void* ep, int32_t local_frame);
+long ggrs_ep_average_frame_advantage(void* ep);
+long ggrs_ep_next_send(void* ep, uint8_t* out, long cap);
+long ggrs_ep_next_event(void* ep, struct ggrs_ep_event* out);
+long ggrs_ep_network_stats(void* ep, uint64_t now_ms, struct ggrs_ep_stats* out);
+void ggrs_ep_peer_connect_status(void* ep, uint8_t* disc, int32_t* last, long n);
+long ggrs_ep_checksum_history(void* ep, int32_t* frames, uint8_t* sums16,
+                              long cap);
+
+// ---------------------------------------------------------------------------
+// UDP socket (fd-based; addresses are host-byte-order IPv4 + port)
+// ---------------------------------------------------------------------------
+
+long ggrs_udp_bind(long port);  // nonblocking 0.0.0.0:port; fd or -1
+long ggrs_udp_local_port(long fd);
+void ggrs_udp_close(long fd);
+long ggrs_udp_send(long fd, const uint8_t* buf, long len, uint32_t ip_host,
+                   uint16_t port);
+// length, -1 = drained (EWOULDBLOCK), -2 = transient error (skip)
+long ggrs_udp_recv(long fd, uint8_t* buf, long cap, uint32_t* ip_host,
+                   uint16_t* port);
+
+// ---------------------------------------------------------------------------
+// session core (SyncLayer + P2P / SyncTest / Spectator)
+// ---------------------------------------------------------------------------
+
+#define GGRS_SESS_P2P 0
+#define GGRS_SESS_SYNCTEST 1
+#define GGRS_SESS_SPECTATOR 2
+
+#define GGRS_KIND_LOCAL 0
+#define GGRS_KIND_REMOTE 1
+#define GGRS_KIND_SPECTATOR 2
+
+// session error codes
+#define GGRS_SERR_NOT_SYNCHRONIZED (-2)
+#define GGRS_SERR_PREDICTION_THRESHOLD (-3)
+#define GGRS_SERR_MISSING_INPUT (-4)
+#define GGRS_SERR_MISMATCHED_CHECKSUM (-5)
+#define GGRS_SERR_SPECTATOR_TOO_FAR_BEHIND (-6)
+#define GGRS_SERR_INVALID_HANDLE (-7)
+#define GGRS_SERR_LOCAL_PLAYER (-8)
+#define GGRS_SERR_ALREADY_DISCONNECTED (-9)
+#define GGRS_SERR_INTERNAL (-10)
+#define GGRS_SERR_CAPACITY (-11)
+
+struct ggrs_sess_config {
+  int32_t session_type;  // GGRS_SESS_*
+  int32_t num_players;
+  int32_t max_prediction;
+  int32_t input_size;
+  int32_t input_delay;
+  int32_t sparse_saving;
+  int32_t desync_interval;  // 0 = off
+  int32_t check_distance;
+  int32_t max_frames_behind;
+  int32_t catchup_speed;
+  int32_t fps;
+  int32_t disconnect_timeout_ms;
+  int32_t disconnect_notify_start_ms;
+  int32_t total_handles;                // players + spectators
+  int32_t num_endpoints;                // unique remote addresses
+  int32_t player_kinds[32];             // GGRS_KIND_* per handle, -1 = unused
+  int32_t player_endpoints[32];         // endpoint index per handle, -1 local
+  uint64_t rng_seed;
+};
+
+// ordered requests (the reference's GGRSRequest contract, src/lib.rs:169-194):
+// type 0 = SaveGameState (cell = snapshot ring slot), 1 = LoadGameState,
+// 2 = AdvanceFrame (statuses: 0 confirmed, 1 predicted, 2 disconnected;
+// inputs packed per player)
+struct ggrs_sess_req {
+  int32_t type;
+  int32_t frame;
+  int32_t cell;
+  int32_t statuses[16];
+  uint8_t inputs[16 * 64];
+};
+
+// session events: 1 Synchronizing(ep,a=total,b=count), 2 Synchronized(ep),
+// 3 Disconnected(ep), 4 NetworkInterrupted(ep,a=timeout_ms),
+// 5 NetworkResumed(ep), 6 WaitRecommendation(a=skip_frames),
+// 7 DesyncDetected(ep,a=frame,local/remote checksums)
+struct ggrs_sess_event {
+  int32_t type;
+  int32_t ep;
+  int32_t a;
+  int32_t b;
+  uint8_t local_checksum[16];
+  uint8_t remote_checksum[16];
+};
+
+void* ggrs_sess_new(const struct ggrs_sess_config* cfg, uint64_t now_ms);
+void ggrs_sess_free(void* s);
+long ggrs_sess_state(void* s);  // 0 synchronizing, 1 running
+int32_t ggrs_sess_current_frame(void* s);
+int32_t ggrs_sess_confirmed_frame(void* s);
+int32_t ggrs_sess_last_saved_frame(void* s);
+long ggrs_sess_frames_ahead(void* s);
+int32_t ggrs_sess_frames_behind_host(void* s);  // spectator sessions
+int32_t ggrs_sess_last_error_frame(void* s);    // MismatchedChecksum detail
+void ggrs_sess_connect_status(void* s, uint8_t* disc, int32_t* last, long n);
+// wire I/O: the host routes datagrams between addresses and endpoint indices
+void ggrs_sess_handle_wire(void* s, long ep, const uint8_t* buf, long len,
+                           uint64_t now_ms);
+long ggrs_sess_drain_wire(void* s, int32_t* ep_out, uint8_t* buf, long cap);
+void ggrs_sess_poll(void* s, uint64_t now_ms);
+long ggrs_sess_add_local_input(void* s, long handle, const uint8_t* buf);
+long ggrs_sess_advance_frame(void* s, uint64_t now_ms,
+                             struct ggrs_sess_req* out, long cap);
+int32_t ggrs_sess_request_count(void* s);
+long ggrs_sess_copy_requests(void* s, struct ggrs_sess_req* out, long cap);
+long ggrs_sess_next_event(void* s, struct ggrs_sess_event* out);
+long ggrs_sess_disconnect_player(void* s, long handle, uint64_t now_ms);
+long ggrs_sess_network_stats(void* s, long ep, uint64_t now_ms,
+                             struct ggrs_ep_stats* out);
+// desync detection: the host materializes the snapshot checksum the core
+// requests, then feeds it back (report + local history natively)
+int32_t ggrs_sess_take_checksum_request(void* s);
+void ggrs_sess_provide_checksum(void* s, int32_t frame, const uint8_t* csum16,
+                                uint64_t now_ms);
+// SyncTest verification: compare-or-record an observed (frame, checksum)
+// against the first-seen history; prunes entries older than oldest_allowed
+long ggrs_sess_st_verify(void* s, int32_t frame, int has,
+                         const uint8_t* csum16, int32_t oldest_allowed);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // GGRS_NATIVE_H_

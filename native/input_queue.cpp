@@ -13,6 +13,8 @@
 #include <cstring>
 #include <new>
 
+#include "ggrs_native.h"
+
 namespace {
 
 constexpr int QUEUE_LEN = 128;

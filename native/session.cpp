@@ -34,79 +34,7 @@
 #include <new>
 #include <vector>
 
-// ---------------------------------------------------------------------------
-// C ABI of the sibling translation units (input_queue.cpp, endpoint.cpp)
-// ---------------------------------------------------------------------------
-
-extern "C" {
-
-void* ggrs_iq_new(int input_size);
-void ggrs_iq_free(void* h);
-void ggrs_iq_set_frame_delay(void* h, int delay);
-int32_t ggrs_iq_first_incorrect_frame(void* h);
-int32_t ggrs_iq_last_added_frame(void* h);
-void ggrs_iq_reset_prediction(void* h);
-long ggrs_iq_confirmed_input(void* h, int32_t frame, uint8_t* out);
-void ggrs_iq_discard_confirmed_frames(void* h, int32_t frame);
-long ggrs_iq_input(void* h, int32_t requested_frame, uint8_t* out);
-long ggrs_iq_add_input(void* h, int32_t frame, const uint8_t* buf);
-
-struct ggrs_ep_config {
-  int32_t handles[16];
-  long num_handles;
-  long num_players;
-  long local_players;
-  long max_prediction;
-  long disconnect_timeout_ms;
-  long disconnect_notify_start_ms;
-  long fps;
-  long input_size;
-  uint16_t magic;
-  uint64_t rng_seed;
-};
-
-struct ggrs_ep_event {
-  int32_t type;
-  int32_t a;
-  int32_t b;
-  int32_t frame;
-  int32_t player;
-  int32_t input_len;
-  uint8_t input[64];
-};
-
-struct ggrs_ep_stats {
-  int32_t send_queue_len;
-  uint32_t ping_ms;
-  uint32_t kbps_sent;
-  int32_t local_frames_behind;
-  int32_t remote_frames_behind;
-};
-
-void* ggrs_ep_new(const ggrs_ep_config* cfg, uint64_t now_ms);
-void ggrs_ep_free(void* ep);
-long ggrs_ep_state(void* ep);
-void ggrs_ep_synchronize(void* ep, uint64_t now_ms);
-void ggrs_ep_disconnect(void* ep, uint64_t now_ms);
-void ggrs_ep_poll(void* ep, const uint8_t* disc, const int32_t* last, long n,
-                  uint64_t now_ms);
-void ggrs_ep_send_input(void* ep, int32_t frame, const uint8_t* data, long len,
-                        const uint8_t* disc, const int32_t* last, long n,
-                        uint64_t now_ms);
-void ggrs_ep_send_checksum_report(void* ep, int32_t frame,
-                                  const uint8_t* csum16, uint64_t now_ms);
-long ggrs_ep_handle_message(void* ep, const uint8_t* buf, long len,
-                            uint64_t now_ms);
-void ggrs_ep_update_local_frame_advantage(void* ep, int32_t local_frame);
-long ggrs_ep_average_frame_advantage(void* ep);
-long ggrs_ep_next_send(void* ep, uint8_t* out, long cap);
-long ggrs_ep_next_event(void* ep, ggrs_ep_event* out);
-long ggrs_ep_network_stats(void* ep, uint64_t now_ms, ggrs_ep_stats* out);
-void ggrs_ep_peer_connect_status(void* ep, uint8_t* disc, int32_t* last, long n);
-long ggrs_ep_checksum_history(void* ep, int32_t* frames, uint8_t* sums16,
-                              long cap);
-
-}  // extern "C"
+#include "ggrs_native.h"  // sibling-TU ABI + this TU's exported structs
 
 namespace {
 
@@ -998,45 +926,30 @@ struct Session {
 
 }  // namespace
 
+// struct layouts (ggrs_sess_config/_req/_event) live in ggrs_native.h; the
+// local sizing constants must stay in lockstep with its fixed array sizes
+static_assert(MAX_PLAYERS == 16, "ggrs_native.h pins statuses[16]");
+static_assert(MAX_TOTAL_HANDLES == 32, "ggrs_native.h pins player_kinds[32]");
+static_assert(MAX_INPUT_SIZE == 64, "ggrs_native.h pins inputs[16*64]");
+// ...and the internal tag/error values must equal the public GGRS_* macros
+static_assert(SESS_P2P == GGRS_SESS_P2P && SESS_SYNCTEST == GGRS_SESS_SYNCTEST &&
+              SESS_SPECTATOR == GGRS_SESS_SPECTATOR, "session type tags drifted");
+static_assert(KIND_LOCAL == GGRS_KIND_LOCAL && KIND_REMOTE == GGRS_KIND_REMOTE &&
+              KIND_SPECTATOR == GGRS_KIND_SPECTATOR, "player kind tags drifted");
+static_assert(
+    SERR_NOT_SYNCHRONIZED == GGRS_SERR_NOT_SYNCHRONIZED &&
+        SERR_PREDICTION_THRESHOLD == GGRS_SERR_PREDICTION_THRESHOLD &&
+        SERR_MISSING_INPUT == GGRS_SERR_MISSING_INPUT &&
+        SERR_MISMATCHED_CHECKSUM == GGRS_SERR_MISMATCHED_CHECKSUM &&
+        SERR_SPECTATOR_TOO_FAR_BEHIND == GGRS_SERR_SPECTATOR_TOO_FAR_BEHIND &&
+        SERR_INVALID_HANDLE == GGRS_SERR_INVALID_HANDLE &&
+        SERR_LOCAL_PLAYER == GGRS_SERR_LOCAL_PLAYER &&
+        SERR_ALREADY_DISCONNECTED == GGRS_SERR_ALREADY_DISCONNECTED &&
+        SERR_INTERNAL == GGRS_SERR_INTERNAL &&
+        SERR_CAPACITY == GGRS_SERR_CAPACITY,
+    "session error codes drifted from ggrs_native.h");
+
 extern "C" {
-
-struct ggrs_sess_config {
-  int32_t session_type;  // 0 p2p, 1 synctest, 2 spectator
-  int32_t num_players;
-  int32_t max_prediction;
-  int32_t input_size;
-  int32_t input_delay;
-  int32_t sparse_saving;
-  int32_t desync_interval;  // 0 = off
-  int32_t check_distance;
-  int32_t max_frames_behind;
-  int32_t catchup_speed;
-  int32_t fps;
-  int32_t disconnect_timeout_ms;
-  int32_t disconnect_notify_start_ms;
-  int32_t total_handles;                        // players + spectators
-  int32_t num_endpoints;                        // unique remote addresses
-  int32_t player_kinds[MAX_TOTAL_HANDLES];      // KIND_* per handle
-  int32_t player_endpoints[MAX_TOTAL_HANDLES];  // endpoint index or -1
-  uint64_t rng_seed;
-};
-
-struct ggrs_sess_req {
-  int32_t type;  // 0 save, 1 load, 2 advance
-  int32_t frame;
-  int32_t cell;  // snapshot ring slot for save/load, -1 otherwise
-  int32_t statuses[MAX_PLAYERS];
-  uint8_t inputs[MAX_PLAYERS * MAX_INPUT_SIZE];
-};
-
-struct ggrs_sess_event {
-  int32_t type;
-  int32_t ep;  // endpoint index, -1 when not applicable
-  int32_t a;   // total / timeout_ms / skip_frames / frame
-  int32_t b;   // count
-  uint8_t local_checksum[16];
-  uint8_t remote_checksum[16];
-};
 
 void* ggrs_sess_new(const ggrs_sess_config* cfg, uint64_t now_ms) {
   if (cfg->num_players < 1 || cfg->num_players > MAX_PLAYERS) return nullptr;

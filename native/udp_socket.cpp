@@ -12,6 +12,8 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include "ggrs_native.h"
+
 extern "C" {
 
 // Bind 0.0.0.0:port nonblocking; returns the fd or -1.
