@@ -40,10 +40,28 @@ class SpanStats:
 class Tracer:
     """Aggregating tracer; `span()` is a no-op context when disabled."""
 
-    def __init__(self, enabled: bool = True):
+    def __init__(self, enabled: bool = True, xprof: bool = False):
         self.enabled = enabled
+        # also emit jax.profiler.TraceAnnotation regions so spans appear in
+        # xprof/TensorBoard device profiles (SURVEY.md §5: xprof hooks)
+        self.xprof = xprof
+        self._annotation_cls = None
         self.stats: Dict[str, SpanStats] = defaultdict(SpanStats)
         self._stack: List[str] = []
+
+    @property
+    def xprof(self) -> bool:
+        return self._xprof
+
+    @xprof.setter
+    def xprof(self, value: bool) -> None:
+        self._xprof = value
+        if value:
+            # import once, outside any timed region, so the one-time import
+            # cost never lands inside a span's measurement
+            import jax.profiler
+
+            self._annotation_cls = jax.profiler.TraceAnnotation
 
     @contextmanager
     def span(self, name: str) -> Iterator[None]:
@@ -51,12 +69,20 @@ class Tracer:
             yield
             return
         path = ("/".join(self._stack + [name])) if self._stack else name
+        annotation = None
+        if self._xprof and self._annotation_cls is not None:
+            # shows up as a named region in xprof / TensorBoard profiles,
+            # aligning host-side phases with the device timeline
+            annotation = self._annotation_cls(path)
+            annotation.__enter__()
         self._stack.append(name)
         t0 = time.perf_counter_ns()
         try:
             yield
         finally:
             dt = time.perf_counter_ns() - t0
+            if annotation is not None:
+                annotation.__exit__(None, None, None)
             self._stack.pop()
             s = self.stats[path]
             s.count += 1
@@ -80,6 +106,7 @@ class Tracer:
 GLOBAL_TRACER = Tracer(enabled=False)
 
 
-def enable_global_tracing() -> Tracer:
+def enable_global_tracing(xprof: bool = False) -> Tracer:
     GLOBAL_TRACER.enabled = True
+    GLOBAL_TRACER.xprof = xprof
     return GLOBAL_TRACER

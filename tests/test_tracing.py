@@ -15,6 +15,17 @@ def test_spans_aggregate_and_nest():
     assert "tick/resim" in t.report()
 
 
+def test_xprof_annotated_spans_record_normally():
+    """xprof mode wraps spans in jax.profiler.TraceAnnotation regions;
+    aggregation semantics are unchanged."""
+    t = Tracer(enabled=True, xprof=True)
+    with t.span("outer"):
+        with t.span("inner"):
+            pass
+    assert t.stats["outer"].count == 1
+    assert t.stats["outer/inner"].count == 1
+
+
 def test_disabled_tracer_records_nothing():
     t = Tracer(enabled=False)
     with t.span("x"):
