@@ -80,15 +80,25 @@ def rle_encode(data: bytes) -> bytes:
     return out.raw[:n]
 
 
-def rle_decode(data: bytes, expected_len: Optional[int] = None) -> bytes:
+def rle_decode(
+    data: bytes,
+    expected_len: Optional[int] = None,
+    max_len: int = 1 << 26,
+) -> bytes:
+    """`max_len` bounds the decoded output (decompression-bomb guard for
+    untrusted streams); exceeding it raises like any malformed stream."""
     lib = load()
     assert lib is not None
-    cap = expected_len if expected_len is not None else max(64, len(data) * 512)
+    cap = (
+        expected_len
+        if expected_len is not None
+        else min(max(64, len(data) * 512), max_len)
+    )
     out = ctypes.create_string_buffer(cap)
     n = lib.ggrs_rle_decode(data, len(data), out, cap)
-    if n == -2 and expected_len is None:
-        # decoded output exceeded the heuristic cap: retry with a hard cap
-        cap = 1 << 26
+    if n == -2 and expected_len is None and cap < max_len:
+        # decoded output exceeded the heuristic cap: retry at the bound
+        cap = max_len
         out = ctypes.create_string_buffer(cap)
         n = lib.ggrs_rle_decode(data, len(data), out, cap)
     if n < 0:

@@ -87,13 +87,21 @@ def rle_encode(data: bytes) -> bytes:
     return bytes(out)
 
 
-def rle_decode(data: bytes) -> bytes:
+# Decoded-output ceiling for untrusted streams: a few bytes of hostile RLE
+# can claim a multi-gigabyte run (a decompression bomb), so decoding is
+# always bounded. Protocol callers pass a tight wire-derived limit.
+MAX_DECODE_OUTPUT = 1 << 26
+
+
+def rle_decode(data: bytes, max_output: int = MAX_DECODE_OUTPUT) -> bytes:
     out = bytearray()
     off = 0
     while off < len(data):
         v, off = _read_varint(data, off)
         kind = v & 3
         length = v >> 2
+        if len(out) + length > max_output:
+            raise ValueError("decoded output exceeds limit")
         if kind == TOKEN_LITERAL:
             if off + length > len(data):
                 raise ValueError("truncated literal run")
@@ -139,10 +147,14 @@ def encode(reference: bytes, pending: Iterable[bytes]) -> bytes:
     return rle_encode(delta_encode(reference, pending))
 
 
-def decode(reference: bytes, data: bytes) -> List[bytes]:
-    """(src/network/compression.rs:32-40)"""
+def decode(
+    reference: bytes, data: bytes, max_output: int = MAX_DECODE_OUTPUT
+) -> List[bytes]:
+    """(src/network/compression.rs:32-40). `max_output` bounds the decoded
+    size — pass the largest legitimate payload when decoding wire data."""
     from .. import native as _native
 
     if _native.available():
-        return _native.delta_decode(reference, _native.rle_decode(data))
-    return delta_decode(reference, rle_decode(data))
+        raw = _native.rle_decode(data, max_len=max_output)
+        return _native.delta_decode(reference, raw)
+    return delta_decode(reference, rle_decode(data, max_output))

@@ -421,9 +421,12 @@ class PeerEndpoint:
                 mine.last_frame = max(mine.last_frame, st.last_frame)
 
         last_recv = self._last_recv_frame()
-        assert last_recv == NULL_FRAME or last_recv + 1 >= body.start_frame, (
-            "peer encoded against an input we never received; cannot recover"
-        )
+        # a start_frame beyond last_recv+1 means the peer encoded against an
+        # input we never received — unrecoverable for this packet, but the
+        # value is network-controlled, so drop it rather than abort (parity
+        # with the C++ endpoint, endpoint.cpp on_input)
+        if last_recv != NULL_FRAME and body.start_frame > last_recv + 1:
+            return
 
         decode_frame = NULL_FRAME if last_recv == NULL_FRAME else body.start_frame - 1
         ref = self.recv_inputs.get(decode_frame)
@@ -431,7 +434,17 @@ class PeerEndpoint:
             return
         self.running_last_input_recv = self.clock.now_ms()
 
-        decoded = compression.decode(ref, body.bytes_)
+        # bound the decode at the largest legitimate payload — the sender
+        # never has more than PENDING_OUTPUT_SIZE un-acked frames in flight —
+        # so a hostile run-length claim can't balloon memory; and a payload
+        # that fails to decode is a dropped datagram, not a session crash
+        # (parity with the C++ endpoint, endpoint.cpp on_input)
+        try:
+            decoded = compression.decode(
+                ref, body.bytes_, max_output=len(ref) * (PENDING_OUTPUT_SIZE + 1)
+            )
+        except ValueError:
+            return
         per_player = self.input_size
         for i, inp_bytes in enumerate(decoded):
             inp_frame = body.start_frame + i
@@ -464,8 +477,10 @@ class PeerEndpoint:
 
     def _on_quality_reply(self, body: QualityReply) -> None:
         now = self.clock.now_ms()
-        assert now >= body.pong
-        self.round_trip_time = now - body.pong
+        # network-controlled value: a pong from the future (clock skew or a
+        # crafted packet) must not produce a negative RTT or crash the
+        # session (parity with the C++ endpoint, endpoint.cpp)
+        self.round_trip_time = now - body.pong if now >= body.pong else 0
 
     def _on_checksum_report(self, body: ChecksumReport) -> None:
         if self.last_added_checksum_frame < body.frame:

@@ -525,7 +525,10 @@ struct Endpoint {
 
     const std::vector<uint8_t>& ref = ref_it->second;
     const long m = static_cast<long>(ref.size());
-    std::vector<uint8_t> decoded(std::max<long>(m, 1) * 256);
+    // decompression-bomb guard, same bound as the Python endpoint
+    // (protocol.py _on_input): a legitimate sender never has more than
+    // PENDING_OUTPUT_SIZE un-acked frames in flight
+    std::vector<uint8_t> decoded(std::max<long>(m, 1) * (PENDING_OUTPUT_SIZE + 1));
     long dlen = ggrs_rle_decode(payload, blen, decoded.data(),
                                 static_cast<long>(decoded.size()));
     if (dlen < 0 || m == 0 || dlen % m != 0) return -1;
