@@ -427,6 +427,17 @@ class PeerEndpoint:
         # with the C++ endpoint, endpoint.cpp on_input)
         if last_recv != NULL_FRAME and body.start_frame > last_recv + 1:
             return
+        # before any input arrived, a legitimate first packet starts within
+        # the sender's pending window (its first queued frame, bounded by
+        # the 128-slot queue); a huge spoofed start_frame would otherwise
+        # permanently poison recv_inputs and blackhole all real inputs
+        if last_recv == NULL_FRAME and not (
+            0 <= body.start_frame <= PENDING_OUTPUT_SIZE
+        ):
+            return
+        # ...and frame arithmetic below must never overflow int32
+        if body.start_frame > (1 << 31) - 1 - 2 * PENDING_OUTPUT_SIZE:
+            return
 
         decode_frame = NULL_FRAME if last_recv == NULL_FRAME else body.start_frame - 1
         ref = self.recv_inputs.get(decode_frame)

@@ -37,6 +37,9 @@ constexpr size_t MAX_CHECKSUM_HISTORY_SIZE = 32;
 constexpr int FRAME_WINDOW_SIZE = 30;
 constexpr int MAX_HANDLES = 16;
 constexpr int MAX_INPUT_SIZE = 64;
+// largest start_frame whose frame arithmetic cannot overflow int32
+constexpr int32_t INT32_MAX_SAFE =
+    0x7FFFFFFF - 2 * static_cast<int32_t>(PENDING_OUTPUT_SIZE);
 
 // message body type tags (ggrs_tpu/network/messages.py:22-29)
 constexpr uint8_t MSG_SYNC_REQUEST = 0;
@@ -517,6 +520,15 @@ struct Endpoint {
     // input we never received — unrecoverable for this packet, but it must
     // not abort the process (the value is network-controlled)
     if (last_recv != NULL_FRAME && start_frame > last_recv + 1) return -1;
+    // before any input arrived, a legitimate first packet starts within the
+    // sender's pending window; a huge spoofed start_frame would otherwise
+    // poison recv_inputs and blackhole all real inputs
+    if (last_recv == NULL_FRAME &&
+        (start_frame < 0 ||
+         start_frame > static_cast<int32_t>(PENDING_OUTPUT_SIZE)))
+      return -1;
+    // ...and the inp_frame arithmetic below must never overflow int32 (UB)
+    if (start_frame > INT32_MAX_SAFE) return -1;
 
     int32_t decode_frame = last_recv == NULL_FRAME ? NULL_FRAME : start_frame - 1;
     auto ref_it = recv_inputs.find(decode_frame);

@@ -1,0 +1,207 @@
+"""Boundary coverage for the input-POD contract: wide inputs (up to the
+native 64-byte cap) and wide sessions (up to the native 16-player cap)
+through queues, compression, wire and both session stacks.
+
+The reference's Input is any POD (src/lib.rs:250-255); here it is a fixed
+byte string per player per frame. Most tests use 1 byte — these pin the
+edges, where stride bugs in the delta/RLE codec, the per-player re-split
+(InputBytes.to_player_inputs analog) and the native fixed-size buffers
+would hide.
+"""
+
+import random
+
+import pytest
+
+from ggrs_tpu import (
+    AdvanceFrame,
+    InputStatus,
+    LoadGameState,
+    PlayerType,
+    SaveGameState,
+    SessionBuilder,
+    SessionState,
+)
+from ggrs_tpu.errors import InvalidRequest
+from ggrs_tpu.native import available
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.utils.clock import FakeClock
+
+NATIVE_PARAMS = [False] + ([True] if available() else [])
+
+
+class WideGameStub:
+    """Deterministic state machine over arbitrary-width inputs."""
+
+    def __init__(self):
+        self.frame = 0
+        self.state = 0
+        self.history = {}
+
+    def handle_requests(self, requests):
+        for req in requests:
+            if isinstance(req, SaveGameState):
+                req.cell.save(req.frame, (self.frame, self.state), self.state)
+            elif isinstance(req, LoadGameState):
+                self.frame, self.state = req.cell.load()
+            elif isinstance(req, AdvanceFrame):
+                self.frame += 1
+                for buf, status in req.inputs:
+                    if status != InputStatus.DISCONNECTED:
+                        self.state = (
+                            self.state * 31 + sum(buf) + len(buf)
+                        ) % (1 << 53)
+                    else:
+                        self.state = (self.state * 31 + 13) % (1 << 53)
+                self.history[self.frame] = self.state
+
+
+def wide_input(frame, handle, size, salt=0):
+    rng = random.Random((frame * 131 + handle) * 977 + salt)
+    return bytes(rng.randrange(256) for _ in range(size))
+
+
+@pytest.mark.parametrize("use_native", NATIVE_PARAMS)
+@pytest.mark.parametrize("input_size", [4, 64])
+def test_wide_inputs_p2p_convergence(use_native, input_size):
+    """Max-width inputs cross the delta+RLE wire under latency and jitter;
+    replicas converge byte-exactly."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=40, jitter_ms=15, seed=3)
+
+    def build(my_addr, other_addr, local_handle):
+        b = (
+            SessionBuilder(input_size=input_size)
+            .with_num_players(2)
+            .with_clock(clock)
+            .with_rng(random.Random(hash(my_addr) & 0xFFFF))
+        )
+        if use_native:
+            b = b.with_native_sessions(True)
+        b = b.add_player(PlayerType.local(), local_handle)
+        b = b.add_player(PlayerType.remote(other_addr), 1 - local_handle)
+        return b.start_p2p_session(net.socket(my_addr))
+
+    s0, s1 = build("a", "b", 0), build("b", "a", 1)
+    for _ in range(400):
+        s0.poll_remote_clients()
+        s1.poll_remote_clients()
+        clock.advance(20)
+        if (
+            s0.current_state() == SessionState.RUNNING
+            and s1.current_state() == SessionState.RUNNING
+        ):
+            break
+    g0, g1 = WideGameStub(), WideGameStub()
+    for frame in range(50):
+        s0.add_local_input(0, wide_input(frame, 0, input_size))
+        g0.handle_requests(s0.advance_frame())
+        s1.add_local_input(1, wide_input(frame, 1, input_size))
+        g1.handle_requests(s1.advance_frame())
+        s0.events()
+        s1.events()
+        clock.advance(16)
+    for _ in range(10):
+        s0.poll_remote_clients()
+        s1.poll_remote_clients()
+        clock.advance(16)
+    s0.add_local_input(0, bytes(input_size))
+    g0.handle_requests(s0.advance_frame())
+    s1.add_local_input(1, bytes(input_size))
+    g1.handle_requests(s1.advance_frame())
+
+    confirmed = min(s0.confirmed_frame(), s1.confirmed_frame())
+    assert confirmed > 25
+    for f in range(1, confirmed + 1):
+        assert g0.history[f] == g1.history[f], f"diverged at frame {f}"
+
+
+@pytest.mark.parametrize("use_native", NATIVE_PARAMS)
+def test_sixteen_player_synctest(use_native):
+    """The native cap: 16 players, multi-byte inputs, forced rollbacks."""
+    players, input_size = 16, 8
+    b = (
+        SessionBuilder(input_size=input_size)
+        .with_num_players(players)
+        .with_check_distance(3)
+    )
+    if use_native:
+        b = b.with_native_sessions(True)
+    sess = b.start_synctest_session()
+    g = WideGameStub()
+    for frame in range(25):
+        for h in range(players):
+            sess.add_local_input(h, wide_input(frame, h, input_size))
+        g.handle_requests(sess.advance_frame())
+    assert g.frame == 25
+
+
+@pytest.mark.parametrize("use_native", NATIVE_PARAMS)
+def test_eight_player_mesh_wide_inputs(use_native):
+    """8 sessions x 8-byte inputs over one network: every peer confirms an
+    identical prefix (full-mesh analog of the reference's 2-session test)."""
+    players, input_size = 8, 8
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=10, seed=5)
+    addrs = [f"p{i}" for i in range(players)]
+
+    def build(i):
+        b = (
+            SessionBuilder(input_size=input_size)
+            .with_num_players(players)
+            .with_clock(clock)
+            .with_rng(random.Random(i + 1))
+        )
+        if use_native:
+            b = b.with_native_sessions(True)
+        for h in range(players):
+            b = b.add_player(
+                PlayerType.local() if h == i else PlayerType.remote(addrs[h]), h
+            )
+        return b.start_p2p_session(net.socket(addrs[i]))
+
+    sessions = [build(i) for i in range(players)]
+    for _ in range(600):
+        for s in sessions:
+            s.poll_remote_clients()
+            s.events()
+        clock.advance(20)
+        if all(s.current_state() == SessionState.RUNNING for s in sessions):
+            break
+    else:
+        raise AssertionError("mesh failed to synchronize")
+
+    stubs = [WideGameStub() for _ in range(players)]
+    for frame in range(20):
+        for i, (s, g) in enumerate(zip(sessions, stubs)):
+            s.add_local_input(i, wide_input(frame, i, input_size))
+            g.handle_requests(s.advance_frame())
+            s.events()
+        clock.advance(16)
+    for _ in range(10):
+        for s in sessions:
+            s.poll_remote_clients()
+        clock.advance(16)
+    for i, (s, g) in enumerate(zip(sessions, stubs)):
+        s.add_local_input(i, bytes(input_size))
+        g.handle_requests(s.advance_frame())
+
+    confirmed = min(s.confirmed_frame() for s in sessions)
+    assert confirmed > 8
+    for f in range(1, confirmed + 1):
+        vals = {g.history[f] for g in stubs}
+        assert len(vals) == 1, f"mesh diverged at frame {f}: {vals}"
+
+
+def test_native_rejects_oversized_inputs():
+    if not available():
+        pytest.skip("native library not built")
+    with pytest.raises(InvalidRequest):
+        SessionBuilder(input_size=65).with_native_sessions(True)
+    with pytest.raises(InvalidRequest):
+        (
+            SessionBuilder(input_size=1)
+            .with_num_players(17)
+            .with_native_sessions(True)
+            .start_synctest_session()
+        )

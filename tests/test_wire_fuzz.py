@@ -209,22 +209,70 @@ def test_sessions_survive_in_stream_tampering(use_native, seed):
 
 
 @pytest.mark.parametrize("seed", range(20))
-def test_rle_decoder_rejects_or_roundtrips_garbage(seed):
+def test_rle_decoder_parity_on_garbage(seed):
     """Both RLE decoders (Python oracle + native) must never crash on
-    arbitrary bytes: either a clean error or a decode."""
+    arbitrary bytes — and must AGREE: same decoded bytes, or both reject.
+    A decoder accepting what the other rejects would desync a native peer
+    from a Python peer on the same wire."""
     rng = random.Random(seed)
     blob = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 200)))
     try:
-        rle_decode(blob)
+        py_result = ("ok", rle_decode(blob))
     except ValueError:
-        pass
+        py_result = ("error", None)
     if available():
         from ggrs_tpu.native import rle_decode as native_rle_decode
 
         try:
-            native_rle_decode(blob)
+            nat_result = ("ok", native_rle_decode(blob))
         except ValueError:
-            pass
+            nat_result = ("error", None)
+        assert py_result == nat_result, f"decoder outcomes diverged on seed {seed}"
+
+
+@pytest.mark.parametrize("use_native", NATIVE_PARAMS)
+def test_spoofed_pre_sync_start_frame_cannot_poison_session(use_native):
+    """Regression: before synchronization the magic filter accepts any
+    packet, and an InputMsg with a huge start_frame used to poison
+    recv_inputs (last_recv jumps to ~2e9, every real input thereafter is
+    'already received' and dropped; its ack also popped the peer's whole
+    pending window). The endpoint must drop it and the session must run
+    normally afterwards."""
+    from ggrs_tpu.network.compression import rle_encode
+    from ggrs_tpu.network.messages import InputMsg, Message, encode_message
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, seed=2)
+    s0, s1 = build_pair(clock, net, use_native)
+
+    # one zero-delta frame for a 1-byte single-handle input stream
+    poison = Message(
+        magic=0x4141,
+        body=InputMsg(
+            peer_connect_status=[],
+            disconnect_requested=False,
+            start_frame=2_000_000_000,
+            ack_frame=-1,
+            bytes_=rle_encode(b"\x00"),
+        ),
+    )
+    attacker = net.socket("b")  # spoofing the real peer's address
+    for _ in range(3):
+        attacker.send_wire(encode_message(poison), "a")
+    s0.poll_remote_clients()
+
+    sync_pair(s0, s1, clock)
+    g0, g1 = GameStub(), GameStub()
+    for frame in range(30):
+        s0.add_local_input(0, bytes([frame % 9]))
+        g0.handle_requests(s0.advance_frame())
+        s1.add_local_input(1, bytes([(frame * 3) % 9]))
+        g1.handle_requests(s1.advance_frame())
+        clock.advance(16)
+    confirmed = min(s0.confirmed_frame(), s1.confirmed_frame())
+    assert confirmed > 15, f"poisoned session stalled (confirmed={confirmed})"
+    for f in range(1, confirmed + 1):
+        assert g0.history[f] == g1.history[f]
 
 
 @pytest.mark.parametrize("seed", range(10))
