@@ -1,0 +1,10 @@
+"""Deterministic device-game model families (the DeviceGame interface
+consumed by ggrs_tpu.tpu): ex_game (the reference example vectorized, pure
+per-entity physics) and arena (bevy_ggrs-style ECS with health/energy
+components and a cross-entity centroid reduction)."""
+
+from . import arena, ex_game
+from .arena import Arena
+from .ex_game import ExGame
+
+__all__ = ["Arena", "ExGame", "arena", "ex_game"]

@@ -1,0 +1,222 @@
+"""Second model family: a bevy_ggrs-style ECS arena with combat.
+
+Where ex_game is the reference's example vectorized (pure per-entity
+physics, embarrassingly parallel), `arena` exercises the parts of the
+DeviceGame seam ex_game cannot: more component types (position, velocity,
+health, energy), per-entity liveness, and a genuine CROSS-ENTITY
+interaction — per-team centroids reduced over all entities each frame,
+which under entity-sharded execution becomes a real collective (GSPMD
+inserts the psum from the sharding of the masked sums). The framework's
+session/backend/sharding layers are game-agnostic; this model is the
+second witness.
+
+Same determinism discipline as ex_game (ggrs_tpu/models/ex_game.py):
+int32-only fixed-point math, dynamics defined once (`_step_generic`) and
+evaluated under jax (device) and numpy (host oracle), order-invariant
+on-device checksum. Reference anchors: the DeviceGame contract consumed by
+ggrs_tpu.tpu.backend (the GGRSRequest boundary, src/lib.rs:169-194), and
+the POD input contract (src/lib.rs:250-255) — one byte per player:
+
+  bits 0-3  thrust up/down/left/right (direct, no heading)
+  bit 4     rally: pull toward the own team's centroid
+  bit 5     overdrive: double thrust while energy lasts
+
+Entity i is owned by player i % num_players; the owner's input drives it.
+Entities at 0 hp stop moving but still count toward nothing (dead entities
+are excluded from centroids). Disconnected players' entities coast
+(input 0). The arena is toroidal (power-of-two size, branch-free wrap) —
+deliberately different boundary semantics from ex_game's clamp.
+
+Integer-overflow budget (all arithmetic strictly int32):
+  pos in [0, 2^18); centroid sums accumulate pos>>6 (max 2^12/entity), so
+  N up to 65536 stays under 2^28; proximity uses Manhattan distance (no
+  squaring of 2^18-scale values); velocity magnitude uses isqrt24 on
+  |vel| <= MAX_SPEED*2 scale.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ..ops import fixed_point as fx
+from ..types import InputStatus
+
+INPUT_UP = 1 << 0
+INPUT_DOWN = 1 << 1
+INPUT_LEFT = 1 << 2
+INPUT_RIGHT = 1 << 3
+INPUT_RALLY = 1 << 4
+INPUT_OVERDRIVE = 1 << 5
+INPUT_SIZE = 1  # bytes per player per frame
+
+ARENA_BITS = 18  # 1024 px in Q8 subpixels; power of two => branch-free wrap
+ARENA_MASK = (1 << ARENA_BITS) - 1
+CENTROID_SHIFT = 6  # centroid sums accumulate pos >> 6 (overflow budget)
+
+ACCEL = 48  # Q8 subpixels/frame^2
+FRICTION_NUM = 247  # ~0.965 as 247/256
+MAX_SPEED = 8 * fx.SUBPIX
+RALLY_SHIFT = 10  # rally pull = (centroid - pos) >> 10, clipped
+RALLY_MAX = 96
+COMBAT_RANGE = 120 * fx.SUBPIX  # Manhattan radius around the enemy centroid
+DAMAGE = 1
+HP_INIT = 100
+ENERGY_INIT = 128
+ENERGY_MAX = 256
+ENERGY_DRAIN = 2
+ENERGY_REGEN = 1
+
+State = Dict[str, Any]
+# {"frame": i32[], "pos": i32[N,2], "vel": i32[N,2], "hp": i32[N], "energy": i32[N]}
+
+
+def _init_arrays(num_entities: int) -> State:
+    """Deterministic grid spawn, teams interleaved (host-side numpy)."""
+    i = np.arange(num_entities, dtype=np.int64)
+    side = int(np.ceil(np.sqrt(num_entities)))
+    gx = (i % side) * ((1 << ARENA_BITS) // side)
+    gy = (i // side) * ((1 << ARENA_BITS) // max(1, (num_entities + side - 1) // side))
+    pos = np.stack([gx, gy], axis=1).astype(np.int32) & ARENA_MASK
+    return {
+        "frame": np.zeros((), dtype=np.int32),
+        "pos": pos,
+        "vel": np.zeros((num_entities, 2), dtype=np.int32),
+        "hp": np.full((num_entities,), HP_INIT, dtype=np.int32),
+        "energy": np.full((num_entities,), ENERGY_INIT, dtype=np.int32),
+    }
+
+
+def _step_generic(state: State, inputs, statuses, num_players: int, xp) -> State:
+    """One deterministic frame; `inputs` uint8[num_players], `statuses`
+    int32[num_players]. Shared by the jax and numpy paths via `xp`."""
+    n = state["pos"].shape[0]
+    owner = xp.arange(n, dtype=xp.int32) % num_players
+
+    inp = inputs.astype(xp.int32)[owner]
+    status = statuses.astype(xp.int32)[owner]
+    # disconnected players' entities coast
+    inp = xp.where(status == int(InputStatus.DISCONNECTED), 0, inp)
+
+    pos, vel = state["pos"], state["vel"]
+    hp, energy = state["hp"], state["energy"]
+    alive = hp > 0
+
+    # --- per-team centroids of living entities: the cross-entity reduction.
+    # Static python loop over players (P is compile-time); masked integer
+    # sums become psums under entity sharding.
+    cent_list = []
+    for t in range(num_players):
+        mask = ((owner == t) & alive).astype(xp.int32)
+        # dtype pinned: numpy would otherwise widen integer sums to int64
+        # while jax stays int32, breaking oracle/device bit-parity
+        count = xp.maximum(mask.sum(dtype=xp.int32), 1)
+        s = (mask[:, None] * (pos >> CENTROID_SHIFT)).sum(axis=0, dtype=xp.int32)
+        cent_list.append((s // count) << CENTROID_SHIFT)
+    centroids = xp.stack(cent_list, axis=0)  # i32[P, 2]
+
+    own_cent = centroids[owner]
+    enemy_cent = centroids[(owner + 1) % num_players]
+
+    # --- thrust (direct axis accel), overdrive doubling while energy lasts
+    ax = xp.where((inp & INPUT_RIGHT) != 0, 1, 0) - xp.where((inp & INPUT_LEFT) != 0, 1, 0)
+    ay = xp.where((inp & INPUT_DOWN) != 0, 1, 0) - xp.where((inp & INPUT_UP) != 0, 1, 0)
+    over = ((inp & INPUT_OVERDRIVE) != 0) & (energy > 0)
+    accel = xp.where(over, 2 * ACCEL, ACCEL)
+    energy = xp.where(
+        over, energy - ENERGY_DRAIN, xp.minimum(energy + ENERGY_REGEN, ENERGY_MAX)
+    )
+    energy = xp.maximum(energy, 0)
+    vel = vel + xp.stack([ax * accel, ay * accel], axis=1)
+
+    # --- rally: bounded pull toward the own team's centroid
+    rally = ((inp & INPUT_RALLY) != 0).astype(xp.int32)
+    pull = xp.clip((own_cent - pos) >> RALLY_SHIFT, -RALLY_MAX, RALLY_MAX)
+    vel = vel + rally[:, None] * pull
+
+    # --- friction + speed clamp (isqrt24, like ex_game)
+    vel = (vel * FRICTION_NUM) >> 8
+    vx, vy = vel[:, 0], vel[:, 1]
+    m2 = vx * vx + vy * vy
+    mag = fx.isqrt24(m2, xp)
+    too_fast = m2 > MAX_SPEED * MAX_SPEED
+    safe_mag = xp.where(mag == 0, 1, mag)
+    vx = xp.where(too_fast, (vx * MAX_SPEED) // safe_mag, vx)
+    vy = xp.where(too_fast, (vy * MAX_SPEED) // safe_mag, vy)
+    vel = xp.stack([vx, vy], axis=1)
+
+    # dead entities stop
+    vel = vel * alive.astype(xp.int32)[:, None]
+
+    # --- integrate on the torus
+    pos = (pos + vel) & ARENA_MASK
+
+    # --- combat: damage inside the enemy centroid's Manhattan radius.
+    # Toroidal delta: wrap each axis difference to [-half, half).
+    half = 1 << (ARENA_BITS - 1)
+    d = ((pos - enemy_cent + half) & ARENA_MASK) - half
+    dist = xp.abs(d[:, 0]) + xp.abs(d[:, 1])
+    hit = alive & (dist < COMBAT_RANGE)
+    hp = xp.maximum(hp - hit.astype(xp.int32) * DAMAGE, 0)
+
+    return {
+        "frame": state["frame"] + xp.int32(1),
+        "pos": pos.astype(xp.int32),
+        "vel": vel.astype(xp.int32),
+        "hp": hp.astype(xp.int32),
+        "energy": energy.astype(xp.int32),
+    }
+
+
+def _checksum_generic(state: State, xp):
+    words = xp.concatenate(
+        [
+            state["pos"].astype(xp.uint32).reshape(-1),
+            state["vel"].astype(xp.uint32).reshape(-1),
+            state["hp"].astype(xp.uint32).reshape(-1),
+            state["energy"].astype(xp.uint32).reshape(-1),
+            state["frame"].astype(xp.uint32).reshape(-1),
+        ]
+    )
+    return fx.weighted_checksum(words, xp)
+
+
+class Arena:
+    """Device game (DeviceGame interface, like ex_game.ExGame)."""
+
+    input_size = INPUT_SIZE
+
+    def __init__(self, num_players: int = 2, num_entities: int = 4096):
+        self.num_players = num_players
+        self.num_entities = num_entities
+
+    def init_state(self) -> State:
+        import jax
+
+        return jax.device_put(_init_arrays(self.num_entities))
+
+    def step(self, state: State, inputs, statuses) -> State:
+        import jax.numpy as jnp
+
+        return _step_generic(state, inputs.reshape(-1), statuses, self.num_players, jnp)
+
+    def checksum(self, state: State):
+        import jax.numpy as jnp
+
+        return _checksum_generic(state, jnp)
+
+
+def init_oracle(num_players: int = 2, num_entities: int = 4096) -> State:
+    return _init_arrays(num_entities)
+
+
+def step_oracle(state: State, inputs: np.ndarray, statuses: np.ndarray, num_players: int) -> State:
+    with np.errstate(over="ignore"):
+        return _step_generic(state, inputs.reshape(-1), statuses, num_players, np)
+
+
+def checksum_oracle(state: State) -> tuple[int, int]:
+    with np.errstate(over="ignore"):
+        hi, lo = _checksum_generic(state, np)
+    return int(hi), int(lo)

@@ -435,8 +435,9 @@ class PeerEndpoint:
             0 <= body.start_frame <= PENDING_OUTPUT_SIZE
         ):
             return
-        # ...and frame arithmetic below must never overflow int32
-        if body.start_frame > (1 << 31) - 1 - 2 * PENDING_OUTPUT_SIZE:
+        # ...and frame arithmetic must stay inside int32 in either direction
+        # (parity with the C++ endpoint, where overflow would be UB)
+        if not (0 <= body.start_frame <= (1 << 31) - 1 - 2 * PENDING_OUTPUT_SIZE):
             return
 
         decode_frame = NULL_FRAME if last_recv == NULL_FRAME else body.start_frame - 1

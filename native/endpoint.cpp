@@ -527,8 +527,10 @@ struct Endpoint {
         (start_frame < 0 ||
          start_frame > static_cast<int32_t>(PENDING_OUTPUT_SIZE)))
       return -1;
-    // ...and the inp_frame arithmetic below must never overflow int32 (UB)
-    if (start_frame > INT32_MAX_SAFE) return -1;
+    // ...and the frame arithmetic below must never overflow int32 (UB in
+    // either direction: start_frame - 1 at INT32_MIN, start_frame + k at
+    // the top)
+    if (start_frame < 0 || start_frame > INT32_MAX_SAFE) return -1;
 
     int32_t decode_frame = last_recv == NULL_FRAME ? NULL_FRAME : start_frame - 1;
     auto ref_it = recv_inputs.find(decode_frame);

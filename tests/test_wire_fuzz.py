@@ -275,6 +275,62 @@ def test_spoofed_pre_sync_start_frame_cannot_poison_session(use_native):
         assert g0.history[f] == g1.history[f]
 
 
+@pytest.mark.parametrize("kind", ["python"] + (["native"] if available() else []))
+def test_negative_start_frame_post_sync_is_dropped(kind):
+    """An in-stream InputMsg with start_frame = INT32_MIN carrying the real
+    peer's magic (a bit-flipped genuine packet) must be dropped after sync:
+    in the C++ endpoint `start_frame - 1` would be signed overflow — UB
+    under `make sanitize`. Driven at the endpoint level so the filter sees
+    the authentic magic deterministically."""
+    from ggrs_tpu.frame_info import PlayerInput
+    from ggrs_tpu.network.compression import rle_encode
+    from ggrs_tpu.network.messages import InputMsg, Message, encode_message
+    from ggrs_tpu.sync_layer import ConnectionStatus
+    from test_native_endpoint import make_pair, pump
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair(kind, kind, clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    ep_a.synchronize()
+    ep_b.synchronize()
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=12)
+    assert ep_a.is_running() and ep_b.is_running()
+
+    # a few real frames so last_recv advances past NULL_FRAME
+    for f in range(3):
+        ep_b.send_input({0: PlayerInput(f, bytes([f]))}, status)
+        ep_b.send_all_messages(sock_b)
+        pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=1)
+
+    poison = Message(
+        magic=ep_b.magic,  # authentic sender magic: passes the filter
+        body=InputMsg(
+            peer_connect_status=[ConnectionStatus(), ConnectionStatus()],
+            disconnect_requested=False,
+            start_frame=-(1 << 31),
+            ack_frame=-1,
+            bytes_=rle_encode(b"\x00"),
+        ),
+    )
+    wire = encode_message(poison)
+    if hasattr(ep_a, "handle_wire"):
+        ep_a.handle_wire(wire)
+    else:
+        from ggrs_tpu.network.messages import decode_message
+
+        ep_a.handle_message(decode_message(wire))
+
+    # the stream continues normally: frames 3.. arrive and are sequential
+    got = []
+    for f in range(3, 8):
+        ep_b.send_input({0: PlayerInput(f, bytes([f]))}, status)
+        ep_b.send_all_messages(sock_b)
+        events = pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=1)
+        got += [e.input.frame for e in events[id(ep_a)] if hasattr(e, "input")]
+    assert got and got == sorted(got), f"input stream broken after poison: {got}"
+
+
 @pytest.mark.parametrize("seed", range(10))
 def test_native_endpoint_handles_arbitrary_bytes(seed):
     """Raw bytes straight into the C++ endpoint state machine (no Python
