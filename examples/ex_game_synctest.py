@@ -34,6 +34,12 @@ def main() -> int:
         action="store_true",
         help="run on the C++ session core (requires `make -C native`)",
     )
+    ap.add_argument(
+        "--model",
+        choices=["ex_game", "arena"],
+        default="ex_game",
+        help="which model family to run (device path only)",
+    )
     args = ap.parse_args()
 
     builder = (
@@ -51,11 +57,12 @@ def main() -> int:
         game = HostGame(args.players, args.entities)
         digest = game.digest
     else:
-        from ggrs_tpu.models.ex_game import ExGame
+        from ggrs_tpu.models import Arena, ExGame
         from ggrs_tpu.tpu import TpuRollbackBackend
 
+        model_cls = Arena if args.model == "arena" else ExGame
         game = TpuRollbackBackend(
-            ExGame(args.players, args.entities),
+            model_cls(args.players, args.entities),
             max_prediction=args.max_prediction,
             num_players=args.players,
         )
@@ -63,7 +70,11 @@ def main() -> int:
         def digest() -> str:
             st = game.state_numpy()
             p0 = st["pos"][0]
-            return f"frame {int(st['frame']):5d} entity0 @ ({int(p0[0])},{int(p0[1])})"
+            extra = f" hp0={int(st['hp'][0])}" if "hp" in st else ""
+            return (
+                f"frame {int(st['frame']):5d} entity0 @ "
+                f"({int(p0[0])},{int(p0[1])}){extra}"
+            )
 
     t0 = time.perf_counter()
     try:

@@ -107,17 +107,24 @@ def _step_generic(state: State, inputs, statuses, num_players: int, xp) -> State
     # Static python loop over players (P is compile-time); masked integer
     # sums become psums under entity sharding.
     cent_list = []
+    count_list = []
     for t in range(num_players):
         mask = ((owner == t) & alive).astype(xp.int32)
         # dtype pinned: numpy would otherwise widen integer sums to int64
         # while jax stays int32, breaking oracle/device bit-parity
-        count = xp.maximum(mask.sum(dtype=xp.int32), 1)
+        count = mask.sum(dtype=xp.int32)
         s = (mask[:, None] * (pos >> CENTROID_SHIFT)).sum(axis=0, dtype=xp.int32)
-        cent_list.append((s // count) << CENTROID_SHIFT)
+        cent_list.append((s // xp.maximum(count, 1)) << CENTROID_SHIFT)
+        count_list.append(count)
     centroids = xp.stack(cent_list, axis=0)  # i32[P, 2]
+    live_counts = xp.stack(count_list, axis=0)  # i32[P]
 
     own_cent = centroids[owner]
-    enemy_cent = centroids[(owner + 1) % num_players]
+    enemy_team = (owner + 1) % num_players
+    enemy_cent = centroids[enemy_team]
+    # an extinct team projects no force: its clamped centroid would sit at
+    # the origin and phantom-damage anyone near it
+    enemy_exists = live_counts[enemy_team] > 0
 
     # --- thrust (direct axis accel), overdrive doubling while energy lasts
     ax = xp.where((inp & INPUT_RIGHT) != 0, 1, 0) - xp.where((inp & INPUT_LEFT) != 0, 1, 0)
@@ -157,7 +164,7 @@ def _step_generic(state: State, inputs, statuses, num_players: int, xp) -> State
     half = 1 << (ARENA_BITS - 1)
     d = ((pos - enemy_cent + half) & ARENA_MASK) - half
     dist = xp.abs(d[:, 0]) + xp.abs(d[:, 1])
-    hit = alive & (dist < COMBAT_RANGE)
+    hit = alive & enemy_exists & (dist < COMBAT_RANGE)
     hp = xp.maximum(hp - hit.astype(xp.int32) * DAMAGE, 0)
 
     return {
@@ -169,15 +176,17 @@ def _step_generic(state: State, inputs, statuses, num_players: int, xp) -> State
     }
 
 
+# Checksum word order: the single source of truth shared by the local
+# checksum and parallel.sharded.sharded_checksum (the frame scalar is
+# always folded in last). Drift between the two would make a sharded peer
+# report false desyncs against a bit-identical single-chip peer.
+CHECKSUM_KEYS = ("pos", "vel", "hp", "energy")
+
+
 def _checksum_generic(state: State, xp):
     words = xp.concatenate(
-        [
-            state["pos"].astype(xp.uint32).reshape(-1),
-            state["vel"].astype(xp.uint32).reshape(-1),
-            state["hp"].astype(xp.uint32).reshape(-1),
-            state["energy"].astype(xp.uint32).reshape(-1),
-            state["frame"].astype(xp.uint32).reshape(-1),
-        ]
+        [state[k].astype(xp.uint32).reshape(-1) for k in CHECKSUM_KEYS]
+        + [state["frame"].astype(xp.uint32).reshape(-1)]
     )
     return fx.weighted_checksum(words, xp)
 
@@ -186,6 +195,7 @@ class Arena:
     """Device game (DeviceGame interface, like ex_game.ExGame)."""
 
     input_size = INPUT_SIZE
+    checksum_keys = CHECKSUM_KEYS
 
     def __init__(self, num_players: int = 2, num_entities: int = 4096):
         self.num_players = num_players

@@ -140,14 +140,15 @@ def _step_generic(state: State, inputs, statuses, num_players: int, xp) -> State
     }
 
 
+# Checksum word order: the single source of truth shared by the local
+# checksum and parallel.sharded.sharded_checksum (frame folded in last).
+CHECKSUM_KEYS = ("pos", "vel", "rot")
+
+
 def _checksum_generic(state: State, xp):
     words = xp.concatenate(
-        [
-            state["pos"].astype(xp.uint32).reshape(-1),
-            state["vel"].astype(xp.uint32).reshape(-1),
-            state["rot"].astype(xp.uint32).reshape(-1),
-            state["frame"].astype(xp.uint32).reshape(-1),
-        ]
+        [state[k].astype(xp.uint32).reshape(-1) for k in CHECKSUM_KEYS]
+        + [state["frame"].astype(xp.uint32).reshape(-1)]
     )
     return fx.weighted_checksum(words, xp)
 
@@ -165,6 +166,7 @@ class ExGame:
     """
 
     input_size = INPUT_SIZE
+    checksum_keys = CHECKSUM_KEYS
 
     def __init__(self, num_players: int = 2, num_entities: int = 4096):
         self.num_players = num_players

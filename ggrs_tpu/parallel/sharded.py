@@ -36,18 +36,23 @@ def shard_state(state, mesh: Mesh):
     return jax.tree.map(put, state)
 
 
-def sharded_checksum(state, mesh: Mesh):
+def sharded_checksum(state, mesh: Mesh, keys=None):
     """Order-invariant checksum of an entity-sharded state with an explicit
     psum across the `entity` axis (the on-device replacement for the
     reference's host-side fletcher16, ex_game.rs:42-52).
 
     Bit-identical to the single-device `_checksum_generic`: word weights run
-    continuously across the concatenation order pos|vel|rot|frame using
-    GLOBAL word indices, and the replicated `frame` scalar is folded in
-    exactly once (on entity-shard 0) — so a sharded peer and a single-chip
-    peer exchanging desync-detection reports always agree.
+    continuously across the model's concatenation order `keys` + frame
+    using GLOBAL word indices, and the replicated `frame` scalar is folded
+    in exactly once (on entity-shard 0) — so a sharded peer and a
+    single-chip peer exchanging desync-detection reports always agree.
+    `keys` must be the model's declared checksum order (its
+    `checksum_keys` class attribute, e.g. ExGame.checksum_keys — the same
+    source _checksum_generic reads); defaults to ex_game's.
     """
-    keys = ["pos", "vel", "rot"]
+    if keys is None:
+        from ..models.ex_game import CHECKSUM_KEYS as keys
+    keys = list(keys)
     offsets = {}
     off = 0
     for k in keys:

@@ -59,6 +59,19 @@ def test_gameplay_semantics():
     assert host2["energy"].max() < arena.ENERGY_INIT
 
 
+def test_extinct_team_projects_no_combat():
+    """Regression: a team with zero living entities must not leave a
+    phantom centroid at the origin damaging enemies near (0,0)."""
+    host = arena.init_oracle(PLAYERS, 8)
+    host["hp"][1::2] = 0  # team 1 extinct
+    host["pos"][:] = 0  # everyone parked at the origin
+    statuses = np.zeros(PLAYERS, dtype=np.int32)
+    idle = np.zeros(PLAYERS, dtype=np.uint8)
+    for _ in range(5):
+        host = arena.step_oracle(host, idle, statuses, PLAYERS)
+    assert (host["hp"][0::2] == arena.HP_INIT).all(), "phantom combat damage"
+
+
 def test_rollback_backend_synctest_with_arena():
     from ggrs_tpu.tpu import TpuRollbackBackend
 
@@ -127,6 +140,25 @@ def test_beam_backend_with_arena_matches_plain():
     sb, sp = beam.state_numpy(), plain.state_numpy()
     for k in sb:
         assert np.array_equal(np.asarray(sb[k]), np.asarray(sp[k]))
+
+
+def test_sharded_arena_psum_checksum_matches_oracle():
+    """The explicit shard_map+psum desync checksum works for the second
+    model's key order too (pos|vel|hp|energy|frame)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from ggrs_tpu.parallel.mesh import make_mesh
+    from ggrs_tpu.parallel.sharded import shard_state, sharded_checksum
+
+    mesh = make_mesh(8)
+    entities = 256
+    host = arena.init_oracle(PLAYERS, entities)
+    sharded = shard_state(jax.device_put(host), mesh)
+    hi, lo = sharded_checksum(sharded, mesh, keys=arena.Arena.checksum_keys)
+    ohi, olo = arena.checksum_oracle(host)
+    assert (int(hi), int(lo)) == (ohi, olo)
 
 
 def test_sharded_arena_centroid_collective_matches_oracle():
