@@ -1,0 +1,59 @@
+"""Long-context scaling: the rollback window is this domain's sequence
+axis (SURVEY.md §5 — its "context length" is max_prediction). The fused
+scan's masked fixed-length design means one compilation covers every
+depth; these tests push far past the BASELINE configs' 16 frames and
+check bit-parity against the oracle at depth 48.
+"""
+
+import numpy as np
+
+from ggrs_tpu import SessionBuilder
+from ggrs_tpu.models import arena, ex_game
+
+PLAYERS = 2
+ENTITIES = 64
+WINDOW = 49  # check_distance 48 < max_prediction 49
+CHECK_DISTANCE = 48
+
+
+def test_48_frame_rollback_window_matches_oracle():
+    from ggrs_tpu.tpu import TpuRollbackBackend
+
+    backend = TpuRollbackBackend(
+        ex_game.ExGame(PLAYERS, ENTITIES), max_prediction=WINDOW,
+        num_players=PLAYERS,
+    )
+    sess = (
+        SessionBuilder(input_size=1)
+        .with_num_players(PLAYERS)
+        .with_max_prediction_window(WINDOW)
+        .with_check_distance(CHECK_DISTANCE)
+        .start_synctest_session()
+    )
+    rng = np.random.default_rng(7)
+    inputs = rng.integers(0, 16, size=(70, PLAYERS, 1), dtype=np.uint8)
+    for f in range(70):
+        for h in range(PLAYERS):
+            sess.add_local_input(h, bytes(inputs[f, h]))
+        backend.handle_requests(sess.advance_frame())
+
+    host = ex_game.init_oracle(PLAYERS, ENTITIES)
+    statuses = np.zeros(PLAYERS, dtype=np.int32)
+    for f in range(70):
+        host = ex_game.step_oracle(host, inputs[f].reshape(-1), statuses, PLAYERS)
+    dev = backend.state_numpy()
+    for k in host:
+        assert np.array_equal(np.asarray(dev[k]), host[k]), f"{k} diverged"
+
+
+def test_48_frame_window_fused_session_with_arena():
+    """Deep windows x the second model family x the fused batch session."""
+    from ggrs_tpu.tpu import TpuSyncTestSession
+
+    sess = TpuSyncTestSession(
+        arena.Arena(PLAYERS, ENTITIES), num_players=PLAYERS,
+        check_distance=CHECK_DISTANCE,
+    )
+    rng = np.random.default_rng(11)
+    sess.advance_frames(rng.integers(0, 64, size=(60, PLAYERS, 1), dtype=np.uint8))
+    sess.check()
