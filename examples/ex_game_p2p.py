@@ -53,6 +53,18 @@ def main() -> int:
         action="store_true",
         help="run on the C++ session core (requires `make -C native`)",
     )
+    ap.add_argument(
+        "--tpu",
+        action="store_true",
+        help="fulfill requests on the device backend (one fused dispatch "
+        "per tick) instead of the numpy host oracle",
+    )
+    ap.add_argument(
+        "--beam",
+        type=int,
+        default=0,
+        help="with --tpu: speculative input-beam width (0 = off)",
+    )
     args = ap.parse_args()
 
     builder = (
@@ -75,8 +87,43 @@ def main() -> int:
             PlayerType.spectator(parse_addr(spec)), len(args.players) + i
         )
 
+    if args.tpu:
+        from ggrs_tpu.models.ex_game import ExGame
+        from ggrs_tpu.tpu import TpuRollbackBackend
+
+        backend = TpuRollbackBackend(
+            ExGame(len(args.players), args.entities),
+            max_prediction=builder.max_prediction,
+            num_players=len(args.players),
+            beam_width=args.beam,
+        )
+        # compile before the session even exists: the first jit would stall
+        # the 60fps loop past the peers' disconnect timeout
+        backend.warmup()
+
     sess = builder.start_p2p_session(UdpNonBlockingSocket(args.local_port))
-    game = HostGame(len(args.players), args.entities)
+    if args.tpu:
+
+        class DeviceGameDriver:
+            handle_requests = staticmethod(backend.handle_requests)
+
+            @staticmethod
+            def digest() -> str:
+                st = backend.state_numpy()
+                p0 = st["pos"][0]
+                hits = (
+                    f" beam {backend.beam_hits}/{backend.beam_hits + backend.beam_misses}"
+                    if args.beam
+                    else ""
+                )
+                return (
+                    f"frame {int(st['frame']):5d} entity0 @ "
+                    f"({int(p0[0])},{int(p0[1])}){hits}"
+                )
+
+        game = DeviceGameDriver()
+    else:
+        game = HostGame(len(args.players), args.entities)
 
     # accumulator loop (ex_game_p2p.rs:80-129)
     frame = 0

@@ -359,6 +359,36 @@ class TpuRollbackBackend:
 
     # ------------------------------------------------------------------
 
+    def warmup(self) -> None:
+        """Compile every device program this backend can dispatch (tick,
+        speculation, adoption) before entering a real-time loop: first
+        compilation takes seconds — enough to trip peers' disconnect
+        timeouts mid-session. Game state is left untouched."""
+        import jax.numpy as jnp
+
+        core = self.core
+        W, P, I = core.window, self.num_players, self.input_size
+        inputs = np.zeros((W, P, I), dtype=np.uint8)
+        statuses = np.zeros((W, P), dtype=np.int32)
+        scratch = np.full((W,), core.scratch_slot, dtype=np.int32)
+        # tick/adopt DONATE their ring+state buffers (invalidated on real
+        # devices), so both must be deep-copied before the dummy dispatches
+        # and restored after
+        ring0 = jax.tree.map(jnp.copy, core.ring)
+        state0 = jax.tree.map(jnp.copy, core.state)
+        core.tick(False, 0, inputs, statuses, scratch, 0)
+        if self.beam_width:
+            from .beam import repeat_last_beam
+
+            beam_inputs = repeat_last_beam(
+                np.zeros((P, I), dtype=np.uint8), W, self.beam_width
+            )
+            beam_statuses = np.zeros((self.beam_width, W, P), dtype=np.int32)
+            spec = core.speculate(0, beam_inputs, beam_statuses)
+            core.adopt(spec, 0, 0, scratch, 1)
+        core.ring, core.state = ring0, state0
+        self.block_until_ready()
+
     def state_numpy(self):
         """Host copy of the live game state (parity checks / rendering)."""
         return self.core.fetch_state()

@@ -71,6 +71,19 @@ def drive_synctest_pair(beam, plain, inputs_for, ticks):
         assert cb.checksum == cp.checksum, f"checksum diverged at frame {cb.frame}"
 
 
+def test_warmup_compiles_without_state_change():
+    """warmup() (pre-session compile for real-time loops) must leave the
+    game state and ring untouched, and ticks afterwards must match a
+    backend that never warmed up."""
+    warmed, fresh = make_backend(beam_width=4), make_backend(beam_width=4)
+    before = warmed.state_numpy()
+    warmed.warmup()
+    after = warmed.state_numpy()
+    for k in before:
+        assert np.array_equal(np.asarray(before[k]), np.asarray(after[k]))
+    drive_synctest_pair(warmed, fresh, lambda t, h: bytes([t % 5]), ticks=15)
+
+
 def test_beam_hits_on_steady_inputs_and_matches_resim():
     """Constant inputs: every forced SyncTest rollback's script equals the
     repeat-last beam member, so after the first speculation every tick is
