@@ -15,7 +15,7 @@ import os
 from typing import List, Optional
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libggrs_native.so")
-_ABI_VERSION = 4
+_ABI_VERSION = 5
 # native/input_queue.cpp MAX_INPUT_SIZE — builder validates against this
 NATIVE_MAX_INPUT_SIZE = 64
 
@@ -55,6 +55,10 @@ def load() -> Optional[ctypes.CDLL]:
     lib.ggrs_weighted_checksum.argtypes = [
         ctypes.POINTER(ctypes.c_uint32), ctypes.c_long,
         ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.ggrs_siphash24.restype = None
+    lib.ggrs_siphash24.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p,
     ]
     _lib = lib
     return _lib
@@ -129,6 +133,16 @@ def delta_decode(reference: bytes, data: bytes) -> List[bytes]:
     lib.ggrs_delta_encode(reference, m, data, k, out)  # XOR is an involution
     raw = out.raw[: len(data)]
     return [raw[i * m : (i + 1) * m] for i in range(k)]
+
+
+def siphash24(key: bytes, data: bytes) -> bytes:
+    """8-byte SipHash-2-4 tag; parity with ggrs_tpu.network.auth.siphash24."""
+    lib = load()
+    assert lib is not None
+    assert len(key) == 16
+    out = ctypes.create_string_buffer(8)
+    lib.ggrs_siphash24(key, data, len(data), out)
+    return out.raw
 
 
 def weighted_checksum_bytes(words_le: bytes) -> tuple[int, int]:

@@ -9,7 +9,7 @@ import ctypes
 import socket as _socket
 from typing import Any, List, Tuple
 
-from ..network.messages import DecodeError, Message, decode_message, encode_message
+from ..network.messages import Message, decode_all, encode_message
 from . import load
 
 RECV_BUFFER_SIZE = 4096
@@ -100,13 +100,7 @@ class NativeUdpNonBlockingSocket:
             received.append(((_int_to_ip(ip.value), port.value), self._buf.raw[:n]))
 
     def receive_all_messages(self) -> List[Tuple[Any, Message]]:
-        received: List[Tuple[Any, Message]] = []
-        for addr, wire in self.receive_all_wire():
-            try:
-                received.append((addr, decode_message(wire)))
-            except DecodeError:
-                continue  # drop garbage, like the reference's bincode filter
-        return received
+        return decode_all(self.receive_all_wire())
 
     def close(self) -> None:
         if self._fd >= 0:

@@ -1,0 +1,149 @@
+"""Authenticated transport: an opt-in MAC layer the reference never had.
+
+The wire's only packet filter in the reference is the 16-bit magic
+(src/network/protocol.rs:551-553); our fuzz suite (tests/test_wire_fuzz.py)
+pins the consequence — in-stream tampering that keeps the magic valid can
+stall a stream (forged acks) or corrupt inputs. `AuthenticatedSocket`
+closes that hole at the transport seam: every datagram carries an 8-byte
+SipHash-2-4 tag over its bytes under a 128-bit pre-shared key; receivers
+verify before anything else parses, so tampered or unkeyed packets are
+indistinguishable from loss (which the reliability layer already absorbs).
+
+Layering: wraps any NonBlockingSocket (UDP, in-memory fault net) and is
+transparent to every session stack — Python or native C++ — because all
+wire bytes pass through the socket seam. The tag math runs in C++ when the
+native library is built (ggrs_native.cpp ggrs_siphash24); the Python
+implementation below is the oracle (tests assert tag parity).
+
+Both peers must wrap (or neither): a keyed peer silently drops all
+unkeyed traffic, so a key mismatch looks like a dead network — sessions
+simply never leave SYNCHRONIZING.
+
+Scope: this authenticates packet CONTENT only — no direction, sequence or
+freshness binding — so an on-path attacker can still REPLAY previously
+captured datagrams. Replayed input packets are absorbed by the protocol's
+own idempotence (frames <= last_recv are skipped; stale acks are
+monotonic), but replayed quality reports can feed stale RTT/advantage
+into throttling. Forgery and bit-flip tampering are fully blocked.
+"""
+
+from __future__ import annotations
+
+import hmac
+from typing import Any, List, Tuple
+
+from .messages import Message, decode_all, encode_message
+
+TAG_LEN = 8
+KEY_LEN = 16
+
+_MASK = (1 << 64) - 1
+
+
+def _rotl(x: int, b: int) -> int:
+    return ((x << b) | (x >> (64 - b))) & _MASK
+
+
+def siphash24(key: bytes, data: bytes) -> int:
+    """SipHash-2-4 (the reference PRF for short untrusted inputs); 64-bit
+    tag under a 128-bit key. Pure-Python oracle for the C++ kernel."""
+    assert len(key) == KEY_LEN
+    k0 = int.from_bytes(key[:8], "little")
+    k1 = int.from_bytes(key[8:], "little")
+    v0 = 0x736F6D6570736575 ^ k0
+    v1 = 0x646F72616E646F6D ^ k1
+    v2 = 0x6C7967656E657261 ^ k0
+    v3 = 0x7465646279746573 ^ k1
+
+    def rounds(n: int) -> None:
+        nonlocal v0, v1, v2, v3
+        for _ in range(n):
+            v0 = (v0 + v1) & _MASK
+            v1 = _rotl(v1, 13) ^ v0
+            v0 = _rotl(v0, 32)
+            v2 = (v2 + v3) & _MASK
+            v3 = _rotl(v3, 16) ^ v2
+            v0 = (v0 + v3) & _MASK
+            v3 = _rotl(v3, 21) ^ v0
+            v2 = (v2 + v1) & _MASK
+            v1 = _rotl(v1, 17) ^ v2
+            v2 = _rotl(v2, 32)
+
+    n = len(data)
+    for off in range(0, n - n % 8, 8):
+        m = int.from_bytes(data[off : off + 8], "little")
+        v3 ^= m
+        rounds(2)
+        v0 ^= m
+    last = int.from_bytes(data[n - n % 8 :], "little") | ((n & 0xFF) << 56)
+    v3 ^= last
+    rounds(2)
+    v0 ^= last
+    v2 ^= 0xFF
+    rounds(4)
+    return (v0 ^ v1 ^ v2 ^ v3) & _MASK
+
+
+def _resolve_tag_fn():
+    """Pick the tag backend once (per AuthenticatedSocket) — not per packet."""
+    from .. import native as _native
+
+    if _native.available():
+        return _native.siphash24
+    return lambda key, data: siphash24(key, data).to_bytes(TAG_LEN, "little")
+
+
+class AuthenticatedSocket:
+    """Wraps a NonBlockingSocket; appends/verifies per-datagram MAC tags.
+    Invalid tags are dropped silently — to the protocol they are packet
+    loss, which it already handles."""
+
+    def __init__(self, inner: Any, key: bytes):
+        if len(key) != KEY_LEN:
+            raise ValueError(f"key must be {KEY_LEN} bytes, got {len(key)}")
+        # tags cover exact wire bytes, so the inner transport must expose
+        # them (a message-level-only socket re-decodes before we could
+        # verify); both shipped transports do
+        if not hasattr(inner, "receive_all_wire") or not hasattr(inner, "send_wire"):
+            raise TypeError("AuthenticatedSocket requires a wire-capable socket")
+        self.inner = inner
+        self.key = bytes(key)
+        self.dropped = 0  # observability: tag-verification failures
+        self._tag = _resolve_tag_fn()
+
+    def __getattr__(self, name: str):
+        # delegate everything else (local_port, close, ...) to the transport
+        return getattr(self.inner, name)
+
+    # -- sending --------------------------------------------------------
+
+    def send_wire(self, wire: bytes, addr: Any) -> None:
+        self.inner.send_wire(wire + self._tag(self.key, wire), addr)
+
+    def send_to(self, msg: Message, addr: Any) -> None:
+        self.send_wire(encode_message(msg), addr)
+
+    # -- receiving ------------------------------------------------------
+
+    def _verify(self, blob: bytes) -> bytes | None:
+        if len(blob) < TAG_LEN:
+            self.dropped += 1
+            return None
+        wire, tag = blob[:-TAG_LEN], blob[-TAG_LEN:]
+        # constant-time compare: an early-exit != would leak tag-prefix
+        # match length through verify latency
+        if not hmac.compare_digest(self._tag(self.key, wire), tag):
+            self.dropped += 1
+            return None
+        return wire
+
+    def receive_all_wire(self) -> List[Tuple[Any, bytes]]:
+        out = []
+        for addr, blob in self.inner.receive_all_wire():
+            wire = self._verify(blob)
+            if wire is not None:
+                out.append((addr, wire))
+        return out
+
+    def receive_all_messages(self) -> List[Tuple[Any, Message]]:
+        return decode_all(self.receive_all_wire())

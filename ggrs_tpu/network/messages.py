@@ -201,3 +201,16 @@ def decode_message(buf: bytes) -> Message:
     except struct.error as exc:
         raise DecodeError(str(exc)) from exc
     raise DecodeError(f"unknown body type {body_type}")
+
+
+def decode_all(pairs):
+    """Decode (addr, wire) pairs, dropping undecodable datagrams — the
+    one garbage filter every transport shares (the reference's bincode
+    deserialization failure analog, src/network/udp_socket.rs:44-50)."""
+    out = []
+    for addr, wire in pairs:
+        try:
+            out.append((addr, decode_message(wire)))
+        except DecodeError:
+            continue
+    return out

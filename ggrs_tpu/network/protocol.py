@@ -169,6 +169,7 @@ class PeerEndpoint:
         self.round_trip_time = 0
         self.last_send_time = now
         self.last_recv_time = now
+        self.last_sync_request_time = now
 
         self.checksum_history: Dict[Frame, int] = {}
         self.last_added_checksum_frame: Frame = NULL_FRAME
@@ -213,7 +214,14 @@ class PeerEndpoint:
     def poll(self, connect_status: Sequence[ConnectionStatus]) -> List[Any]:
         now = self.clock.now_ms()
         if self.state == ProtocolState.SYNCHRONIZING:
-            if self.last_send_time + SYNC_RETRY_INTERVAL_MS < now:
+            # Deliberate divergence from the reference (protocol.rs:353):
+            # retries key off the last sync REQUEST, not the last send of
+            # anything. A Synchronizing endpoint also answers the running
+            # peer's 200ms quality reports, and on the reference's condition
+            # each QualityReply refreshes last_send_time — permanently
+            # starving handshake retries once the final SyncReply is lost
+            # (a livelock our tampering fuzz exposed).
+            if self.last_sync_request_time + SYNC_RETRY_INTERVAL_MS < now:
                 self._send_sync_request()
         elif self.state == ProtocolState.RUNNING:
             if self.running_last_input_recv + RUNNING_RETRY_INTERVAL_MS < now:
@@ -328,6 +336,7 @@ class PeerEndpoint:
         self._queue_message(InputAck(ack_frame=self._last_recv_frame()))
 
     def _send_sync_request(self) -> None:
+        self.last_sync_request_time = self.clock.now_ms()
         nonce = self._rng.getrandbits(32)
         self.sync_random_requests.add(nonce)
         self._queue_message(SyncRequest(random_request=nonce))

@@ -15,7 +15,7 @@ import socket as _socket
 from typing import Any, Dict, List, Protocol, Tuple
 
 from ..utils.clock import Clock
-from .messages import DecodeError, Message, decode_message, encode_message
+from .messages import Message, decode_all, encode_message
 
 RECV_BUFFER_SIZE = 4096
 
@@ -49,8 +49,10 @@ class UdpNonBlockingSocket:
         """Pre-encoded fast path used by native endpoints."""
         self.sock.sendto(wire, addr)
 
-    def receive_all_messages(self) -> List[Tuple[Any, Message]]:
-        received: List[Tuple[Any, Message]] = []
+    def receive_all_wire(self) -> List[Tuple[Any, bytes]]:
+        """Raw datagrams (pre-codec): used by native endpoints and the
+        authenticated-transport wrapper, which must see exact wire bytes."""
+        received: List[Tuple[Any, bytes]] = []
         while True:
             try:
                 buf, src = self.sock.recvfrom(RECV_BUFFER_SIZE)
@@ -58,10 +60,10 @@ class UdpNonBlockingSocket:
                 return received
             except ConnectionResetError:
                 continue
-            try:
-                received.append((src, decode_message(buf)))
-            except DecodeError:
-                continue  # drop garbage, like the reference's bincode filter
+            received.append((src, buf))
+
+    def receive_all_messages(self) -> List[Tuple[Any, Message]]:
+        return decode_all(self.receive_all_wire())
 
     def close(self) -> None:
         self.sock.close()
@@ -108,17 +110,17 @@ class InMemoryNetwork:
                 (self.clock.now_ms() + delay, self._seq, (src, wire)),
             )
 
-    def _drain(self, addr: Any) -> List[Tuple[Any, Message]]:
+    def _drain_wire(self, addr: Any) -> List[Tuple[Any, bytes]]:
         q = self.queues.setdefault(addr, [])
         now = self.clock.now_ms()
-        out: List[Tuple[Any, Message]] = []
+        out: List[Tuple[Any, bytes]] = []
         while q and q[0][0] <= now:
             _, _, (src, wire) = heapq.heappop(q)
-            try:
-                out.append((src, decode_message(wire)))
-            except DecodeError:
-                continue
+            out.append((src, wire))
         return out
+
+    def _drain(self, addr: Any) -> List[Tuple[Any, Message]]:
+        return decode_all(self._drain_wire(addr))
 
 
 class InMemorySocket:
@@ -135,6 +137,9 @@ class InMemorySocket:
     def send_wire(self, wire: bytes, addr: Any) -> None:
         """Pre-encoded fast path used by native endpoints."""
         self.net._deliver(self.addr, addr, wire)
+
+    def receive_all_wire(self) -> List[Tuple[Any, bytes]]:
+        return self.net._drain_wire(self.addr)
 
     def receive_all_messages(self) -> List[Tuple[Any, Message]]:
         return self.net._drain(self.addr)

@@ -207,6 +207,7 @@ struct Endpoint {
   uint64_t round_trip_time = 0;
   uint64_t last_send_time;
   uint64_t last_recv_time;
+  uint64_t last_sync_request_time;
 
   std::map<int32_t, std::array<uint8_t, 16>> checksum_history;
   int32_t last_added_checksum_frame = NULL_FRAME;
@@ -231,7 +232,8 @@ struct Endpoint {
         running_last_input_recv(now),
         shutdown_timeout(now),
         last_send_time(now),
-        last_recv_time(now) {
+        last_recv_time(now),
+        last_sync_request_time(now) {
     std::copy(h, h + nh, handles);
     std::sort(handles, handles + nh);
     peer_connect_status.resize(np);
@@ -259,6 +261,7 @@ struct Endpoint {
   }
 
   void send_sync_request(uint64_t now) {
+    last_sync_request_time = now;
     uint32_t nonce = static_cast<uint32_t>(rng.next());
     sync_random_requests.insert(nonce);
     auto o = header(MSG_SYNC_REQUEST);
@@ -359,7 +362,11 @@ struct Endpoint {
   void poll(const ConnStatus* status, long n_status, uint64_t now) {
     // (protocol.py poll; reference protocol.rs:351-404)
     if (state == State::kSynchronizing) {
-      if (last_send_time + SYNC_RETRY_INTERVAL_MS < now) send_sync_request(now);
+      // retries key off the last sync REQUEST: QualityReplies to a running
+      // peer would otherwise refresh last_send_time every 200ms and starve
+      // the handshake forever (see protocol.py poll for the full story)
+      if (last_sync_request_time + SYNC_RETRY_INTERVAL_MS < now)
+        send_sync_request(now);
     } else if (state == State::kRunning) {
       if (running_last_input_recv + RUNNING_RETRY_INTERVAL_MS < now) {
         send_pending_output(status, n_status, now);

@@ -164,10 +164,46 @@ void ggrs_weighted_checksum(const uint32_t* words, long n, uint32_t* hi,
   *lo = l;
 }
 
+// SipHash-2-4: per-datagram MAC tag for the authenticated transport
+// (ggrs_tpu/network/auth.py is the oracle; tags must match bit-for-bit).
+void ggrs_siphash24(const uint8_t key[16], const uint8_t* data, long n,
+                    uint8_t out[8]) {
+  auto rotl = [](uint64_t x, int b) { return (x << b) | (x >> (64 - b)); };
+  auto load64 = [](const uint8_t* p) {
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v |= static_cast<uint64_t>(p[i]) << (8 * i);
+    return v;
+  };
+  uint64_t k0 = load64(key), k1 = load64(key + 8);
+  uint64_t v0 = 0x736f6d6570736575ull ^ k0;
+  uint64_t v1 = 0x646f72616e646f6dull ^ k1;
+  uint64_t v2 = 0x6c7967656e657261ull ^ k0;
+  uint64_t v3 = 0x7465646279746573ull ^ k1;
+  auto round = [&] {
+    v0 += v1; v1 = rotl(v1, 13); v1 ^= v0; v0 = rotl(v0, 32);
+    v2 += v3; v3 = rotl(v3, 16); v3 ^= v2;
+    v0 += v3; v3 = rotl(v3, 21); v3 ^= v0;
+    v2 += v1; v1 = rotl(v1, 17); v1 ^= v2; v2 = rotl(v2, 32);
+  };
+  long full = n - (n % 8);
+  for (long off = 0; off < full; off += 8) {
+    uint64_t m = load64(data + off);
+    v3 ^= m; round(); round(); v0 ^= m;
+  }
+  uint64_t last = static_cast<uint64_t>(n & 0xFF) << 56;
+  for (long i = 0; i < n % 8; ++i)
+    last |= static_cast<uint64_t>(data[full + i]) << (8 * i);
+  v3 ^= last; round(); round(); v0 ^= last;
+  v2 ^= 0xFF;
+  round(); round(); round(); round();
+  uint64_t tag = v0 ^ v1 ^ v2 ^ v3;
+  for (int i = 0; i < 8; ++i) out[i] = (tag >> (8 * i)) & 0xFF;
+}
+
 // ABI version for the ctypes loader to sanity-check. Bump whenever exported
 // symbols change (v2: added the ggrs_iq_* input-queue family; v3: the
 // ggrs_ep_* reliability endpoint and ggrs_udp_* socket families; v4: the
-// ggrs_sess_* session core family).
-long ggrs_native_abi_version() { return 4; }
+// ggrs_sess_* session core family; v5: ggrs_siphash24).
+long ggrs_native_abi_version() { return 5; }
 
 }  // extern "C"

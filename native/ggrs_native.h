@@ -57,6 +57,9 @@ void ggrs_delta_decode(const uint8_t* ref, long m, const uint8_t* data,
                        long k, uint8_t* out);
 void ggrs_weighted_checksum(const uint32_t* words, long n, uint32_t* hi,
                             uint32_t* lo);
+// SipHash-2-4 MAC tag (authenticated transport; 128-bit key, 64-bit tag)
+void ggrs_siphash24(const uint8_t key[16], const uint8_t* data, long n,
+                    uint8_t out[8]);
 
 // ---------------------------------------------------------------------------
 // input queue (128-slot ring, repeat-last prediction, misprediction detect)

@@ -331,6 +331,82 @@ def test_negative_start_frame_post_sync_is_dropped(kind):
     assert got == list(range(3, 8)), f"input stream broken after poison: {got}"
 
 
+class SyncReplyBlackhole:
+    """Drops SyncReply datagrams toward the wrapped socket until `until_ms`
+    on the shared clock — forcing the asymmetric handshake state where the
+    peer is already RUNNING while this side still waits for its final
+    roundtrip."""
+
+    MSG_SYNC_REPLY = 1  # wire byte 2 (messages.py body tags)
+
+    def __init__(self, inner, clock, until_ms):
+        self.inner = inner
+        self.clock = clock
+        self.until_ms = until_ms
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _keep(self, wire):
+        if self.clock.now_ms() >= self.until_ms:
+            return True
+        return len(wire) < 3 or wire[2] != self.MSG_SYNC_REPLY
+
+    def receive_all_wire(self):
+        return [(a, w) for a, w in self.inner.receive_all_wire() if self._keep(w)]
+
+    def receive_all_messages(self):
+        from ggrs_tpu.network.messages import decode_all
+
+        return decode_all(self.receive_all_wire())
+
+
+@pytest.mark.parametrize("use_native", NATIVE_PARAMS)
+def test_asymmetric_handshake_recovers_despite_quality_chatter(use_native):
+    """Regression (livelock inherited from the reference, protocol.rs:353):
+    when one peer completes the handshake and the other loses the final
+    SyncReply, the running peer's 200ms quality reports made the stuck
+    side's QualityReplies refresh last_send_time forever, starving its
+    sync-request retries. Retries now key off the last sync request: once
+    the blackhole lifts, the pair must synchronize."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    s0, s1 = build_pair(clock, net, use_native)
+    # s1 loses every SyncReply until t=1200ms (past phase 1's 800ms, lifted
+    # mid-chatter in phase 2)
+    s1.socket = SyncReplyBlackhole(s1.socket, clock, until_ms=1200)
+    if hasattr(s1, "_wire_recv"):
+        s1._wire_recv = True
+    else:
+        s1._wire_dispatch = None
+
+    # s0 completes and starts ticking (quality reports flow); s1 is stuck
+    for _ in range(40):
+        s0.poll_remote_clients()
+        s1.poll_remote_clients()
+        clock.advance(20)
+    assert s0.current_state() == SessionState.RUNNING
+    assert s1.current_state() == SessionState.SYNCHRONIZING
+    from ggrs_tpu.errors import PredictionThreshold
+
+    g0 = GameStub()
+    for frame in range(60):  # sustained quality-report chatter toward s1
+        try:
+            s0.add_local_input(0, b"\x01")
+            g0.handle_requests(s0.advance_frame())
+        except PredictionThreshold:
+            s0.poll_remote_clients()  # window full: wait on the stuck peer
+        s1.poll_remote_clients()
+        clock.advance(16)  # passes the 1200ms mark mid-loop
+    for _ in range(40):
+        s0.poll_remote_clients()
+        s1.poll_remote_clients()
+        clock.advance(20)
+    assert s1.current_state() == SessionState.RUNNING, (
+        "handshake retries starved by quality-reply chatter"
+    )
+
+
 @pytest.mark.parametrize("seed", range(10))
 def test_native_endpoint_handles_arbitrary_bytes(seed):
     """Raw bytes straight into the C++ endpoint state machine (no Python
