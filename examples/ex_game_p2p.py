@@ -65,6 +65,12 @@ def main() -> int:
         default=0,
         help="with --tpu: speculative input-beam width (0 = off)",
     )
+    ap.add_argument(
+        "--auth-key",
+        default=None,
+        help="32 hex chars: authenticate every datagram (SipHash-2-4); all "
+        "peers must share the key",
+    )
     args = ap.parse_args()
 
     builder = (
@@ -101,7 +107,12 @@ def main() -> int:
         # the 60fps loop past the peers' disconnect timeout
         backend.warmup()
 
-    sess = builder.start_p2p_session(UdpNonBlockingSocket(args.local_port))
+    sock = UdpNonBlockingSocket(args.local_port)
+    if args.auth_key:
+        from ggrs_tpu.network.auth import AuthenticatedSocket
+
+        sock = AuthenticatedSocket(sock, bytes.fromhex(args.auth_key))
+    sess = builder.start_p2p_session(sock)
     if args.tpu:
 
         class DeviceGameDriver:

@@ -45,6 +45,11 @@ def main() -> int:
         action="store_true",
         help="run on the C++ session core (requires `make -C native`)",
     )
+    ap.add_argument(
+        "--auth-key",
+        default=None,
+        help="32 hex chars: authenticate every datagram (SipHash-2-4)",
+    )
     args = ap.parse_args()
 
     builder = (
@@ -56,9 +61,12 @@ def main() -> int:
     )
     if args.native:
         builder = builder.with_native_sessions(True)
-    sess = builder.start_spectator_session(
-        parse_addr(args.host), UdpNonBlockingSocket(args.local_port)
-    )
+    sock = UdpNonBlockingSocket(args.local_port)
+    if args.auth_key:
+        from ggrs_tpu.network.auth import AuthenticatedSocket
+
+        sock = AuthenticatedSocket(sock, bytes.fromhex(args.auth_key))
+    sess = builder.start_spectator_session(parse_addr(args.host), sock)
     game = HostGame(args.num_players, args.entities)
 
     frames = 0
