@@ -71,7 +71,15 @@ def main() -> int:
         help="32 hex chars: authenticate every datagram (SipHash-2-4); all "
         "peers must share the key",
     )
+    ap.add_argument(
+        "--replay-protect",
+        action="store_true",
+        help="with --auth-key: drop replayed datagrams too (all peers must "
+        "enable it together)",
+    )
     args = ap.parse_args()
+    if args.replay_protect and not args.auth_key:
+        ap.error("--replay-protect requires --auth-key")
 
     builder = (
         SessionBuilder(input_size=1)
@@ -111,7 +119,9 @@ def main() -> int:
     if args.auth_key:
         from ggrs_tpu.network.auth import AuthenticatedSocket
 
-        sock = AuthenticatedSocket(sock, bytes.fromhex(args.auth_key))
+        sock = AuthenticatedSocket(
+            sock, bytes.fromhex(args.auth_key), replay_protect=args.replay_protect
+        )
     sess = builder.start_p2p_session(sock)
     if args.tpu:
 

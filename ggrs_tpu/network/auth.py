@@ -19,23 +19,52 @@ Both peers must wrap (or neither): a keyed peer silently drops all
 unkeyed traffic, so a key mismatch looks like a dead network — sessions
 simply never leave SYNCHRONIZING.
 
-Scope: this authenticates packet CONTENT only — no direction, sequence or
-freshness binding — so an on-path attacker can still REPLAY previously
-captured datagrams. Replayed input packets are absorbed by the protocol's
-own idempotence (frames <= last_recv are skipped; stale acks are
-monotonic), but replayed quality reports can feed stale RTT/advantage
+Format note: tags are computed over a 1-byte mode domain plus the
+payload (see `_domain` below). This supersedes the round-1 format that
+tagged the bare wire bytes — peers on the two formats drop each other's
+traffic exactly like a key mismatch. The change is deliberate: an empty
+plain-mode domain would be splicable into the replay-protected mode.
+
+Scope: by default this authenticates packet CONTENT only — no direction,
+sequence or freshness binding — so an on-path attacker can still REPLAY
+previously captured datagrams. Replayed input packets are absorbed by the
+protocol's own idempotence (frames <= last_recv are skipped; stale acks
+are monotonic), but replayed quality reports can feed stale RTT/advantage
 into throttling. Forgery and bit-flip tampering are fully blocked.
+
+`replay_protect=True` closes the replay window too: every datagram then
+carries a random 8-byte sender id plus a monotonically increasing 8-byte
+counter, both under the MAC. The receiver accepts each (sender id,
+counter) at most once via an IPsec-style sliding window of
+`_ReplayWindow.WINDOW` (1024) counters; anything older or repeated is
+dropped as loss. The sender runs one counter stream across all of its
+destinations, so a receiver behind a P-way fan-out (host + P-1 other
+peers/spectators) tolerates genuine reorder of about WINDOW/P datagrams
+— 1024 counters of skew at P=1, ~60 datagrams at P=17. Receivers drop
+datagrams bearing their OWN sender id (reflection of captured outbound
+traffic cannot poison the windows). Windows are keyed by the
+authenticated sender id — never by the UDP source address, which is
+spoofable — so only actual key-holders can allocate window state. The
+two modes use distinct equal-length MAC domain bytes, so a mode mismatch
+(or a splice between modes) fails tag verification outright, same as a
+key mismatch. Residual on-path power: an attacker can still re-route a
+sender's packets between that sender's peers to advance a window and
+shadow in-flight traffic older than the window — indistinguishable from
+the packet drops an on-path attacker can always inflict.
 """
 
 from __future__ import annotations
 
 import hmac
+import os
 from typing import Any, List, Tuple
 
 from .messages import Message, decode_all, encode_message
 
 TAG_LEN = 8
 KEY_LEN = 16
+CTR_LEN = 8  # replay-protection counter, little-endian, under the MAC
+SENDER_ID_LEN = 8  # random per-socket id; replay windows key on it
 
 _MASK = (1 << 64) - 1
 
@@ -93,12 +122,55 @@ def _resolve_tag_fn():
     return lambda key, data: siphash24(key, data).to_bytes(TAG_LEN, "little")
 
 
+class _ReplayWindow:
+    """IPsec-style sliding-window anti-replay: accepts each counter at most
+    once, tolerating reorder within the window. Counters start at 1, so the
+    zero-initial `top` never collides with a real packet."""
+
+    # sized for fan-out: the sender runs ONE counter stream across all
+    # destinations, so a host broadcasting to P peers/spectators consumes
+    # ~P counters per tick and a receiver must tolerate reorder×P of
+    # counter skew. 1024 bits ≈ 60 datagrams of genuine reorder at a
+    # 17-way fan-out; the mask is one Python big-int, so width is cheap
+    WINDOW = 1024
+
+    def __init__(self) -> None:
+        self.top = 0  # highest counter accepted so far
+        self.mask = 1  # bit i set => counter (top - i) already seen
+
+    def check_and_update(self, ctr: int) -> bool:
+        if ctr > self.top:
+            shift = ctr - self.top
+            # clamp before shifting: ctr is attacker-influenced u64, and an
+            # unclamped `mask << 2**60` materializes a 2**60-bit big-int
+            if shift >= self.WINDOW:
+                self.mask = 1
+            else:
+                self.mask = ((self.mask << shift) | 1) & ((1 << self.WINDOW) - 1)
+            self.top = ctr
+            return True
+        off = self.top - ctr
+        if off >= self.WINDOW:
+            return False  # too old to distinguish from a replay
+        bit = 1 << off
+        if self.mask & bit:
+            return False  # replay
+        self.mask |= bit
+        return True
+
+
 class AuthenticatedSocket:
     """Wraps a NonBlockingSocket; appends/verifies per-datagram MAC tags.
     Invalid tags are dropped silently — to the protocol they are packet
     loss, which it already handles."""
 
-    def __init__(self, inner: Any, key: bytes):
+    def __init__(
+        self,
+        inner: Any,
+        key: bytes,
+        replay_protect: bool = False,
+        sender_id: bytes | None = None,
+    ):
         if len(key) != KEY_LEN:
             raise ValueError(f"key must be {KEY_LEN} bytes, got {len(key)}")
         # tags cover exact wire bytes, so the inner transport must expose
@@ -109,6 +181,20 @@ class AuthenticatedSocket:
         self.inner = inner
         self.key = bytes(key)
         self.dropped = 0  # observability: tag-verification failures
+        self.replayed = 0  # observability: replay-window rejections
+        self.replay_protect = replay_protect
+        self._send_ctr = 0  # one stream for all peers; per-peer view stays monotonic
+        self._recv_windows: dict = {}  # authenticated sender id -> _ReplayWindow
+        if sender_id is None:
+            sender_id = os.urandom(SENDER_ID_LEN)
+        elif len(sender_id) != SENDER_ID_LEN:
+            raise ValueError(f"sender_id must be {SENDER_ID_LEN} bytes")
+        self.sender_id = bytes(sender_id)
+        # domain separation, equal-length in both modes: without it a mode
+        # mismatch would still MAC-verify and mis-frame trailing bytes, and
+        # an empty plain-mode domain would let a plain packet starting with
+        # the protected domain byte be spliced across modes
+        self._domain = b"\x01" if replay_protect else b"\x00"
         self._tag = _resolve_tag_fn()
 
     def __getattr__(self, name: str):
@@ -118,7 +204,12 @@ class AuthenticatedSocket:
     # -- sending --------------------------------------------------------
 
     def send_wire(self, wire: bytes, addr: Any) -> None:
-        self.inner.send_wire(wire + self._tag(self.key, wire), addr)
+        if self.replay_protect:
+            self._send_ctr += 1
+            body = wire + self.sender_id + self._send_ctr.to_bytes(CTR_LEN, "little")
+        else:
+            body = wire
+        self.inner.send_wire(body + self._tag(self.key, self._domain + body), addr)
 
     def send_to(self, msg: Message, addr: Any) -> None:
         self.send_wire(encode_message(msg), addr)
@@ -126,14 +217,34 @@ class AuthenticatedSocket:
     # -- receiving ------------------------------------------------------
 
     def _verify(self, blob: bytes) -> bytes | None:
-        if len(blob) < TAG_LEN:
+        trailer = SENDER_ID_LEN + CTR_LEN if self.replay_protect else 0
+        if len(blob) < TAG_LEN + trailer:
             self.dropped += 1
             return None
-        wire, tag = blob[:-TAG_LEN], blob[-TAG_LEN:]
+        body, tag = blob[:-TAG_LEN], blob[-TAG_LEN:]
         # constant-time compare: an early-exit != would leak tag-prefix
         # match length through verify latency
-        if not hmac.compare_digest(self._tag(self.key, wire), tag):
+        if not hmac.compare_digest(self._tag(self.key, self._domain + body), tag):
             self.dropped += 1
+            return None
+        if not self.replay_protect:
+            return body
+        # replay state touched only AFTER the MAC verifies — unauthenticated
+        # datagrams must not be able to advance windows or allocate them
+        wire = body[:-trailer]
+        sender = body[-trailer:-CTR_LEN]
+        ctr = int.from_bytes(body[-CTR_LEN:], "little")
+        if sender == self.sender_id:
+            # our own outbound traffic reflected back at us
+            self.replayed += 1
+            return None
+        window = self._recv_windows.get(sender)
+        if window is None:
+            # keyed by the MAC-covered sender id, so only key-holders can
+            # allocate window state — a spoofed UDP source address cannot
+            window = self._recv_windows[sender] = _ReplayWindow()
+        if not window.check_and_update(ctr):
+            self.replayed += 1
             return None
         return wire
 

@@ -153,8 +153,13 @@ class SpectatorSession:
             self._push_event(Disconnected(addr=addr))
         elif isinstance(event, EvInput):
             inp = event.input
+            # mirror the native twin's defensive guards: a buggy/hostile
+            # endpoint must not index out of range or rewind the ring
+            if event.player < 0 or event.player >= self.num_players or inp.frame < 0:
+                return
+            if inp.frame < self.last_recv_frame:
+                return
             self.inputs[inp.frame % SPECTATOR_BUFFER_SIZE][event.player] = inp
-            assert inp.frame >= self.last_recv_frame
             self.last_recv_frame = inp.frame
             self.host.update_local_frame_advantage(inp.frame)
             for i in range(self.num_players):

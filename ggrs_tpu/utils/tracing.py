@@ -42,10 +42,12 @@ class Tracer:
 
     def __init__(self, enabled: bool = True, xprof: bool = False):
         self.enabled = enabled
+        # must precede the xprof assignment: the setter resolves the
+        # annotation class, and this default would otherwise clobber it
+        self._annotation_cls = None
         # also emit jax.profiler.TraceAnnotation regions so spans appear in
         # xprof/TensorBoard device profiles (SURVEY.md §5: xprof hooks)
         self.xprof = xprof
-        self._annotation_cls = None
         self.stats: Dict[str, SpanStats] = defaultdict(SpanStats)
         self._stack: List[str] = []
 

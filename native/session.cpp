@@ -645,6 +645,9 @@ struct Session {
           ggrs_iq_add_input(sync.queues[player], ev.frame, ev.input);
         } else if (type == SESS_SPECTATOR) {
           // (spectator_session.py _handle_event EvInput branch)
+          // mirror the P2P branch's bounds guard: a buggy/changed endpoint
+          // must not become an out-of-bounds write into spec_inputs
+          if (ev.player < 0 || ev.player >= num_players || ev.frame < 0) break;
           if (ev.frame < spec_last_recv_frame) break;  // defensive
           SpecSlot& cell =
               spec_inputs[(ev.frame % SPECTATOR_BUFFER) * num_players +

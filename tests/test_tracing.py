@@ -19,6 +19,9 @@ def test_xprof_annotated_spans_record_normally():
     """xprof mode wraps spans in jax.profiler.TraceAnnotation regions;
     aggregation semantics are unchanged."""
     t = Tracer(enabled=True, xprof=True)
+    # the constructor path must actually resolve the annotation class —
+    # a None here means spans silently skip xprof region emission
+    assert t._annotation_cls is not None
     with t.span("outer"):
         with t.span("inner"):
             pass

@@ -12,7 +12,7 @@ import pytest
 
 from ggrs_tpu import PlayerType, SessionBuilder, SessionState
 from ggrs_tpu.native import available
-from ggrs_tpu.network.auth import KEY_LEN, AuthenticatedSocket, siphash24
+from ggrs_tpu.network.auth import KEY_LEN, AuthenticatedSocket, _ReplayWindow, siphash24
 from ggrs_tpu.network.sockets import InMemoryNetwork
 from ggrs_tpu.utils.clock import FakeClock
 from stubs import GameStub
@@ -195,3 +195,199 @@ def test_tampering_degrades_to_loss_under_auth(use_native, seed):
     assert confirmed > 30, f"authenticated pair stalled (confirmed={confirmed})"
     for f in range(1, confirmed + 1):
         assert g0.history[f] == g1.history[f], f"diverged at {f} despite MAC"
+
+
+# -- replay protection ------------------------------------------------------
+
+
+def test_replay_window_semantics():
+    w = _ReplayWindow()
+    assert w.check_and_update(1)
+    assert not w.check_and_update(1)  # exact replay
+    assert w.check_and_update(5)
+    assert w.check_and_update(3)  # in-window reorder accepted once
+    assert not w.check_and_update(3)  # ...but only once
+    assert w.check_and_update(5 + _ReplayWindow.WINDOW)
+    assert not w.check_and_update(5)  # slid out of the window => replay
+    # an attacker-influenced u64 jump must not materialize a 2**60-bit mask
+    assert w.check_and_update(2**63)
+    assert not w.check_and_update(2**63)
+    assert w.top == 2**63
+
+
+class _LoopbackInner:
+    """Minimal wire socket: everything sent is received back, with the
+    source address chosen per-delivery (for spoofing probes)."""
+
+    def __init__(self):
+        self.sent = []
+        self._incoming = []
+
+    def send_wire(self, wire, addr):
+        self.sent.append(wire)
+
+    def receive_all_wire(self):
+        out = self._incoming
+        self._incoming = []
+        return out
+
+    def deliver(self, addr, blob):
+        self._incoming.append((addr, blob))
+
+
+def _protected_socket(sender_id=None):
+    inner = _LoopbackInner()
+    return inner, AuthenticatedSocket(inner, KEY, replay_protect=True, sender_id=sender_id)
+
+
+def test_reflection_of_own_traffic_is_dropped():
+    """Capturing a socket's outbound datagram and feeding it back (source
+    address spoofed as a peer) must not deliver or poison any window."""
+    inner, sock = _protected_socket()
+    sock.send_wire(b"hello-wire", "peer")
+    blob = inner.sent[0]
+    inner.deliver("peer", blob)
+    assert sock.receive_all_wire() == []
+    assert sock.replayed == 1
+    assert not sock._recv_windows  # reflection allocated no window state
+
+
+def test_spoofed_source_address_cannot_split_replay_state():
+    """Windows key on the authenticated sender id, not the UDP source
+    address: the same captured datagram replayed from N spoofed addresses
+    is accepted once and rejected N times, with exactly one window."""
+    _, sender = _protected_socket(sender_id=b"AAAAAAAA")
+    inner_r, receiver = _protected_socket(sender_id=b"BBBBBBBB")
+    sender.inner.sent.clear()
+    sender.send_wire(b"payload", "r")
+    blob = sender.inner.sent[0]
+    inner_r.deliver("addr0", blob)
+    assert [w for _, w in receiver.receive_all_wire()] == [b"payload"]
+    for i in range(5):
+        inner_r.deliver(f"spoofed{i}", blob)
+    assert receiver.receive_all_wire() == []
+    assert receiver.replayed == 5
+    assert len(receiver._recv_windows) == 1
+
+
+def test_mode_splice_rejected():
+    """A plain-mode packet must not be splicable into a protected receiver
+    by byte-stripping: the two modes use distinct equal-length MAC domains,
+    so any cross-mode delivery fails tag verification."""
+    plain_inner = _LoopbackInner()
+    plain = AuthenticatedSocket(plain_inner, KEY)
+    # craft a plain packet whose wire STARTS with the protected domain byte
+    plain.send_wire(b"\x01" + bytes(range(24)), "x")
+    blob = plain_inner.sent[0]
+    inner_r, receiver = _protected_socket()
+    for attempt in (blob, blob[1:]):  # as-is, and domain-byte-stripped
+        inner_r.deliver("p", attempt)
+        assert receiver.receive_all_wire() == []
+    assert receiver.dropped == 2
+    assert not receiver._recv_windows
+
+
+class ReplayingSocket:
+    """On-path replay attacker: records every received datagram and
+    re-delivers each one a second time on the next receive call."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self._pending = []
+
+    def send_wire(self, wire, addr):
+        self.inner.send_wire(wire, addr)
+
+    def receive_all_wire(self):
+        out = list(self._pending)
+        self._pending = []
+        fresh = self.inner.receive_all_wire()
+        self._pending.extend(fresh)
+        out.extend(fresh)
+        return out
+
+
+def test_replay_protect_drops_duplicates_and_converges():
+    """With replay_protect, a 2× duplication attack costs nothing: every
+    duplicate is rejected by the window (counted in .replayed) and the pair
+    still converges with identical histories."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=10, seed=7)
+
+    def build(my_addr, other_addr, local_handle):
+        b = (
+            SessionBuilder(input_size=1)
+            .with_num_players(2)
+            .with_clock(clock)
+            .with_rng(random.Random(hash(my_addr) & 0xFFFF))
+        )
+        b = b.add_player(PlayerType.local(), local_handle)
+        b = b.add_player(PlayerType.remote(other_addr), 1 - local_handle)
+        inner = net.socket(my_addr)
+        if my_addr == "a":  # one side receives through the replayer
+            inner = ReplayingSocket(inner)
+        return b.start_p2p_session(
+            AuthenticatedSocket(inner, KEY, replay_protect=True)
+        )
+
+    s0, s1 = build("a", "b", 0), build("b", "a", 1)
+    for _ in range(400):
+        s0.poll_remote_clients()
+        s1.poll_remote_clients()
+        clock.advance(20)
+        if (
+            s0.current_state() == SessionState.RUNNING
+            and s1.current_state() == SessionState.RUNNING
+        ):
+            break
+    g0, g1 = GameStub(), GameStub()
+    for frame in range(50):
+        s0.add_local_input(0, bytes([frame % 9]))
+        g0.handle_requests(s0.advance_frame())
+        s1.add_local_input(1, bytes([(frame * 3) % 9]))
+        g1.handle_requests(s1.advance_frame())
+        clock.advance(16)
+    for _ in range(10):
+        s0.poll_remote_clients()
+        s1.poll_remote_clients()
+        clock.advance(16)
+    s0.add_local_input(0, b"\x00")
+    g0.handle_requests(s0.advance_frame())
+    s1.add_local_input(1, b"\x00")
+    g1.handle_requests(s1.advance_frame())
+
+    assert s0.socket.replayed > 0, "replayer never fired"
+    confirmed = min(s0.confirmed_frame(), s1.confirmed_frame())
+    assert confirmed > 25, f"replay-protected pair stalled (confirmed={confirmed})"
+    for f in range(1, confirmed + 1):
+        assert g0.history[f] == g1.history[f]
+
+
+def test_replay_protect_mismatch_never_synchronizes():
+    """Counter framing is under the MAC, so a protected peer and an
+    unprotected peer see each other's packets as unauthenticated."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+
+    def build(my_addr, other_addr, local_handle, protect):
+        b = (
+            SessionBuilder(input_size=1)
+            .with_num_players(2)
+            .with_clock(clock)
+            .with_rng(random.Random(hash(my_addr) & 0xFFFF))
+        )
+        b = b.add_player(PlayerType.local(), local_handle)
+        b = b.add_player(PlayerType.remote(other_addr), 1 - local_handle)
+        return b.start_p2p_session(
+            AuthenticatedSocket(net.socket(my_addr), KEY, replay_protect=protect)
+        )
+
+    s0 = build("a", "b", 0, True)
+    s1 = build("b", "a", 1, False)
+    for _ in range(100):
+        s0.poll_remote_clients()
+        s1.poll_remote_clients()
+        clock.advance(20)
+    assert s0.current_state() == SessionState.SYNCHRONIZING
+    assert s1.current_state() == SessionState.SYNCHRONIZING
+    assert s0.socket.dropped > 0 and s1.socket.dropped > 0
