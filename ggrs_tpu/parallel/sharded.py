@@ -27,13 +27,31 @@ from ..ops.fixed_point import GOLDEN32
 
 def shard_state(state, mesh: Mesh):
     """Place a game-state pytree on the mesh: entity arrays split over the
-    `entity` axis, scalars replicated."""
+    `entity` axis, scalars replicated.
+
+    This is THE sharded-state placement policy (every consumer — ResimCore,
+    TpuSyncTestSession, the beam rollout — must route through here or
+    `shard_ring` so the contract can't drift between components): every
+    non-scalar state leaf has entities on axis 0, divisible by the `entity`
+    axis size."""
 
     def put(x):
         spec = P("entity") if x.ndim >= 1 else P()
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree.map(put, state)
+
+
+def shard_ring(ring, mesh: Mesh):
+    """Place a snapshot-ring pytree (state leaves with a leading slot axis)
+    on the mesh: entity dims split over `entity` on axis 1, per-slot scalars
+    replicated. The ring twin of `shard_state`'s placement policy."""
+
+    def put(x):
+        spec = P(None, "entity") if x.ndim >= 2 else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, ring)
 
 
 def sharded_checksum(state, mesh: Mesh, keys=None):

@@ -184,8 +184,24 @@ class TpuRollbackBackend:
     """
 
     def __init__(self, game, max_prediction: int, num_players: int,
-                 beam_width: int = 0):
-        self.core = ResimCore(game, max_prediction, num_players)
+                 beam_width: int = 0, mesh=None):
+        """`mesh`: optional jax Mesh with an `entity` axis — the world and
+        its snapshot ring shard across it (see ResimCore); the session-facing
+        contract (requests in, SnapshotRefs + lazy checksums out) is
+        unchanged, and checksums stay bit-identical to the unsharded
+        backend, so sharded and unsharded peers interoperate in one P2P
+        session (desync detection agrees)."""
+        self.core = ResimCore(game, max_prediction, num_players, mesh=mesh)
+        if (
+            beam_width
+            and self.core._beam_sharding is not None
+            and beam_width % mesh.shape["beam"] != 0
+        ):
+            raise ValueError(
+                f"beam_width={beam_width} must divide evenly over the mesh's "
+                f"beam axis ({mesh.shape['beam']}) — an indivisible beam "
+                "would silently run replicated, wasting every beam shard"
+            )
         self.num_players = num_players
         self.input_size = game.input_size
         self.current_frame: Frame = 0
@@ -416,7 +432,7 @@ class TpuRollbackBackend:
         )
 
     @classmethod
-    def restore(cls, path: str, game) -> "TpuRollbackBackend":
+    def restore(cls, path: str, game, mesh=None) -> "TpuRollbackBackend":
         from ..utils.checkpoint import load_device_checkpoint
 
         tree, meta = load_device_checkpoint(path)
@@ -426,8 +442,15 @@ class TpuRollbackBackend:
             max_prediction=meta["max_prediction"],
             num_players=meta["num_players"],
             beam_width=meta.get("beam_width", 0),
+            mesh=mesh,
         )
-        backend.core.ring = jax.device_put(tree["ring"])
-        backend.core.state = jax.device_put(tree["state"])
+        # re-place onto the freshly-built core's shardings (sharded under a
+        # mesh, single-device otherwise) — checkpoints are layout-agnostic
+        backend.core.ring = jax.device_put(
+            tree["ring"], jax.tree.map(lambda a: a.sharding, backend.core.ring)
+        )
+        backend.core.state = jax.device_put(
+            tree["state"], jax.tree.map(lambda a: a.sharding, backend.core.state)
+        )
         backend.current_frame = meta["current_frame"]
         return backend

@@ -41,19 +41,47 @@ class ResimCore:
     checksum(state) -> (u32, u32). All pure jax.
     """
 
-    def __init__(self, game, max_prediction: int, num_players: int):
+    def __init__(self, game, max_prediction: int, num_players: int, mesh=None):
+        """`mesh`: optional jax Mesh with an `entity` axis — the live state
+        AND the snapshot ring shard across it (BASELINE.json configs[4]), so
+        a partitioned world can run inside any session that drives this
+        core (the seam the reference exposes at
+        src/sessions/p2p_session.rs:621-673, here executed multi-chip).
+        GSPMD partitions the fused tick from the operand shardings; the
+        checksum reduction is the only cross-shard collective (uint32
+        wraparound sums are order-invariant, so the psum'd value is
+        bit-identical to the single-chip one). Sharded-state contract: every
+        non-scalar state leaf has entities on axis 0, divisible by the
+        `entity` axis size. If the mesh also has a `beam` axis, speculative
+        rollouts shard candidate futures across it."""
         self.game = game
         self.num_players = num_players
         self.max_prediction = max_prediction
         self.ring_len = max_prediction + 2  # parity with SavedStates
         self.scratch_slot = self.ring_len  # masked-off saves land here
         self.window = max_prediction + 2  # advances + possible trailing save
+        self.mesh = mesh
 
         state = game.init_state()
+        self._beam_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.sharded import shard_state
+
+            assert "entity" in mesh.axis_names, "mesh needs an `entity` axis"
+            state = shard_state(state, mesh)
+            if "beam" in mesh.axis_names and mesh.shape["beam"] > 1:
+                self._beam_sharding = NamedSharding(mesh, P("beam"))
         self.state = state
-        self.ring = jax.tree.map(
+        ring = jax.tree.map(
             lambda x: jnp.zeros((self.ring_len + 1,) + x.shape, x.dtype), state
         )
+        if mesh is not None:
+            from ..parallel.sharded import shard_ring
+
+            ring = shard_ring(ring, mesh)
+        self.ring = ring
         self._tick_fn = jax.jit(self._tick_packed_impl, donate_argnums=(0, 1))
         self._speculate_fn = jax.jit(self._speculate_impl)
         self._adopt_fn = jax.jit(self._adopt_impl, donate_argnums=(0,))
@@ -163,6 +191,16 @@ class ResimCore:
         """beam_inputs u8[B, W, P, I], beam_statuses i32[B, W, P] ->
         per-member per-frame trajectories [B, W, ...], per-frame checksums
         [B, W] (of the state AFTER each step), and the anchor's checksum."""
+        if (
+            self._beam_sharding is not None
+            and beam_inputs.shape[0] % self.mesh.shape["beam"] == 0
+        ):
+            beam_inputs = jax.lax.with_sharding_constraint(
+                beam_inputs, self._beam_sharding
+            )
+            beam_statuses = jax.lax.with_sharding_constraint(
+                beam_statuses, self._beam_sharding
+            )
         anchor = jax.tree.map(
             lambda r: jax.lax.dynamic_index_in_dim(r, anchor_slot, 0, keepdims=False),
             ring,

@@ -67,29 +67,19 @@ class TpuSyncTestSession:
 
         state = game.init_state()
         if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..parallel.sharded import shard_ring, shard_state
 
-            state = jax.tree.map(
-                lambda x: jax.device_put(
-                    x,
-                    NamedSharding(mesh, P("entity") if x.ndim >= 1 else P()),
+            state = shard_state(state, mesh)
+            zeros = lambda extra: shard_ring(
+                jax.tree.map(
+                    lambda x: jnp.zeros((extra,) + x.shape, x.dtype), state
                 ),
-                state,
-            )
-            self._ring_shard = lambda x: jax.device_put(
-                x,
-                NamedSharding(
-                    mesh, P(None, "entity") if x.ndim >= 2 else P()
-                ),
+                mesh,
             )
         else:
-            self._ring_shard = lambda x: x
-        zeros = lambda extra: jax.tree.map(
-            lambda x: self._ring_shard(
-                jnp.zeros((extra,) + x.shape, x.dtype)
-            ),
-            state,
-        )
+            zeros = lambda extra: jax.tree.map(
+                lambda x: jnp.zeros((extra,) + x.shape, x.dtype), state
+            )
         self.carry = {
             "state": state,
             "ring": zeros(self.ring_len),

@@ -1,0 +1,216 @@
+"""Entity-sharded product backend: a world partitioned over the mesh's
+`entity` axis must run inside real sessions (SyncTest AND P2P) with
+bit-parity vs the unsharded backend — state, checksums, and the desync
+detector all agree. This is the multi-chip request path (the rollback seam
+src/sessions/p2p_session.rs:621-673 executed over a device mesh,
+BASELINE.json configs[4])."""
+
+import random
+
+import numpy as np
+import pytest
+
+from ggrs_tpu import (
+    DesyncDetected,
+    DesyncDetection,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+)
+from ggrs_tpu.models import ex_game
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.parallel.mesh import make_mesh
+from ggrs_tpu.utils.clock import FakeClock
+
+NUM_PLAYERS = 2
+ENTITIES = 128  # divisible by the 4-wide entity axis of the 8-device mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)  # (beam=2, entity=4) on the virtual CPU devices
+
+
+def make_backend(mesh=None, beam_width=0, max_prediction=8):
+    from ggrs_tpu.tpu import TpuRollbackBackend
+
+    game = ex_game.ExGame(NUM_PLAYERS, ENTITIES)
+    return TpuRollbackBackend(
+        game,
+        max_prediction=max_prediction,
+        num_players=NUM_PLAYERS,
+        beam_width=beam_width,
+        mesh=mesh,
+    )
+
+
+def drive_synctest(handler, frames, check_distance, max_prediction=8, seed=3):
+    sess = (
+        SessionBuilder(input_size=1)
+        .with_num_players(NUM_PLAYERS)
+        .with_max_prediction_window(max_prediction)
+        .with_check_distance(check_distance)
+        .start_synctest_session()
+    )
+    rng = np.random.default_rng(seed)
+    for _ in range(frames):
+        for h in range(NUM_PLAYERS):
+            sess.add_local_input(h, bytes([int(rng.integers(0, 16))]))
+        handler.handle_requests(sess.advance_frame())
+    return sess
+
+
+def assert_state_equal(a, b):
+    for key in ("frame", "pos", "vel", "rot"):
+        np.testing.assert_array_equal(np.asarray(a[key]), np.asarray(b[key]))
+
+
+def test_sharded_state_placement(mesh):
+    backend = make_backend(mesh)
+    ent = mesh.shape["entity"]
+    # entity arrays actually split: each device holds N/ent rows
+    shard = backend.core.state["pos"].addressable_shards[0]
+    assert shard.data.shape[0] == ENTITIES // ent
+    ring_shard = backend.core.ring["pos"].addressable_shards[0]
+    assert ring_shard.data.shape == (
+        backend.core.ring_len + 1,
+        ENTITIES // ent,
+        2,
+    )
+
+
+@pytest.mark.parametrize("check_distance", [2, 7])
+def test_sharded_backend_bit_parity(mesh, check_distance):
+    """Same request stream through the sharded and unsharded backends:
+    final state and every saved checksum must be bitwise identical."""
+    sharded = make_backend(mesh)
+    plain = make_backend(None)
+    drive_synctest(sharded, 50, check_distance)
+    drive_synctest(plain, 50, check_distance)
+    assert_state_equal(sharded.state_numpy(), plain.state_numpy())
+
+
+def test_sharded_backend_with_beam(mesh):
+    """Beam speculation over the sharded core: candidate futures shard the
+    `beam` axis, adoption still bit-matches the plain resim path."""
+    def drive_constant(handler, frames):
+        sess = (
+            SessionBuilder(input_size=1)
+            .with_num_players(NUM_PLAYERS)
+            .with_max_prediction_window(8)
+            .with_check_distance(3)
+            .start_synctest_session()
+        )
+        for _ in range(frames):
+            for h in range(NUM_PLAYERS):
+                sess.add_local_input(h, bytes([h + 1]))
+            handler.handle_requests(sess.advance_frame())
+
+    sharded = make_backend(mesh, beam_width=8)
+    plain = make_backend(None)
+    drive_constant(sharded, 40)
+    drive_constant(plain, 40)
+    assert_state_equal(sharded.state_numpy(), plain.state_numpy())
+    # a constant script makes the repeat-last member the corrected script:
+    # the sharded adopt path must actually run
+    assert sharded.beam_hits > 0
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path, mesh):
+    from ggrs_tpu.tpu import TpuRollbackBackend
+
+    backend = make_backend(mesh)
+    drive_synctest(backend, 20, check_distance=2)
+    path = str(tmp_path / "ckpt.npz")
+    backend.save(path)
+
+    game = ex_game.ExGame(NUM_PLAYERS, ENTITIES)
+    # restore sharded -> unsharded and vice versa: layout-agnostic
+    plain = TpuRollbackBackend.restore(path, game)
+    resharded = TpuRollbackBackend.restore(path, game, mesh=mesh)
+    assert_state_equal(plain.state_numpy(), backend.state_numpy())
+    assert_state_equal(resharded.state_numpy(), backend.state_numpy())
+    shard = resharded.core.state["pos"].addressable_shards[0]
+    assert shard.data.shape[0] == ENTITIES // mesh.shape["entity"]
+
+
+# ---------------------------------------------------------------------------
+# the decisive end-to-end: a sharded world inside a live P2P session
+# ---------------------------------------------------------------------------
+
+
+def build_pair(clock, net):
+    def build(my_addr, other_addr, local_handle):
+        return (
+            SessionBuilder(input_size=1)
+            .with_num_players(2)
+            .with_max_prediction_window(8)
+            .with_desync_detection_mode(DesyncDetection.on(interval=10))
+            .with_clock(clock)
+            .with_rng(random.Random(hash(my_addr) & 0xFFFF))
+            .add_player(PlayerType.local(), local_handle)
+            .add_player(PlayerType.remote(other_addr), 1 - local_handle)
+            .start_p2p_session(net.socket(my_addr))
+        )
+
+    return build("a", "b", 0), build("b", "a", 1)
+
+
+def sync_sessions(sessions, clock):
+    for _ in range(400):
+        for s in sessions:
+            s.poll_remote_clients()
+            s.events()
+        clock.advance(20)
+        if all(s.current_state() == SessionState.RUNNING for s in sessions):
+            return
+    raise AssertionError("sessions failed to synchronize")
+
+
+def test_p2p_sharded_vs_unsharded_peer(mesh):
+    """One peer runs the mesh-sharded backend, the other the single-device
+    backend, desync detection on: the framework's own detector must stay
+    silent for the whole run (checksums bit-agree across layouts), and the
+    final worlds must match."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock=clock)
+    sess_a, sess_b = build_pair(clock, net)
+    back_a = make_backend(mesh)
+    back_b = make_backend(None)
+    sync_sessions([sess_a, sess_b], clock)
+
+    rng = np.random.default_rng(7)
+    desyncs = []
+    for frame in range(60):
+        for sess, backend, handle in ((sess_a, back_a, 0), (sess_b, back_b, 1)):
+            sess.poll_remote_clients()
+            desyncs += [e for e in sess.events() if isinstance(e, DesyncDetected)]
+            sess.add_local_input(handle, bytes([int(rng.integers(0, 16))]))
+            backend.handle_requests(sess.advance_frame())
+        clock.advance(17)
+    # let in-flight inputs land, then advance twice more so each peer's
+    # pending rollbacks run and its ring slots at confirmed frames are final
+    for _ in range(10):
+        sess_a.poll_remote_clients()
+        sess_b.poll_remote_clients()
+        clock.advance(17)
+    for _ in range(2):
+        for sess, backend, handle in ((sess_a, back_a, 0), (sess_b, back_b, 1)):
+            sess.poll_remote_clients()
+            desyncs += [e for e in sess.events() if isinstance(e, DesyncDetected)]
+            sess.add_local_input(handle, b"\x00")
+            backend.handle_requests(sess.advance_frame())
+        clock.advance(17)
+
+    assert desyncs == [], f"sharded vs unsharded checksum mismatch: {desyncs[:3]}"
+    assert back_a.current_frame == back_b.current_frame == 62
+    assert sess_a.local_checksum_history and sess_b.local_checksum_history
+
+    # bitwise cross-layout check: both rings hold the identical snapshot of
+    # the last mutually-confirmed frame
+    c = min(sess_a.confirmed_frame(), sess_b.confirmed_frame())
+    assert c > 62 - back_a.core.ring_len, "confirmed frame fell out of the ring"
+    snap_a = back_a.core.fetch_ring_slot(c % back_a.core.ring_len)
+    snap_b = back_b.core.fetch_ring_slot(c % back_b.core.ring_len)
+    assert int(np.asarray(snap_a["frame"])) == c
+    assert_state_equal(snap_a, snap_b)
