@@ -42,29 +42,43 @@ DEFERRED_LAG = 60  # request-path checksum verification burst cadence
 NORTH_STAR_FRAMES_PER_SEC = 8000.0  # 8 frames / 1 ms
 
 
-def input_script(frames, start=0):
+def input_script(frames, start=0, mod=16):
     out = np.zeros((frames, PLAYERS, 1), dtype=np.uint8)
     for f in range(frames):
         for h in range(PLAYERS):
-            x = ((start + f) * (3 + h) + h) % 16
+            x = ((start + f) * (3 + h) + h) % mod
             out[f, h, 0] = x
     return out
 
 
+def _game_family(model):
+    """(GameClass, oracle module, input mod) for a bench model name."""
+    if model == "arena":
+        from ggrs_tpu.models import arena
+
+        return arena.Arena, arena, 64  # exercise rally/overdrive bits too
+    from ggrs_tpu.models import ex_game
+
+    return ex_game.ExGame, ex_game, 16
+
+
 def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
-                bench_batches=BENCH_BATCHES, backend="pallas"):
+                bench_batches=BENCH_BATCHES, backend="pallas",
+                model="ex_game"):
     """backend="pallas" runs the whole batch as one TPU kernel with carries
     resident in VMEM (~3x the XLA scan on the 4k world; bit-identical —
-    tests/test_pallas_core.py); falls back to the XLA scan when the config
-    is outside the kernel's support envelope."""
-    from ggrs_tpu.models.ex_game import ExGame
+    tests/test_pallas_core.py, tests/test_pallas_arena.py); falls back to
+    the XLA scan when the config is outside the kernel's support envelope.
+    `model` selects the game family (the pallas path is adapter-generic)."""
     from ggrs_tpu.tpu import TpuSyncTestSession
+
+    Game, _, mod = _game_family(model)
 
     def build_and_warm(b):
         # pallas failures surface lazily at first compile/dispatch, so the
         # warmup must be inside the fallback guard, not just construction
         s = TpuSyncTestSession(
-            ExGame(PLAYERS, entities),
+            Game(PLAYERS, entities),
             num_players=PLAYERS,
             check_distance=check_distance,
             flush_interval=10_000_000,  # verdict checked manually per phase
@@ -72,7 +86,7 @@ def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
         )
         f = 0
         for _ in range(WARMUP_BATCHES):
-            s.advance_frames(input_script(BATCH, f))
+            s.advance_frames(input_script(BATCH, f, mod))
             f += BATCH
         s.check()
         s.block_until_ready()
@@ -88,7 +102,7 @@ def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
 
     t0 = time.perf_counter()
     for _ in range(bench_batches):
-        sess.advance_frames(input_script(BATCH, frame))
+        sess.advance_frames(input_script(BATCH, frame, mod))
         frame += BATCH
     sess.block_until_ready()
     elapsed = time.perf_counter() - t0
@@ -188,22 +202,24 @@ def bench_host_python(ticks=40):
     return (ticks * CHECK_DISTANCE) / elapsed
 
 
-def parity_fused_vs_oracle():
+def parity_fused_vs_oracle(model="ex_game"):
     """Both fused backends (XLA scan and the pallas kernel) must match the
     numpy oracle bit for bit."""
-    from ggrs_tpu.models.ex_game import ExGame, init_oracle, step_oracle
     from ggrs_tpu.tpu import TpuSyncTestSession
 
-    script = input_script(PARITY_TICKS)
-    state = init_oracle(PLAYERS, ENTITIES)
+    Game, oracle_mod, mod = _game_family(model)
+    script = input_script(PARITY_TICKS, mod=mod)
+    state = oracle_mod.init_oracle(PLAYERS, ENTITIES)
     statuses = np.zeros(PLAYERS, dtype=np.int32)
     for f in range(PARITY_TICKS):
-        state = step_oracle(state, script[f].reshape(-1), statuses, PLAYERS)
+        state = oracle_mod.step_oracle(
+            state, script[f].reshape(-1), statuses, PLAYERS
+        )
 
     for backend in ("xla", "pallas"):
         try:
             sess = TpuSyncTestSession(
-                ExGame(PLAYERS, ENTITIES),
+                Game(PLAYERS, ENTITIES),
                 num_players=PLAYERS,
                 check_distance=CHECK_DISTANCE,
                 backend=backend,
@@ -214,9 +230,9 @@ def parity_fused_vs_oracle():
             if backend == "xla":
                 raise  # the always-supported backend must work
             continue  # pallas unusable here: bench_fused fell back too
+        keys = list(Game.checksum_keys) + ["frame"]
         if not all(
-            np.array_equal(np.asarray(dev[k]), state[k])
-            for k in ("frame", "pos", "vel", "rot")
+            np.array_equal(np.asarray(dev[k]), state[k]) for k in keys
         ):
             return False
     return True
@@ -415,6 +431,12 @@ def main():
     cfg4_rate, cfg4_ms, cfg4_backend = _run_phase(
         "bench_fused(entities=13056, check_distance=16, bench_batches=20)[:3]"
     )
+    # second model family on the generic pallas path (arena: cross-entity
+    # centroid reductions + combat; adapter in ggrs_tpu/tpu/pallas_core.py)
+    arena_rate, arena_ms, arena_backend = _run_phase(
+        "bench_fused(model='arena', bench_batches=20)[:3]"
+    )
+    arena_parity = _run_phase("parity_fused_vs_oracle(model='arena')")
 
     print(
         json.dumps(
@@ -434,6 +456,10 @@ def main():
                 "cfg4_ms_per_16frame_tick": round(cfg4_ms, 4),
                 "fused_backend": fused_backend,
                 "cfg4_backend": cfg4_backend,
+                "arena_frames_per_sec": round(arena_rate, 1),
+                "arena_ms_per_8frame_tick": round(arena_ms, 4),
+                "arena_fused_backend": arena_backend,
+                "arena_parity_vs_oracle": arena_parity,
                 "parity_vs_oracle": parity,
                 "device": device,
                 "entities": ENTITIES,

@@ -17,9 +17,15 @@ on-device checksum. Reference anchors: the DeviceGame contract consumed by
 ggrs_tpu.tpu.backend (the GGRSRequest boundary, src/lib.rs:169-194), and
 the POD input contract (src/lib.rs:250-255) — one byte per player:
 
-  bits 0-3  thrust up/down/left/right (direct, no heading)
-  bit 4     rally: pull toward the own team's centroid
-  bit 5     overdrive: double thrust while energy lasts
+  byte 0, bits 0-3  thrust up/down/left/right (direct, no heading)
+  byte 0, bit 4     rally: pull toward the own team's centroid
+  byte 0, bit 5     overdrive: double thrust while energy lasts
+  byte 1 (optional, input_size=2), bits 0-3  analog throttle t in [0,15]:
+      base acceleration scales as ACCEL*(t+4)>>3 — t=4 reproduces the
+      1-byte dynamics exactly, so the wide mode is a strict extension.
+      This is the framework's input_size>1 witness (the reference's Input
+      is an arbitrary POD, src/lib.rs:250-255 — multi-byte inputs must
+      flow through queues, wire codec, prediction and the device paths).
 
 Entity i is owned by player i % num_players; the owner's input drives it.
 Entities at 0 hp stop moving but still count toward nothing (dead entities
@@ -88,16 +94,25 @@ def _init_arrays(num_entities: int) -> State:
     }
 
 
-def _step_generic(state: State, inputs, statuses, num_players: int, xp) -> State:
-    """One deterministic frame; `inputs` uint8[num_players], `statuses`
-    int32[num_players]. Shared by the jax and numpy paths via `xp`."""
+def _step_generic(
+    state: State, inputs, statuses, num_players: int, xp, input_size: int = 1
+) -> State:
+    """One deterministic frame; `inputs` uint8[num_players * input_size],
+    `statuses` int32[num_players]. Shared by the jax and numpy paths via
+    `xp`."""
     n = state["pos"].shape[0]
     owner = xp.arange(n, dtype=xp.int32) % num_players
 
-    inp = inputs.astype(xp.int32)[owner]
+    inp_bytes = inputs.astype(xp.int32).reshape(num_players, input_size)
+    inp = inp_bytes[:, 0][owner]
     status = statuses.astype(xp.int32)[owner]
     # disconnected players' entities coast
     inp = xp.where(status == int(InputStatus.DISCONNECTED), 0, inp)
+    if input_size >= 2:
+        throttle = inp_bytes[:, 1][owner] & 0x0F
+        throttle = xp.where(status == int(InputStatus.DISCONNECTED), 4, throttle)
+    else:
+        throttle = xp.int32(4)  # ACCEL*(4+4)>>3 == ACCEL: 1-byte dynamics
 
     pos, vel = state["pos"], state["vel"]
     hp, energy = state["hp"], state["energy"]
@@ -130,7 +145,8 @@ def _step_generic(state: State, inputs, statuses, num_players: int, xp) -> State
     ax = xp.where((inp & INPUT_RIGHT) != 0, 1, 0) - xp.where((inp & INPUT_LEFT) != 0, 1, 0)
     ay = xp.where((inp & INPUT_DOWN) != 0, 1, 0) - xp.where((inp & INPUT_UP) != 0, 1, 0)
     over = ((inp & INPUT_OVERDRIVE) != 0) & (energy > 0)
-    accel = xp.where(over, 2 * ACCEL, ACCEL)
+    accel_base = (ACCEL * (throttle + 4)) >> 3
+    accel = xp.where(over, 2 * accel_base, accel_base)
     energy = xp.where(
         over, energy - ENERGY_DRAIN, xp.minimum(energy + ENERGY_REGEN, ENERGY_MAX)
     )
@@ -192,14 +208,21 @@ def _checksum_generic(state: State, xp):
 
 
 class Arena:
-    """Device game (DeviceGame interface, like ex_game.ExGame)."""
+    """Device game (DeviceGame interface, like ex_game.ExGame).
+
+    `input_size=2` enables the analog-throttle byte (see module docstring);
+    `input_size` becomes an instance attribute shadowing the class default."""
 
     input_size = INPUT_SIZE
     checksum_keys = CHECKSUM_KEYS
 
-    def __init__(self, num_players: int = 2, num_entities: int = 4096):
+    def __init__(
+        self, num_players: int = 2, num_entities: int = 4096, input_size: int = 1
+    ):
+        assert input_size in (1, 2)
         self.num_players = num_players
         self.num_entities = num_entities
+        self.input_size = input_size
 
     def init_state(self) -> State:
         import jax
@@ -209,7 +232,10 @@ class Arena:
     def step(self, state: State, inputs, statuses) -> State:
         import jax.numpy as jnp
 
-        return _step_generic(state, inputs.reshape(-1), statuses, self.num_players, jnp)
+        return _step_generic(
+            state, inputs.reshape(-1), statuses, self.num_players, jnp,
+            self.input_size,
+        )
 
     def checksum(self, state: State):
         import jax.numpy as jnp
@@ -221,9 +247,17 @@ def init_oracle(num_players: int = 2, num_entities: int = 4096) -> State:
     return _init_arrays(num_entities)
 
 
-def step_oracle(state: State, inputs: np.ndarray, statuses: np.ndarray, num_players: int) -> State:
+def step_oracle(
+    state: State,
+    inputs: np.ndarray,
+    statuses: np.ndarray,
+    num_players: int,
+    input_size: int = 1,
+) -> State:
     with np.errstate(over="ignore"):
-        return _step_generic(state, inputs.reshape(-1), statuses, num_players, np)
+        return _step_generic(
+            state, inputs.reshape(-1), statuses, num_players, np, input_size
+        )
 
 
 def checksum_oracle(state: State) -> tuple[int, int]:

@@ -1,41 +1,49 @@
-"""Pallas TPU kernel for the fused SyncTest hot loop.
+"""Pallas TPU kernel for the fused SyncTest hot loop — model-generic.
 
 The XLA scan in TpuSyncTestSession spends most of each tick on per-op
-overhead: the world state is only ~80KB, so the ~60 small int ops per step
-plus ring/history bookkeeping cost far more than the math. This kernel runs
-the ENTIRE batch — T ticks, each with its forced `check_distance`-frame
+overhead: the world state is small, so the ~60 small int ops per step plus
+ring/history bookkeeping cost far more than the math. This kernel runs the
+ENTIRE batch — T ticks, each with its forced `check_distance`-frame
 rollback, resimulation, snapshot-ring writes, on-device checksums and
 first-seen history comparison — as ONE pallas_call with every carry buffer
 resident in VMEM/SMEM, written in place via input/output aliasing.
 
 Semantics are bit-identical to TpuSyncTestSession._tick (tests enforce
 carry-level parity): same masked rollback, same first-seen checksum history,
-same mismatch latch, and the same step math (ggrs_tpu/models/ex_game
-_step_generic with all-CONFIRMED statuses — the only configuration the
-fused SyncTest uses).
+same mismatch latch, and the same step math as the model's `_step_generic`
+with all-CONFIRMED statuses (the only configuration the fused SyncTest
+uses). Reference semantics anchor: src/sessions/sync_test_session.rs:85-146.
 
-Layout: entity arrays are packed to (N/128, 128) int32 tiles (px, py, vx,
-vy, rot), the snapshot ring to (ring_len, N/128, 128); inputs, the input
-ring, the checksum history and frame/mismatch scalars live in SMEM.
-Unsigned checksum math is done in int32 (two's-complement wraparound is
-bit-identical) and bitcast back to uint32 at the boundary.
+The kernel scaffolding (ring, history, checksum, tick loop) is MODEL-
+GENERIC; per-model code is confined to a small `PlaneAdapter` that (a)
+declares how the model's state pytree packs into (N/128, 128) int32 planes
+and (b) re-states the model's step on those planes. The checksum needs no
+per-model code at all: its word weights are derived from the model's
+`checksum_keys` declaration, reproducing `_checksum_generic` bit-for-bit.
+Adapters ship for both model families (ex_game, arena — including arena's
+2-byte analog-throttle inputs); third-party models register via
+`register_adapter`.
 
-Supported configuration: input_size == 1, N % 128 == 0, unsharded. The XLA
+Layout: entity arrays are packed to (N/128, 128) int32 tiles, the snapshot
+ring to (ring_len, N/128, 128); inputs, the input ring, the checksum
+history and frame/mismatch scalars live in SMEM. Unsigned checksum math is
+done in int32 (two's-complement wraparound is bit-identical) and bitcast
+back to uint32 at the boundary.
+
+Supported configuration: N % 128 == 0, unsharded, any input_size. The XLA
 path remains the fallback (and the sharded/multi-chip implementation).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models import ex_game
 from ..ops import fixed_point as fx
-from ..types import InputStatus
 
 GOLDEN = np.int32(np.uint32(fx.GOLDEN32).view(np.int32))
 
@@ -62,6 +70,26 @@ def _exact_floor_div(a, b):
     return q
 
 
+def _exact_floor_div_wide(a, b):
+    """floor(a / b) for int32 a (|a| < 2^30), b in [1, 2^16).
+
+    Wider-range variant for reductions (e.g. centroid sums): the float32
+    estimate can be off by ~|a|/2^23 >> 1, so ±1 fixups alone can't close
+    it. Two residual re-estimates shrink the error multiplicatively to
+    <= 1, then ±1 fixups make it the exact floor. All intermediates stay
+    within int32 (|q*b| ~ |a| and the residual is <= b * error)."""
+    q = jnp.floor(a.astype(jnp.float32) / b.astype(jnp.float32)).astype(jnp.int32)
+    for _ in range(2):
+        r = a - q * b
+        q = q + jnp.floor(
+            r.astype(jnp.float32) / b.astype(jnp.float32)
+        ).astype(jnp.int32)
+    for _ in range(2):
+        r = a - q * b
+        q = q + (r >= b).astype(jnp.int32) - (r < 0).astype(jnp.int32)
+    return q
+
+
 def _isqrt24(n):
     """fx.isqrt24 verbatim (12 unrolled digit iterations), jnp ops."""
     x = n
@@ -76,67 +104,267 @@ def _isqrt24(n):
     return c
 
 
-def _step_packed(px, py, vx, vy, rot, owner, inp_scalars, num_players):
-    """ex_game._step_generic on packed (rows,128) tiles, all-CONFIRMED.
+def _select_by_owner(owner, values):
+    """Per-entity select of a per-player value without a gather (dynamic
+    gathers don't vectorize on the VPU): values is a length-P list of
+    scalars/planes; returns where(owner==p, values[p])."""
+    out = jnp.zeros_like(owner)
+    for p, v in enumerate(values):
+        out = jnp.where(owner == p, v, out)
+    return out
 
-    inp_scalars: length-num_players list of scalar int32 input bytes.
+
+class KernelCtx:
+    """Loop-invariant planes + TPU-safe integer helpers handed to a
+    PlaneAdapter's step: `gi` is the global entity index plane, `owner`
+    the owning-player plane (gi % num_players)."""
+
+    def __init__(self, gi, owner):
+        self.gi = gi
+        self.owner = owner
+        self.floor_div = _exact_floor_div
+        self.floor_div_wide = _exact_floor_div_wide
+        self.isqrt24 = _isqrt24
+        self.select_by_owner = _select_by_owner
+
+
+class PlaneAdapter:
+    """Maps a DeviceGame onto packed planes for the pallas kernel.
+
+    Subclasses declare:
+      planes: ordered tuple of (plane_name, state_key, component) —
+        component is None for [N] state arrays, an int for [N, w] arrays.
+        Plane order MUST follow the game's `checksum_keys` concatenation
+        order (key by key, components 0..w-1) so the generically derived
+        checksum weights reproduce the model's `_checksum_generic`
+        word-for-word; __init__ validates this.
+      step(planes, inputs, ctx) -> planes: the model's `_step_generic`
+        re-stated on (rows, 128) int32 planes, all-CONFIRMED statuses.
+        `inputs` is a [num_players][input_size] nested list of scalar int32
+        bytes; `ctx` is a KernelCtx. The state's `frame` scalar is managed
+        by the scaffolding (tick-frame invariant), not the adapter.
     """
-    inp = jnp.zeros_like(px)
-    for p in range(num_players):
-        inp = jnp.where(owner == p, inp_scalars[p], inp)
 
-    up = (inp & ex_game.INPUT_UP) != 0
-    down = (inp & ex_game.INPUT_DOWN) != 0
-    left = (inp & ex_game.INPUT_LEFT) != 0
-    right = (inp & ex_game.INPUT_RIGHT) != 0
+    planes: Tuple[Tuple[str, str, Optional[int]], ...]
 
-    vx = (vx * ex_game.FRICTION_NUM) >> 8
-    vy = (vy * ex_game.FRICTION_NUM) >> 8
+    def __init__(self, game):
+        self.game = game
+        keys_in_order = []
+        for _, key, _ in self.planes:
+            if key not in keys_in_order:
+                keys_in_order.append(key)
+        assert tuple(keys_in_order) == tuple(game.checksum_keys), (
+            f"plane order {keys_in_order} must follow checksum_keys "
+            f"{game.checksum_keys}"
+        )
 
-    thrust = jnp.where(up & ~down, 1, 0) + jnp.where(down & ~up, -1, 0)
-    cos_t = fx.cos16(rot, jnp)
-    sin_t = fx.sin16(rot, jnp)
-    dvx = (ex_game.MOVE_SPEED * cos_t) >> fx.TRIG_SCALE_BITS
-    dvy = (ex_game.MOVE_SPEED * sin_t) >> fx.TRIG_SCALE_BITS
-    vx = vx + thrust * dvx
-    vy = vy + thrust * dvy
+    def step(self, planes: Dict[str, Any], inputs: List[List[Any]],
+             ctx: KernelCtx) -> Dict[str, Any]:
+        raise NotImplementedError
 
-    turn = jnp.where(left & ~right, -ex_game.ROT_SPEED, 0) + jnp.where(
-        right & ~left, ex_game.ROT_SPEED, 0
+
+# ---------------------------------------------------------------------------
+# Model adapters
+# ---------------------------------------------------------------------------
+
+
+class ExGamePlanes(PlaneAdapter):
+    """ggrs_tpu.models.ex_game._step_generic on packed planes."""
+
+    planes = (
+        ("px", "pos", 0), ("py", "pos", 1),
+        ("vx", "vel", 0), ("vy", "vel", 1),
+        ("rot", "rot", None),
     )
-    rot = (rot + turn) & (fx.ANGLE_MOD - 1)
 
-    m2 = vx * vx + vy * vy
-    mag = _isqrt24(m2)
-    over = m2 > ex_game.MAX_SPEED * ex_game.MAX_SPEED
-    safe = jnp.where(mag == 0, 1, mag)
-    vx = jnp.where(over, _exact_floor_div(vx * ex_game.MAX_SPEED, safe), vx)
-    vy = jnp.where(over, _exact_floor_div(vy * ex_game.MAX_SPEED, safe), vy)
+    def step(self, pl, inputs, ctx):
+        from ..models import ex_game
 
-    px = jnp.clip(px + vx, 0, ex_game.MAX_X)
-    py = jnp.clip(py + vy, 0, ex_game.MAX_Y)
-    return px, py, vx, vy, rot
+        px, py = pl["px"], pl["py"]
+        vx, vy, rot = pl["vx"], pl["vy"], pl["rot"]
+        inp = ctx.select_by_owner(ctx.owner, [b[0] for b in inputs])
+
+        up = (inp & ex_game.INPUT_UP) != 0
+        down = (inp & ex_game.INPUT_DOWN) != 0
+        left = (inp & ex_game.INPUT_LEFT) != 0
+        right = (inp & ex_game.INPUT_RIGHT) != 0
+
+        vx = (vx * ex_game.FRICTION_NUM) >> 8
+        vy = (vy * ex_game.FRICTION_NUM) >> 8
+
+        thrust = jnp.where(up & ~down, 1, 0) + jnp.where(down & ~up, -1, 0)
+        cos_t = fx.cos16(rot, jnp)
+        sin_t = fx.sin16(rot, jnp)
+        dvx = (ex_game.MOVE_SPEED * cos_t) >> fx.TRIG_SCALE_BITS
+        dvy = (ex_game.MOVE_SPEED * sin_t) >> fx.TRIG_SCALE_BITS
+        vx = vx + thrust * dvx
+        vy = vy + thrust * dvy
+
+        turn = jnp.where(left & ~right, -ex_game.ROT_SPEED, 0) + jnp.where(
+            right & ~left, ex_game.ROT_SPEED, 0
+        )
+        rot = (rot + turn) & (fx.ANGLE_MOD - 1)
+
+        m2 = vx * vx + vy * vy
+        mag = ctx.isqrt24(m2)
+        over = m2 > ex_game.MAX_SPEED * ex_game.MAX_SPEED
+        safe = jnp.where(mag == 0, 1, mag)
+        vx = jnp.where(over, ctx.floor_div(vx * ex_game.MAX_SPEED, safe), vx)
+        vy = jnp.where(over, ctx.floor_div(vy * ex_game.MAX_SPEED, safe), vy)
+
+        px = jnp.clip(px + vx, 0, ex_game.MAX_X)
+        py = jnp.clip(py + vy, 0, ex_game.MAX_Y)
+        return {"px": px, "py": py, "vx": vx, "vy": vy, "rot": rot}
 
 
-def _checksum_packed(px, py, vx, vy, rot, gi, frame, n_entities):
-    """_checksum_generic bit-for-bit on the packed layout (int32 wraparound
-    == uint32): word order is pos interleaved, vel interleaved, rot, frame;
-    `frame` is the state's frame field (the word at index 5N)."""
-    g = GOLDEN
-    n = np.int32(n_entities)
-    hi = (
-        jnp.sum(px * ((2 * gi + 1) * g))
-        + jnp.sum(py * ((2 * gi + 2) * g))
-        + jnp.sum(vx * ((2 * n + 2 * gi + 1) * g))
-        + jnp.sum(vy * ((2 * n + 2 * gi + 2) * g))
-        + jnp.sum(rot * ((4 * n + gi + 1) * g))
-        + frame * _wrap_i32((5 * int(n) + 1) * int(g))
+class ArenaPlanes(PlaneAdapter):
+    """ggrs_tpu.models.arena._step_generic on packed planes, including the
+    cross-entity per-team centroid reductions (full-plane sums -> SMEM
+    scalars -> broadcast back, the in-kernel form of the collective) and
+    the optional 2-byte analog-throttle inputs."""
+
+    planes = (
+        ("px", "pos", 0), ("py", "pos", 1),
+        ("vx", "vel", 0), ("vy", "vel", 1),
+        ("hp", "hp", None), ("energy", "energy", None),
     )
-    lo = (
-        jnp.sum(px) + jnp.sum(py) + jnp.sum(vx) + jnp.sum(vy) + jnp.sum(rot)
-        + frame
+
+    def step(self, pl, inputs, ctx):
+        from ..models import arena
+
+        game = self.game
+        P = game.num_players
+        px, py = pl["px"], pl["py"]
+        vx, vy = pl["vx"], pl["vy"]
+        hp, energy = pl["hp"], pl["energy"]
+        owner = ctx.owner
+
+        inp = ctx.select_by_owner(owner, [b[0] for b in inputs])
+        if game.input_size >= 2:
+            throttle = ctx.select_by_owner(owner, [b[1] for b in inputs]) & 0x0F
+        else:
+            throttle = jnp.int32(4)
+
+        alive = hp > 0
+
+        # per-team centroids of living entities (matches _step_generic's
+        # masked int32 sums; scalar division via the wide exact floor div —
+        # sums stay under 2^28 by the model's overflow budget)
+        cents, counts = [], []
+        for t in range(P):
+            mask = (owner == t) & alive
+            count = jnp.sum(mask.astype(jnp.int32))
+            sx = jnp.sum(jnp.where(mask, px >> arena.CENTROID_SHIFT, 0))
+            sy = jnp.sum(jnp.where(mask, py >> arena.CENTROID_SHIFT, 0))
+            safe_count = jnp.maximum(count, 1)
+            cents.append(
+                (
+                    ctx.floor_div_wide(sx, safe_count) << arena.CENTROID_SHIFT,
+                    ctx.floor_div_wide(sy, safe_count) << arena.CENTROID_SHIFT,
+                )
+            )
+            counts.append(count)
+
+        own_cx = ctx.select_by_owner(owner, [c[0] for c in cents])
+        own_cy = ctx.select_by_owner(owner, [c[1] for c in cents])
+        enemy_cx = ctx.select_by_owner(owner, [cents[(t + 1) % P][0] for t in range(P)])
+        enemy_cy = ctx.select_by_owner(owner, [cents[(t + 1) % P][1] for t in range(P)])
+        enemy_exists = (
+            ctx.select_by_owner(owner, [counts[(t + 1) % P] for t in range(P)]) > 0
+        )
+
+        # thrust + overdrive + energy (order matches _step_generic exactly)
+        ax = jnp.where((inp & arena.INPUT_RIGHT) != 0, 1, 0) - jnp.where(
+            (inp & arena.INPUT_LEFT) != 0, 1, 0
+        )
+        ay = jnp.where((inp & arena.INPUT_DOWN) != 0, 1, 0) - jnp.where(
+            (inp & arena.INPUT_UP) != 0, 1, 0
+        )
+        over = ((inp & arena.INPUT_OVERDRIVE) != 0) & (energy > 0)
+        accel_base = (arena.ACCEL * (throttle + 4)) >> 3
+        accel = jnp.where(over, 2 * accel_base, accel_base)
+        energy = jnp.where(
+            over,
+            energy - arena.ENERGY_DRAIN,
+            jnp.minimum(energy + arena.ENERGY_REGEN, arena.ENERGY_MAX),
+        )
+        energy = jnp.maximum(energy, 0)
+        vx = vx + ax * accel
+        vy = vy + ay * accel
+
+        # rally pull toward the own centroid
+        rally = ((inp & arena.INPUT_RALLY) != 0).astype(jnp.int32)
+        pull_x = jnp.clip(
+            (own_cx - px) >> arena.RALLY_SHIFT, -arena.RALLY_MAX, arena.RALLY_MAX
+        )
+        pull_y = jnp.clip(
+            (own_cy - py) >> arena.RALLY_SHIFT, -arena.RALLY_MAX, arena.RALLY_MAX
+        )
+        vx = vx + rally * pull_x
+        vy = vy + rally * pull_y
+
+        # friction + speed clamp
+        vx = (vx * arena.FRICTION_NUM) >> 8
+        vy = (vy * arena.FRICTION_NUM) >> 8
+        m2 = vx * vx + vy * vy
+        mag = ctx.isqrt24(m2)
+        too_fast = m2 > arena.MAX_SPEED * arena.MAX_SPEED
+        safe = jnp.where(mag == 0, 1, mag)
+        vx = jnp.where(too_fast, ctx.floor_div(vx * arena.MAX_SPEED, safe), vx)
+        vy = jnp.where(too_fast, ctx.floor_div(vy * arena.MAX_SPEED, safe), vy)
+
+        # dead entities stop; integrate on the torus
+        alive_i = alive.astype(jnp.int32)
+        vx = vx * alive_i
+        vy = vy * alive_i
+        px = (px + vx) & arena.ARENA_MASK
+        py = (py + vy) & arena.ARENA_MASK
+
+        # combat around the (pre-move) enemy centroid, toroidal Manhattan
+        half = 1 << (arena.ARENA_BITS - 1)
+        dx = ((px - enemy_cx + half) & arena.ARENA_MASK) - half
+        dy = ((py - enemy_cy + half) & arena.ARENA_MASK) - half
+        dist = jnp.abs(dx) + jnp.abs(dy)
+        hit = alive & enemy_exists & (dist < arena.COMBAT_RANGE)
+        hp = jnp.maximum(hp - hit.astype(jnp.int32) * arena.DAMAGE, 0)
+
+        return {"px": px, "py": py, "vx": vx, "vy": vy, "hp": hp,
+                "energy": energy}
+
+
+_ADAPTERS: Dict[type, Callable] = {}
+
+
+def _builtin_adapters() -> Dict[type, Callable]:
+    from ..models.arena import Arena
+    from ..models.ex_game import ExGame
+
+    return {ExGame: ExGamePlanes, Arena: ArenaPlanes}
+
+
+def register_adapter(game_cls: type, adapter_cls) -> None:
+    """Register a PlaneAdapter for a third-party DeviceGame class. Keyed by
+    class identity (not name) and resolved through the MRO, so subclasses
+    inherit their base's adapter and an unrelated same-named class can
+    never silently pick up the wrong dynamics."""
+    _ADAPTERS[game_cls] = adapter_cls
+
+
+def get_adapter(game) -> PlaneAdapter:
+    if not _ADAPTERS:
+        _ADAPTERS.update(_builtin_adapters())
+    for cls in type(game).__mro__:
+        if cls in _ADAPTERS:
+            return _ADAPTERS[cls](game)
+    raise KeyError(
+        f"no pallas PlaneAdapter registered for {type(game).__name__}; use "
+        "the XLA backend or register_adapter()"
     )
-    return hi, lo
+
+
+# ---------------------------------------------------------------------------
+# Generic core
+# ---------------------------------------------------------------------------
 
 
 class PallasSyncTestCore:
@@ -144,73 +372,104 @@ class PallasSyncTestCore:
 
     def __init__(self, game, num_players: int, check_distance: int,
                  interpret: bool = False):
-        assert game.input_size == 1, "pallas core supports 1-byte inputs"
         assert game.num_entities % 128 == 0, "entity count must be 128-aligned"
         self.game = game
+        self.adapter = get_adapter(game)
         self.num_players = num_players
+        self.input_size = game.input_size
         self.d = check_distance
         self.ring_len = check_distance + 2
         self.hist_len = check_distance + 2
         self.n_rows = game.num_entities // 128
         self.interpret = interpret
         self._batch = functools.lru_cache(maxsize=4)(self._build)
+        # generically derived checksum weights: for checksum key k of
+        # per-entity width w at word offset off_k, plane (k, j) element gi
+        # sits at global word index off_k + gi*w + j (the concatenation
+        # order _checksum_generic flattens), weighted (index+1)*GOLDEN
+        n = game.num_entities
+        widths: Dict[str, int] = {}
+        for _, key, _ in self.adapter.planes:
+            widths[key] = widths.get(key, 0) + 1
+        offs: Dict[str, int] = {}
+        off = 0
+        for key in game.checksum_keys:
+            offs[key] = off
+            off += n * widths[key]
+        self._cs_entries = []  # (plane_name, w, wrapped off+j+1)
+        for name, key, comp in self.adapter.planes:
+            j = comp or 0
+            self._cs_entries.append(
+                (name, np.int32(widths[key]), _wrap_i32(offs[key] + j + 1))
+            )
+        self._cs_frame_weight = _wrap_i32((off + 1) * int(GOLDEN))
 
     # -- carry packing ---------------------------------------------------
 
     def pack(self, carry: Dict[str, Any]):
         rows = self.n_rows
 
-        def comp(a, i):  # [..., N, 2] -> [..., rows, 128] per component
-            return a[..., i].reshape(a.shape[:-2] + (rows, 128))
+        def comp(a, c):  # state leaf -> [..., rows, 128] plane
+            plane = a if c is None else a[..., c]
+            return plane.reshape(plane.shape[: plane.ndim - 1] + (rows, 128))
 
         s, r = carry["state"], carry["ring"]
-        return {
-            "px": comp(s["pos"], 0), "py": comp(s["pos"], 1),
-            "vx": comp(s["vel"], 0), "vy": comp(s["vel"], 1),
-            "rot": s["rot"].reshape(rows, 128),
-            "r_px": comp(r["pos"], 0), "r_py": comp(r["pos"], 1),
-            "r_vx": comp(r["vel"], 0), "r_vy": comp(r["vel"], 1),
-            "r_rot": r["rot"].reshape(-1, rows, 128),
-            "r_frame": r["frame"].astype(jnp.int32),
-            "iring": carry["input_ring"][:, :, 0].astype(jnp.int32),
-            "h_tag": carry["h_tag"],
-            "h_hi": jax.lax.bitcast_convert_type(carry["h_hi"], jnp.int32),
-            "h_lo": jax.lax.bitcast_convert_type(carry["h_lo"], jnp.int32),
-            "meta": jnp.stack(
-                [
-                    carry["frame"],
-                    carry["mismatch"].astype(jnp.int32),
-                    carry["mismatch_frame"],
-                    jnp.int32(0),
-                ]
-            ),
-        }
+        packed = {}
+        for name, key, c in self.adapter.planes:
+            packed[name] = comp(s[key], c)
+            packed["r_" + name] = comp(r[key], c)
+        packed.update(
+            {
+                "r_frame": r["frame"].astype(jnp.int32),
+                "iring": carry["input_ring"]
+                .reshape(self.d + 2, self.num_players * self.input_size)
+                .astype(jnp.int32),
+                "h_tag": carry["h_tag"],
+                "h_hi": jax.lax.bitcast_convert_type(carry["h_hi"], jnp.int32),
+                "h_lo": jax.lax.bitcast_convert_type(carry["h_lo"], jnp.int32),
+                "meta": jnp.stack(
+                    [
+                        carry["frame"],
+                        carry["mismatch"].astype(jnp.int32),
+                        carry["mismatch_frame"],
+                        jnp.int32(0),
+                    ]
+                ),
+            }
+        )
+        return packed
 
-    def unpack(self, p, frame_scalar_state) -> Dict[str, Any]:
+    def unpack(self, p, _unused=None) -> Dict[str, Any]:
         n = self.game.num_entities
 
-        def merge(x, y):  # packed components -> [..., N, 2]
-            lead = x.shape[:-2]
-            return jnp.stack(
-                [x.reshape(lead + (n,)), y.reshape(lead + (n,))], axis=-1
-            )
+        # group planes back into state arrays, preserving declaration order
+        groups: Dict[str, List[Tuple[Optional[int], str]]] = {}
+        for name, key, c in self.adapter.planes:
+            groups.setdefault(key, []).append((c, name))
 
-        state = {
-            "frame": p["meta"][0],  # state frame == tick frame by invariant
-            "pos": merge(p["px"], p["py"]),
-            "vel": merge(p["vx"], p["vy"]),
-            "rot": p["rot"].reshape(n),
-        }
-        ring = {
-            "frame": p["r_frame"],
-            "pos": merge(p["r_px"], p["r_py"]),
-            "vel": merge(p["r_vx"], p["r_vy"]),
-            "rot": p["r_rot"].reshape(-1, n),
-        }
+        def rebuild(prefix, lead):
+            out = {}
+            for key, comps in groups.items():
+                if len(comps) == 1 and comps[0][0] is None:
+                    out[key] = p[prefix + comps[0][1]].reshape(lead + (n,))
+                else:
+                    assert [c for c, _ in comps] == list(range(len(comps)))
+                    out[key] = jnp.stack(
+                        [p[prefix + nm].reshape(lead + (n,)) for _, nm in comps],
+                        axis=-1,
+                    )
+            return out
+
+        state = rebuild("", ())
+        state["frame"] = p["meta"][0]  # state frame == tick frame invariant
+        ring = rebuild("r_", (self.ring_len,))
+        ring["frame"] = p["r_frame"]
         return {
             "state": state,
             "ring": ring,
-            "input_ring": p["iring"].astype(jnp.uint8)[:, :, None],
+            "input_ring": p["iring"]
+            .astype(jnp.uint8)
+            .reshape(self.d + 2, self.num_players, self.input_size),
             "h_tag": p["h_tag"],
             "h_hi": jax.lax.bitcast_convert_type(p["h_hi"], jnp.uint32),
             "h_lo": jax.lax.bitcast_convert_type(p["h_lo"], jnp.uint32),
@@ -221,13 +480,24 @@ class PallasSyncTestCore:
 
     # -- kernel ----------------------------------------------------------
 
+    def _checksum_planes(self, planes: Dict[str, Any], gi, frame):
+        """The model's `_checksum_generic` bit-for-bit on the packed layout
+        (int32 wraparound == uint32), weights derived in __init__."""
+        hi = frame * self._cs_frame_weight
+        lo = frame
+        for name, w, base in self._cs_entries:
+            hi = hi + jnp.sum(planes[name] * ((w * gi + base) * GOLDEN))
+            lo = lo + jnp.sum(planes[name])
+        return hi, lo
+
     def _build(self, t_ticks: int):
         from jax.experimental import pallas as pl
         from jax.experimental.pallas import tpu as pltpu
 
         d, ring_len, hist_len = self.d, self.ring_len, self.hist_len
-        rows, P = self.n_rows, self.num_players
-        n_entities = self.game.num_entities
+        rows, P, I = self.n_rows, self.num_players, self.input_size
+        adapter = self.adapter
+        plane_names = [name for name, _, _ in adapter.planes]
 
         # loop-invariant entity-index planes (numpy: _build may run under jit
         # tracing via the lru_cache miss)
@@ -242,13 +512,12 @@ class PallasSyncTestCore:
         # propagate input bytes into an SMEM output buffer (verified
         # empirically; interpret mode hides it) — so the small state flows
         # input ref -> SMEM scratch (mutated through the loop) -> output ref.
-        vmem_names = ["px", "py", "vx", "vy", "rot",
-                      "r_px", "r_py", "r_vx", "r_vy", "r_rot"]
+        vmem_names = plane_names + ["r_" + n_ for n_ in plane_names]
         smem_names = ["r_frame", "iring", "h_tag", "h_hi", "h_lo", "meta"]
         carry_names = vmem_names + smem_names
         smem_shapes = {
             "r_frame": (ring_len,),
-            "iring": (d + 2, P),
+            "iring": (d + 2, P * I),
             "h_tag": (hist_len,),
             "h_hi": (hist_len,),
             "h_lo": (hist_len,),
@@ -271,12 +540,10 @@ class PallasSyncTestCore:
                     for i in range(shape[0]):
                         for j in range(shape[1]):
                             scratch[name][i, j] = ins[name][i, j]
-            gi_v = gi_ref[:]
-            owner_v = owner_ref[:]
+            ctx = KernelCtx(gi_ref[:], owner_ref[:])
 
             def read_state():
-                return (out["px"][:], out["py"][:], out["vx"][:],
-                        out["vy"][:], out["rot"][:])
+                return {n_: out[n_][:] for n_ in plane_names}
 
             def ring_slot(name, slot):
                 return out[name][pl.ds(slot, 1)][0]
@@ -284,14 +551,13 @@ class PallasSyncTestCore:
             def save_and_check(state, frame, mask):
                 """Masked ring write + first-seen history compare, matching
                 TpuSyncTestSession._save_and_check under a tree-where."""
-                px, py, vx, vy, rot = state
-                hi, lo = _checksum_packed(px, py, vx, vy, rot, gi_v, frame,
-                                          n_entities)
+                hi, lo = self._checksum_planes(state, ctx.gi, frame)
                 slot = frame % ring_len
-                for name, val in (("r_px", px), ("r_py", py), ("r_vx", vx),
-                                  ("r_vy", vy), ("r_rot", rot)):
-                    old = ring_slot(name, slot)
-                    out[name][pl.ds(slot, 1)] = jnp.where(mask, val, old)[None]
+                for name in plane_names:
+                    old = ring_slot("r_" + name, slot)
+                    out["r_" + name][pl.ds(slot, 1)] = jnp.where(
+                        mask, state[name], old
+                    )[None]
                 old_f = out["r_frame"][slot]
                 # ring "frame" component records the state's frame field
                 out["r_frame"][slot] = jnp.where(mask, frame, old_f)
@@ -308,8 +574,10 @@ class PallasSyncTestCore:
                 out["h_hi"][h] = jnp.where(mask & ~seen, hi, ohi)
                 out["h_lo"][h] = jnp.where(mask & ~seen, lo, olo)
 
-            def step(state, inp_scalars):
-                return _step_packed(*state, owner_v, inp_scalars, P)
+            def where_state(pred, a, b):
+                return {
+                    n_: jnp.where(pred, a[n_], b[n_]) for n_ in plane_names
+                }
 
             def tick(t, _):
                 c = out["meta"][0]
@@ -318,36 +586,36 @@ class PallasSyncTestCore:
 
                 # load the rollback base snapshot (masked)
                 bslot = base % ring_len
-                loaded = tuple(
-                    ring_slot(n_, bslot)
-                    for n_ in ("r_px", "r_py", "r_vx", "r_vy", "r_rot")
-                )
-                cur = read_state()
-                state = tuple(
-                    jnp.where(do_rb, l, s) for l, s in zip(loaded, cur)
-                )
+                loaded = {
+                    n_: ring_slot("r_" + n_, bslot) for n_ in plane_names
+                }
+                state = where_state(do_rb, loaded, read_state())
 
                 for i in range(d):
                     f = base + i
                     if i > 0:
                         save_and_check(state, f, do_rb)
                     islot = f % (d + 2)
-                    inps = [out["iring"][islot, p] for p in range(P)]
-                    nxt = step(state, inps)
-                    state = tuple(
-                        jnp.where(do_rb, n_, s) for n_, s in zip(nxt, state)
-                    )
+                    inps = [
+                        [out["iring"][islot, p * I + j] for j in range(I)]
+                        for p in range(P)
+                    ]
+                    nxt = adapter.step(state, inps, ctx)
+                    state = where_state(do_rb, nxt, state)
 
                 # save current frame, record input, advance
                 save_and_check(state, c, jnp.bool_(True))
                 cslot = c % (d + 2)
-                new_inps = [inputs_ref[t, p] for p in range(P)]
+                new_inps = [
+                    [inputs_ref[t, p * I + j] for j in range(I)]
+                    for p in range(P)
+                ]
                 for p in range(P):
-                    out["iring"][cslot, p] = new_inps[p]
-                state = step(state, new_inps)
-                out["px"][:], out["py"][:] = state[0], state[1]
-                out["vx"][:], out["vy"][:] = state[2], state[3]
-                out["rot"][:] = state[4]
+                    for j in range(I):
+                        out["iring"][cslot, p * I + j] = new_inps[p][j]
+                state = adapter.step(state, new_inps, ctx)
+                for n_ in plane_names:
+                    out[n_][:] = state[n_]
                 out["meta"][0] = c + 1
                 return 0
 
@@ -405,6 +673,8 @@ class PallasSyncTestCore:
         t = inputs.shape[0]
         run = self._batch(t)
         packed = self.pack(carry)
-        inputs_i32 = inputs[:, :, 0].astype(jnp.int32)
+        inputs_i32 = inputs.reshape(
+            t, self.num_players * self.input_size
+        ).astype(jnp.int32)
         out = run(packed, inputs_i32)
         return self.unpack(out, None)

@@ -1,0 +1,139 @@
+"""Arena on the pallas fused-SyncTest kernel: full-carry bit parity with the
+XLA scan, for both 1-byte and 2-byte (analog throttle) inputs — the witness
+that the pallas path is model-generic (VERDICT round 1) and that multi-byte
+POD inputs flow through the device paths (reference Input contract,
+src/lib.rs:250-255).
+
+Runs the kernel in interpreter mode (tests execute on the CPU mesh); the
+real-TPU execution of the same kernel is exercised by bench.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.tree_util as jtu
+
+from ggrs_tpu.models.arena import Arena, checksum_oracle, init_oracle, step_oracle
+from ggrs_tpu.tpu import TpuSyncTestSession
+
+P = 2
+
+
+def drive(game, backend, script, check_distance, batches=3):
+    sess = TpuSyncTestSession(
+        game,
+        num_players=P,
+        check_distance=check_distance,
+        flush_interval=10_000,
+        backend=backend,
+    )
+    t = script.shape[0] // batches
+    for i in range(batches):
+        sess.advance_frames(script[i * t : (i + 1) * t])
+    return sess
+
+
+def assert_carry_equal(a, b):
+    la = jtu.tree_leaves_with_path(jax.device_get(a))
+    lb = jtu.tree_leaves(jax.device_get(b))
+    assert len(la) == len(lb)
+    for (path, x), y in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=jtu.keystr(path)
+        )
+
+
+@pytest.mark.parametrize("check_distance,entities", [(2, 256), (6, 512)])
+def test_arena_pallas_carry_parity_with_xla(check_distance, entities):
+    rng = np.random.default_rng(9)
+    script = rng.integers(0, 64, size=(60, P, 1), dtype=np.uint8)
+    xla = drive(Arena(P, entities), "xla", script, check_distance)
+    pls = drive(Arena(P, entities), "pallas-interpret", script, check_distance)
+    assert_carry_equal(xla.carry, pls.carry)
+    xla.check()
+    pls.check()
+
+
+def test_arena_wide_inputs_pallas_parity_and_oracle():
+    """input_size=2: pallas vs XLA carry parity AND the device state vs a
+    straight numpy-oracle replay (ties the whole wide-input path to ground
+    truth, including the throttle byte actually changing the dynamics)."""
+    rng = np.random.default_rng(10)
+    script = np.stack(
+        [
+            rng.integers(0, 64, size=(48, P), dtype=np.uint8),  # bitmask byte
+            rng.integers(0, 16, size=(48, P), dtype=np.uint8),  # throttle byte
+        ],
+        axis=-1,
+    )
+    xla = drive(Arena(P, 256, input_size=2), "xla", script, check_distance=4)
+    pls = drive(
+        Arena(P, 256, input_size=2), "pallas-interpret", script, check_distance=4
+    )
+    assert_carry_equal(xla.carry, pls.carry)
+    pls.check()
+
+    state = init_oracle(P, 256)
+    statuses = np.zeros((P,), dtype=np.int32)
+    for f in range(48):
+        state = step_oracle(state, script[f], statuses, P, input_size=2)
+    dev = jax.device_get(pls.carry["state"])
+    for key in ("frame", "pos", "vel", "hp", "energy"):
+        np.testing.assert_array_equal(np.asarray(dev[key]), state[key])
+
+    # the throttle byte is live: a different throttle script diverges
+    alt = script.copy()
+    alt[:, :, 1] = (alt[:, :, 1] + 7) % 16
+    state2 = init_oracle(P, 256)
+    for f in range(48):
+        state2 = step_oracle(state2, alt[f], statuses, P, input_size=2)
+    assert not np.array_equal(state["pos"], state2["pos"])
+
+
+def test_wide_input_one_byte_equivalence():
+    """Throttle 4 reproduces the 1-byte dynamics exactly (strict-extension
+    contract in the model docstring)."""
+    rng = np.random.default_rng(11)
+    masks = rng.integers(0, 64, size=(30, P), dtype=np.uint8)
+    statuses = np.zeros((P,), dtype=np.int32)
+    narrow = init_oracle(P, 128)
+    wide = init_oracle(P, 128)
+    for f in range(30):
+        narrow = step_oracle(narrow, masks[f], statuses, P)
+        wide_in = np.stack([masks[f], np.full((P,), 4, np.uint8)], axis=-1)
+        wide = step_oracle(wide, wide_in, statuses, P, input_size=2)
+    for key in narrow:
+        np.testing.assert_array_equal(narrow[key], wide[key])
+
+
+def test_arena_pallas_detects_injected_divergence():
+    from ggrs_tpu.errors import MismatchedChecksum
+
+    rng = np.random.default_rng(12)
+    script = rng.integers(0, 64, size=(40, P, 1), dtype=np.uint8)
+    sess = TpuSyncTestSession(
+        Arena(P, 256),
+        num_players=P,
+        check_distance=4,
+        flush_interval=10_000,
+        backend="pallas-interpret",
+    )
+    sess.advance_frames(script[:20])
+    sess.check()
+    ring = dict(sess.carry["ring"])
+    slot = (sess.current_frame - 4) % sess.ring_len
+    ring["hp"] = ring["hp"].at[slot, 0].add(1)
+    sess.carry = {**sess.carry, "ring": ring}
+    sess.advance_frames(script[20:])
+    with pytest.raises(MismatchedChecksum):
+        sess.check()
+
+
+def test_unregistered_model_rejected():
+    from ggrs_tpu.tpu.pallas_core import get_adapter
+
+    class MysteryGame:
+        pass
+
+    with pytest.raises(KeyError):
+        get_adapter(MysteryGame())
