@@ -215,6 +215,9 @@ class Arena:
 
     input_size = INPUT_SIZE
     checksum_keys = CHECKSUM_KEYS
+    # step reads statuses only to zero DISCONNECTED players' inputs (coast)
+    # — the property beam adoption needs
+    statuses_contract = "disconnect-only"
 
     def __init__(
         self, num_players: int = 2, num_entities: int = 4096, input_size: int = 1

@@ -167,10 +167,20 @@ class ExGame:
 
     input_size = INPUT_SIZE
     checksum_keys = CHECKSUM_KEYS
+    # step reads statuses only to substitute DISCONNECTED players' inputs
+    # (the dummy spin, ex_game.rs:268) — the property beam adoption needs
+    statuses_contract = "disconnect-only"
 
-    def __init__(self, num_players: int = 2, num_entities: int = 4096):
+    def __init__(
+        self, num_players: int = 2, num_entities: int = 4096, substeps: int = 1
+    ):
+        """`substeps`: physics sub-iterations per frame (frame still
+        advances by 1). Models games whose per-frame simulation is
+        compute-heavy (iterative solvers) — the regime where rollback
+        resimulation actually hurts and speculative adoption pays."""
         self.num_players = num_players
         self.num_entities = num_entities
+        self.substeps = substeps
 
     def init_state(self) -> State:
         import jax
@@ -181,7 +191,12 @@ class ExGame:
         """inputs: uint8[P, input_size] device array; statuses: int32[P]."""
         import jax.numpy as jnp
 
-        return _step_generic(state, inputs.reshape(-1), statuses, self.num_players, jnp)
+        s = state
+        for _ in range(self.substeps):
+            s = _step_generic(s, inputs.reshape(-1), statuses, self.num_players, jnp)
+        if self.substeps > 1:
+            s = {**s, "frame": state["frame"] + jnp.int32(1)}
+        return s
 
     def checksum(self, state: State):
         import jax.numpy as jnp
@@ -198,10 +213,21 @@ def init_oracle(num_players: int = 2, num_entities: int = 4096) -> State:
     return _init_arrays(num_entities)
 
 
-def step_oracle(state: State, inputs: np.ndarray, statuses: np.ndarray, num_players: int) -> State:
+def step_oracle(
+    state: State,
+    inputs: np.ndarray,
+    statuses: np.ndarray,
+    num_players: int,
+    substeps: int = 1,
+) -> State:
     """numpy mirror of ExGame.step; uint8[P] inputs, int32[P] statuses."""
     with np.errstate(over="ignore"):
-        return _step_generic(state, inputs.reshape(-1), statuses, num_players, np)
+        s = state
+        for _ in range(substeps):
+            s = _step_generic(s, inputs.reshape(-1), statuses, num_players, np)
+        if substeps > 1:
+            s = {**s, "frame": state["frame"] + np.int32(1)}
+        return s
 
 
 def checksum_oracle(state: State) -> tuple[int, int]:

@@ -214,11 +214,40 @@ class TpuRollbackBackend:
         # the precomputed trajectory is adopted — no resimulation. Correct
         # for any game whose step branches on statuses only to zero out
         # DISCONNECTED players (candidates are speculated as CONFIRMED).
+        if beam_width:
+            # the adoption-correctness contract (documented above) is now
+            # ENFORCED, not assumed: games declare it explicitly
+            contract = getattr(game, "statuses_contract", None)
+            if contract != "disconnect-only":
+                raise ValueError(
+                    "beam speculation adopts trajectories rolled out with "
+                    "all-CONFIRMED statuses, which is only correct for games "
+                    "whose step reads statuses solely to substitute "
+                    "DISCONNECTED players' inputs; declare statuses_contract "
+                    "= 'disconnect-only' on the game class to opt in "
+                    f"(got {contract!r} on {type(game).__name__})"
+                )
         self.beam_width = beam_width
         self._spec = None  # (anchor_frame, beam_inputs, device results)
         self._last_segment = None  # launch args, deferred to end of tick
         self.beam_hits = 0
         self.beam_misses = 0
+        # per-player input history feeding the branching candidate
+        # generator: last row seen and the previous DISTINCT row (the
+        # toggle partner). Rows with predicted values repeat the last
+        # confirmed input, so observed transitions are always real ones.
+        p, i = num_players, game.input_size
+        self._last_inputs = np.zeros((p, i), dtype=np.uint8)
+        self._prev_inputs = np.zeros((p, i), dtype=np.uint8)
+        # (inputs u8[P,I], statuses i32[P]) actually played per recent
+        # frame: shift-flexible adoption checks a member's pre-load rows
+        # against this history (frames before the load are confirmed-
+        # correct, so what was played is what happened)
+        self._played: dict = {}
+        # observed rollback depth (current-after-tick minus load frame);
+        # the next speculation anchors one frame deeper than the depth
+        # predicts so ±1 jitter still lands inside the member window
+        self._depth = 2
 
     # ------------------------------------------------------------------
 
@@ -237,7 +266,10 @@ class TpuRollbackBackend:
             self._run_segment(segment)
         # one speculation per tick, from the final segment's frontier — an
         # earlier segment's beam could never be matched (only the last
-        # segment defines the next tick's expected rollback anchor)
+        # segment defines the next tick's expected rollback anchor). A
+        # fresh launch every tick keeps the candidates built from the
+        # newest input history, which measures as a much higher hit rate
+        # than reusing a standing rollout across ticks.
         if self.beam_width and self._last_segment is not None:
             self._launch_speculation(*self._last_segment)
             self._last_segment = None
@@ -295,8 +327,9 @@ class TpuRollbackBackend:
 
         his = los = None
         if load is not None and self._spec is not None:
-            member = self._match_speculation(load.frame, inputs, statuses, count)
-            if member is not None:
+            matched = self._match_speculation(load.frame, inputs, statuses, count)
+            if matched is not None:
+                member, shift = matched
                 self.beam_hits += 1
                 with GLOBAL_TRACER.span("tpu/beam_adopt"):
                     his, los = core.adopt(
@@ -305,6 +338,7 @@ class TpuRollbackBackend:
                         load.frame % core.ring_len,
                         save_slots,
                         count,
+                        shift=shift,
                     )
             else:
                 self.beam_misses += 1
@@ -326,48 +360,113 @@ class TpuRollbackBackend:
             save.cell.save_lazy(save.frame, ref, _LazyChecksum(batch, idx))
 
         if self.beam_width:
-            # invalidate immediately (the ring just changed under the old
-            # spec); the one speculation per tick launches in handle_requests
-            self._spec = None
+            # the speculation survives the tick UNLESS this rollback rewrote
+            # history at or before its anchor (the anchor snapshot is then
+            # stale); divergence after the anchor is handled by the played-
+            # prefix match, since trajectories are deterministic in the
+            # anchor state + candidate rows
+            if (
+                self._spec is not None
+                and load is not None
+                and load.frame <= self._spec[0]
+            ):
+                self._spec = None
             self._last_segment = (load, start_frame, count, inputs, statuses)
+            if load is not None:
+                self._depth = count  # observed rollback depth
+            for f in range(count):
+                changed = (inputs[f] != self._last_inputs).any(axis=1)
+                if changed.any():
+                    self._prev_inputs[changed] = self._last_inputs[changed]
+                    self._last_inputs[changed] = inputs[f][changed]
+                self._played[start_frame + f] = (
+                    inputs[f].copy(),
+                    statuses[f].copy(),
+                )
+            horizon = self.current_frame - core.window - core.max_prediction
+            for key in [k for k in self._played if k < horizon]:
+                del self._played[key]
 
     # ------------------------------------------------------------------
     # speculative beam
     # ------------------------------------------------------------------
 
-    def _match_speculation(self, load_frame: Frame, inputs: np.ndarray,
-                           statuses: np.ndarray, count: int) -> Optional[int]:
-        from .beam import match_beam
+    def _match_speculation(
+        self, load_frame: Frame, inputs: np.ndarray, statuses: np.ndarray,
+        count: int,
+    ) -> Optional[Tuple[int, int]]:
+        """Returns (member, shift) of an adoptable speculation, else None.
+        shift = load_frame - anchor_frame: the member must ALSO match the
+        inputs actually played for frames anchor..load (its trajectory
+        baked them in) — rollback depth jitter then lands inside the same
+        speculated window instead of invalidating it."""
+        from .beam import match_beam_prefixed
 
         anchor_frame, beam_inputs, _ = self._spec
-        if load_frame != anchor_frame or count > beam_inputs.shape[1]:
+        shift = load_frame - anchor_frame
+        if shift < 0 or shift + count > beam_inputs.shape[1]:
             return None
         # a disconnected player's dummy inputs were not speculated
         if (statuses[:count] >= int(InputStatus.DISCONNECTED)).any():
             return None
-        return match_beam(beam_inputs, inputs[:count])
+        prefix_rows = []
+        for j in range(shift):
+            rec = self._played.get(anchor_frame + j)
+            if rec is None:
+                return None
+            pin, pst = rec
+            if (pst >= int(InputStatus.DISCONNECTED)).any():
+                return None
+            prefix_rows.append(pin)
+        prefix = (
+            np.stack(prefix_rows)
+            if prefix_rows
+            else np.zeros((0,) + inputs.shape[1:], dtype=np.uint8)
+        )
+        member = match_beam_prefixed(beam_inputs, prefix, inputs[:count])
+        return None if member is None else (member, shift)
 
     def _launch_speculation(self, load: Optional[LoadGameState],
                             start_frame: Frame, count: int,
                             inputs: np.ndarray, statuses: np.ndarray) -> None:
-        """Anchor at the frame the next rollback is expected to load: one
-        past this tick's load under a steady rollback depth, else the frame
-        just saved (current - 1). Both are in the ring by construction of
-        the dense-saving request grammar. Candidate scripts extend this
-        tick's last used inputs (the reference's repeat-last prediction is
-        member 0; the rest perturb one player each)."""
-        from .beam import repeat_last_beam
+        """Anchor one frame DEEPER than the observed rollback depth
+        predicts for the next tick, so the next load lands at shift 1 and
+        depth jitter of ±1 still falls inside the member window (the
+        shift-flexible match absorbs it). The anchor's snapshot is in the
+        ring by dense-saving construction. Candidate scripts branch between
+        each player's last and previous-distinct inputs at every plausible
+        offset (see beam.branching_beam); member 0 is the reference's
+        repeat-last prediction."""
+        from .beam import branching_beam
 
         core = self.core
         if count == 0:
             return
-        anchor = load.frame + 1 if load is not None else start_frame + count - 1
-        if anchor < 0 or anchor >= start_frame + count:
-            return
-        base = inputs[count - 1]
-        beam_inputs = repeat_last_beam(base, core.window, self.beam_width)
+        current_after = start_frame + count
+        anchor = current_after - self._depth
+        # the anchor snapshot must still be live in the ring (and a frame
+        # that actually exists)
+        anchor = max(anchor, current_after - core.max_prediction, 0)
+        anchor = min(anchor, current_after - 1)
+        # consecutive depths coalesce to one length (5,5,7,7,...) so jit
+        # compiles O(1) rollout-length variants as the depth jitters
+        rollout = min(self._depth + 3 + (self._depth & 1), core.window)
+        beam_inputs = branching_beam(
+            self._last_inputs,
+            self._prev_inputs,
+            core.window,
+            self.beam_width,
+            # branches must cover prefix + script anywhere the rollout can
+            # be matched (offset 0 first: the likeliest switch point)
+            max_offset=rollout,
+        )
+        # roll out only as deep as a rollback can reach while this
+        # speculation stands (shift ~1 + depth + reuse/growth margin): on
+        # big worlds the speculation's B*L step cost is the beam's
+        # overhead, so L tracks need, not the window
+        beam_inputs = beam_inputs[:, :rollout]
         beam_statuses = np.zeros(
-            (self.beam_width, core.window, self.num_players), dtype=np.int32
+            (self.beam_width, rollout, self.num_players), dtype=np.int32
         )
         with GLOBAL_TRACER.span("tpu/beam_speculate"):
             spec = core.speculate(anchor % core.ring_len, beam_inputs, beam_statuses)
@@ -394,12 +493,22 @@ class TpuRollbackBackend:
         state0 = jax.tree.map(jnp.copy, core.state)
         core.tick(False, 0, inputs, statuses, scratch, 0)
         if self.beam_width:
-            from .beam import repeat_last_beam
+            from .beam import branching_beam
 
-            beam_inputs = repeat_last_beam(
-                np.zeros((P, I), dtype=np.uint8), W, self.beam_width
+            # compile the rollout length the live path will actually
+            # dispatch first (the _depth-derived trim), not the full
+            # window — otherwise the first real rollback still pays a
+            # mid-session compile, the stall warmup exists to prevent
+            rollout = min(self._depth + 3 + (self._depth & 1), W)
+            beam_inputs = branching_beam(
+                np.zeros((P, I), dtype=np.uint8),
+                np.zeros((P, I), dtype=np.uint8),
+                W,
+                self.beam_width,
+            )[:, :rollout]
+            beam_statuses = np.zeros(
+                (self.beam_width, rollout, P), dtype=np.int32
             )
-            beam_statuses = np.zeros((self.beam_width, W, P), dtype=np.int32)
             spec = core.speculate(0, beam_inputs, beam_statuses)
             core.adopt(spec, 0, 0, scratch, 1)
         core.ring, core.state = ring0, state0

@@ -75,6 +75,103 @@ def repeat_last_beam(
     return beam
 
 
+def branching_beam(
+    last_inputs: np.ndarray,
+    prev_inputs: np.ndarray,
+    window: int,
+    beam_width: int,
+    max_offset: Optional[int] = None,
+) -> np.ndarray:
+    """Candidate generator for live sessions: per-frame branching scripts.
+
+    Member 0 is the reference's repeat-last prediction
+    (src/input_queue.rs:126-145) for every player. Real input rows are runs
+    of held values; a rollback means someone switched mid-window, almost
+    always between two recently-held values (press/release toggling). So
+    each further member branches between the tracked `last` and
+    previous-distinct (`prev`) rows at ONE offset, in four families per
+    offset k, likeliest first:
+
+      all-switch@k   every toggling player: last before k, prev from k
+      all-back@k     every toggling player: prev before k, last from k
+                     (the toggle landed just before the anchor, so replayed
+                     frames start on the OLD value and return to last)
+      one-switch@k   a single player switches last->prev at k, others repeat
+      one-back@k     a single player switches prev->last at k, others repeat
+
+    Offsets are covered breadth-first from 0 (the first unconfirmed frame,
+    the most likely switch point) and capped at `max_offset` (pass the
+    expected rollback depth: a branch at an offset the rollback never
+    replays can only duplicate member 0's matched prefix). Players with no
+    toggle history yet (prev == last) have no meaningful branch, so the
+    remaining members fall back to whole-window single-pattern XOR
+    perturbations (value diversity over timing diversity).
+
+    last_inputs/prev_inputs: u8[P, I]. Returns u8[B, W, P, I].
+    """
+    p, _i = last_inputs.shape
+    beam = np.tile(last_inputs, (beam_width, window, 1, 1))
+    has_hist = [
+        not np.array_equal(prev_inputs[pl], last_inputs[pl]) for pl in range(p)
+    ]
+    toggling = [pl for pl in range(p) if has_hist[pl]]
+    if max_offset is None:
+        max_offset = window
+    max_offset = min(max_offset, window)
+
+    # one candidate stream per player (offset branches for toggling
+    # players, then endless XOR patterns; pure-XOR for the rest), plus the
+    # correlated all-players stream — round-robined so no player's pool
+    # can crowd out another's
+    def player_stream(pl):
+        if has_hist[pl]:
+            for k in range(max_offset):
+                yield ("one", k, False, pl)
+                if k > 0:  # one-back@0 duplicates member 0 (all-last)
+                    yield ("one", k, True, pl)
+        pattern = 1
+        while True:
+            yield ("xor", pl, pattern)
+            pattern += 1
+
+    def all_stream():
+        for k in range(max_offset):
+            yield ("all", k, False)
+            if k > 0:
+                yield ("all", k, True)
+
+    streams = [player_stream(pl) for pl in range(p)]
+    if len(toggling) >= 2:
+        streams.insert(0, all_stream())
+
+    b = 1
+    exhausted = [False] * len(streams)
+    while b < beam_width and not all(exhausted):
+        for si, stream in enumerate(streams):
+            if b >= beam_width:
+                break
+            spec = next(stream, None)
+            if spec is None:
+                exhausted[si] = True
+                continue
+            if spec[0] == "xor":
+                _, pl, pattern = spec
+                beam[b, :, pl, 0] ^= np.uint8(pattern & 0xFF)
+            else:
+                kind, k, back = spec[0], spec[1], spec[2]
+                players = toggling if kind == "all" else [spec[3]]
+                for pl in players:
+                    before, after = (
+                        (prev_inputs[pl], last_inputs[pl])
+                        if back
+                        else (last_inputs[pl], prev_inputs[pl])
+                    )
+                    beam[b, :k, pl] = before
+                    beam[b, k:, pl] = after
+            b += 1
+    return beam
+
+
 def match_beam(
     beam_inputs: np.ndarray, actual_inputs: np.ndarray
 ) -> Optional[int]:
@@ -86,5 +183,27 @@ def match_beam(
     k = actual_inputs.shape[0]
     for b in range(beam_inputs.shape[0]):
         if np.array_equal(beam_inputs[b, :k], actual_inputs):
+            return b
+    return None
+
+
+def match_beam_prefixed(
+    beam_inputs: np.ndarray,
+    prefix_inputs: np.ndarray,
+    actual_inputs: np.ndarray,
+) -> Optional[int]:
+    """Shift-flexible match: the speculation was anchored `S` frames before
+    the rollback's load frame (S = prefix_inputs.shape[0]). A member is
+    adoptable iff its first S rows equal the inputs ACTUALLY PLAYED for the
+    frames between anchor and load (its trajectory baked them in) and its
+    next K rows equal the corrected script.
+
+    prefix_inputs: u8[S, P, I]; actual_inputs: u8[K, P, I]; S + K <= window.
+    """
+    s, k = prefix_inputs.shape[0], actual_inputs.shape[0]
+    for b in range(beam_inputs.shape[0]):
+        if np.array_equal(beam_inputs[b, :s], prefix_inputs) and np.array_equal(
+            beam_inputs[b, s : s + k], actual_inputs
+        ):
             return b
     return None

@@ -178,6 +178,11 @@ class ExGamePlanes(PlaneAdapter):
     )
 
     def step(self, pl, inputs, ctx):
+        for _ in range(getattr(self.game, "substeps", 1)):
+            pl = self._substep(pl, inputs, ctx)
+        return pl
+
+    def _substep(self, pl, inputs, ctx):
         from ..models import ex_game
 
         px, py = pl["px"], pl["py"]

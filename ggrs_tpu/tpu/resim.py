@@ -139,15 +139,37 @@ class ResimCore:
         def body(carry, xs):
             ring, state = carry
             i, inp, stat, save_slot = xs
-            # save-then-advance: slot i snapshots the pre-advance state
-            hi, lo = self.game.checksum(state)
-            ring = jax.tree.map(
-                lambda r, s: jax.lax.dynamic_update_index_in_dim(r, s, save_slot, 0),
-                ring,
+            # save-then-advance: slot i snapshots the pre-advance state.
+            # lax.cond (not a masked select) so skipped slots cost nothing:
+            # XLA executes only the taken branch, making the tick's device
+            # time proportional to the ACTUAL rollback depth and save
+            # count, not to the static window (a no-rollback tick runs one
+            # step + one checksum instead of W of each).
+            do_save = save_slot < self.ring_len
+
+            def save(args):
+                ring, state = args
+                hi, lo = self.game.checksum(state)
+                ring = jax.tree.map(
+                    lambda r, s: jax.lax.dynamic_update_index_in_dim(
+                        r, s, save_slot, 0
+                    ),
+                    ring,
+                    state,
+                )
+                return ring, hi, lo
+
+            def skip(args):
+                ring, _ = args
+                return ring, jnp.uint32(0), jnp.uint32(0)
+
+            ring, hi, lo = jax.lax.cond(do_save, save, skip, (ring, state))
+            state = jax.lax.cond(
+                i < advance_count,
+                lambda s: self.game.step(s, inp, stat),
+                lambda s: s,
                 state,
             )
-            nxt = self.game.step(state, inp, stat)
-            state = _tree_where(i < advance_count, nxt, state)
             return (ring, state), (hi, lo)
 
         (ring, state), (his, los) = jax.lax.scan(
@@ -231,14 +253,19 @@ class ResimCore:
         """Commit a beam member's trajectory as this tick's result: fill the
         requested ring slots with its per-frame states (slot i = state at
         load_frame + i, exactly what _tick_impl's resim would have saved)
-        and set the live state to the final frame. Checksums come from the
-        speculation (slot 0 = anchor's, slot i>0 = member's step i-1), so
-        no step or checksum math reruns here. Control words ride one packed
-        array for the same one-transfer reason as _tick_packed_impl."""
+        and set the live state to the final frame. `shift` offsets into the
+        trajectory: the speculation was anchored `shift` frames BEFORE the
+        rollback's load frame (member frames anchor+1..anchor+W, so frame
+        load+i is trajectory index shift+i-1) — rollback depth can jitter
+        without invalidating the whole speculation. Checksums come from the
+        speculation (the anchor's own plus one per member step), so no step
+        or checksum math reruns here. Control words ride one packed array
+        for the same one-transfer reason as _tick_packed_impl."""
         member = packed[0]
         load_slot = packed[1]
         advance_count = packed[2]
-        save_slots = packed[self._off_save : self._off_status]
+        shift = packed[3]
+        save_slots = packed[4 : 4 + self.window]
         loaded = jax.tree.map(
             lambda r: jax.lax.dynamic_index_in_dim(r, load_slot, 0, keepdims=False),
             ring,
@@ -251,43 +278,65 @@ class ResimCore:
 
         def body(ring, xs):
             i, save_slot = xs
-            prev = jax.tree.map(
-                lambda t: jax.lax.dynamic_index_in_dim(
-                    t, jnp.maximum(i - 1, 0), 0, keepdims=False
-                ),
-                mtraj,
-            )
-            s_i = _tree_where(i == 0, loaded, prev)
-            ring = jax.tree.map(
-                lambda r, s: jax.lax.dynamic_update_index_in_dim(r, s, save_slot, 0),
-                ring,
-                s_i,
+
+            def save(ring):
+                idx = shift + i - 1
+                prev = jax.tree.map(
+                    lambda t: jax.lax.dynamic_index_in_dim(
+                        t, jnp.maximum(idx, 0), 0, keepdims=False
+                    ),
+                    mtraj,
+                )
+                # idx < 0 only at (shift=0, i=0): the anchor state itself
+                s_i = _tree_where(idx < 0, loaded, prev)
+                return jax.tree.map(
+                    lambda r, s: jax.lax.dynamic_update_index_in_dim(
+                        r, s, save_slot, 0
+                    ),
+                    ring,
+                    s_i,
+                )
+
+            # scratch-slot writes skipped outright (same cond rationale as
+            # _tick_impl: device time tracks the actual save count)
+            ring = jax.lax.cond(
+                save_slot < self.ring_len, save, lambda r: r, ring
             )
             return ring, None
 
         ring, _ = jax.lax.scan(body, ring, (iota, save_slots))
         state = jax.tree.map(
             lambda t: jax.lax.dynamic_index_in_dim(
-                t, jnp.maximum(advance_count - 1, 0), 0, keepdims=False
+                t, jnp.maximum(shift + advance_count - 1, 0), 0, keepdims=False
             ),
             mtraj,
         )
         mhis = jax.lax.dynamic_index_in_dim(spec_his, member, 0, keepdims=False)
         mlos = jax.lax.dynamic_index_in_dim(spec_los, member, 0, keepdims=False)
-        his = jnp.concatenate([a_hi[None], mhis[: self.window - 1]])
-        los = jnp.concatenate([a_lo[None], mlos[: self.window - 1]])
+        # checksums of frames anchor..anchor+W, windowed at shift; zero-pad
+        # so dynamic_slice never clamps (entries past shift+count are only
+        # ever consumed by scratch-slot saves, so the padding is dead)
+        pad = jnp.zeros((self.window - 1,), dtype=a_hi.dtype)
+        full_hi = jnp.concatenate([a_hi[None], mhis, pad])
+        full_lo = jnp.concatenate([a_lo[None], mlos, pad])
+        his = jax.lax.dynamic_slice(full_hi, (shift,), (self.window,))
+        los = jax.lax.dynamic_slice(full_lo, (shift,), (self.window,))
         return ring, state, his, los
 
     def adopt(self, spec, member: int, load_slot: int, save_slots: np.ndarray,
-              advance_count: int) -> Tuple[Any, Any]:
+              advance_count: int, shift: int = 0) -> Tuple[Any, Any]:
         """Fulfill a rollback tick from a matching speculation; returns
-        (checksum_hi[W], checksum_lo[W]) like tick()."""
+        (checksum_hi[W], checksum_lo[W]) like tick(). `shift` = load_frame -
+        anchor_frame (caller guarantees shift + advance_count <= window and
+        that the member's first `shift` input rows equal the inputs actually
+        played for frames anchor..load)."""
         traj, spec_his, spec_los, a_hi, a_lo = spec
-        packed = np.empty((self._off_status,), dtype=np.int32)
+        packed = np.empty((4 + self.window,), dtype=np.int32)
         packed[0] = member
         packed[1] = load_slot
         packed[2] = advance_count
-        packed[self._off_save :] = save_slots
+        packed[3] = shift
+        packed[4:] = save_slots
         self.ring, self.state, his, los = self._adopt_fn(
             self.ring, traj, spec_his, spec_los, a_hi, a_lo, packed
         )

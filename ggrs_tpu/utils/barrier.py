@@ -1,0 +1,24 @@
+"""True execution barriers for tunneled devices.
+
+On the axon TPU tunnel, `jax.block_until_ready` returns once the dispatch
+is acknowledged — NOT when execution finishes (measured: a 1.2s-exec fused
+tick "blocks" in 0.2ms). The only reliable barrier is materializing device
+bytes on the host. Every latency/throughput measurement in bench.py goes
+through `true_barrier`; using block_until_ready there silently measures
+host dispatch cost instead of device execution.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def true_barrier(tree) -> None:
+    """Force completion of all device work feeding `tree` by fetching one
+    scalar's worth of bytes from its first array leaf (execution-ordered
+    with everything queued before it on the device stream)."""
+    leaves = [x for x in jax.tree.leaves(tree) if hasattr(x, "dtype")]
+    if not leaves:
+        return
+    first = leaves[0]
+    jax.device_get(first.ravel()[:1] if first.ndim else first)

@@ -176,3 +176,70 @@ def test_beam_perturbed_member_hits_in_p2p():
             assert np.array_equal(np.asarray(sa[k]), np.asarray(sb[k])), (
                 f"state[{k}] diverged at tick {t}"
             )
+
+
+def test_branching_beam_hits_mid_window_toggle():
+    """The press/release toggle with unknown timing: a player alternating
+    between two held values switches mid-rollback-window. The branching
+    candidate (switch to previous-distinct at that offset) must adopt where
+    repeat-last alone cannot — and stay bit-identical to resimulation."""
+    beam, plain = make_backend(beam_width=32), make_backend(beam_width=0)
+    # hold 6 frames of value A, then 6 of value B, alternating; with
+    # check_distance 4 the switch lands at every offset of the rollback
+    # window over the run (both players toggle together: the correlated
+    # all-switch/all-back families must carry this)
+    script = lambda t, h: bytes([(5 if (t // 6) % 2 == 0 else 9) + h])
+    drive_synctest_pair(beam, plain, script, ticks=40)
+    # warmup misses aside, toggles at covered offsets must adopt: require a
+    # majority of rollbacks adopted, not just one lucky hit
+    assert beam.beam_hits > beam.beam_misses, (
+        beam.beam_hits, beam.beam_misses,
+    )
+
+
+def test_branching_beam_generator_shapes():
+    from ggrs_tpu.tpu.beam import branching_beam
+
+    last = np.array([[5], [9]], dtype=np.uint8)
+    prev = np.array([[5], [2]], dtype=np.uint8)  # player 1 toggles 2<->9
+    beam = branching_beam(last, prev, window=6, beam_width=16)
+    assert beam.shape == (16, 6, 2, 1)
+    # member 0: pure repeat-last
+    assert (beam[0, :, 0, 0] == 5).all() and (beam[0, :, 1, 0] == 9).all()
+    # some member covers player 1 switching to 2 at offset 2 exactly
+    want = np.full((6,), 9, dtype=np.uint8)
+    want[2:] = 2
+    assert any(
+        np.array_equal(beam[b, :, 1, 0], want)
+        and (beam[b, :, 0, 0] == 5).all()
+        for b in range(16)
+    )
+    # player 0 has no history: whole-window XOR patterns, not offset splits
+    assert any(
+        (beam[b, :, 0, 0] == 5 ^ 1).all() and (beam[b, :, 1, 0] == 9).all()
+        for b in range(16)
+    )
+
+
+def test_beam_requires_statuses_contract():
+    """A game that hasn't declared the disconnect-only statuses contract
+    must be rejected at construction (silent wrong adoption otherwise)."""
+    import pytest
+
+    class NoContractGame(ExGame):
+        statuses_contract = None
+
+    with pytest.raises(ValueError, match="statuses_contract"):
+        TpuRollbackBackend(
+            NoContractGame(num_players=PLAYERS, num_entities=ENTITIES),
+            max_prediction=6,
+            num_players=PLAYERS,
+            beam_width=8,
+        )
+    # beam off: no contract needed (nothing is ever adopted)
+    TpuRollbackBackend(
+        NoContractGame(num_players=PLAYERS, num_entities=ENTITIES),
+        max_prediction=6,
+        num_players=PLAYERS,
+        beam_width=0,
+    )
