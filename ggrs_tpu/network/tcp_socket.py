@@ -1,0 +1,181 @@
+"""TCP-backed transport behind the unreliable-datagram seam.
+
+The reference ecosystem swaps transports behind its NonBlockingSocket trait
+(WebRTC data channels via matchbox, README.md:50-55). This is our second
+real transport witness: datagram semantics over TCP streams — the shape a
+WebRTC/relay/stream transport takes — implementing the exact socket
+protocol (`send_to`/`receive_all_messages` plus the wire-level API the
+authenticated wrapper and native endpoints compose with).
+
+Design:
+- one listening socket per peer; outgoing connections are created lazily on
+  first send and complete asynchronously (writes buffer until the stream
+  opens — "never block" is the seam's contract).
+- frames are [2-byte BE length][1-byte type][payload]; type 1 is a HELLO
+  carrying the sender's canonical listen port, sent once per outgoing
+  connection, so received messages are attributed to the peer's LISTEN
+  address (sessions route by address; the ephemeral source port of an
+  accepted stream would never match the configured remote).
+- a dead stream drops its buffered frames and the connection — exactly the
+  loss the datagram seam already tolerates; the endpoint protocol's
+  ack/resend machinery recovers.
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+from typing import Any, Dict, List, Optional, Tuple
+
+from .messages import Message, decode_all, encode_message
+
+_DATA = 0
+_HELLO = 1
+_MAX_FRAME = 65532
+
+
+class _Conn:
+    def __init__(self, sock: _socket.socket, peer: Optional[Tuple[str, int]]):
+        self.sock = sock
+        self.peer = peer  # canonical (host, listen_port); None until HELLO
+        self.outbuf = bytearray()
+        self.inbuf = bytearray()
+        self.dead = False
+
+    def queue(self, kind: int, payload: bytes) -> None:
+        n = len(payload) + 1
+        assert n <= _MAX_FRAME + 1, "frame too large for 2-byte framing"
+        self.outbuf += n.to_bytes(2, "big") + bytes([kind]) + payload
+
+    def flush(self) -> None:
+        while self.outbuf and not self.dead:
+            try:
+                sent = self.sock.send(self.outbuf)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self.dead = True
+                return
+            if sent <= 0:
+                return
+            del self.outbuf[:sent]
+
+    def read_frames(self) -> List[Tuple[int, bytes]]:
+        while not self.dead:
+            try:
+                chunk = self.sock.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self.dead = True
+                break
+            if not chunk:  # orderly close
+                self.dead = True
+                break
+            self.inbuf += chunk
+        frames = []
+        while len(self.inbuf) >= 2:
+            n = int.from_bytes(self.inbuf[:2], "big")
+            if len(self.inbuf) < 2 + n:
+                break
+            body = bytes(self.inbuf[2 : 2 + n])
+            del self.inbuf[: 2 + n]
+            if body:
+                frames.append((body[0], body[1:]))
+        return frames
+
+
+class TcpDatagramSocket:
+    """Datagram-seam socket over TCP. Addresses are (host, port) tuples
+    naming the peer's LISTEN port, like the UDP transport."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0"):
+        self._listener = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        self._listener.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(16)
+        self._listener.setblocking(False)
+        self._all: List[_Conn] = []  # every live stream (polled for reads)
+        self._conns: Dict[Tuple[str, int], _Conn] = {}  # canonical -> send route
+
+    @property
+    def local_port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    # -- outgoing ----------------------------------------------------------
+
+    def _connect(self, addr: Tuple[str, int]) -> _Conn:
+        sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        sock.setblocking(False)
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        try:
+            sock.connect(addr)
+        except (BlockingIOError, InterruptedError, OSError):
+            pass  # in progress (or refused: surfaces as a dead stream)
+        conn = _Conn(sock, tuple(addr))
+        conn.queue(_HELLO, int(self.local_port).to_bytes(2, "big"))
+        self._conns[tuple(addr)] = conn
+        self._all.append(conn)
+        return conn
+
+    def send_wire(self, wire: bytes, addr: Any) -> None:
+        addr = tuple(addr)
+        conn = self._conns.get(addr)
+        if conn is None or conn.dead:
+            conn = self._connect(addr)
+        conn.queue(_DATA, wire)
+        conn.flush()
+
+    def send_to(self, msg: Message, addr: Any) -> None:
+        self.send_wire(encode_message(msg), addr)
+
+    # -- incoming ----------------------------------------------------------
+
+    def _accept_new(self) -> None:
+        while True:
+            try:
+                sock, _src = self._listener.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            sock.setblocking(False)
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            self._all.append(_Conn(sock, None))
+
+    def receive_all_wire(self) -> List[Tuple[Any, bytes]]:
+        self._accept_new()
+        received: List[Tuple[Any, bytes]] = []
+
+        for conn in list(self._all):
+            for kind, payload in conn.read_frames():
+                if kind == _HELLO and len(payload) == 2:
+                    try:
+                        host = conn.sock.getpeername()[0]
+                    except OSError:
+                        conn.dead = True
+                        break
+                    peer = (host, int.from_bytes(payload, "big"))
+                    conn.peer = peer
+                    # the send route prefers whichever live stream
+                    # identified itself most recently; duplicates (both
+                    # sides dialing at once) are all still polled via _all
+                    if peer not in self._conns or self._conns[peer].dead:
+                        self._conns[peer] = conn
+                elif kind == _DATA and conn.peer is not None:
+                    received.append((conn.peer, payload))
+            conn.flush()  # opportunistic drain of queued writes
+
+        for conn in [c for c in self._all if c.dead]:
+            self._all.remove(conn)
+            conn.sock.close()
+        for peer in [p for p, c in self._conns.items() if c.dead]:
+            del self._conns[peer]
+        return received
+
+    def receive_all_messages(self) -> List[Tuple[Any, Message]]:
+        return decode_all(self.receive_all_wire())
+
+    def close(self) -> None:
+        self._listener.close()
+        for conn in self._all:
+            conn.sock.close()

@@ -495,22 +495,25 @@ class TpuRollbackBackend:
         if self.beam_width:
             from .beam import branching_beam
 
-            # compile the rollout length the live path will actually
-            # dispatch first (the _depth-derived trim), not the full
-            # window — otherwise the first real rollback still pays a
-            # mid-session compile, the stall warmup exists to prevent
-            rollout = min(self._depth + 3 + (self._depth & 1), W)
-            beam_inputs = branching_beam(
+            # compile EVERY rollout length the live path can dispatch
+            # (depth coalescing yields 5, 7, 9, ... up to the window) —
+            # a mid-session depth change must not pay the seconds-long
+            # speculate/adopt compile stall warmup exists to prevent
+            full_beam = branching_beam(
                 np.zeros((P, I), dtype=np.uint8),
                 np.zeros((P, I), dtype=np.uint8),
                 W,
                 self.beam_width,
-            )[:, :rollout]
-            beam_statuses = np.zeros(
-                (self.beam_width, rollout, P), dtype=np.int32
             )
-            spec = core.speculate(0, beam_inputs, beam_statuses)
-            core.adopt(spec, 0, 0, scratch, 1)
+            rollouts = sorted(
+                {min(d + 3 + (d & 1), W) for d in range(1, W + 1)}
+            )
+            for rollout in rollouts:
+                beam_statuses = np.zeros(
+                    (self.beam_width, rollout, P), dtype=np.int32
+                )
+                spec = core.speculate(0, full_beam[:, :rollout], beam_statuses)
+                core.adopt(spec, 0, 0, scratch, 1)
         core.ring, core.state = ring0, state0
         self.block_until_ready()
 

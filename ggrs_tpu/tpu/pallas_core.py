@@ -375,11 +375,28 @@ def get_adapter(game) -> PlaneAdapter:
 class PallasSyncTestCore:
     """Drop-in batch executor for TpuSyncTestSession's carry (unsharded)."""
 
+    # VMEM envelope: input+output windows for every state/ring plane plus
+    # kernel temporaries must fit the ~128MB core VMEM. Past roughly this
+    # budget Mosaic does NOT always fail loudly — at ~100MB of windows a
+    # 512k-entity world compiled but silently read one input plane as
+    # zeros (verified on v5e), so the limit is enforced here and callers
+    # fall back to the XLA scan.
+    VMEM_BUDGET_BYTES = 96 * 1024 * 1024
+
     def __init__(self, game, num_players: int, check_distance: int,
                  interpret: bool = False):
         assert game.num_entities % 128 == 0, "entity count must be 128-aligned"
         self.game = game
         self.adapter = get_adapter(game)
+        n_planes = len(self.adapter.planes)
+        plane_bytes = game.num_entities * 4
+        vmem_est = 2 * n_planes * (1 + check_distance + 2) * plane_bytes
+        if not interpret and vmem_est > self.VMEM_BUDGET_BYTES:
+            raise ValueError(
+                f"world too large for the VMEM-resident kernel: ~{vmem_est >> 20}MB "
+                f"of plane windows exceeds the validated {self.VMEM_BUDGET_BYTES >> 20}MB "
+                "budget; use the XLA backend for this configuration"
+            )
         self.num_players = num_players
         self.input_size = game.input_size
         self.d = check_distance
@@ -664,6 +681,16 @@ class PallasSyncTestCore:
                 scratch_shapes=[
                     pltpu.SMEM(smem_shapes[n], jnp.int32) for n in smem_names
                 ],
+                # default scoped-vmem budget is 16MB; large VMEM-resident
+                # worlds (the compute-bound regime, ~512k entities at
+                # check_distance 2) need most of the 128MB core VMEM
+                compiler_params=(
+                    None
+                    if self.interpret
+                    else pltpu.CompilerParams(
+                        vmem_limit_bytes=100 * 1024 * 1024
+                    )
+                ),
                 interpret=self.interpret,
             )(inputs_i32, jnp.asarray(gi), jnp.asarray(owner_np),
               *[packed[n] for n in carry_names])

@@ -85,9 +85,11 @@ class ResimCore:
         self._tick_fn = jax.jit(self._tick_packed_impl, donate_argnums=(0, 1))
         self._speculate_fn = jax.jit(self._speculate_impl)
         self._adopt_fn = jax.jit(self._adopt_impl, donate_argnums=(0,))
-        # packed control-word layout, shared by the pack sites (tick, adopt)
-        # and unpack sites (_tick_packed_impl, _adopt_impl): 3 header words,
-        # then save_slots[W], statuses[W*P], inputs[W*P*I]
+        # tick's packed control-word layout (pack site: tick(); unpack:
+        # _tick_packed_impl): 3 header words, then save_slots[W],
+        # statuses[W*P], inputs[W*P*I]. The adopt path has its OWN layout
+        # — 4 header words (member, load_slot, advance_count, shift) then
+        # save_slots[W] — see adopt()/_adopt_impl.
         p, i = num_players, game.input_size
         self._off_save = 3
         self._off_status = self._off_save + self.window

@@ -1,0 +1,126 @@
+"""Cross-stack loopback-UDP soak (VERDICT r1 item 6).
+
+The reference proves P2P liveness with two same-implementation sessions
+over real loopback UDP (tests/test_p2p_session.rs:67-95). Here the pair is
+CROSS-IMPLEMENTATION — one pure-Python stack, one full C++ native stack
+(session core + endpoints + socket) — so the soak certifies wire-format
+and protocol-semantics interop end to end on real sockets, with desync
+detection as the bit-parity referee. A second soak rides the authenticated
+transport (SipHash MAC + anti-replay) on both peers. Runs against
+whichever native build is current, including `make -C native sanitize`
+(UBSAN) — the CI recipe is: make sanitize && pytest this file && make.
+"""
+
+import time
+
+import pytest
+
+from ggrs_tpu import (
+    DesyncDetected,
+    DesyncDetection,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+)
+from ggrs_tpu.native import available
+from stubs import GameStub
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native library not built (make -C native)"
+)
+
+KEY = bytes(range(16))
+
+
+def build_pair(port_a, port_b, auth=False):
+    """Session A: pure Python stack. Session B: full native stack."""
+    from ggrs_tpu.native.sockets import NativeUdpNonBlockingSocket
+    from ggrs_tpu.network.auth import AuthenticatedSocket
+    from ggrs_tpu.network.sockets import UdpNonBlockingSocket
+
+    def base(handle, other_port):
+        return (
+            SessionBuilder(input_size=1)
+            .with_num_players(2)
+            .with_max_prediction_window(8)
+            .with_desync_detection_mode(DesyncDetection.on(interval=20))
+            .add_player(PlayerType.local(), handle)
+            .add_player(
+                PlayerType.remote(("127.0.0.1", other_port)), 1 - handle
+            )
+        )
+
+    sock_a = UdpNonBlockingSocket(port_a)
+    if auth:
+        sock_a = AuthenticatedSocket(sock_a, KEY, replay_protect=True)
+    sess_a = base(0, port_b).start_p2p_session(sock_a)
+
+    # the native session core drives the Python-visible socket seam, so the
+    # authenticated wrapper composes the same way on the native stack
+    b = base(1, port_a).with_native_sessions(True)
+    sock_b = NativeUdpNonBlockingSocket(port_b) if not auth else (
+        AuthenticatedSocket(UdpNonBlockingSocket(port_b), KEY, replay_protect=True)
+    )
+    sess_b = b.start_p2p_session(sock_b)
+    return sess_a, sess_b
+
+
+def soak(sess_a, sess_b, frames):
+    for _ in range(300):
+        sess_a.poll_remote_clients()
+        sess_b.poll_remote_clients()
+        sess_a.events()
+        sess_b.events()
+        if (
+            sess_a.current_state() == SessionState.RUNNING
+            and sess_b.current_state() == SessionState.RUNNING
+        ):
+            break
+        time.sleep(0.002)
+    assert sess_a.current_state() == SessionState.RUNNING, "handshake failed"
+    assert sess_b.current_state() == SessionState.RUNNING
+
+    ga, gb = GameStub(), GameStub()
+    desyncs = []
+    for f in range(frames):
+        sess_a.poll_remote_clients()
+        desyncs += [e for e in sess_a.events() if isinstance(e, DesyncDetected)]
+        sess_a.add_local_input(0, bytes([(f * 3 + 1) % 13]))
+        ga.handle_requests(sess_a.advance_frame())
+
+        sess_b.poll_remote_clients()
+        desyncs += [e for e in sess_b.events() if isinstance(e, DesyncDetected)]
+        sess_b.add_local_input(1, bytes([(f * 7 + 2) % 13]))
+        gb.handle_requests(sess_b.advance_frame())
+        if f % 8 == 0:
+            time.sleep(0.001)  # let the kernel's loopback queue breathe
+
+    # drain in-flight inputs and checksum reports, then one final advance
+    for _ in range(40):
+        sess_a.poll_remote_clients()
+        sess_b.poll_remote_clients()
+        desyncs += [e for e in sess_a.events() if isinstance(e, DesyncDetected)]
+        desyncs += [e for e in sess_b.events() if isinstance(e, DesyncDetected)]
+        time.sleep(0.001)
+    sess_a.add_local_input(0, b"\x00")
+    ga.handle_requests(sess_a.advance_frame())
+    sess_b.add_local_input(1, b"\x00")
+    gb.handle_requests(sess_b.advance_frame())
+
+    assert not desyncs, f"cross-stack desync: {desyncs[:3]}"
+    confirmed = min(sess_a.confirmed_frame(), sess_b.confirmed_frame())
+    assert confirmed > frames // 2, f"confirmed only {confirmed}/{frames}"
+    for f in range(1, confirmed + 1):
+        assert ga.history[f] == gb.history[f], f"replicas diverged at frame {f}"
+    return confirmed
+
+
+def test_cross_stack_udp_soak():
+    sess_a, sess_b = build_pair(7941, 7942)
+    confirmed = soak(sess_a, sess_b, frames=200)
+    assert confirmed > 150
+
+
+def test_cross_stack_udp_soak_authenticated():
+    sess_a, sess_b = build_pair(7943, 7944, auth=True)
+    soak(sess_a, sess_b, frames=120)

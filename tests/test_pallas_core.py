@@ -85,3 +85,17 @@ def test_pallas_rejects_unsupported_configs():
             check_distance=2,
             backend="pallas-interpret",
         )
+
+
+def test_pallas_rejects_vmem_overflow_configs():
+    """Worlds whose plane windows exceed the validated VMEM budget must be
+    rejected at construction (beyond it Mosaic has been observed to
+    miscompile silently), sending callers to the XLA backend."""
+    import pytest
+
+    from ggrs_tpu.tpu.pallas_core import PallasSyncTestCore
+
+    with pytest.raises(ValueError, match="VMEM-resident"):
+        PallasSyncTestCore(ExGame(P, 524288), num_players=P, check_distance=2)
+    # the validated large config constructs fine
+    PallasSyncTestCore(ExGame(P, 262144), num_players=P, check_distance=2)
