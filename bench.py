@@ -156,22 +156,34 @@ def bench_roofline():
     return out
 
 
-def bench_request_path():
+def bench_request_path(device_verify=True):
+    """Interactive path: one dispatch per tick. `device_verify=True` keeps
+    the SyncTest verdict on device (zero per-run checksum readbacks; the
+    final backend.check() is the run's one transfer and its true barrier);
+    False uses the host-side deferred-burst verification, whose per-burst
+    ~100ms readbacks are the number to compare against."""
     from ggrs_tpu import SessionBuilder
     from ggrs_tpu.models.ex_game import ExGame
     from ggrs_tpu.tpu import TpuRollbackBackend
 
     backend = TpuRollbackBackend(
-        ExGame(PLAYERS, ENTITIES), max_prediction=MAX_PREDICTION, num_players=PLAYERS
+        ExGame(PLAYERS, ENTITIES),
+        max_prediction=MAX_PREDICTION,
+        num_players=PLAYERS,
+        device_verify=device_verify,
     )
-    sess = (
+    b = (
         SessionBuilder(input_size=1)
         .with_num_players(PLAYERS)
         .with_max_prediction_window(MAX_PREDICTION)
         .with_check_distance(CHECK_DISTANCE)
-        .with_deferred_checksum_verification(DEFERRED_LAG)
-        .start_synctest_session()
     )
+    b = (
+        b.with_device_checksum_verification()
+        if device_verify
+        else b.with_deferred_checksum_verification(DEFERRED_LAG)
+    )
+    sess = b.start_synctest_session()
     # cover the first two deferred drain bursts + tunnel dispatch ramp-up
     warmup = 2 * DEFERRED_LAG + 50
     script = input_script(REQUEST_PATH_TICKS + warmup)
@@ -190,9 +202,13 @@ def bench_request_path():
         t1 = time.perf_counter()
         tick(f)
         times.append(time.perf_counter() - t1)
-    # flush resolves every pending device checksum (real device_get) — the
-    # TRUE execution barrier; the rate therefore includes device execution
-    sess.flush_checksum_checks()
+    # close with a TRUE barrier so the rate includes device execution:
+    # device mode fetches the on-device verdict (raising on divergence);
+    # host mode resolves every pending checksum via the flush's device_get
+    if device_verify:
+        backend.check()
+    else:
+        sess.flush_checksum_checks()
     elapsed = time.perf_counter() - t0
     # the median tick is HOST-SIDE dispatch latency (what a 60fps loop that
     # never blocks on device state sees per tick); device execution
@@ -681,6 +697,9 @@ def main():
     device = _run_phase("device_name()")
     rate, ms_per_tick, fused_backend = _run_phase("bench_fused()[:3]")
     request_rate, request_median_ms = _run_phase("bench_request_path()")
+    hostverify_rate, _hv_ms = _run_phase(
+        "bench_request_path(device_verify=False)"
+    )
     host_rate = _run_phase("bench_host_python()")
     beam_rate = _run_phase("bench_beam()")
     parity = _run_phase("parity_fused_vs_oracle()")
@@ -714,6 +733,7 @@ def main():
                 "ms_per_8frame_rollback_tick": round(ms_per_tick, 4),
                 "request_path_frames_per_sec": round(request_rate, 1),
                 "request_path_median_tick_ms": round(request_median_ms, 4),
+                "request_path_hostverify_frames_per_sec": round(hostverify_rate, 1),
                 "host_python_frames_per_sec": round(host_rate, 1),
                 "beam16_frames_per_sec": round(beam_rate, 1),
                 "p2p4_12frame_rollback_frames_per_sec": round(p2p4_rate, 1),

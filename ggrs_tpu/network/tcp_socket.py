@@ -41,9 +41,16 @@ class _Conn:
         self.inbuf = bytearray()
         self.dead = False
 
+    # a stalled stream must behave like a full UDP socket buffer: new
+    # datagrams are LOST, not queued without bound (unbounded queueing leaks
+    # memory and floods the peer with minutes-old packets on recovery)
+    MAX_OUTBUF = 256 * 1024
+
     def queue(self, kind: int, payload: bytes) -> None:
         n = len(payload) + 1
         assert n <= _MAX_FRAME + 1, "frame too large for 2-byte framing"
+        if len(self.outbuf) > self.MAX_OUTBUF:
+            return  # datagram loss, the seam's contract
         self.outbuf += n.to_bytes(2, "big") + bytes([kind]) + payload
 
     def flush(self) -> None:
@@ -156,11 +163,13 @@ class TcpDatagramSocket:
                         break
                     peer = (host, int.from_bytes(payload, "big"))
                     conn.peer = peer
-                    # the send route prefers whichever live stream
-                    # identified itself most recently; duplicates (both
-                    # sides dialing at once) are all still polled via _all
-                    if peer not in self._conns or self._conns[peer].dead:
-                        self._conns[peer] = conn
+                    # most-recent HELLO wins the send route: a peer that
+                    # silently restarted (no FIN/RST — its old stream looks
+                    # alive for the TCP retransmit window, ~minutes) dials
+                    # back in and must take over immediately; duplicates
+                    # (both sides dialing at once) are all still polled
+                    # via _all
+                    self._conns[peer] = conn
                 elif kind == _DATA and conn.peer is not None:
                     received.append((conn.peer, payload))
             conn.flush()  # opportunistic drain of queued writes

@@ -51,6 +51,7 @@ class SessionBuilder:
         self.use_native_endpoints = False
         self.use_native_sessions = False
         self.deferred_checksum_lag = 0
+        self.device_checksum_verification = False
 
     # ------------------------------------------------------------------
     # fluent setters (src/sessions/builder.rs:90-244)
@@ -157,6 +158,20 @@ class SessionBuilder:
         self.deferred_checksum_lag = lag
         return self
 
+    def with_device_checksum_verification(
+        self, enabled: bool = True
+    ) -> "SessionBuilder":
+        """SyncTest extension for device backends: skip the host-side
+        checksum comparison entirely and delegate the verdict to the
+        fulfilling backend (TpuRollbackBackend(device_verify=True) keeps
+        the first-seen history + mismatch latch on device; read it with
+        backend.check()). The session's forced rollbacks are unchanged —
+        this removes the LAST per-run device->host checksum traffic, which
+        on a tunneled device (~100ms per readback) dominates the
+        interactive path. Python sessions only."""
+        self.device_checksum_verification = enabled
+        return self
+
     def with_native_input_queues(self, enabled: bool = True) -> "SessionBuilder":
         """Back per-player input queues with the C++ ring (native/
         input_queue.cpp) instead of the Python oracle. Requires the native
@@ -225,6 +240,11 @@ class SessionBuilder:
         if self.check_distance >= self.max_prediction:
             raise InvalidRequest("Check distance too big.")
         if self.use_native_sessions:
+            if self.device_checksum_verification:
+                raise InvalidRequest(
+                    "Device checksum verification requires the Python "
+                    "session (the native session verifies on host)."
+                )
             from ..native.session import NativeSyncTestSession
 
             return NativeSyncTestSession(
@@ -243,6 +263,7 @@ class SessionBuilder:
             self.input_size,
             use_native_queues=self.use_native_queues,
             deferred_checksum_lag=self.deferred_checksum_lag,
+            host_verification=not self.device_checksum_verification,
         )
 
     def start_p2p_session(self, socket: Any):

@@ -71,7 +71,14 @@ class SyncTestSession:
         input_size: int,
         use_native_queues: bool = False,
         deferred_checksum_lag: int = 0,
+        host_verification: bool = True,
     ):
+        """`host_verification=False` delegates the checksum comparison
+        entirely to the fulfilling backend (TpuRollbackBackend
+        device_verify mode keeps the first-seen history + verdict on
+        device; read it with backend.check()). The session still forces
+        the per-tick rollback — only the host-side compare, and with it
+        every per-burst device->host checksum transfer, is skipped."""
         self.num_players = num_players
         self.max_prediction = max_prediction
         self.check_distance = check_distance
@@ -90,6 +97,7 @@ class SyncTestSession:
         # stalls the tick on a device->host checksum transfer. Mismatches
         # still raise MismatchedChecksum, at most `lag` ticks late.
         self.deferred_checksum_lag = deferred_checksum_lag
+        self.host_verification = host_verification
         self._pending_checks = DeferredChecks(deferred_checksum_lag)
         self._tick = 0
 
@@ -110,7 +118,9 @@ class SyncTestSession:
         # rollback of check_distance frames.
         self._tick += 1
         if self.check_distance > 0 and self.sync_layer.current_frame > self.check_distance:
-            if self.deferred_checksum_lag > 0:
+            if not self.host_verification:
+                pass  # the backend's device-side history is the referee
+            elif self.deferred_checksum_lag > 0:
                 self._schedule_checks()
                 # Drain in bursts (not every tick): one burst = one batched
                 # device->host transfer covering `lag` ticks of observations.
@@ -187,6 +197,15 @@ class SyncTestSession:
 
     def flush_checksum_checks(self) -> None:
         """Force every deferred comparison now (end of run / tests)."""
+        if not self.host_verification:
+            # a silent no-op here would make a mispaired run (device-verify
+            # session + a backend without a device history) report success
+            # having verified nothing — fail loudly instead
+            raise InvalidRequest(
+                "This session delegates verification to the backend "
+                "(with_device_checksum_verification): read the verdict with "
+                "backend.check(), not flush_checksum_checks()."
+            )
         self._pending_checks.flush(self._verify_observation)
 
     def _checksums_consistent(self, frame_to_check: Frame) -> bool:

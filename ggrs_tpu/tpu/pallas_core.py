@@ -682,8 +682,9 @@ class PallasSyncTestCore:
                     pltpu.SMEM(smem_shapes[n], jnp.int32) for n in smem_names
                 ],
                 # default scoped-vmem budget is 16MB; large VMEM-resident
-                # worlds (the compute-bound regime, ~512k entities at
-                # check_distance 2) need most of the 128MB core VMEM
+                # worlds (the compute-bound regime — up to the enforced
+                # envelope, ~262k entities at check_distance 2) need most
+                # of the 128MB core VMEM
                 compiler_params=(
                     None
                     if self.interpret

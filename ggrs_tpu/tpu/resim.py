@@ -41,7 +41,8 @@ class ResimCore:
     checksum(state) -> (u32, u32). All pure jax.
     """
 
-    def __init__(self, game, max_prediction: int, num_players: int, mesh=None):
+    def __init__(self, game, max_prediction: int, num_players: int, mesh=None,
+                 device_verify: bool = False):
         """`mesh`: optional jax Mesh with an `entity` axis — the live state
         AND the snapshot ring shard across it (BASELINE.json configs[4]), so
         a partitioned world can run inside any session that drives this
@@ -82,23 +83,53 @@ class ResimCore:
 
             ring = shard_ring(ring, mesh)
         self.ring = ring
-        self._tick_fn = jax.jit(self._tick_packed_impl, donate_argnums=(0, 1))
+        # device-resident determinism verdict (opt-in): a first-seen
+        # checksum history + mismatch latch updated INSIDE the fused tick,
+        # mirroring the fused SyncTest session's _save_and_check. With it,
+        # SyncTest-style verification needs NO per-burst host readback of
+        # checksum values — on the tunneled device every readback costs a
+        # ~100ms round trip, which dominates the whole interactive path.
+        # Only valid for confirmed-input replay (SyncTest): P2P rollbacks
+        # legitimately re-save corrected frames with different state.
+        self.device_verify = device_verify
+        if device_verify:
+            verify = {
+                "h_tag": jnp.full((self.ring_len,), -1, dtype=jnp.int32),
+                "h_hi": jnp.zeros((self.ring_len,), dtype=jnp.uint32),
+                "h_lo": jnp.zeros((self.ring_len,), dtype=jnp.uint32),
+                # [mismatch?, first mismatching frame]
+                "flag": jnp.array([0, -1], dtype=jnp.int32),
+            }
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                verify = jax.tree.map(
+                    lambda x: jax.device_put(x, NamedSharding(mesh, P())),
+                    verify,
+                )
+            self.verify = verify
+        else:
+            self.verify = {}
+        self._tick_fn = jax.jit(
+            self._tick_packed_impl, donate_argnums=(0, 1, 3)
+        )
         self._speculate_fn = jax.jit(self._speculate_impl)
-        self._adopt_fn = jax.jit(self._adopt_impl, donate_argnums=(0,))
+        self._adopt_fn = jax.jit(self._adopt_impl, donate_argnums=(0, 6))
         # tick's packed control-word layout (pack site: tick(); unpack:
-        # _tick_packed_impl): 3 header words, then save_slots[W],
-        # statuses[W*P], inputs[W*P*I]. The adopt path has its OWN layout
-        # — 4 header words (member, load_slot, advance_count, shift) then
+        # _tick_packed_impl): 4 header words (do_load, load_slot,
+        # advance_count, start_frame), then save_slots[W], statuses[W*P],
+        # inputs[W*P*I]. The adopt path has its OWN layout — 5 header
+        # words (member, load_slot, advance_count, shift, load_frame) then
         # save_slots[W] — see adopt()/_adopt_impl.
         p, i = num_players, game.input_size
-        self._off_save = 3
+        self._off_save = 4
         self._off_status = self._off_save + self.window
         self._off_input = self._off_status + self.window * p
         self._packed_len = self._off_input + self.window * p * i
 
     # ------------------------------------------------------------------
 
-    def _tick_packed_impl(self, ring, state, packed):
+    def _tick_packed_impl(self, ring, state, packed, verify):
         """Unpack the single control-word array (see tick()) and run the
         fused tick. One argument means ONE host->device transfer per tick —
         on a tunneled device every transferred buffer pays a latency floor
@@ -107,6 +138,7 @@ class ResimCore:
         do_load = packed[0] != 0
         load_slot = packed[1]
         advance_count = packed[2]
+        start_frame = packed[3]
         save_slots = packed[self._off_save : self._off_status]
         statuses = packed[self._off_status : self._off_input].reshape(W, P)
         inputs = (
@@ -116,8 +148,34 @@ class ResimCore:
         )
         return self._tick_impl(
             ring, state, do_load, load_slot, inputs, statuses, save_slots,
-            advance_count,
+            advance_count, start_frame, verify,
         )
+
+    def _verify_update(self, verify, frame, hi, lo):
+        """First-seen history record/compare + mismatch latch (the device
+        twin of the fused session's _save_and_check). Static no-op when
+        device verification is off."""
+        if not self.device_verify:
+            return verify
+        h = frame % self.ring_len
+        seen = verify["h_tag"][h] == frame
+        differs = seen & (
+            (verify["h_hi"][h] != hi) | (verify["h_lo"][h] != lo)
+        )
+        first = differs & (verify["flag"][0] == 0)
+        flag = verify["flag"]
+        flag = flag.at[0].set(jnp.where(differs, 1, flag[0]))
+        flag = flag.at[1].set(jnp.where(first, frame, flag[1]))
+        return {
+            "h_tag": verify["h_tag"].at[h].set(frame),
+            "h_hi": verify["h_hi"].at[h].set(
+                jnp.where(seen, verify["h_hi"][h], hi)
+            ),
+            "h_lo": verify["h_lo"].at[h].set(
+                jnp.where(seen, verify["h_lo"][h], lo)
+            ),
+            "flag": flag,
+        }
 
     def _tick_impl(
         self,
@@ -129,6 +187,8 @@ class ResimCore:
         statuses,  # i32[W, P]
         save_slots,  # i32[W]; scratch_slot means "no save"
         advance_count,  # i32[]
+        start_frame,  # i32[]; frame of the first window slot
+        verify,  # device-verify carry ({} when disabled)
     ):
         loaded = jax.tree.map(
             lambda r: jax.lax.dynamic_index_in_dim(r, load_slot, 0, keepdims=False),
@@ -139,7 +199,7 @@ class ResimCore:
         iota = jnp.arange(self.window, dtype=jnp.int32)
 
         def body(carry, xs):
-            ring, state = carry
+            ring, state, verify = carry
             i, inp, stat, save_slot = xs
             # save-then-advance: slot i snapshots the pre-advance state.
             # lax.cond (not a masked select) so skipped slots cost nothing:
@@ -150,7 +210,7 @@ class ResimCore:
             do_save = save_slot < self.ring_len
 
             def save(args):
-                ring, state = args
+                ring, state, verify = args
                 hi, lo = self.game.checksum(state)
                 ring = jax.tree.map(
                     lambda r, s: jax.lax.dynamic_update_index_in_dim(
@@ -159,25 +219,28 @@ class ResimCore:
                     ring,
                     state,
                 )
-                return ring, hi, lo
+                verify = self._verify_update(verify, start_frame + i, hi, lo)
+                return ring, verify, hi, lo
 
             def skip(args):
-                ring, _ = args
-                return ring, jnp.uint32(0), jnp.uint32(0)
+                ring, _, verify = args
+                return ring, verify, jnp.uint32(0), jnp.uint32(0)
 
-            ring, hi, lo = jax.lax.cond(do_save, save, skip, (ring, state))
+            ring, verify, hi, lo = jax.lax.cond(
+                do_save, save, skip, (ring, state, verify)
+            )
             state = jax.lax.cond(
                 i < advance_count,
                 lambda s: self.game.step(s, inp, stat),
                 lambda s: s,
                 state,
             )
-            return (ring, state), (hi, lo)
+            return (ring, state, verify), (hi, lo)
 
-        (ring, state), (his, los) = jax.lax.scan(
-            body, (ring, state), (iota, inputs, statuses, save_slots)
+        (ring, state, verify), (his, los) = jax.lax.scan(
+            body, (ring, state, verify), (iota, inputs, statuses, save_slots)
         )
-        return ring, state, his, los
+        return ring, state, verify, his, los
 
     # ------------------------------------------------------------------
 
@@ -189,20 +252,31 @@ class ResimCore:
         statuses: np.ndarray,
         save_slots: np.ndarray,
         advance_count: int,
+        start_frame: int = 0,
     ) -> Tuple[Any, Any]:
         """Run one fused tick; returns (checksum_hi[W], checksum_lo[W]) as
-        device arrays (no host sync)."""
+        device arrays (no host sync). `start_frame` feeds the device-verify
+        history (slot i saves frame start_frame + i)."""
         packed = np.empty((self._packed_len,), dtype=np.int32)
         packed[0] = 1 if do_load else 0
         packed[1] = load_slot
         packed[2] = advance_count
+        packed[3] = start_frame
         packed[self._off_save : self._off_status] = save_slots
         packed[self._off_status : self._off_input] = statuses.reshape(-1)
         packed[self._off_input :] = inputs.reshape(-1)
-        self.ring, self.state, his, los = self._tick_fn(
-            self.ring, self.state, packed
+        self.ring, self.state, self.verify, his, los = self._tick_fn(
+            self.ring, self.state, packed, self.verify
         )
         return his, los
+
+    def check_device_verdict(self) -> Tuple[bool, int]:
+        """Fetch the device-verify latch: (mismatch?, first bad frame).
+        ONE small host readback — the only transfer device verification
+        ever makes."""
+        assert self.device_verify, "core built without device_verify"
+        flag = np.asarray(jax.device_get(self.verify["flag"]))
+        return bool(flag[0]), int(flag[1])
 
     # ------------------------------------------------------------------
     # speculative beam (the north-star "rollback becomes a select"):
@@ -251,7 +325,8 @@ class ResimCore:
             self.ring, np.int32(anchor_slot), beam_inputs, beam_statuses
         )
 
-    def _adopt_impl(self, ring, traj, spec_his, spec_los, a_hi, a_lo, packed):
+    def _adopt_impl(self, ring, traj, spec_his, spec_los, a_hi, a_lo, verify,
+                    packed):
         """Commit a beam member's trajectory as this tick's result: fill the
         requested ring slots with its per-frame states (slot i = state at
         load_frame + i, exactly what _tick_impl's resim would have saved)
@@ -267,7 +342,8 @@ class ResimCore:
         load_slot = packed[1]
         advance_count = packed[2]
         shift = packed[3]
-        save_slots = packed[4 : 4 + self.window]
+        load_frame = packed[4]
+        save_slots = packed[5 : 5 + self.window]
         loaded = jax.tree.map(
             lambda r: jax.lax.dynamic_index_in_dim(r, load_slot, 0, keepdims=False),
             ring,
@@ -275,43 +351,6 @@ class ResimCore:
         mtraj = jax.tree.map(
             lambda t: jax.lax.dynamic_index_in_dim(t, member, 0, keepdims=False),
             traj,
-        )
-        iota = jnp.arange(self.window, dtype=jnp.int32)
-
-        def body(ring, xs):
-            i, save_slot = xs
-
-            def save(ring):
-                idx = shift + i - 1
-                prev = jax.tree.map(
-                    lambda t: jax.lax.dynamic_index_in_dim(
-                        t, jnp.maximum(idx, 0), 0, keepdims=False
-                    ),
-                    mtraj,
-                )
-                # idx < 0 only at (shift=0, i=0): the anchor state itself
-                s_i = _tree_where(idx < 0, loaded, prev)
-                return jax.tree.map(
-                    lambda r, s: jax.lax.dynamic_update_index_in_dim(
-                        r, s, save_slot, 0
-                    ),
-                    ring,
-                    s_i,
-                )
-
-            # scratch-slot writes skipped outright (same cond rationale as
-            # _tick_impl: device time tracks the actual save count)
-            ring = jax.lax.cond(
-                save_slot < self.ring_len, save, lambda r: r, ring
-            )
-            return ring, None
-
-        ring, _ = jax.lax.scan(body, ring, (iota, save_slots))
-        state = jax.tree.map(
-            lambda t: jax.lax.dynamic_index_in_dim(
-                t, jnp.maximum(shift + advance_count - 1, 0), 0, keepdims=False
-            ),
-            mtraj,
         )
         mhis = jax.lax.dynamic_index_in_dim(spec_his, member, 0, keepdims=False)
         mlos = jax.lax.dynamic_index_in_dim(spec_los, member, 0, keepdims=False)
@@ -323,24 +362,74 @@ class ResimCore:
         full_lo = jnp.concatenate([a_lo[None], mlos, pad])
         his = jax.lax.dynamic_slice(full_hi, (shift,), (self.window,))
         los = jax.lax.dynamic_slice(full_lo, (shift,), (self.window,))
-        return ring, state, his, los
+
+        iota = jnp.arange(self.window, dtype=jnp.int32)
+
+        def body(carry, xs):
+            ring, verify = carry
+            i, save_slot, hi, lo = xs
+
+            def save(args):
+                ring, verify = args
+                idx = shift + i - 1
+                prev = jax.tree.map(
+                    lambda t: jax.lax.dynamic_index_in_dim(
+                        t, jnp.maximum(idx, 0), 0, keepdims=False
+                    ),
+                    mtraj,
+                )
+                # idx < 0 only at (shift=0, i=0): the anchor state itself
+                s_i = _tree_where(idx < 0, loaded, prev)
+                ring = jax.tree.map(
+                    lambda r, s: jax.lax.dynamic_update_index_in_dim(
+                        r, s, save_slot, 0
+                    ),
+                    ring,
+                    s_i,
+                )
+                verify = self._verify_update(verify, load_frame + i, hi, lo)
+                return ring, verify
+
+            # scratch-slot writes skipped outright (same cond rationale as
+            # _tick_impl: device time tracks the actual save count)
+            ring, verify = jax.lax.cond(
+                save_slot < self.ring_len,
+                save,
+                lambda args: args,
+                (ring, verify),
+            )
+            return (ring, verify), None
+
+        (ring, verify), _ = jax.lax.scan(
+            body, (ring, verify), (iota, save_slots, his, los)
+        )
+        state = jax.tree.map(
+            lambda t: jax.lax.dynamic_index_in_dim(
+                t, jnp.maximum(shift + advance_count - 1, 0), 0, keepdims=False
+            ),
+            mtraj,
+        )
+        return ring, state, verify, his, los
 
     def adopt(self, spec, member: int, load_slot: int, save_slots: np.ndarray,
-              advance_count: int, shift: int = 0) -> Tuple[Any, Any]:
+              advance_count: int, shift: int = 0,
+              load_frame: int = 0) -> Tuple[Any, Any]:
         """Fulfill a rollback tick from a matching speculation; returns
         (checksum_hi[W], checksum_lo[W]) like tick(). `shift` = load_frame -
         anchor_frame (caller guarantees shift + advance_count <= window and
         that the member's first `shift` input rows equal the inputs actually
         played for frames anchor..load)."""
         traj, spec_his, spec_los, a_hi, a_lo = spec
-        packed = np.empty((4 + self.window,), dtype=np.int32)
+        packed = np.empty((5 + self.window,), dtype=np.int32)
         packed[0] = member
         packed[1] = load_slot
         packed[2] = advance_count
         packed[3] = shift
-        packed[4:] = save_slots
-        self.ring, self.state, his, los = self._adopt_fn(
-            self.ring, traj, spec_his, spec_los, a_hi, a_lo, packed
+        packed[4] = load_frame
+        packed[5:] = save_slots
+        self.ring, self.state, self.verify, his, los = self._adopt_fn(
+            self.ring, traj, spec_his, spec_los, a_hi, a_lo, self.verify,
+            packed,
         )
         return his, los
 

@@ -116,11 +116,11 @@ def soak(sess_a, sess_b, frames):
 
 
 def test_cross_stack_udp_soak():
-    sess_a, sess_b = build_pair(7941, 7942)
+    sess_a, sess_b = build_pair(17941, 17942)
     confirmed = soak(sess_a, sess_b, frames=200)
     assert confirmed > 150
 
 
 def test_cross_stack_udp_soak_authenticated():
-    sess_a, sess_b = build_pair(7943, 7944, auth=True)
+    sess_a, sess_b = build_pair(17943, 17944, auth=True)
     soak(sess_a, sess_b, frames=120)

@@ -72,19 +72,19 @@ def run_lockstep(s0, s1, frames):
 
 
 def test_p2p_over_tcp_transport():
-    s0, s1 = build_pair(7951, 7952)
+    s0, s1 = build_pair(17951, 17952)
     run_lockstep(s0, s1, frames=80)
 
 
 def test_p2p_over_tcp_with_authenticated_wrapper():
     """The MAC wrapper composes over any wire-level transport."""
-    s0, s1 = build_pair(7953, 7954, auth=True)
+    s0, s1 = build_pair(17953, 17954, auth=True)
     run_lockstep(s0, s1, frames=60)
 
 
 def test_tcp_socket_wire_roundtrip():
-    a, b = TcpDatagramSocket(7955), TcpDatagramSocket(7956)
-    a.send_wire(b"hello-wire", ("127.0.0.1", 7956))
+    a, b = TcpDatagramSocket(17955), TcpDatagramSocket(17956)
+    a.send_wire(b"hello-wire", ("127.0.0.1", 17956))
     got = []
     for _ in range(100):
         got = b.receive_all_wire()
@@ -92,7 +92,7 @@ def test_tcp_socket_wire_roundtrip():
             break
         a.receive_all_wire()  # drains a's pending connect/flush
         time.sleep(0.002)
-    assert got and got[0] == (("127.0.0.1", 7955), b"hello-wire")
+    assert got and got[0] == (("127.0.0.1", 17955), b"hello-wire")
     # reply flows back over the canonical address without a fresh dial
     b.send_wire(b"pong", got[0][0])
     back = []
@@ -108,11 +108,11 @@ def test_tcp_socket_wire_roundtrip():
 
 
 def test_dead_stream_is_datagram_loss_not_crash():
-    a = TcpDatagramSocket(7957)
-    # nobody listens on 7958: the dialed stream dies; sends must neither
+    a = TcpDatagramSocket(17957)
+    # nobody listens on 17958: the dialed stream dies; sends must neither
     # block nor raise (loss is the seam's contract)
     for _ in range(5):
-        a.send_wire(b"x", ("127.0.0.1", 7958))
+        a.send_wire(b"x", ("127.0.0.1", 17958))
         a.receive_all_wire()
         time.sleep(0.002)
     a.close()
