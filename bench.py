@@ -126,14 +126,18 @@ def bench_roofline():
     on achieved bandwidth and an honest measure of how much of the
     machine the configuration actually exercises. Peak: v5e HBM is
     819 GB/s (measured ~805 on this chip with a pure elementwise chain).
-    The 1M-entity world only fits the XLA scan; the VMEM-resident pallas
-    kernel covers up to its validated envelope (~262k entities at
-    check_distance 2 — past it Mosaic has been observed to miscompile, see
-    PallasSyncTestCore.VMEM_BUDGET_BYTES)."""
+    Three large-world configurations: the ENTITY-TILED pallas kernel
+    (ggrs_tpu/tpu/pallas_tiled.py: grid over entity tiles, the whole
+    T-tick batch inside per-tile VMEM — any world size, per-batch HBM
+    traffic at the ideal-fusion bound), the XLA scan on the same 1M-entity
+    world (the dozens-of-unfused-passes baseline the tiled kernel beats),
+    and the whole-batch VMEM-resident kernel at its envelope (~262k
+    entities at check_distance 2, see PallasSyncTestCore.VMEM_BUDGET_BYTES)."""
     HBM_PEAK_GBS = 819.0
     out = {"hbm_peak_gb_per_sec": HBM_PEAK_GBS}
     for label, entities, d, backend in (
-        ("cfg_large_1m", 1048576, 8, "xla"),
+        ("cfg_large_1m_tiled", 1048576, 8, "pallas-tiled"),
+        ("cfg_large_1m_xla", 1048576, 8, "xla"),
         ("cfg_large_vmem", 262144, 2, "pallas"),
     ):
         rate, ms, be, _ = bench_fused(

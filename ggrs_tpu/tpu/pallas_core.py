@@ -146,6 +146,10 @@ class PlaneAdapter:
     """
 
     planes: Tuple[Tuple[str, str, Optional[int]], ...]
+    # True iff the step is per-entity independent (no cross-entity
+    # reductions): unlocks the entity-tiled kernel (pallas_tiled), which
+    # runs the time loop inside per-tile VMEM at any world size
+    tileable = False
 
     def __init__(self, game):
         self.game = game
@@ -171,6 +175,7 @@ class PlaneAdapter:
 class ExGamePlanes(PlaneAdapter):
     """ggrs_tpu.models.ex_game._step_generic on packed planes."""
 
+    tileable = True  # pure per-entity physics, per-entity checksum terms
     planes = (
         ("px", "pos", 0), ("py", "pos", 1),
         ("vx", "vel", 0), ("vy", "vel", 1),
@@ -337,6 +342,32 @@ class ArenaPlanes(PlaneAdapter):
                 "energy": energy}
 
 
+def derive_checksum_weights(game, adapter):
+    """Generic checksum weights for a packed-plane layout: for checksum key
+    k of per-entity width w at word offset off_k, plane (k, j) element gi
+    sits at global word index off_k + gi*w + j (the concatenation order
+    _checksum_generic flattens), weighted (index+1)*GOLDEN. THE single
+    derivation shared by every pallas kernel — a drifted copy would make
+    two kernels disagree on the same state's checksum.
+
+    Returns (entries, frame_weight): entries = [(plane_name, w, wrapped
+    off+j+1)], frame_weight = wrapped (total_words + 1) * GOLDEN."""
+    n = game.num_entities
+    widths: Dict[str, int] = {}
+    for _, key, _ in adapter.planes:
+        widths[key] = widths.get(key, 0) + 1
+    offs: Dict[str, int] = {}
+    off = 0
+    for key in game.checksum_keys:
+        offs[key] = off
+        off += n * widths[key]
+    entries = [
+        (name, np.int32(widths[key]), _wrap_i32(offs[key] + (comp or 0) + 1))
+        for name, key, comp in adapter.planes
+    ]
+    return entries, _wrap_i32((off + 1) * int(GOLDEN))
+
+
 _ADAPTERS: Dict[type, Callable] = {}
 
 
@@ -405,26 +436,9 @@ class PallasSyncTestCore:
         self.n_rows = game.num_entities // 128
         self.interpret = interpret
         self._batch = functools.lru_cache(maxsize=4)(self._build)
-        # generically derived checksum weights: for checksum key k of
-        # per-entity width w at word offset off_k, plane (k, j) element gi
-        # sits at global word index off_k + gi*w + j (the concatenation
-        # order _checksum_generic flattens), weighted (index+1)*GOLDEN
-        n = game.num_entities
-        widths: Dict[str, int] = {}
-        for _, key, _ in self.adapter.planes:
-            widths[key] = widths.get(key, 0) + 1
-        offs: Dict[str, int] = {}
-        off = 0
-        for key in game.checksum_keys:
-            offs[key] = off
-            off += n * widths[key]
-        self._cs_entries = []  # (plane_name, w, wrapped off+j+1)
-        for name, key, comp in self.adapter.planes:
-            j = comp or 0
-            self._cs_entries.append(
-                (name, np.int32(widths[key]), _wrap_i32(offs[key] + j + 1))
-            )
-        self._cs_frame_weight = _wrap_i32((off + 1) * int(GOLDEN))
+        self._cs_entries, self._cs_frame_weight = derive_checksum_weights(
+            game, self.adapter
+        )
 
     # -- carry packing ---------------------------------------------------
 

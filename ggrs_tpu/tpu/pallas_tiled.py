@@ -1,0 +1,475 @@
+"""Entity-tiled pallas kernel: VMEM-resident fused SyncTest at ANY world
+size.
+
+The whole-batch kernel (pallas_core) holds the entire world + snapshot ring
+in VMEM, which caps it at ~262k entities. Past that the XLA scan runs the
+step as dozens of unfused elementwise passes over HBM (~2% of peak
+bandwidth at 1M entities). This kernel tiles the ENTITY axis instead: a
+1-D pallas grid where each grid step streams one entity tile's state +
+ring into VMEM and runs the ENTIRE T-tick batch on it — per batch, every
+state/ring byte crosses HBM exactly once in and once out, the ideal-fusion
+bound.
+
+What makes the time-inside-tile order legal: the model's step must be
+per-entity independent (no cross-entity reductions) and its checksum a
+per-entity weighted modular sum. Adapters declare `tileable = True`
+(ex_game qualifies; arena's per-team centroids do not — it stays on the
+whole-batch kernel or the XLA scan). Checksums are emitted as PARTIAL
+per-tile sums accumulated across grid steps in an SMEM revisit buffer
+(uint32 wraparound sums are order-invariant, so the total is bit-identical
+to the unsharded checksum); the first-seen history compare — a few hundred
+scalar ops — moves to a jnp post-pass over the per-save totals, carrying
+the same h_tag/h_hi/h_lo/mismatch state as TpuSyncTestSession's carry, so
+the tiled core is a drop-in `backend="pallas-tiled"`.
+
+Save-event layout the post-pass decodes (mirroring TpuSyncTestSession._tick
+for tick frame c = c0 + t):
+  parts[t, j], j < d-1: rollback re-save of frame (c-d)+1+j  (valid iff c > d)
+  parts[t, d-1]:        the save of the current frame c      (always valid)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .pallas_core import GOLDEN, KernelCtx, derive_checksum_weights, get_adapter
+
+LANE = 128
+
+
+class PallasTiledSyncTestCore:
+    """Drop-in batch executor for TpuSyncTestSession's carry, tiled over
+    entities (unsharded; any world size that fits HBM)."""
+
+    # per-tile VMEM budget for the streamed windows (state+ring in/out).
+    # Mosaic DOUBLE-BUFFERS grid-step windows to overlap DMA with compute,
+    # so the effective VMEM cost is ~2x this figure plus temporaries — 28MB
+    # keeps the total under the 100MB scoped limit (verified on v5e at 1M
+    # entities, check_distance 8)
+    VMEM_TILE_BUDGET = 28 * 1024 * 1024
+
+    def __init__(self, game, num_players: int, check_distance: int,
+                 interpret: bool = False, tile_rows: int = 0):
+        assert game.num_entities % LANE == 0, "entity count must be 128-aligned"
+        self.game = game
+        self.adapter = get_adapter(game)
+        assert getattr(self.adapter, "tileable", False), (
+            f"{type(self.adapter).__name__} is not tileable (the step must "
+            "be per-entity independent); use the whole-batch kernel or XLA"
+        )
+        self.num_players = num_players
+        self.input_size = game.input_size
+        self.d = check_distance
+        self.ring_len = check_distance + 2
+        self.hist_len = check_distance + 2
+        self.n_rows = game.num_entities // LANE
+        self.interpret = interpret
+        n_planes = len(self.adapter.planes)
+        if tile_rows <= 0:
+            # largest 8-multiple divisor of n_rows fitting the budget
+            # (bigger tiles = fewer grid steps); a world whose row count
+            # has no such divisor falls back to one full tile
+            per_row = n_planes * (1 + self.ring_len) * LANE * 4 * 2
+            budget_rows = max(1, self.VMEM_TILE_BUDGET // per_row)
+            candidates = [
+                r
+                for r in range(8, self.n_rows + 1, 8)
+                if self.n_rows % r == 0 and r <= budget_rows
+            ]
+            tile_rows = max(candidates) if candidates else self.n_rows
+        assert self.n_rows % tile_rows == 0, (
+            f"tile_rows {tile_rows} must divide {self.n_rows}"
+        )
+        # Mosaic block constraint: second-to-last dim divisible by 8, or
+        # equal to the full array dim
+        assert tile_rows >= 8 or tile_rows == self.n_rows, (
+            f"tile_rows {tile_rows} violates the 8-sublane block constraint"
+        )
+        self.tile_rows = tile_rows
+        self.n_tiles = self.n_rows // tile_rows
+        self._batch = functools.lru_cache(maxsize=4)(self._build)
+        self._cs_entries, self._cs_frame_weight = derive_checksum_weights(
+            game, self.adapter
+        )
+
+    # -- carry packing (same layout as the whole-batch core) -------------
+
+    def pack(self, carry):
+        rows = self.n_rows
+
+        def comp(a, c):
+            plane = a if c is None else a[..., c]
+            return plane.reshape(plane.shape[: plane.ndim - 1] + (rows, LANE))
+
+        s, r = carry["state"], carry["ring"]
+        packed = {}
+        for name, key, c in self.adapter.planes:
+            packed[name] = comp(s[key], c)
+            packed["r_" + name] = comp(r[key], c)
+        packed["r_frame"] = r["frame"].astype(jnp.int32)
+        packed["iring"] = carry["input_ring"].reshape(
+            self.d + 2, self.num_players * self.input_size
+        ).astype(jnp.int32)
+        return packed
+
+    def unpack(self, p, carry, verdict):
+        n = self.game.num_entities
+        groups: Dict[str, list] = {}
+        for name, key, c in self.adapter.planes:
+            groups.setdefault(key, []).append((c, name))
+
+        def rebuild(prefix, lead):
+            out = {}
+            for key, comps in groups.items():
+                if len(comps) == 1 and comps[0][0] is None:
+                    out[key] = p[prefix + comps[0][1]].reshape(lead + (n,))
+                else:
+                    out[key] = jnp.stack(
+                        [p[prefix + nm].reshape(lead + (n,)) for _, nm in comps],
+                        axis=-1,
+                    )
+            return out
+
+        state = rebuild("", ())
+        state["frame"] = verdict["frame"]
+        ring = rebuild("r_", (self.ring_len,))
+        ring["frame"] = p["r_frame"]
+        return {
+            "state": state,
+            "ring": ring,
+            "input_ring": p["iring"].astype(jnp.uint8).reshape(
+                self.d + 2, self.num_players, self.input_size
+            ),
+            "h_tag": verdict["h_tag"],
+            "h_hi": verdict["h_hi"],
+            "h_lo": verdict["h_lo"],
+            "mismatch": verdict["mismatch"],
+            "mismatch_frame": verdict["mismatch_frame"],
+            "frame": verdict["frame"],
+        }
+
+    # -- kernel ----------------------------------------------------------
+
+    def _build(self, t_ticks: int):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        d, ring_len = self.d, self.ring_len
+        rows, tile_rows, P, I = self.n_rows, self.tile_rows, self.num_players, self.input_size
+        adapter = self.adapter
+        plane_names = [name for name, _, _ in adapter.planes]
+        n_tiles = self.n_tiles
+
+        gi_full = (
+            np.arange(rows, dtype=np.int32)[:, None] * LANE
+            + np.arange(LANE, dtype=np.int32)[None, :]
+        )
+        owner_full = gi_full % P
+
+        vmem_names = plane_names + ["r_" + n_ for n_ in plane_names]
+
+        def kernel(inputs_ref, c0_ref, iring0_ref, rframe0_ref, gi_ref,
+                   owner_ref, *refs):
+            n_io = len(vmem_names)
+            ins = dict(zip(vmem_names, refs[:n_io]))
+            outs = dict(zip(vmem_names, refs[n_io : 2 * n_io]))
+            parts_hi_ref = refs[2 * n_io]
+            parts_lo_ref = refs[2 * n_io + 1]
+            rframe_ref = refs[2 * n_io + 2]
+            iring_out_ref = refs[2 * n_io + 3]
+            iring_scratch = refs[2 * n_io + 4]
+
+            first_tile = pl.program_id(0) == 0
+
+            # local copy of the (tiny, tile-invariant) input ring; every
+            # tile evolves it identically from the same batch inputs
+            for a in range(d + 2):
+                for b in range(P * I):
+                    iring_scratch[a, b] = iring0_ref[a, b]
+            # seed the revisit buffers from the carry on the first tile
+            # (out blocks start uninitialized; later tiles read them after
+            # tile 0 ran — the grid is sequential)
+            for s in range(ring_len):
+                rframe_ref[s] = jnp.where(
+                    first_tile, rframe0_ref[s], rframe_ref[s]
+                )
+
+            ctx = KernelCtx(gi_ref[:], owner_ref[:])
+            out = {n_: outs[n_] for n_ in vmem_names}
+            # initialize output windows EXPLICITLY from the input refs:
+            # relying on input_output_aliases to pre-fill gridded output
+            # windows silently fails past ~1MB planes on real TPUs (one
+            # plane reads as zeros — same Mosaic behavior the whole-batch
+            # kernel documents for SMEM outs); an in-VMEM copy is cheap
+            for n_ in vmem_names:
+                out[n_][...] = ins[n_][...]
+
+            def read_state():
+                return {n_: out[n_][:] for n_ in plane_names}
+
+            def ring_slot(name, slot):
+                return out[name][pl.ds(slot, 1)][0]
+
+            def partial_checksum(state, frame):
+                # PARTIAL sums over this tile's entities; global weights
+                # ride in via the sliced gi plane. The frame term is folded
+                # by tile 0 only so the cross-tile total counts it once.
+                hi = frame * self._cs_frame_weight
+                lo = frame
+                zero = jnp.int32(0)
+                hi = jnp.where(first_tile, hi, zero)
+                lo = jnp.where(first_tile, lo, zero)
+                for name, w, base in self._cs_entries:
+                    hi = hi + jnp.sum(state[name] * ((w * ctx.gi + base) * GOLDEN))
+                    lo = lo + jnp.sum(state[name])
+                return hi, lo
+
+            def save_tile(state, frame, mask, t, j):
+                """Masked ring write + partial-checksum emission into the
+                cross-tile accumulator at event (t, j)."""
+                hi, lo = partial_checksum(state, frame)
+                slot = frame % ring_len
+                for name in plane_names:
+                    old = ring_slot("r_" + name, slot)
+                    out["r_" + name][pl.ds(slot, 1)] = jnp.where(
+                        mask, state[name], old
+                    )[None]
+                old_f = rframe_ref[slot]
+                rframe_ref[slot] = jnp.where(
+                    first_tile & mask, frame, old_f
+                )
+                acc_hi = parts_hi_ref[t, j]
+                acc_lo = parts_lo_ref[t, j]
+                base_hi = jnp.where(first_tile, jnp.int32(0), acc_hi)
+                base_lo = jnp.where(first_tile, jnp.int32(0), acc_lo)
+                parts_hi_ref[t, j] = base_hi + jnp.where(mask, hi, 0)
+                parts_lo_ref[t, j] = base_lo + jnp.where(mask, lo, 0)
+
+            def tick(t, _):
+                c = c0_ref[0] + t
+                do_rb = c > d
+                base = jnp.maximum(c - d, 0)
+                bslot = base % ring_len
+                loaded = {
+                    n_: ring_slot("r_" + n_, bslot) for n_ in plane_names
+                }
+                cur = read_state()
+                state = {
+                    n_: jnp.where(do_rb, loaded[n_], cur[n_])
+                    for n_ in plane_names
+                }
+
+                for i in range(d):
+                    f = base + i
+                    if i > 0:
+                        save_tile(state, f, do_rb, t, i - 1)
+                    islot = f % (d + 2)
+                    inps = [
+                        [iring_scratch[islot, p * I + j] for j in range(I)]
+                        for p in range(P)
+                    ]
+                    nxt = adapter.step(state, inps, ctx)
+                    state = {
+                        n_: jnp.where(do_rb, nxt[n_], state[n_])
+                        for n_ in plane_names
+                    }
+
+                save_tile(state, c, jnp.bool_(True), t, d - 1)
+                cslot = c % (d + 2)
+                new_inps = [
+                    [inputs_ref[t, p * I + j] for j in range(I)]
+                    for p in range(P)
+                ]
+                for p in range(P):
+                    for j in range(I):
+                        iring_scratch[cslot, p * I + j] = new_inps[p][j]
+                state = adapter.step(state, new_inps, ctx)
+                for n_ in plane_names:
+                    out[n_][:] = state[n_]
+                return 0
+
+            jax.lax.fori_loop(0, t_ticks, tick, 0)
+
+            # evolved input ring out (identical on every tile; revisit
+            # buffer keeps the last write)
+            for a in range(d + 2):
+                for b in range(P * I):
+                    iring_out_ref[a, b] = iring_scratch[a, b]
+
+        def state_spec():
+            return pl.BlockSpec(
+                (tile_rows, LANE), lambda g: (g, 0), memory_space=pltpu.VMEM
+            )
+
+        def ring_spec():
+            return pl.BlockSpec(
+                (ring_len, tile_rows, LANE),
+                lambda g: (0, g, 0),
+                memory_space=pltpu.VMEM,
+            )
+
+        def run(packed, inputs_i32, c0):
+            in_specs = (
+                [
+                    pl.BlockSpec(memory_space=pltpu.SMEM),  # inputs [T, P*I]
+                    pl.BlockSpec(memory_space=pltpu.SMEM),  # c0 [1]
+                    pl.BlockSpec(memory_space=pltpu.SMEM),  # iring0
+                    pl.BlockSpec(memory_space=pltpu.SMEM),  # rframe0
+                    state_spec(),  # gi
+                    state_spec(),  # owner
+                ]
+                + [state_spec() for _ in plane_names]
+                + [ring_spec() for _ in plane_names]
+            )
+            out_specs = (
+                [state_spec() for _ in plane_names]
+                + [ring_spec() for _ in plane_names]
+                + [
+                    # cross-tile revisit accumulators: every grid step maps
+                    # to the SAME block, so partial sums carry across tiles
+                    pl.BlockSpec(
+                        (t_ticks, d), lambda g: (0, 0), memory_space=pltpu.SMEM
+                    ),
+                    pl.BlockSpec(
+                        (t_ticks, d), lambda g: (0, 0), memory_space=pltpu.SMEM
+                    ),
+                    pl.BlockSpec(
+                        (ring_len,), lambda g: (0,), memory_space=pltpu.SMEM
+                    ),
+                    pl.BlockSpec(
+                        (d + 2, P * I), lambda g: (0, 0), memory_space=pltpu.SMEM
+                    ),
+                ]
+            )
+            out_shapes = (
+                [
+                    jax.ShapeDtypeStruct((rows, LANE), jnp.int32)
+                    for _ in plane_names
+                ]
+                + [
+                    jax.ShapeDtypeStruct((ring_len, rows, LANE), jnp.int32)
+                    for _ in plane_names
+                ]
+                + [
+                    jax.ShapeDtypeStruct((t_ticks, d), jnp.int32),
+                    jax.ShapeDtypeStruct((t_ticks, d), jnp.int32),
+                    jax.ShapeDtypeStruct((ring_len,), jnp.int32),
+                    jax.ShapeDtypeStruct((d + 2, P * I), jnp.int32),
+                ]
+            )
+            n_p = len(plane_names)
+            # alias state+ring ins (after the 6 leading operands) onto outs
+            aliases = {6 + i: i for i in range(2 * n_p)}
+            results = pl.pallas_call(
+                kernel,
+                grid=(n_tiles,),
+                in_specs=in_specs,
+                out_specs=out_specs,
+                out_shape=out_shapes,
+                input_output_aliases=aliases,
+                scratch_shapes=[
+                    pltpu.SMEM((d + 2, P * I), jnp.int32),
+                ],
+                compiler_params=(
+                    None
+                    if self.interpret
+                    else pltpu.CompilerParams(
+                        vmem_limit_bytes=100 * 1024 * 1024
+                    )
+                ),
+                interpret=self.interpret,
+            )(
+                inputs_i32,
+                c0,
+                packed["iring"],
+                packed["r_frame"],
+                jnp.asarray(gi_full),
+                jnp.asarray(owner_full),
+                *[packed[n_] for n_ in plane_names],
+                *[packed["r_" + n_] for n_ in plane_names],
+            )
+            out = dict(zip(vmem_names, results[: 2 * n_p]))
+            out["parts_hi"] = results[2 * n_p]
+            out["parts_lo"] = results[2 * n_p + 1]
+            out["r_frame_new"] = results[2 * n_p + 2]
+            out["iring_new"] = results[2 * n_p + 3]
+            return out
+
+        return run
+
+    # -- post-pass: first-seen history over the per-save totals ----------
+
+    def _verdict(self, carry, parts_hi, parts_lo, c0, t_ticks):
+        """jnp scan over the T*d save events (a few hundred scalars),
+        carrying the session's h_tag/h_hi/h_lo/mismatch exactly like
+        TpuSyncTestSession._save_and_check."""
+        d, hist = self.d, self.hist_len
+        t_idx = jnp.arange(t_ticks, dtype=jnp.int32)[:, None]
+        j_idx = jnp.arange(d, dtype=jnp.int32)[None, :]
+        c = c0 + t_idx
+        frames = jnp.where(
+            j_idx < d - 1, (c - d) + 1 + j_idx, c
+        )  # event frame
+        valid = (j_idx == d - 1) | (c > d)
+        ev = (
+            frames.reshape(-1),
+            valid.reshape(-1),
+            jax.lax.bitcast_convert_type(parts_hi.reshape(-1), jnp.uint32),
+            jax.lax.bitcast_convert_type(parts_lo.reshape(-1), jnp.uint32),
+        )
+
+        def body(hc, e):
+            frame, ok, hi, lo = e
+            h = frame % hist
+            seen = hc["h_tag"][h] == frame
+            differs = ok & seen & ((hc["h_hi"][h] != hi) | (hc["h_lo"][h] != lo))
+            first = differs & ~hc["mismatch"]
+            return {
+                "h_tag": hc["h_tag"].at[h].set(
+                    jnp.where(ok, frame, hc["h_tag"][h])
+                ),
+                "h_hi": hc["h_hi"].at[h].set(
+                    jnp.where(ok & ~seen, hi, hc["h_hi"][h])
+                ),
+                "h_lo": hc["h_lo"].at[h].set(
+                    jnp.where(ok & ~seen, lo, hc["h_lo"][h])
+                ),
+                "mismatch": hc["mismatch"] | differs,
+                "mismatch_frame": jnp.where(
+                    first, frame, hc["mismatch_frame"]
+                ),
+            }, None
+
+        hc = {
+            "h_tag": carry["h_tag"],
+            "h_hi": carry["h_hi"],
+            "h_lo": carry["h_lo"],
+            "mismatch": carry["mismatch"],
+            "mismatch_frame": carry["mismatch_frame"],
+        }
+        hc, _ = jax.lax.scan(body, hc, ev)
+        hc["frame"] = c0 + t_ticks
+        return hc
+
+    # -- public ----------------------------------------------------------
+
+    def batch(self, carry: Dict[str, Any], inputs) -> Dict[str, Any]:
+        t = inputs.shape[0]
+        run = self._batch(t)
+        packed = self.pack(carry)
+        inputs_i32 = inputs.reshape(
+            t, self.num_players * self.input_size
+        ).astype(jnp.int32)
+        c0 = carry["frame"].reshape(1).astype(jnp.int32)
+        out = run(packed, inputs_i32, c0)
+        verdict = self._verdict(
+            carry, out["parts_hi"], out["parts_lo"], carry["frame"], t
+        )
+        out["r_frame"] = out["r_frame_new"]
+        out["iring"] = out["iring_new"]
+        return self.unpack(out, carry, verdict)

@@ -46,13 +46,20 @@ class TpuSyncTestSession:
         partitions the fused scan, and the checksum reduction becomes the
         only cross-shard collective.
 
-        `backend`: "xla" (lax.scan; works everywhere, required for mesh) or
-        "pallas" (whole batch as one TPU kernel, state resident in VMEM —
-        see ggrs_tpu.tpu.pallas_core; bit-identical carries, much faster on
-        small worlds where per-op overhead dominates). "pallas-interpret"
-        runs the same kernel in interpreter mode (CPU tests)."""
+        `backend`: "xla" (lax.scan; works everywhere, required for mesh),
+        "pallas" (whole batch as one TPU kernel, every carry resident in
+        VMEM — see ggrs_tpu.tpu.pallas_core; bit-identical carries, much
+        faster on small worlds where per-op overhead dominates; capped by
+        the VMEM envelope), or "pallas-tiled" (grid over entity tiles with
+        the time loop inside per-tile VMEM — any world size, for models
+        whose step is per-entity independent; ggrs_tpu.tpu.pallas_tiled).
+        The "-interpret" suffixed variants run the same kernels in
+        interpreter mode (CPU tests)."""
         assert check_distance >= 1
-        assert backend in ("xla", "pallas", "pallas-interpret")
+        assert backend in (
+            "xla", "pallas", "pallas-interpret",
+            "pallas-tiled", "pallas-tiled-interpret",
+        )
         assert backend == "xla" or mesh is None, "pallas path is unsharded"
         self.game = game
         self.num_players = num_players
@@ -95,6 +102,16 @@ class TpuSyncTestSession:
         }
         if backend == "xla":
             self._batch_fn = jax.jit(self._batch_impl, donate_argnums=(0,))
+        elif backend.startswith("pallas-tiled"):
+            from .pallas_tiled import PallasTiledSyncTestCore
+
+            core = PallasTiledSyncTestCore(
+                game,
+                num_players,
+                check_distance,
+                interpret=backend.endswith("-interpret"),
+            )
+            self._batch_fn = jax.jit(core.batch, donate_argnums=(0,))
         else:
             from .pallas_core import PallasSyncTestCore
 
