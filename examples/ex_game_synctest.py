@@ -40,7 +40,35 @@ def main() -> int:
         default="ex_game",
         help="which model family to run (device path only)",
     )
+    ap.add_argument(
+        "--fused",
+        choices=["xla", "pallas", "pallas-tiled"],
+        default=None,
+        help="run the FULLY-FUSED device session (60 ticks per dispatch, "
+        "ring/history/verdict device-resident) on the chosen kernel "
+        "instead of the per-tick request path",
+    )
+    ap.add_argument(
+        "--device-verify",
+        action="store_true",
+        help="request path: keep the SyncTest checksum history and verdict "
+        "on device (zero readbacks until the final check)",
+    )
     args = ap.parse_args()
+
+    if args.fused and (args.host or args.native or args.device_verify):
+        ap.error(
+            "--fused bypasses the request path entirely; it cannot combine "
+            "with --host, --native or --device-verify"
+        )
+    if args.device_verify and (args.host or args.native):
+        ap.error(
+            "--device-verify needs the device backend (the verdict lives on "
+            "device); it cannot combine with --host or --native"
+        )
+
+    if args.fused:
+        return run_fused(args)
 
     builder = (
         SessionBuilder(input_size=1)
@@ -51,6 +79,8 @@ def main() -> int:
     )
     if args.native:
         builder = builder.with_native_sessions(True)
+    if args.device_verify:
+        builder = builder.with_device_checksum_verification()
     sess = builder.start_synctest_session()
 
     if args.host:
@@ -65,6 +95,7 @@ def main() -> int:
             model_cls(args.players, args.entities),
             max_prediction=args.max_prediction,
             num_players=args.players,
+            device_verify=args.device_verify,
         )
 
         def digest() -> str:
@@ -84,6 +115,8 @@ def main() -> int:
             game.handle_requests(sess.advance_frame())
             if frame % 60 == 0:
                 print(digest())
+        if args.device_verify:
+            game.check()  # the run's single device readback
     except MismatchedChecksum as exc:
         print(f"DESYNC: {exc}")
         return 1
@@ -92,6 +125,47 @@ def main() -> int:
     print(
         f"ok: {args.frames} frames, {resim} rollback-frames resimulated in "
         f"{dt:.3f}s ({resim / dt:.0f} frames/s)"
+    )
+    return 0
+
+
+def run_fused(args) -> int:
+    """The fully-fused session: batches of 60 ticks per device dispatch."""
+    import numpy as np
+
+    from ggrs_tpu.models import Arena, ExGame
+    from ggrs_tpu.tpu import TpuSyncTestSession
+    from ggrs_tpu.utils.barrier import true_barrier
+
+    model_cls = Arena if args.model == "arena" else ExGame
+    sess = TpuSyncTestSession(
+        model_cls(args.players, args.entities),
+        num_players=args.players,
+        check_distance=args.check_distance,
+        input_delay=args.input_delay,
+        flush_interval=60,
+        backend=args.fused,
+    )
+    batch = 60
+    script = np.zeros((args.frames, args.players, 1), dtype=np.uint8)
+    for f in range(args.frames):
+        for h in range(args.players):
+            script[f, h, 0] = scripted_input(f, h)[0]
+    t0 = time.perf_counter()
+    try:
+        for start in range(0, args.frames, batch):
+            sess.advance_frames(script[start : start + batch])
+        sess.check()
+        true_barrier(sess.carry["state"])
+    except MismatchedChecksum as exc:
+        print(f"DESYNC: {exc}")
+        return 1
+    dt = time.perf_counter() - t0
+    st = sess.state_numpy()
+    resim = args.frames * args.check_distance
+    print(
+        f"fused[{args.fused}] frame {int(st['frame'])}: {resim} "
+        f"rollback-frames in {dt:.3f}s ({resim / dt:.0f} frames/s)"
     )
     return 0
 

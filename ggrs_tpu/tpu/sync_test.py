@@ -262,7 +262,11 @@ class TpuSyncTestSession:
         save_device_checkpoint(path, self.carry, meta)
 
     @classmethod
-    def restore(cls, path: str, game, flush_interval: int = 1) -> "TpuSyncTestSession":
+    def restore(cls, path: str, game, flush_interval: int = 1,
+                backend: str = "xla") -> "TpuSyncTestSession":
+        """Checkpoints are backend-agnostic (the carry pytree is identical
+        across the XLA scan and both pallas kernels), so a run saved under
+        one backend can resume under any other."""
         import jax as _jax
 
         from ..utils.checkpoint import load_device_checkpoint
@@ -275,6 +279,7 @@ class TpuSyncTestSession:
             check_distance=meta["check_distance"],
             input_delay=meta["input_delay"],
             flush_interval=flush_interval,
+            backend=backend,
         )
         sess.carry = _jax.device_put(tree)
         sess.current_frame = meta["current_frame"]

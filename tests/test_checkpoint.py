@@ -101,3 +101,39 @@ def test_backend_resume_bitexact(tmp_path):
     b = back.state_numpy()
     for key in ("frame", "pos", "vel", "rot"):
         np.testing.assert_array_equal(np.asarray(a[key]), np.asarray(b[key]))
+
+
+def test_fused_resume_across_backends(tmp_path):
+    """Checkpoints are backend-agnostic: a run saved under the XLA scan
+    resumes bit-exactly under the tiled pallas kernel and vice versa."""
+    import numpy as np
+
+    import jax
+    import jax.tree_util as jtu
+
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.tpu import TpuSyncTestSession
+
+    rng = np.random.default_rng(3)
+    script = rng.integers(0, 16, size=(24, 2, 1), dtype=np.uint8)
+    sess = TpuSyncTestSession(
+        ExGame(2, 1024), num_players=2, check_distance=3, backend="xla"
+    )
+    sess.advance_frames(script[:12])
+    path = str(tmp_path / "xb.npz")
+    sess.save(path)
+
+    resumed = {}
+    for backend in ("xla", "pallas-tiled-interpret"):
+        r = TpuSyncTestSession.restore(
+            path, ExGame(2, 1024), backend=backend
+        )
+        r.advance_frames(script[12:])
+        r.check()
+        resumed[backend] = jax.device_get(r.carry)
+    la = jtu.tree_leaves_with_path(resumed["xla"])
+    lb = jtu.tree_leaves(resumed["pallas-tiled-interpret"])
+    for (p, x), y in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=jtu.keystr(p)
+        )
