@@ -61,6 +61,11 @@ def main() -> int:
             "--fused bypasses the request path entirely; it cannot combine "
             "with --host, --native or --device-verify"
         )
+    if args.fused == "pallas-tiled" and args.model == "arena":
+        ap.error(
+            "arena's cross-entity centroids are not tileable; use --fused "
+            "pallas or --fused xla for the arena family"
+        )
     if args.device_verify and (args.host or args.native):
         ap.error(
             "--device-verify needs the device backend (the verdict lives on "

@@ -40,6 +40,7 @@ class TpuSyncTestSession:
         flush_interval: int = 1,
         mesh=None,
         backend: str = "xla",
+        _defer_carry: bool = False,
     ):
         """`mesh`: optional jax Mesh with an `entity` axis — the world state
         and snapshot ring shard across it (BASELINE.json configs[4]); GSPMD
@@ -72,6 +73,41 @@ class TpuSyncTestSession:
         self.ring_len = d + 2
         self.hist_len = d + 2
 
+        if _defer_carry:
+            # restore() installs a checkpointed carry right after
+            # construction: building the initial one (a full init_state
+            # plus ring_len world-sized zero buffers) would be a
+            # multi-hundred-MB transient at large-world scale
+            self.carry = None
+        else:
+            self._build_initial_carry(game, mesh, num_players, d)
+        if backend == "xla":
+            self._batch_fn = jax.jit(self._batch_impl, donate_argnums=(0,))
+        elif backend.startswith("pallas-tiled"):
+            from .pallas_tiled import PallasTiledSyncTestCore
+
+            core = PallasTiledSyncTestCore(
+                game,
+                num_players,
+                check_distance,
+                interpret=backend.endswith("-interpret"),
+            )
+            self._batch_fn = jax.jit(core.batch, donate_argnums=(0,))
+        else:
+            from .pallas_core import PallasSyncTestCore
+
+            core = PallasSyncTestCore(
+                game,
+                num_players,
+                check_distance,
+                interpret=backend == "pallas-interpret",
+            )
+            self._batch_fn = jax.jit(core.batch, donate_argnums=(0,))
+        self._raw_inputs: list = []  # host-side delay shift buffer
+        self._ticks_since_flush = 0
+        self.current_frame = 0
+
+    def _build_initial_carry(self, game, mesh, num_players, d) -> None:
         state = game.init_state()
         if mesh is not None:
             from ..parallel.sharded import shard_ring, shard_state
@@ -100,31 +136,6 @@ class TpuSyncTestSession:
             "mismatch_frame": jnp.full((), -1, dtype=jnp.int32),
             "frame": jnp.zeros((), dtype=jnp.int32),
         }
-        if backend == "xla":
-            self._batch_fn = jax.jit(self._batch_impl, donate_argnums=(0,))
-        elif backend.startswith("pallas-tiled"):
-            from .pallas_tiled import PallasTiledSyncTestCore
-
-            core = PallasTiledSyncTestCore(
-                game,
-                num_players,
-                check_distance,
-                interpret=backend.endswith("-interpret"),
-            )
-            self._batch_fn = jax.jit(core.batch, donate_argnums=(0,))
-        else:
-            from .pallas_core import PallasSyncTestCore
-
-            core = PallasSyncTestCore(
-                game,
-                num_players,
-                check_distance,
-                interpret=backend == "pallas-interpret",
-            )
-            self._batch_fn = jax.jit(core.batch, donate_argnums=(0,))
-        self._raw_inputs: list = []  # host-side delay shift buffer
-        self._ticks_since_flush = 0
-        self.current_frame = 0
 
     # ------------------------------------------------------------------
 
@@ -280,6 +291,7 @@ class TpuSyncTestSession:
             input_delay=meta["input_delay"],
             flush_interval=flush_interval,
             backend=backend,
+            _defer_carry=True,  # the checkpoint replaces the initial carry
         )
         sess.carry = _jax.device_put(tree)
         sess.current_frame = meta["current_frame"]

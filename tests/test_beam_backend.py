@@ -22,6 +22,40 @@ ENTITIES = 64
 PLAYERS = 2
 
 
+def build_p2p_pair(max_prediction=6, seeds=(1234, 5678)):
+    """Two P2P sessions over a deterministic in-memory net, synced to
+    RUNNING. Fixed rng seeds: the protocol handshake must not depend on
+    Python's per-process string-hash randomization."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+
+    def build(my_addr, other_addr, local_handle, seed):
+        return (
+            SessionBuilder(input_size=1)
+            .with_num_players(PLAYERS)
+            .with_max_prediction_window(max_prediction)
+            .with_clock(clock)
+            .with_rng(random.Random(seed))
+            .add_player(PlayerType.local(), local_handle)
+            .add_player(PlayerType.remote(other_addr), 1 - local_handle)
+            .start_p2p_session(net.socket(my_addr))
+        )
+
+    s0 = build("a", "b", 0, seeds[0])
+    s1 = build("b", "a", 1, seeds[1])
+    for _ in range(400):
+        s0.poll_remote_clients()
+        s1.poll_remote_clients()
+        clock.advance(20)
+        if (
+            s0.current_state() == SessionState.RUNNING
+            and s1.current_state() == SessionState.RUNNING
+        ):
+            break
+    assert s0.current_state() == SessionState.RUNNING
+    return clock, s0, s1
+
+
 def make_backend(beam_width, max_prediction=6):
     return TpuRollbackBackend(
         ExGame(num_players=PLAYERS, num_entities=ENTITIES),
@@ -120,40 +154,12 @@ def test_beam_perturbed_member_hits_in_p2p():
     Two identical session pairs (deterministic net) — the beam pair's
     backend states must track the plain pair's exactly."""
 
-    def build_pair():
-        clock = FakeClock()
-        net = InMemoryNetwork(clock)
-
-        def build(my_addr, other_addr, local_handle):
-            return (
-                SessionBuilder(input_size=1)
-                .with_num_players(PLAYERS)
-                .with_max_prediction_window(6)
-                .with_clock(clock)
-                .with_rng(random.Random(hash(my_addr) & 0xFFFF))
-                .add_player(PlayerType.local(), local_handle)
-                .add_player(PlayerType.remote(other_addr), 1 - local_handle)
-                .start_p2p_session(net.socket(my_addr))
-            )
-
-        s0, s1 = build("a", "b", 0), build("b", "a", 1)
-        for _ in range(400):
-            s0.poll_remote_clients()
-            s1.poll_remote_clients()
-            clock.advance(20)
-            if (
-                s0.current_state() == SessionState.RUNNING
-                and s1.current_state() == SessionState.RUNNING
-            ):
-                break
-        return clock, s0, s1
-
     # local constant 5, remote constant 2: the remote's value equals the
     # XOR-2 perturbation of the blank prediction, so member (pattern 2,
     # player 1) covers the corrected script
     results = []
     for beam_width in (8, 0):
-        clock, s0, s1 = build_pair()
+        clock, s0, s1 = build_p2p_pair()
         backend0 = make_backend(beam_width)
         backend1 = make_backend(0)
         states = []
@@ -243,3 +249,28 @@ def test_beam_requires_statuses_contract():
         num_players=PLAYERS,
         beam_width=0,
     )
+
+
+def test_arena_beam_adoption_live_p2p():
+    """The beam is game-agnostic: arena (declared statuses contract,
+    cross-entity centroids) adopts in a live P2P session with sticky
+    toggling inputs. (Bit-parity of adopted trajectories is covered by the
+    synctest-pair tests above; adoption correctness for arena rests on the
+    same enforced statuses contract.)"""
+    from ggrs_tpu.models.arena import Arena
+
+    clock, s0, s1 = build_p2p_pair()
+    beam = TpuRollbackBackend(
+        Arena(PLAYERS, 64), max_prediction=6, num_players=PLAYERS, beam_width=16
+    )
+    plain = TpuRollbackBackend(
+        Arena(PLAYERS, 64), max_prediction=6, num_players=PLAYERS
+    )
+    for f in range(40):
+        v = 1 if (f // 7) % 2 == 0 else 9  # sticky toggle
+        s0.add_local_input(0, bytes([v]))
+        beam.handle_requests(s0.advance_frame())
+        s1.add_local_input(1, bytes([v ^ 3]))
+        plain.handle_requests(s1.advance_frame())
+        clock.advance(16)
+    assert beam.beam_hits > 0, (beam.beam_hits, beam.beam_misses)

@@ -116,3 +116,31 @@ def test_dead_stream_is_datagram_loss_not_crash():
         a.receive_all_wire()
         time.sleep(0.002)
     a.close()
+
+
+def test_native_session_over_tcp_transport():
+    """The native C++ session core pumps through the Python socket seam,
+    so it composes with the TCP transport unchanged — full-native peer vs
+    Python peer over TCP streams."""
+    import pytest
+
+    from ggrs_tpu.native import available
+
+    if not available():
+        pytest.skip("native library not built")
+
+    def build(my_port, other_port, handle, native):
+        b = (
+            SessionBuilder(input_size=1)
+            .with_num_players(2)
+            .with_max_prediction_window(8)
+            .add_player(PlayerType.local(), handle)
+            .add_player(PlayerType.remote(("127.0.0.1", other_port)), 1 - handle)
+        )
+        if native:
+            b = b.with_native_sessions(True)
+        return b.start_p2p_session(TcpDatagramSocket(my_port))
+
+    s0 = build(17961, 17962, 0, native=False)
+    s1 = build(17962, 17961, 1, native=True)
+    run_lockstep(s0, s1, frames=60)
