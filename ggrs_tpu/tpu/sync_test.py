@@ -80,7 +80,7 @@ class TpuSyncTestSession:
             # multi-hundred-MB transient at large-world scale
             self.carry = None
         else:
-            self._build_initial_carry(game, mesh, num_players, d)
+            self._build_initial_carry()
         if backend == "xla":
             self._batch_fn = jax.jit(self._batch_impl, donate_argnums=(0,))
         elif backend.startswith("pallas-tiled"):
@@ -107,7 +107,9 @@ class TpuSyncTestSession:
         self._ticks_since_flush = 0
         self.current_frame = 0
 
-    def _build_initial_carry(self, game, mesh, num_players, d) -> None:
+    def _build_initial_carry(self) -> None:
+        game, mesh = self.game, self.mesh
+        num_players, d = self.num_players, self.check_distance
         state = game.init_state()
         if mesh is not None:
             from ..parallel.sharded import shard_ring, shard_state

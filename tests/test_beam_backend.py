@@ -53,6 +53,7 @@ def build_p2p_pair(max_prediction=6, seeds=(1234, 5678)):
         ):
             break
     assert s0.current_state() == SessionState.RUNNING
+    assert s1.current_state() == SessionState.RUNNING
     return clock, s0, s1
 
 

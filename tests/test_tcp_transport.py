@@ -122,8 +122,6 @@ def test_native_session_over_tcp_transport():
     """The native C++ session core pumps through the Python socket seam,
     so it composes with the TCP transport unchanged — full-native peer vs
     Python peer over TCP streams."""
-    import pytest
-
     from ggrs_tpu.native import available
 
     if not available():
