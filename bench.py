@@ -64,7 +64,7 @@ def _game_family(model):
 
 def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
                 bench_batches=BENCH_BATCHES, backend="pallas",
-                model="ex_game", batch=BATCH):
+                model="ex_game", batch=BATCH, mesh=None):
     """backend="pallas" runs the whole batch as one TPU kernel with carries
     resident in VMEM (~3x the XLA scan on the 4k world; bit-identical —
     tests/test_pallas_core.py, tests/test_pallas_arena.py); falls back to
@@ -83,6 +83,7 @@ def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
             check_distance=check_distance,
             flush_interval=10_000_000,  # verdict checked manually per phase
             backend=b,
+            mesh=mesh,
         )
         f = 0
         for _ in range(WARMUP_BATCHES):
@@ -135,18 +136,28 @@ def bench_roofline():
     entities at check_distance 2, see PallasSyncTestCore.VMEM_BUDGET_BYTES)."""
     HBM_PEAK_GBS = 819.0
     out = {"hbm_peak_gb_per_sec": HBM_PEAK_GBS}
-    for label, entities, d, backend, batch in (
+    for label, entities, d, backend, batch, mesh_devices in (
         # the tiled kernel streams state+ring once per BATCH, so a longer
         # batch amortizes the HBM traffic per tick: at 240 ticks/dispatch
         # a 1M-entity 8-frame rollback lands under 1ms/tick — the literal
         # north-star criterion at 256x the north-star world size
-        ("cfg_large_1m_tiled", 1048576, 8, "pallas-tiled", 240),
-        ("cfg_large_1m_xla", 1048576, 8, "xla", BATCH),
-        ("cfg_large_vmem", 262144, 2, "pallas", BATCH),
+        ("cfg_large_1m_tiled", 1048576, 8, "pallas-tiled", 240, 0),
+        # the SHARDED tiled composition (shard_map + psum'd partial
+        # checksums) on a single-chip mesh slice: same kernel per shard,
+        # so the delta vs cfg_large_1m_tiled is the multi-chip plumbing
+        # overhead — the cost of scaling the 90%-of-peak backend out
+        ("cfg_large_1m_tiled_mesh1", 1048576, 8, "pallas-tiled", 240, 1),
+        ("cfg_large_1m_xla", 1048576, 8, "xla", BATCH, 0),
+        ("cfg_large_vmem", 262144, 2, "pallas", BATCH, 0),
     ):
+        mesh = None
+        if mesh_devices:
+            from ggrs_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(mesh_devices)
         rate, ms, be, _ = bench_fused(
             entities=entities, check_distance=d, bench_batches=10,
-            backend=backend, batch=batch,
+            backend=backend, batch=batch, mesh=mesh,
         )
         state_bytes = entities * 5 * 4
         ticks_per_s = rate / d

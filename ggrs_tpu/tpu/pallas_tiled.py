@@ -54,8 +54,16 @@ class PallasTiledSyncTestCore:
     VMEM_TILE_BUDGET = 28 * 1024 * 1024
 
     def __init__(self, game, num_players: int, check_distance: int,
-                 interpret: bool = False, tile_rows: int = 0):
-        assert game.num_entities % LANE == 0, "entity count must be 128-aligned"
+                 interpret: bool = False, tile_rows: int = 0,
+                 local_entities: int = 0):
+        """`local_entities`: when nonzero, the kernel operates on that many
+        entities (one shard's slice of the world) while checksum weights
+        keep using the GLOBAL entity count — the sharded composition
+        (ShardedPallasTiledCore) runs one such local kernel per mesh device
+        and psums the partial checksums, which then match the unsharded
+        total bit-for-bit."""
+        self.n = local_entities or game.num_entities
+        assert self.n % LANE == 0, "entity count must be 128-aligned"
         self.game = game
         self.adapter = get_adapter(game)
         assert getattr(self.adapter, "tileable", False), (
@@ -67,7 +75,7 @@ class PallasTiledSyncTestCore:
         self.d = check_distance
         self.ring_len = check_distance + 2
         self.hist_len = check_distance + 2
-        self.n_rows = game.num_entities // LANE
+        self.n_rows = self.n // LANE
         self.interpret = interpret
         n_planes = len(self.adapter.planes)
         if tile_rows <= 0:
@@ -118,7 +126,7 @@ class PallasTiledSyncTestCore:
         return packed
 
     def unpack(self, p, carry, verdict):
-        n = self.game.num_entities
+        n = self.n
         groups: Dict[str, list] = {}
         for name, key, c in self.adapter.planes:
             groups.setdefault(key, []).append((c, name))
@@ -165,12 +173,6 @@ class PallasTiledSyncTestCore:
         plane_names = [name for name, _, _ in adapter.planes]
         n_tiles = self.n_tiles
 
-        gi_full = (
-            np.arange(rows, dtype=np.int32)[:, None] * LANE
-            + np.arange(LANE, dtype=np.int32)[None, :]
-        )
-        owner_full = gi_full % P
-
         vmem_names = plane_names + ["r_" + n_ for n_ in plane_names]
 
         def kernel(inputs_ref, c0_ref, iring0_ref, rframe0_ref, gi_ref,
@@ -215,15 +217,15 @@ class PallasTiledSyncTestCore:
             def ring_slot(name, slot):
                 return out[name][pl.ds(slot, 1)][0]
 
-            def partial_checksum(state, frame):
+            def partial_checksum(state):
                 # PARTIAL sums over this tile's entities; global weights
                 # ride in via the sliced gi plane. The frame term is folded
-                # by tile 0 only so the cross-tile total counts it once.
-                hi = frame * self._cs_frame_weight
-                lo = frame
-                zero = jnp.int32(0)
-                hi = jnp.where(first_tile, hi, zero)
-                lo = jnp.where(first_tile, lo, zero)
+                # once by the _verdict post-pass (NOT here), so sharded
+                # runs can psum the per-shard partials without multiply-
+                # counting it — int32 wraparound adds commute, keeping the
+                # total bit-identical to the unsharded checksum.
+                hi = jnp.int32(0)
+                lo = jnp.int32(0)
                 for name, w, base in self._cs_entries:
                     hi = hi + jnp.sum(state[name] * ((w * ctx.gi + base) * GOLDEN))
                     lo = lo + jnp.sum(state[name])
@@ -232,7 +234,7 @@ class PallasTiledSyncTestCore:
             def save_tile(state, frame, mask, t, j):
                 """Masked ring write + partial-checksum emission into the
                 cross-tile accumulator at event (t, j)."""
-                hi, lo = partial_checksum(state, frame)
+                hi, lo = partial_checksum(state)
                 slot = frame % ring_len
                 for name in plane_names:
                     old = ring_slot("r_" + name, slot)
@@ -313,7 +315,7 @@ class PallasTiledSyncTestCore:
                 memory_space=pltpu.VMEM,
             )
 
-        def run(packed, inputs_i32, c0):
+        def run(packed, inputs_i32, c0, gi, owner):
             in_specs = (
                 [
                     pl.BlockSpec(memory_space=pltpu.SMEM),  # inputs [T, P*I]
@@ -388,8 +390,8 @@ class PallasTiledSyncTestCore:
                 c0,
                 packed["iring"],
                 packed["r_frame"],
-                jnp.asarray(gi_full),
-                jnp.asarray(owner_full),
+                gi,
+                owner,
                 *[packed[n_] for n_ in plane_names],
                 *[packed["r_" + n_] for n_ in plane_names],
             )
@@ -416,11 +418,16 @@ class PallasTiledSyncTestCore:
             j_idx < d - 1, (c - d) + 1 + j_idx, c
         )  # event frame
         valid = (j_idx == d - 1) | (c > d)
+        # fold the frame checksum term here, once per event — the kernel
+        # emits pure entity partial sums so sharded runs can psum them
+        flat_frames = frames.reshape(-1)
+        ev_hi = parts_hi.reshape(-1) + flat_frames * self._cs_frame_weight
+        ev_lo = parts_lo.reshape(-1) + flat_frames
         ev = (
-            frames.reshape(-1),
+            flat_frames,
             valid.reshape(-1),
-            jax.lax.bitcast_convert_type(parts_hi.reshape(-1), jnp.uint32),
-            jax.lax.bitcast_convert_type(parts_lo.reshape(-1), jnp.uint32),
+            jax.lax.bitcast_convert_type(ev_hi, jnp.uint32),
+            jax.lax.bitcast_convert_type(ev_lo, jnp.uint32),
         )
 
         def body(hc, e):
@@ -458,7 +465,19 @@ class PallasTiledSyncTestCore:
 
     # -- public ----------------------------------------------------------
 
-    def batch(self, carry: Dict[str, Any], inputs) -> Dict[str, Any]:
+    def base_gi(self) -> np.ndarray:
+        """Local entity-index plane [n_rows, LANE]; a sharded caller adds
+        its global entity offset before handing it to run_kernel."""
+        return (
+            np.arange(self.n_rows, dtype=np.int32)[:, None] * LANE
+            + np.arange(LANE, dtype=np.int32)[None, :]
+        )
+
+    def run_kernel(self, carry, inputs, gi):
+        """pack -> kernel -> raw outputs (parts NOT yet verdict-folded).
+        `gi` is the global entity-index plane for this kernel's slice;
+        owner derives from it so round-robin ownership follows GLOBAL
+        entity ids regardless of sharding."""
         t = inputs.shape[0]
         run = self._batch(t)
         packed = self.pack(carry)
@@ -466,10 +485,101 @@ class PallasTiledSyncTestCore:
             t, self.num_players * self.input_size
         ).astype(jnp.int32)
         c0 = carry["frame"].reshape(1).astype(jnp.int32)
-        out = run(packed, inputs_i32, c0)
+        gi = jnp.asarray(gi, dtype=jnp.int32)
+        owner = gi % jnp.int32(self.num_players)
+        out = run(packed, inputs_i32, c0, gi, owner)
+        out["r_frame"] = out["r_frame_new"]
+        out["iring"] = out["iring_new"]
+        return out
+
+    def batch(self, carry: Dict[str, Any], inputs) -> Dict[str, Any]:
+        t = inputs.shape[0]
+        out = self.run_kernel(carry, inputs, self.base_gi())
         verdict = self._verdict(
             carry, out["parts_hi"], out["parts_lo"], carry["frame"], t
         )
-        out["r_frame"] = out["r_frame_new"]
-        out["iring"] = out["iring_new"]
         return self.unpack(out, carry, verdict)
+
+
+class ShardedPallasTiledCore:
+    """The entity-tiled kernel composed with a device mesh: shard_map over
+    the `entity` axis runs one local tiled kernel per device on its slice
+    of the world + ring, then psums the per-shard partial checksums (int32
+    wraparound sums are order-invariant, so the totals are bit-identical
+    to the unsharded kernel's) and runs the first-seen verdict post-pass on
+    the replicated totals. Drop-in for TpuSyncTestSession's carry with
+    `mesh=` — the multi-chip execution of the SyncTest loop
+    (src/sessions/sync_test_session.rs:85-146) at the tiled kernel's
+    bandwidth instead of the XLA scan's."""
+
+    def __init__(self, game, num_players: int, check_distance: int,
+                 mesh, interpret: bool = False):
+        assert "entity" in mesh.axis_names, "mesh needs an `entity` axis"
+        self.mesh = mesh
+        n_shards = mesh.shape["entity"]
+        assert game.num_entities % (n_shards * LANE) == 0, (
+            f"num_entities {game.num_entities} must split into "
+            f"{n_shards} 128-aligned shards"
+        )
+        self.local_n = game.num_entities // n_shards
+        self.inner = PallasTiledSyncTestCore(
+            game, num_players, check_distance, interpret=interpret,
+            local_entities=self.local_n,
+        )
+        self.game = game
+
+    def _carry_specs(self, carry):
+        from jax.sharding import PartitionSpec as P
+
+        return {
+            "state": jax.tree.map(
+                lambda x: P("entity") if x.ndim >= 1 else P(),
+                carry["state"],
+            ),
+            "ring": jax.tree.map(
+                lambda x: P(None, "entity") if x.ndim >= 2 else P(),
+                carry["ring"],
+            ),
+            "input_ring": P(),
+            "h_tag": P(),
+            "h_hi": P(),
+            "h_lo": P(),
+            "mismatch": P(),
+            "mismatch_frame": P(),
+            "frame": P(),
+        }
+
+    def batch(self, carry: Dict[str, Any], inputs) -> Dict[str, Any]:
+        from jax.sharding import PartitionSpec as P
+
+        inner = self.inner
+        t = inputs.shape[0]
+        specs = self._carry_specs(carry)
+        base_gi = inner.base_gi()
+
+        def body(carry, inputs):
+            idx = jax.lax.axis_index("entity")
+            gi = jnp.asarray(base_gi) + idx.astype(jnp.int32) * jnp.int32(
+                self.local_n
+            )
+            out = inner.run_kernel(carry, inputs, gi)
+            # the ONLY cross-shard collective in the hot loop: wraparound
+            # partial-checksum sums ride ICI; everything else is local
+            out["parts_hi"] = jax.lax.psum(out["parts_hi"], "entity")
+            out["parts_lo"] = jax.lax.psum(out["parts_lo"], "entity")
+            verdict = inner._verdict(
+                carry, out["parts_hi"], out["parts_lo"], carry["frame"], t
+            )
+            return inner.unpack(out, carry, verdict)
+
+        shard_fn = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(specs, P()),
+            out_specs=specs,
+            # pallas outputs defeat replication inference; the replicated
+            # outs (iring, verdict carry) are computed identically on every
+            # shard from replicated inputs (+psum'd totals)
+            check_vma=False,
+        )
+        return shard_fn(carry, inputs)

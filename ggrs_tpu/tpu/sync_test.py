@@ -61,7 +61,11 @@ class TpuSyncTestSession:
             "xla", "pallas", "pallas-interpret",
             "pallas-tiled", "pallas-tiled-interpret",
         )
-        assert backend == "xla" or mesh is None, "pallas path is unsharded"
+        assert (
+            backend == "xla"
+            or backend.startswith("pallas-tiled")
+            or mesh is None
+        ), "the whole-batch pallas kernel is unsharded"
         self.game = game
         self.num_players = num_players
         self.check_distance = check_distance
@@ -84,14 +88,25 @@ class TpuSyncTestSession:
         if backend == "xla":
             self._batch_fn = jax.jit(self._batch_impl, donate_argnums=(0,))
         elif backend.startswith("pallas-tiled"):
-            from .pallas_tiled import PallasTiledSyncTestCore
+            if mesh is not None:
+                from .pallas_tiled import ShardedPallasTiledCore
 
-            core = PallasTiledSyncTestCore(
-                game,
-                num_players,
-                check_distance,
-                interpret=backend.endswith("-interpret"),
-            )
+                core = ShardedPallasTiledCore(
+                    game,
+                    num_players,
+                    check_distance,
+                    mesh,
+                    interpret=backend.endswith("-interpret"),
+                )
+            else:
+                from .pallas_tiled import PallasTiledSyncTestCore
+
+                core = PallasTiledSyncTestCore(
+                    game,
+                    num_players,
+                    check_distance,
+                    interpret=backend.endswith("-interpret"),
+                )
             self._batch_fn = jax.jit(core.batch, donate_argnums=(0,))
         else:
             from .pallas_core import PallasSyncTestCore
