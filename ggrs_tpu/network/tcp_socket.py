@@ -36,7 +36,11 @@ _MAX_FRAME = 65532
 class _Conn:
     def __init__(self, sock: _socket.socket, peer: Optional[Tuple[str, int]]):
         self.sock = sock
-        self.peer = peer  # canonical (host, listen_port); None until HELLO
+        # canonical (numeric IP, listen_port); None until HELLO. User-facing
+        # attribution resolves through the socket's alias map at READ time
+        # (not latched here): the alias may only be registered by a later
+        # outgoing send.
+        self.peer = peer
         self.outbuf = bytearray()
         self.inbuf = bytearray()
         self.dead = False
@@ -103,10 +107,35 @@ class TcpDatagramSocket:
         self._listener.setblocking(False)
         self._all: List[_Conn] = []  # every live stream (polled for reads)
         self._conns: Dict[Tuple[str, int], _Conn] = {}  # canonical -> send route
+        self._resolved: Dict[str, str] = {}  # hostname -> numeric IP cache
+        # canonical -> the address form the user sent to: sessions route
+        # inbound messages by their CONFIGURED address, so attribution must
+        # echo that form back, not the resolved IP
+        self._alias: Dict[Tuple[str, int], Any] = {}
 
     @property
     def local_port(self) -> int:
         return self._listener.getsockname()[1]
+
+    def _canon(self, addr: Any) -> Tuple[str, int]:
+        """Canonical route key: (numeric IP, port). Incoming messages are
+        attributed to (getpeername() IP, HELLO listen port) — numeric — so
+        a session configured with a hostname ('localhost') must resolve to
+        the same key or its inbound traffic would never match the send
+        route. Resolution is cached: this runs on every send."""
+        host, port = tuple(addr)
+        ip = self._resolved.get(host)
+        if ip is None:
+            try:
+                ip = _socket.gethostbyname(host)
+            except OSError:
+                # transient DNS failure: do NOT cache it — the next send
+                # retries resolution (a cached failure would blackhole the
+                # peer for the socket's lifetime); meanwhile the verbatim
+                # key just loses this datagram, the seam's contract
+                return (host, int(port))
+            self._resolved[host] = ip
+        return (ip, int(port))
 
     # -- outgoing ----------------------------------------------------------
 
@@ -125,7 +154,9 @@ class TcpDatagramSocket:
         return conn
 
     def send_wire(self, wire: bytes, addr: Any) -> None:
-        addr = tuple(addr)
+        canon = self._canon(addr)
+        self._alias.setdefault(canon, tuple(addr))
+        addr = canon
         conn = self._conns.get(addr)
         if conn is None or conn.dead:
             conn = self._connect(addr)
@@ -161,17 +192,19 @@ class TcpDatagramSocket:
                     except OSError:
                         conn.dead = True
                         break
-                    peer = (host, int.from_bytes(payload, "big"))
-                    conn.peer = peer
+                    canon = (host, int.from_bytes(payload, "big"))
+                    conn.peer = canon
                     # most-recent HELLO wins the send route: a peer that
                     # silently restarted (no FIN/RST — its old stream looks
                     # alive for the TCP retransmit window, ~minutes) dials
                     # back in and must take over immediately; duplicates
                     # (both sides dialing at once) are all still polled
                     # via _all
-                    self._conns[peer] = conn
+                    self._conns[canon] = conn
                 elif kind == _DATA and conn.peer is not None:
-                    received.append((conn.peer, payload))
+                    received.append(
+                        (self._alias.get(conn.peer, conn.peer), payload)
+                    )
             conn.flush()  # opportunistic drain of queued writes
 
         for conn in [c for c in self._all if c.dead]:

@@ -552,15 +552,23 @@ class TpuRollbackBackend:
     def save(self, path: str) -> None:
         from ..utils.checkpoint import save_device_checkpoint
 
+        tree = {"ring": self.core.ring, "state": self.core.state}
+        if self.core.device_verify:
+            # the accumulated first-seen history + mismatch latch resume
+            # with the run: without it a restored device-verify run would
+            # silently restart its history (and check() would trip on the
+            # missing pytree)
+            tree["verify"] = self.core.verify
         save_device_checkpoint(
             path,
-            {"ring": self.core.ring, "state": self.core.state},
+            tree,
             {
                 "kind": "TpuRollbackBackend",
                 "current_frame": self.current_frame,
                 "max_prediction": self.core.max_prediction,
                 "num_players": self.num_players,
                 "beam_width": self.beam_width,
+                "device_verify": self.core.device_verify,
             },
         )
 
@@ -576,6 +584,7 @@ class TpuRollbackBackend:
             num_players=meta["num_players"],
             beam_width=meta.get("beam_width", 0),
             mesh=mesh,
+            device_verify=meta.get("device_verify", False),
         )
         # re-place onto the freshly-built core's shardings (sharded under a
         # mesh, single-device otherwise) — checkpoints are layout-agnostic
@@ -585,5 +594,10 @@ class TpuRollbackBackend:
         backend.core.state = jax.device_put(
             tree["state"], jax.tree.map(lambda a: a.sharding, backend.core.state)
         )
+        if meta.get("device_verify", False):
+            backend.core.verify = jax.device_put(
+                tree["verify"],
+                jax.tree.map(lambda a: a.sharding, backend.core.verify),
+            )
         backend.current_frame = meta["current_frame"]
         return backend

@@ -66,12 +66,16 @@ def repeat_last_beam(
 
     last_inputs: u8[P, I]. Returns u8[B, W, P, I].
     """
-    p, _i = last_inputs.shape
+    p, i = last_inputs.shape
     beam = np.tile(last_inputs, (beam_width, window, 1, 1))
     for b in range(1, beam_width):
         player = (b - 1) % p
-        pattern = ((b - 1) // p + 1) & 0xFF
-        beam[b, :, player, 0] ^= pattern
+        k = (b - 1) // p
+        # cycle the perturbed byte across the full input width and keep the
+        # XOR value in [1, 255] so no candidate ever collapses into member 0
+        byte = (k // 255) % i
+        pattern = k % 255 + 1
+        beam[b, :, player, byte] ^= np.uint8(pattern)
     return beam
 
 
@@ -129,10 +133,13 @@ def branching_beam(
                 yield ("one", k, False, pl)
                 if k > 0:  # one-back@0 duplicates member 0 (all-last)
                     yield ("one", k, True, pl)
-        pattern = 1
+        k = 0
         while True:
-            yield ("xor", pl, pattern)
-            pattern += 1
+            # cycle over every input byte (arena's analog throttle byte gets
+            # candidate diversity too) with XOR values in [1, 255] — a zero
+            # value would emit a duplicate of member 0
+            yield ("xor", pl, (k // 255) % _i, k % 255 + 1)
+            k += 1
 
     def all_stream():
         for k in range(max_offset):
@@ -155,8 +162,8 @@ def branching_beam(
                 exhausted[si] = True
                 continue
             if spec[0] == "xor":
-                _, pl, pattern = spec
-                beam[b, :, pl, 0] ^= np.uint8(pattern & 0xFF)
+                _, pl, byte, pattern = spec
+                beam[b, :, pl, byte] ^= np.uint8(pattern)
             else:
                 kind, k, back = spec[0], spec[1], spec[2]
                 players = toggling if kind == "all" else [spec[3]]

@@ -239,6 +239,26 @@ class ArenaPlanes(PlaneAdapter):
         ("hp", "hp", None), ("energy", "energy", None),
     )
 
+    def __init__(self, game):
+        super().__init__(game)
+        # the centroid division runs through _exact_floor_div_wide, whose
+        # verified contract is b in [1, 2^16) and |a| < 2^30: per-team live
+        # counts (the divisor) are bounded by ceil(N/P), and the centroid
+        # sums by count * (ARENA_MASK >> CENTROID_SHIFT) < 2^28 under the
+        # same bound — enforce it rather than assume it (an arena inside
+        # the VMEM envelope can otherwise exceed both ranges)
+        from ..models import arena
+
+        per_team = -(-game.num_entities // game.num_players)  # ceil
+        assert per_team < (1 << 16), (
+            f"arena pallas kernel: per-team entity count {per_team} exceeds "
+            "the exact-division contract (divisor must stay < 2^16); use "
+            "the XLA backend or more players"
+        )
+        assert per_team * (arena.ARENA_MASK >> arena.CENTROID_SHIFT) < (
+            1 << 30
+        ), "arena pallas kernel: centroid sum exceeds the 2^30 budget"
+
     def step(self, pl, inputs, ctx):
         from ..models import arena
 

@@ -30,6 +30,42 @@ from ..errors import MismatchedChecksum
 from ..types import InputStatus
 
 
+def _pick_backend(game, check_distance: int, mesh) -> str:
+    """Resolve backend="auto": the fastest kernel this configuration
+    supports, by construction-time-checkable criteria only (adapter
+    registered, 128-aligned entities, VMEM envelope, tileability, shard
+    divisibility). Non-TPU platforms always get the XLA scan — the pallas
+    kernels compile for TPU hardware (tests opt into interpret mode
+    explicitly)."""
+    if jax.devices()[0].platform != "tpu":
+        return "xla"
+    from .pallas_core import PallasSyncTestCore, get_adapter
+
+    try:
+        # adapter CONSTRUCTION can reject a config outright (no adapter
+        # registered, or a model-envelope assert like arena's centroid
+        # division bound) — any such rejection means "auto" answers "xla",
+        # never a construction-time crash
+        adapter = get_adapter(game)
+    except Exception:
+        return "xla"
+    if game.num_entities % 128 != 0:
+        return "xla"
+    if mesh is None:
+        n_planes = len(adapter.planes)
+        vmem_est = (
+            2 * n_planes * (1 + check_distance + 2) * game.num_entities * 4
+        )
+        if vmem_est <= PallasSyncTestCore.VMEM_BUDGET_BYTES:
+            return "pallas"
+    if getattr(adapter, "tileable", False) and (
+        mesh is None
+        or game.num_entities % (mesh.shape["entity"] * 128) == 0
+    ):
+        return "pallas-tiled"
+    return "xla"
+
+
 class TpuSyncTestSession:
     def __init__(
         self,
@@ -37,9 +73,9 @@ class TpuSyncTestSession:
         num_players: int,
         check_distance: int,
         input_delay: int = 0,
-        flush_interval: int = 1,
+        flush_interval: Optional[int] = None,
         mesh=None,
-        backend: str = "xla",
+        backend: str = "auto",
         _defer_carry: bool = False,
     ):
         """`mesh`: optional jax Mesh with an `entity` axis — the world state
@@ -47,7 +83,23 @@ class TpuSyncTestSession:
         partitions the fused scan, and the checksum reduction becomes the
         only cross-shard collective.
 
-        `backend`: "xla" (lax.scan; works everywhere, required for mesh),
+        `flush_interval`: None (the default) defers the determinism verdict
+        entirely to explicit `check()` calls — the mismatch latch is
+        device-resident and durable (the first divergence stays latched
+        with its frame), so nothing is lost by checking late, and the
+        out-of-box configuration pays ZERO per-batch host readbacks (on a
+        tunneled device each costs ~100ms — the exact overhead the fused
+        design exists to avoid). Pass an integer to auto-check every that
+        many ticks instead (a periodic safety net for long unattended
+        runs).
+
+        `backend`: "auto" (the default) resolves to the fastest kernel the
+        configuration supports — on TPU, the whole-batch pallas kernel
+        inside its VMEM envelope, the entity-tiled kernel for larger
+        tileable worlds (sharded or not), the XLA scan otherwise (and
+        always on non-TPU platforms) — so the out-of-box session runs at
+        the tuned-bench backend, not the fallback. Explicit choices:
+        "xla" (lax.scan; works everywhere; the mesh-sharded scan),
         "pallas" (whole batch as one TPU kernel, every carry resident in
         VMEM — see ggrs_tpu.tpu.pallas_core; bit-identical carries, much
         faster on small worlds where per-op overhead dominates; capped by
@@ -58,9 +110,12 @@ class TpuSyncTestSession:
         interpreter mode (CPU tests)."""
         assert check_distance >= 1
         assert backend in (
-            "xla", "pallas", "pallas-interpret",
+            "auto", "xla", "pallas", "pallas-interpret",
             "pallas-tiled", "pallas-tiled-interpret",
         )
+        if backend == "auto":
+            backend = _pick_backend(game, check_distance, mesh)
+        self.backend = backend
         assert (
             backend == "xla"
             or backend.startswith("pallas-tiled")
@@ -70,7 +125,9 @@ class TpuSyncTestSession:
         self.num_players = num_players
         self.check_distance = check_distance
         self.input_delay = input_delay
-        self.flush_interval = max(1, flush_interval)
+        self.flush_interval = (
+            None if flush_interval is None else max(1, flush_interval)
+        )
         self.mesh = mesh
 
         d = check_distance
@@ -256,7 +313,10 @@ class TpuSyncTestSession:
         self.carry = self._batch_fn(self.carry, jnp.asarray(eff))
         self.current_frame += t
         self._ticks_since_flush += t
-        if self._ticks_since_flush >= self.flush_interval:
+        if (
+            self.flush_interval is not None
+            and self._ticks_since_flush >= self.flush_interval
+        ):
             self.check()
 
     def check(self) -> None:
@@ -290,8 +350,8 @@ class TpuSyncTestSession:
         save_device_checkpoint(path, self.carry, meta)
 
     @classmethod
-    def restore(cls, path: str, game, flush_interval: int = 1,
-                backend: str = "xla") -> "TpuSyncTestSession":
+    def restore(cls, path: str, game, flush_interval: Optional[int] = None,
+                backend: str = "auto") -> "TpuSyncTestSession":
         """Checkpoints are backend-agnostic (the carry pytree is identical
         across the XLA scan and both pallas kernels), so a run saved under
         one backend can resume under any other."""

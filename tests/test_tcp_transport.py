@@ -107,6 +107,25 @@ def test_tcp_socket_wire_roundtrip():
     b.close()
 
 
+def test_p2p_over_tcp_with_hostname_addresses():
+    """Sessions configured with a hostname ('localhost') instead of a
+    numeric IP: inbound attribution must echo the CONFIGURED address form
+    or every received message silently misses the endpoint route."""
+
+    def build(my_port, other_port, handle):
+        return (
+            SessionBuilder(input_size=1)
+            .with_num_players(2)
+            .with_max_prediction_window(8)
+            .add_player(PlayerType.local(), handle)
+            .add_player(PlayerType.remote(("localhost", other_port)), 1 - handle)
+            .start_p2p_session(TcpDatagramSocket(my_port))
+        )
+
+    s0, s1 = build(17959, 17960, 0), build(17960, 17959, 1)
+    run_lockstep(s0, s1, frames=40)
+
+
 def test_dead_stream_is_datagram_loss_not_crash():
     a = TcpDatagramSocket(17957)
     # nobody listens on 17958: the dialed stream dies; sends must neither
