@@ -184,7 +184,9 @@ class TpuRollbackBackend:
     """
 
     def __init__(self, game, max_prediction: int, num_players: int,
-                 beam_width: int = 0, mesh=None, device_verify: bool = False):
+                 beam_width: int = 0, mesh=None, device_verify: bool = False,
+                 speculation_gate: str = "always",
+                 defer_speculation: bool = False):
         """`mesh`: optional jax Mesh with an `entity` axis — the world and
         its snapshot ring shard across it (see ResimCore); the session-facing
         contract (requests in, SnapshotRefs + lazy checksums out) is
@@ -196,7 +198,25 @@ class TpuRollbackBackend:
         mismatch verdict ON DEVICE (read with check()) so determinism runs
         never pay per-burst checksum readbacks — ~100ms a pop on a
         tunneled device. Only for confirmed-input replay (SyncTest): P2P
-        rollbacks legitimately re-save corrected frames."""
+        rollbacks legitimately re-save corrected frames.
+
+        `speculation_gate`: "always" launches a speculation every tick
+        (pays B*L speculative steps of device time unconditionally);
+        "adaptive" launches only when the measured idle time between ticks
+        covers the measured speculation cost — on a paced loop with spare
+        frame budget the beam rides idle device time for free, on an
+        oversubscribed loop it automatically stands down instead of
+        delaying real work. The cost is measured once in warmup()
+        (required for adaptive mode); host-loop idle is the proxy for
+        device idle — the tunnel's async dispatch hides true device
+        occupancy from the host.
+
+        `defer_speculation`: keep the speculation launch OFF the tick's
+        critical path — handle_requests() only fulfills requests; the
+        caller launches the (gated) speculation from its idle time via
+        launch_pending_speculation(). The launch costs ~1ms of host time
+        (candidate generation + dispatch), which a real-time loop should
+        pay after presenting the frame, not before."""
         self.core = ResimCore(
             game, max_prediction, num_players, mesh=mesh,
             device_verify=device_verify,
@@ -239,8 +259,15 @@ class TpuRollbackBackend:
         self.beam_width = beam_width
         self._spec = None  # (anchor_frame, beam_inputs, device results)
         self._last_segment = None  # launch args, deferred to end of tick
-        self.beam_hits = 0
+        self.beam_hits = 0  # full adoptions (every corrected frame served)
+        self.beam_partial_hits = 0  # prefix adoptions (suffix resimulated)
         self.beam_misses = 0
+        # THE adoption metric: fraction of rollback frames served from
+        # speculation = rollback_frames_adopted / rollback_frames (a full
+        # hit serves all of a rollback's frames, a partial hit its matched
+        # prefix) — honest about partial wins in a way hit counts aren't
+        self.rollback_frames = 0
+        self.rollback_frames_adopted = 0
         # per-player input history feeding the branching candidate
         # generator: last row seen and the previous DISTINCT row (the
         # toggle partner). Rows with predicted values repeat the last
@@ -257,6 +284,13 @@ class TpuRollbackBackend:
         # the next speculation anchors one frame deeper than the depth
         # predicts so ±1 jitter still lands inside the member window
         self._depth = 2
+        assert speculation_gate in ("always", "adaptive")
+        self.speculation_gate = speculation_gate
+        self.defer_speculation = defer_speculation
+        self.beam_gated = 0  # ticks where the gate skipped speculation
+        self._spec_cost_s: Optional[float] = None  # measured in warmup()
+        self._idle_ema_s = 0.0
+        self._last_tick_end: Optional[float] = None
 
     # ------------------------------------------------------------------
 
@@ -265,6 +299,16 @@ class TpuRollbackBackend:
         legally contain two rollback blocks (misprediction rollback + ring
         keepalive rollback, p2p_session.rs:286+:792): split into one batch
         per LoadGameState and fuse each."""
+        import time as _time
+
+        if self.speculation_gate == "adaptive":
+            now = _time.perf_counter()
+            if self._last_tick_end is not None:
+                idle = now - self._last_tick_end
+                # EMA over ~10 ticks: reacts to phase changes (a pause
+                # menu, a scene load) without flapping on single-frame
+                # jitter
+                self._idle_ema_s = 0.9 * self._idle_ema_s + 0.1 * idle
         segment: List[Request] = []
         for req in requests:
             if isinstance(req, LoadGameState) and segment:
@@ -279,9 +323,34 @@ class TpuRollbackBackend:
         # fresh launch every tick keeps the candidates built from the
         # newest input history, which measures as a much higher hit rate
         # than reusing a standing rollout across ticks.
+        if not self.defer_speculation:
+            self.launch_pending_speculation()
+        if self.speculation_gate == "adaptive":
+            self._last_tick_end = _time.perf_counter()
+
+    def launch_pending_speculation(self) -> None:
+        """Launch (or gate) the speculation staged by the last tick. With
+        defer_speculation=True, call this from loop idle time after the
+        frame's critical path; otherwise handle_requests calls it
+        automatically."""
         if self.beam_width and self._last_segment is not None:
-            self._launch_speculation(*self._last_segment)
+            if self._speculation_affordable():
+                self._launch_speculation(*self._last_segment)
+            else:
+                self.beam_gated += 1
             self._last_segment = None
+
+    def _speculation_affordable(self) -> bool:
+        """The adaptive gate: speculation is worth launching only when the
+        loop's idle time can absorb its device cost — otherwise the B*L
+        speculative steps delay the NEXT real tick by more than an adopted
+        rollback could ever save. 80% slack biases toward speculating
+        (a near-covered cost still wins when a deep rollback adopts)."""
+        if self.speculation_gate != "adaptive":
+            return True
+        if self._spec_cost_s is None:
+            return True  # not yet measured (warmup pending): don't stall
+        return self._idle_ema_s >= 0.8 * self._spec_cost_s
 
     def _run_segment(self, requests: List[Request]) -> None:
         load: Optional[LoadGameState] = None
@@ -335,11 +404,17 @@ class TpuRollbackBackend:
             saves.append((count, trailing_save))
 
         his = los = None
+        if load is not None:
+            self.rollback_frames += count
         if load is not None and self._spec is not None:
-            matched = self._match_speculation(load.frame, inputs, statuses, count)
-            if matched is not None:
-                member, shift = matched
-                self.beam_hits += 1
+            match = self._match_speculation(load.frame, inputs, statuses, count)
+            if match is not None:
+                member, shift, matched = match
+                if matched == count:
+                    self.beam_hits += 1
+                else:
+                    self.beam_partial_hits += 1
+                self.rollback_frames_adopted += matched
                 with GLOBAL_TRACER.span("tpu/beam_adopt"):
                     his, los = core.adopt(
                         self._spec[2],
@@ -349,6 +424,9 @@ class TpuRollbackBackend:
                         count,
                         shift=shift,
                         load_frame=load.frame,
+                        inputs=inputs,
+                        statuses=statuses,
+                        matched=matched,
                     )
             else:
                 self.beam_misses += 1
@@ -405,20 +483,30 @@ class TpuRollbackBackend:
     def _match_speculation(
         self, load_frame: Frame, inputs: np.ndarray, statuses: np.ndarray,
         count: int,
-    ) -> Optional[Tuple[int, int]]:
-        """Returns (member, shift) of an adoptable speculation, else None.
-        shift = load_frame - anchor_frame: the member must ALSO match the
-        inputs actually played for frames anchor..load (its trajectory
-        baked them in) — rollback depth jitter then lands inside the same
-        speculated window instead of invalidating it."""
-        from .beam import match_beam_prefixed
+    ) -> Optional[Tuple[int, int, int]]:
+        """Returns (member, shift, matched) of an adoptable speculation,
+        else None. shift = load_frame - anchor_frame: the member must ALSO
+        match the inputs actually played for frames anchor..load (its
+        trajectory baked them in) — rollback depth jitter then lands inside
+        the same speculated window instead of invalidating it. `matched`
+        is the longest leading run of the corrected script the member's
+        rows cover (src/input_queue.rs:167-204's localization, fused): the
+        suffix past it resimulates in the same adopt dispatch."""
+        from .beam import match_beam_longest
 
         anchor_frame, beam_inputs, _ = self._spec
         shift = load_frame - anchor_frame
-        if shift < 0 or shift + count > beam_inputs.shape[1]:
+        if shift < 0 or shift >= beam_inputs.shape[1]:
             return None
-        # a disconnected player's dummy inputs were not speculated
-        if (statuses[:count] >= int(InputStatus.DISCONNECTED)).any():
+        # a disconnected player's dummy inputs were not speculated: the
+        # adopted prefix must stop before the first disconnect row (the
+        # resimulated suffix handles them like any plain tick)
+        clean = 0
+        while clean < count and (
+            statuses[clean] < int(InputStatus.DISCONNECTED)
+        ).all():
+            clean += 1
+        if clean == 0:
             return None
         prefix_rows = []
         for j in range(shift):
@@ -434,8 +522,12 @@ class TpuRollbackBackend:
             if prefix_rows
             else np.zeros((0,) + inputs.shape[1:], dtype=np.uint8)
         )
-        member = match_beam_prefixed(beam_inputs, prefix, inputs[:count])
-        return None if member is None else (member, shift)
+        matched, member = match_beam_longest(
+            beam_inputs, prefix, inputs[:clean]
+        )
+        if member is None or matched == 0:
+            return None
+        return (member, shift, matched)
 
     def _launch_speculation(self, load: Optional[LoadGameState],
                             start_frame: Frame, count: int,
@@ -485,6 +577,30 @@ class TpuRollbackBackend:
 
     # ------------------------------------------------------------------
 
+    def reset(self) -> None:
+        """Fresh-session state without recompilation: the core returns to
+        its initial world/ring, every counter and speculation artifact
+        clears, but compiled programs and the measured speculation cost
+        survive — back-to-back sessions (benchmark arms, rematches) skip
+        the tens-of-seconds tunnel compile a new backend would pay."""
+        self.core.reset()
+        self.current_frame = 0
+        self.ledger = ChecksumLedger()
+        self._spec = None
+        self._last_segment = None
+        self.beam_hits = 0
+        self.beam_partial_hits = 0
+        self.beam_misses = 0
+        self.beam_gated = 0
+        self.rollback_frames = 0
+        self.rollback_frames_adopted = 0
+        self._last_inputs[:] = 0
+        self._prev_inputs[:] = 0
+        self._played.clear()
+        self._depth = 2
+        self._idle_ema_s = 0.0
+        self._last_tick_end = None
+
     def warmup(self) -> None:
         """Compile every device program this backend can dispatch (tick,
         speculation, adoption) before entering a real-time loop: first
@@ -525,6 +641,26 @@ class TpuRollbackBackend:
                 )
                 spec = core.speculate(0, full_beam[:, :rollout], beam_statuses)
                 core.adopt(spec, 0, 0, scratch, 1)
+            # measure the post-compile speculation cost for the adaptive
+            # gate: a few amortized dispatches at the mid rollout length
+            # under a TRUE barrier (block_until_ready is dispatch-ack only
+            # on the tunnel)
+            import time as _time
+
+            from ..utils.barrier import true_barrier
+
+            rollout = rollouts[len(rollouts) // 2]
+            beam_statuses = np.zeros(
+                (self.beam_width, rollout, P), dtype=np.int32
+            )
+            spec = core.speculate(0, full_beam[:, :rollout], beam_statuses)
+            true_barrier(spec[1])
+            n = 5
+            t0 = _time.perf_counter()
+            for _ in range(n):
+                spec = core.speculate(0, full_beam[:, :rollout], beam_statuses)
+            true_barrier(spec[1])
+            self._spec_cost_s = (_time.perf_counter() - t0) / n
         core.ring, core.state = ring0, state0
         self.block_until_ready()
 

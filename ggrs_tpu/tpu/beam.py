@@ -214,3 +214,38 @@ def match_beam_prefixed(
         ):
             return b
     return None
+
+
+def match_beam_longest(
+    beam_inputs: np.ndarray,
+    prefix_inputs: np.ndarray,
+    actual_inputs: np.ndarray,
+) -> Tuple[int, Optional[int]]:
+    """Longest-prefix variant of match_beam_prefixed: returns (matched,
+    member) where `member` is the played-prefix-compatible member whose rows
+    match the LONGEST leading run of the corrected script, and `matched` is
+    that run's length (0, None when no member clears the played prefix or
+    matches even the first corrected row). The TPU analog of the
+    reference's per-player misprediction localization
+    (src/input_queue.rs:167-204): one wrong byte costs the suffix, not the
+    whole precomputed trajectory. Full matches win ties by construction
+    (matched == actual_inputs.shape[0]).
+
+    prefix_inputs: u8[S, P, I]; actual_inputs: u8[K, P, I].
+    """
+    s, k = prefix_inputs.shape[0], actual_inputs.shape[0]
+    best_m, best_b = 0, None
+    for b in range(beam_inputs.shape[0]):
+        if not np.array_equal(beam_inputs[b, :s], prefix_inputs):
+            continue
+        kmax = min(k, beam_inputs.shape[1] - s)
+        m = 0
+        while m < kmax and np.array_equal(
+            beam_inputs[b, s + m], actual_inputs[m]
+        ):
+            m += 1
+        if m > best_m:
+            best_m, best_b = m, b
+            if m == k:
+                break
+    return best_m, best_b

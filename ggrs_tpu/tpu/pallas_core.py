@@ -434,14 +434,23 @@ class PallasSyncTestCore:
     # fall back to the XLA scan.
     VMEM_BUDGET_BYTES = 96 * 1024 * 1024
 
+    @classmethod
+    def vmem_estimate(cls, game, check_distance: int, adapter=None) -> int:
+        """Bytes of VMEM windows this config needs (state + ring planes,
+        in and out). THE single formula — backend='auto' consults it too,
+        so the selector can never drift from what construction enforces."""
+        if adapter is None:
+            adapter = get_adapter(game)
+        n_planes = len(adapter.planes)
+        plane_bytes = game.num_entities * 4
+        return 2 * n_planes * (1 + check_distance + 2) * plane_bytes
+
     def __init__(self, game, num_players: int, check_distance: int,
                  interpret: bool = False):
         assert game.num_entities % 128 == 0, "entity count must be 128-aligned"
         self.game = game
         self.adapter = get_adapter(game)
-        n_planes = len(self.adapter.planes)
-        plane_bytes = game.num_entities * 4
-        vmem_est = 2 * n_planes * (1 + check_distance + 2) * plane_bytes
+        vmem_est = self.vmem_estimate(game, check_distance, self.adapter)
         if not interpret and vmem_est > self.VMEM_BUDGET_BYTES:
             raise ValueError(
                 f"world too large for the VMEM-resident kernel: ~{vmem_est >> 20}MB "

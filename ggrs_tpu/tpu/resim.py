@@ -22,7 +22,7 @@ Buffers are donated, so the ring is updated in place across ticks.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -118,14 +118,19 @@ class ResimCore:
         # tick's packed control-word layout (pack site: tick(); unpack:
         # _tick_packed_impl): 4 header words (do_load, load_slot,
         # advance_count, start_frame), then save_slots[W], statuses[W*P],
-        # inputs[W*P*I]. The adopt path has its OWN layout — 5 header
-        # words (member, load_slot, advance_count, shift, load_frame) then
-        # save_slots[W] — see adopt()/_adopt_impl.
+        # inputs[W*P*I]. The adopt path has its OWN layout — 6 header
+        # words (member, load_slot, advance_count, shift, load_frame,
+        # matched), then save_slots[W], statuses[W*P], inputs[W*P*I] (the
+        # suffix resim rows) — see adopt()/_adopt_impl.
         p, i = num_players, game.input_size
         self._off_save = 4
         self._off_status = self._off_save + self.window
         self._off_input = self._off_status + self.window * p
         self._packed_len = self._off_input + self.window * p * i
+        self._aoff_save = 6
+        self._aoff_status = self._aoff_save + self.window
+        self._aoff_input = self._aoff_status + self.window * p
+        self._apacked_len = self._aoff_input + self.window * p * i
 
     # ------------------------------------------------------------------
 
@@ -327,23 +332,37 @@ class ResimCore:
 
     def _adopt_impl(self, ring, traj, spec_his, spec_los, a_hi, a_lo, verify,
                     packed):
-        """Commit a beam member's trajectory as this tick's result: fill the
-        requested ring slots with its per-frame states (slot i = state at
-        load_frame + i, exactly what _tick_impl's resim would have saved)
-        and set the live state to the final frame. `shift` offsets into the
-        trajectory: the speculation was anchored `shift` frames BEFORE the
-        rollback's load frame (member frames anchor+1..anchor+W, so frame
-        load+i is trajectory index shift+i-1) — rollback depth can jitter
-        without invalidating the whole speculation. Checksums come from the
-        speculation (the anchor's own plus one per member step), so no step
-        or checksum math reruns here. Control words ride one packed array
-        for the same one-transfer reason as _tick_packed_impl."""
+        """Commit a beam member's trajectory as (the prefix of) this tick's
+        result. The first `matched` frames are served from the speculation:
+        ring slots fill with the member's precomputed per-frame states
+        (slot i = state at load_frame + i = trajectory index shift+i-1) and
+        their checksums come from the speculation — no step or checksum
+        math reruns. Frames past `matched` RESIMULATE from the member's
+        frame load+matched state with the actual corrected inputs, exactly
+        like _tick_impl, in this same dispatch — one wrong byte from one
+        player no longer discards an otherwise-correct trajectory, it
+        costs only the mispredicted suffix (the TPU analog of the
+        reference's per-player misprediction localization,
+        src/input_queue.rs:167-204). `matched == advance_count` is the
+        full adoption. `shift` offsets into the trajectory: the
+        speculation was anchored `shift` frames BEFORE the rollback's load
+        frame — depth jitter doesn't invalidate the speculation. Control
+        words + suffix inputs ride one packed array for the same
+        one-transfer reason as _tick_packed_impl."""
+        W, P, I = self.window, self.num_players, self.game.input_size
         member = packed[0]
         load_slot = packed[1]
         advance_count = packed[2]
         shift = packed[3]
         load_frame = packed[4]
-        save_slots = packed[5 : 5 + self.window]
+        matched = packed[5]
+        save_slots = packed[self._aoff_save : self._aoff_status]
+        statuses = packed[self._aoff_status : self._aoff_input].reshape(W, P)
+        inputs = (
+            packed[self._aoff_input : self._apacked_len]
+            .astype(jnp.uint8)
+            .reshape(W, P, I)
+        )
         loaded = jax.tree.map(
             lambda r: jax.lax.dynamic_index_in_dim(r, load_slot, 0, keepdims=False),
             ring,
@@ -354,9 +373,9 @@ class ResimCore:
         )
         mhis = jax.lax.dynamic_index_in_dim(spec_his, member, 0, keepdims=False)
         mlos = jax.lax.dynamic_index_in_dim(spec_los, member, 0, keepdims=False)
-        # checksums of frames anchor..anchor+W, windowed at shift; zero-pad
-        # so dynamic_slice never clamps (entries past shift+count are only
-        # ever consumed by scratch-slot saves, so the padding is dead)
+        # checksums of frames anchor..anchor+rollout, windowed at shift;
+        # zero-pad so dynamic_slice never clamps (entries past shift+matched
+        # are never read: suffix saves compute their checksums fresh)
         pad = jnp.zeros((self.window - 1,), dtype=a_hi.dtype)
         full_hi = jnp.concatenate([a_hi[None], mhis, pad])
         full_lo = jnp.concatenate([a_lo[None], mlos, pad])
@@ -366,72 +385,128 @@ class ResimCore:
         iota = jnp.arange(self.window, dtype=jnp.int32)
 
         def body(carry, xs):
-            ring, verify = carry
-            i, save_slot, hi, lo = xs
+            ring, state, verify = carry
+            i, inp, stat, save_slot, spec_hi, spec_lo = xs
+            # slots i <= matched enter on the precomputed trajectory state
+            # of frame load+i (idx < 0 only at shift=0, i=0: the anchor
+            # snapshot itself); later slots carry the resimulated state
+            idx = shift + i - 1
+            prev = jax.tree.map(
+                lambda t: jax.lax.dynamic_index_in_dim(
+                    t, jnp.maximum(idx, 0), 0, keepdims=False
+                ),
+                mtraj,
+            )
+            s_pre = _tree_where(idx < 0, loaded, prev)
+            state = _tree_where(i <= matched, s_pre, state)
+            use_spec = i <= matched
 
             def save(args):
-                ring, verify = args
-                idx = shift + i - 1
-                prev = jax.tree.map(
-                    lambda t: jax.lax.dynamic_index_in_dim(
-                        t, jnp.maximum(idx, 0), 0, keepdims=False
-                    ),
-                    mtraj,
+                ring, state, verify = args
+                hi, lo = jax.lax.cond(
+                    use_spec,
+                    lambda s: (spec_hi, spec_lo),
+                    lambda s: self.game.checksum(s),
+                    state,
                 )
-                # idx < 0 only at (shift=0, i=0): the anchor state itself
-                s_i = _tree_where(idx < 0, loaded, prev)
                 ring = jax.tree.map(
                     lambda r, s: jax.lax.dynamic_update_index_in_dim(
                         r, s, save_slot, 0
                     ),
                     ring,
-                    s_i,
+                    state,
                 )
                 verify = self._verify_update(verify, load_frame + i, hi, lo)
-                return ring, verify
+                return ring, verify, hi, lo
+
+            def skip(args):
+                ring, _, verify = args
+                return ring, verify, jnp.uint32(0), jnp.uint32(0)
 
             # scratch-slot writes skipped outright (same cond rationale as
             # _tick_impl: device time tracks the actual save count)
-            ring, verify = jax.lax.cond(
-                save_slot < self.ring_len,
-                save,
-                lambda args: args,
-                (ring, verify),
+            ring, verify, hi, lo = jax.lax.cond(
+                save_slot < self.ring_len, save, skip, (ring, state, verify)
             )
-            return (ring, verify), None
+            # only the mispredicted suffix resimulates; served frames'
+            # states come from the trajectory selects above
+            state = jax.lax.cond(
+                (i >= matched) & (i < advance_count),
+                lambda s: self.game.step(s, inp, stat),
+                lambda s: s,
+                state,
+            )
+            return (ring, state, verify), (hi, lo)
 
-        (ring, verify), _ = jax.lax.scan(
-            body, (ring, verify), (iota, save_slots, his, los)
+        (ring, state, verify), (out_his, out_los) = jax.lax.scan(
+            body, (ring, loaded, verify),
+            (iota, inputs, statuses, save_slots, his, los),
         )
-        state = jax.tree.map(
-            lambda t: jax.lax.dynamic_index_in_dim(
-                t, jnp.maximum(shift + advance_count - 1, 0), 0, keepdims=False
-            ),
-            mtraj,
-        )
-        return ring, state, verify, his, los
+        return ring, state, verify, out_his, out_los
 
     def adopt(self, spec, member: int, load_slot: int, save_slots: np.ndarray,
-              advance_count: int, shift: int = 0,
-              load_frame: int = 0) -> Tuple[Any, Any]:
-        """Fulfill a rollback tick from a matching speculation; returns
-        (checksum_hi[W], checksum_lo[W]) like tick(). `shift` = load_frame -
-        anchor_frame (caller guarantees shift + advance_count <= window and
-        that the member's first `shift` input rows equal the inputs actually
-        played for frames anchor..load)."""
+              advance_count: int, shift: int = 0, load_frame: int = 0,
+              inputs: Optional[np.ndarray] = None,
+              statuses: Optional[np.ndarray] = None,
+              matched: Optional[int] = None) -> Tuple[Any, Any]:
+        """Fulfill a rollback tick from a (prefix-)matching speculation;
+        returns (checksum_hi[W], checksum_lo[W]) like tick(). `shift` =
+        load_frame - anchor_frame (caller guarantees the member's first
+        `shift` input rows equal the inputs actually played for frames
+        anchor..load). `matched` (default: advance_count, the full
+        adoption) is how many corrected frames the member's rows match;
+        the rest resimulate from `inputs`/`statuses` in this dispatch —
+        required whenever matched < advance_count."""
+        if matched is None:
+            matched = advance_count
+        assert matched == advance_count or inputs is not None, (
+            "partial adoption needs the corrected inputs for the suffix"
+        )
+        W, P, I = self.window, self.num_players, self.game.input_size
         traj, spec_his, spec_los, a_hi, a_lo = spec
-        packed = np.empty((5 + self.window,), dtype=np.int32)
+        packed = np.zeros((self._apacked_len,), dtype=np.int32)
         packed[0] = member
         packed[1] = load_slot
         packed[2] = advance_count
         packed[3] = shift
         packed[4] = load_frame
-        packed[5:] = save_slots
+        packed[5] = matched
+        packed[self._aoff_save : self._aoff_status] = save_slots
+        if statuses is not None:
+            packed[self._aoff_status : self._aoff_input] = statuses.reshape(-1)
+        if inputs is not None:
+            packed[self._aoff_input :] = inputs.reshape(-1)
         self.ring, self.state, self.verify, his, los = self._adopt_fn(
             self.ring, traj, spec_his, spec_los, a_hi, a_lo, self.verify,
             packed,
         )
         return his, los
+
+    def reset(self) -> None:
+        """Return the core to its initial condition (fresh world, zeroed
+        ring and verify carry) WITHOUT recompiling anything — a new
+        session can reuse a warmed core's compiled programs (each compile
+        costs tens of seconds through the tunnel)."""
+        state = self.game.init_state()
+        if self.mesh is not None:
+            from ..parallel.sharded import shard_state
+
+            state = shard_state(state, self.mesh)
+        self.state = state
+        self.ring = jax.tree.map(jnp.zeros_like, self.ring)
+        if self.device_verify:
+            self.verify = {
+                "h_tag": jnp.full_like(self.verify["h_tag"], -1),
+                "h_hi": jnp.zeros_like(self.verify["h_hi"]),
+                "h_lo": jnp.zeros_like(self.verify["h_lo"]),
+                # device_put onto the existing sharding: a bare asarray
+                # would drop the mesh placement __init__ applied and make
+                # the next donated tick recompile (or reject the pytree)
+                "flag": jax.device_put(
+                    np.array([0, -1], dtype=np.int32),
+                    self.verify["flag"].sharding,
+                ),
+            }
 
     def fetch_state(self):
         """Device -> host copy of the live state (test/debug aid)."""

@@ -52,9 +52,8 @@ def _pick_backend(game, check_distance: int, mesh) -> str:
     if game.num_entities % 128 != 0:
         return "xla"
     if mesh is None:
-        n_planes = len(adapter.planes)
-        vmem_est = (
-            2 * n_planes * (1 + check_distance + 2) * game.num_entities * 4
+        vmem_est = PallasSyncTestCore.vmem_estimate(
+            game, check_distance, adapter
         )
         if vmem_est <= PallasSyncTestCore.VMEM_BUDGET_BYTES:
             return "pallas"

@@ -228,6 +228,96 @@ def test_branching_beam_generator_shapes():
     )
 
 
+def test_partial_prefix_adoption_core_parity():
+    """core.adopt with matched < count: the served prefix comes from the
+    trajectory, the suffix resimulates in the same dispatch — ring, live
+    state and per-slot checksums must all be bit-identical to a plain
+    fused resim of the corrected script."""
+    from ggrs_tpu.tpu.resim import ResimCore
+
+    game = ExGame(num_players=PLAYERS, num_entities=ENTITIES)
+    rng = np.random.default_rng(42)
+    W = 8  # max_prediction 6 -> window 8
+
+    def fresh_core():
+        core = ResimCore(game, max_prediction=6, num_players=PLAYERS)
+        # run a few confirmed frames so the ring has real snapshots
+        for f in range(4):
+            inputs = np.zeros((W, PLAYERS, 1), dtype=np.uint8)
+            inputs[0] = rng.integers(0, 16, size=(PLAYERS, 1))
+            statuses = np.zeros((W, PLAYERS), dtype=np.int32)
+            save_slots = np.full((W,), core.scratch_slot, dtype=np.int32)
+            save_slots[0] = f % core.ring_len
+            core.tick(False, 0, inputs, statuses, save_slots, 1, start_frame=f)
+        return core
+
+    rng_state = rng.bit_generator.state
+    core_a = fresh_core()
+    rng.bit_generator.state = rng_state
+    core_b = fresh_core()
+
+    # speculate 5 frames from the frame-3 snapshot on core_a
+    B, L = 4, 5
+    beam_inputs = rng.integers(0, 16, size=(B, L, PLAYERS, 1), dtype=np.uint8)
+    beam_statuses = np.zeros((B, L, PLAYERS), dtype=np.int32)
+    spec = core_a.speculate(3 % core_a.ring_len, beam_inputs, beam_statuses)
+
+    # corrected script: member 2's rows for 3 frames, then a divergence
+    count, matched, member = 5, 3, 2
+    actual = np.zeros((W, PLAYERS, 1), dtype=np.uint8)
+    actual[:count] = beam_inputs[member, :count]
+    actual[matched:count] = (actual[matched:count] + 7) % 16  # suffix differs
+    statuses = np.zeros((W, PLAYERS), dtype=np.int32)
+    save_slots = np.full((W,), core_a.scratch_slot, dtype=np.int32)
+    for i in range(count + 1):
+        save_slots[i] = (3 + i) % core_a.ring_len
+
+    core_a.adopt(
+        spec, member, 3 % core_a.ring_len, save_slots, count,
+        shift=0, load_frame=3, inputs=actual, statuses=statuses,
+        matched=matched,
+    )
+    his_b, los_b = core_b.tick(
+        True, 3 % core_b.ring_len, actual, statuses, save_slots, count,
+        start_frame=3,
+    )
+
+    sa, sb = core_a.fetch_state(), core_b.fetch_state()
+    for k in sa:
+        assert np.array_equal(np.asarray(sa[k]), np.asarray(sb[k])), (
+            f"live state[{k}] diverged"
+        )
+    for slot in range(core_a.ring_len):
+        ra, rb = core_a.fetch_ring_slot(slot), core_b.fetch_ring_slot(slot)
+        for k in ra:
+            assert np.array_equal(np.asarray(ra[k]), np.asarray(rb[k])), (
+                f"ring[{slot}][{k}] diverged"
+            )
+
+
+def test_partial_prefix_adoption_in_synctest_pair():
+    """Players toggling at DIFFERENT offsets inside the same rollback
+    window: no single branching member covers both switches, so full
+    adoption is impossible — the longest-prefix path must fire (serving
+    frames up to the second switch) and stay bit-identical to resim."""
+    beam, plain = make_backend(beam_width=32), make_backend(beam_width=0)
+
+    def script(t, h):
+        # player 0 toggles every 5 frames, player 1 every 7: switches
+        # regularly land at different offsets of the 4-frame window
+        period = 5 if h == 0 else 7
+        return bytes([(3 if (t // period) % 2 == 0 else 12) + h])
+
+    drive_synctest_pair(beam, plain, script, ticks=45)
+    assert beam.beam_partial_hits > 0, (
+        beam.beam_hits, beam.beam_partial_hits, beam.beam_misses,
+    )
+    # the headline metric: fraction of rollback frames served from
+    # speculation — partial prefixes must contribute
+    assert beam.rollback_frames_adopted > 0
+    assert beam.rollback_frames >= beam.rollback_frames_adopted
+
+
 def test_beam_requires_statuses_contract():
     """A game that hasn't declared the disconnect-only statuses contract
     must be rejected at construction (silent wrong adoption otherwise)."""
