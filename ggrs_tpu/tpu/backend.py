@@ -51,11 +51,13 @@ def _array_is_ready(arr) -> bool:
 
 
 class _ChecksumBatch:
-    """One tick's worth of device checksums; fetched to host at most once,
-    and only if some cell's checksum is actually read. Resolution goes
-    through the owning ChecksumLedger so every pending batch rides the same
-    device->host transfer — on a remote/tunneled device one round trip costs
-    ~100ms, so per-read transfers would dominate the whole tick."""
+    """One dispatch's worth of device checksums ([W] for a single tick,
+    [T, W] for a lazy multi-tick flush — lazy checksum indices are flat
+    row-major either way); fetched to host at most once, and only if some
+    cell's checksum is actually read. Resolution goes through the owning
+    ChecksumLedger so every pending batch rides the same device->host
+    transfer — on a remote/tunneled device one round trip costs ~100ms,
+    so per-read transfers would dominate the whole tick."""
 
     def __init__(self, his, los, ledger: "ChecksumLedger"):
         self._his = his
@@ -103,7 +105,8 @@ class _ChecksumBatch:
         return combine_checksum(self._np[0][idx], self._np[1][idx])
 
     def _store(self, his: np.ndarray, los: np.ndarray) -> None:
-        self._np = (np.asarray(his), np.asarray(los))
+        # flat row-major: multi-tick [T, W] batches index as j*W + i
+        self._np = (np.asarray(his).ravel(), np.asarray(los).ravel())
 
 
 class ChecksumLedger:
@@ -135,8 +138,8 @@ class ChecksumLedger:
         # compiles for a handful of shapes, not one per drain size.
         import jax.numpy as jnp
 
-        parts = [jnp.atleast_1d(b._his) for b in todo] + [
-            jnp.atleast_1d(b._los) for b in todo
+        parts = [jnp.ravel(b._his) for b in todo] + [
+            jnp.ravel(b._los) for b in todo
         ]
         bucket = 1
         while bucket < len(parts):
@@ -174,6 +177,38 @@ class _LazyChecksum:
         return self._batch.ready
 
 
+class _FutureChecksumBatch:
+    """Checksum-batch stand-in for ticks still sitting in the lazy tick
+    buffer (no dispatch has happened, so no device arrays exist yet).
+    First touch forces the backend's buffer flush, which installs the real
+    batch; every method then delegates. Cells handed out before the flush
+    keep working unmodified — laziness composes with laziness."""
+
+    __slots__ = ("_flush", "batch")
+
+    def __init__(self, flush_fn):
+        self._flush = flush_fn
+        self.batch: Optional[_ChecksumBatch] = None
+
+    def _ensure(self) -> _ChecksumBatch:
+        if self.batch is None:
+            self._flush()
+            assert self.batch is not None, "flush did not materialize batch"
+        return self.batch
+
+    def resolve(self, idx: int) -> int:
+        return self._ensure().resolve(idx)
+
+    def prefetch(self) -> None:
+        # dispatching the buffer is non-blocking, so an early prefetch can
+        # legitimately force it: the copy then overlaps device execution
+        self._ensure().prefetch()
+
+    @property
+    def ready(self) -> bool:
+        return self.batch is not None and self.batch.ready
+
+
 class TpuRollbackBackend:
     """Request-fulfilling rollback backend over a device game.
 
@@ -186,7 +221,7 @@ class TpuRollbackBackend:
     def __init__(self, game, max_prediction: int, num_players: int,
                  beam_width: int = 0, mesh=None, device_verify: bool = False,
                  speculation_gate: str = "always",
-                 defer_speculation: bool = False):
+                 defer_speculation: bool = False, lazy_ticks: int = 0):
         """`mesh`: optional jax Mesh with an `entity` axis — the world and
         its snapshot ring shard across it (see ResimCore); the session-facing
         contract (requests in, SnapshotRefs + lazy checksums out) is
@@ -216,7 +251,20 @@ class TpuRollbackBackend:
         caller launches the (gated) speculation from its idle time via
         launch_pending_speculation(). The launch costs ~1ms of host time
         (candidate generation + dispatch), which a real-time loop should
-        pay after presenting the frame, not before."""
+        pay after presenting the frame, not before.
+
+        `lazy_ticks`: > 0 enables LAZY TICK BATCHING — ticks (rollbacks
+        included) accumulate as packed control words on the host and
+        dispatch as ONE fused multi-tick device program when the buffer
+        fills or any device result is actually needed (a checksum read,
+        state_numpy(), a speculation launch, flush()). Nothing a session
+        needs synchronously lives on device — checksums are already lazy —
+        so on the tunnel (where every dispatch costs ~1ms of host time
+        regardless of content) this divides the request path's dominant
+        cost by the buffer depth. The live state lags the session by up to
+        lazy_ticks frames between flushes: loops that render every frame
+        call state_numpy() (or flush()) per frame and get per-tick
+        dispatch behavior back automatically."""
         self.core = ResimCore(
             game, max_prediction, num_players, mesh=mesh,
             device_verify=device_verify,
@@ -287,6 +335,10 @@ class TpuRollbackBackend:
         assert speculation_gate in ("always", "adaptive")
         self.speculation_gate = speculation_gate
         self.defer_speculation = defer_speculation
+        assert lazy_ticks >= 0
+        self.lazy_ticks = lazy_ticks
+        self._tick_rows: List[np.ndarray] = []  # packed rows awaiting dispatch
+        self._tick_future: Optional[_FutureChecksumBatch] = None
         self.beam_gated = 0  # ticks where the gate skipped speculation
         self._spec_cost_s: Optional[float] = None  # measured in warmup()
         self._idle_ema_s = 0.0
@@ -415,6 +467,8 @@ class TpuRollbackBackend:
                 else:
                     self.beam_partial_hits += 1
                 self.rollback_frames_adopted += matched
+                # adoption reads the ring: buffered ticks must land first
+                self.flush()
                 with GLOBAL_TRACER.span("tpu/beam_adopt"):
                     his, los = core.adopt(
                         self._spec[2],
@@ -430,7 +484,28 @@ class TpuRollbackBackend:
                     )
             else:
                 self.beam_misses += 1
-        if his is None:
+        batch = None
+        base_idx = 0
+        if his is None and self.lazy_ticks > 0:
+            # lazy tick batching: stage the packed row; the fused
+            # multi-tick dispatch happens at flush() (buffer full or first
+            # device-result need). Rollback rows buffer like any other —
+            # the load executes in order inside the multi-tick scan.
+            row = core.pack_tick_row(
+                do_load=load is not None,
+                load_slot=(load.frame % core.ring_len) if load is not None else 0,
+                inputs=inputs,
+                statuses=statuses,
+                save_slots=save_slots,
+                advance_count=count,
+                start_frame=start_frame,
+            )
+            if self._tick_future is None:
+                self._tick_future = _FutureChecksumBatch(self.flush)
+            batch = self._tick_future
+            base_idx = len(self._tick_rows) * core.window
+            self._tick_rows.append(row)
+        elif his is None:
             with GLOBAL_TRACER.span("tpu/fused_tick"):
                 his, los = core.tick(
                     do_load=load is not None,
@@ -443,10 +518,15 @@ class TpuRollbackBackend:
                 )
         self.current_frame = start_frame + count
 
-        batch = _ChecksumBatch(his, los, self.ledger)
+        if batch is None:
+            batch = _ChecksumBatch(his, los, self.ledger)
         for idx, save in saves:
             ref = SnapshotRef(save.frame, save.frame % core.ring_len)
-            save.cell.save_lazy(save.frame, ref, _LazyChecksum(batch, idx))
+            save.cell.save_lazy(
+                save.frame, ref, _LazyChecksum(batch, base_idx + idx)
+            )
+        if self._tick_rows and len(self._tick_rows) >= self.lazy_ticks:
+            self.flush()
 
         if self.beam_width:
             # the speculation survives the tick UNLESS this rollback rewrote
@@ -529,6 +609,34 @@ class TpuRollbackBackend:
             return None
         return (member, shift, matched)
 
+    def flush(self) -> None:
+        """Dispatch buffered lazy ticks as ONE fused multi-tick program
+        (no-op when the buffer is empty or lazy_ticks is 0). Pads to the
+        configured buffer depth with no-op rows so one length compiles
+        once; materializes the future checksum batch the buffered saves'
+        cells already hold. A single-row buffer dispatches through the
+        plain tick program instead — a flush-heavy configuration (e.g.
+        beam speculation forcing a flush every tick) then pays the
+        one-tick program, not the T-deep scan."""
+        rows, future = self._tick_rows, self._tick_future
+        if not rows:
+            return
+        self._tick_rows = []
+        self._tick_future = None
+        core = self.core
+        if len(rows) == 1:
+            with GLOBAL_TRACER.span("tpu/fused_tick"):
+                core.ring, core.state, core.verify, his, los = core._tick_fn(
+                    core.ring, core.state, rows[0], core.verify
+                )
+        else:
+            buf = np.tile(core.pad_tick_row(), (self.lazy_ticks, 1))
+            for j, r in enumerate(rows):
+                buf[j] = r
+            with GLOBAL_TRACER.span("tpu/fused_multi_tick"):
+                his, los = core.tick_multi(buf)
+        future.batch = _ChecksumBatch(his, los, self.ledger)
+
     def _launch_speculation(self, load: Optional[LoadGameState],
                             start_frame: Frame, count: int,
                             inputs: np.ndarray, statuses: np.ndarray) -> None:
@@ -545,6 +653,8 @@ class TpuRollbackBackend:
         core = self.core
         if count == 0:
             return
+        # the rollout anchors on a ring snapshot: buffered ticks must land
+        self.flush()
         current_after = start_frame + count
         anchor = current_after - self._depth
         # the anchor snapshot must still be live in the ring (and a frame
@@ -583,6 +693,10 @@ class TpuRollbackBackend:
         clears, but compiled programs and the measured speculation cost
         survive — back-to-back sessions (benchmark arms, rematches) skip
         the tens-of-seconds tunnel compile a new backend would pay."""
+        # materialize any staged lazy ticks first: cells from the old
+        # session already hold this buffer's future checksums, and an
+        # orphaned future would turn their later reads into errors
+        self.flush()
         self.core.reset()
         self.current_frame = 0
         self.ledger = ChecksumLedger()
@@ -619,6 +733,12 @@ class TpuRollbackBackend:
         ring0 = jax.tree.map(jnp.copy, core.ring)
         state0 = jax.tree.map(jnp.copy, core.state)
         core.tick(False, 0, inputs, statuses, scratch, 0)
+        if self.lazy_ticks:
+            # compile the fused multi-tick program at the buffer depth
+            # (all-padding rows: a true no-op on the game state)
+            core.tick_multi(
+                np.tile(core.pad_tick_row(), (self.lazy_ticks, 1))
+            )
         if self.beam_width:
             from .beam import branching_beam
 
@@ -670,15 +790,18 @@ class TpuRollbackBackend:
         device_verify=True."""
         from ..errors import MismatchedChecksum
 
+        self.flush()
         mismatch, frame = self.core.check_device_verdict()
         if mismatch:
             raise MismatchedChecksum(frame)
 
     def state_numpy(self):
         """Host copy of the live game state (parity checks / rendering)."""
+        self.flush()
         return self.core.fetch_state()
 
     def block_until_ready(self) -> None:
+        self.flush()
         jax.block_until_ready(self.core.state)
 
     # ------------------------------------------------------------------
@@ -688,6 +811,7 @@ class TpuRollbackBackend:
     def save(self, path: str) -> None:
         from ..utils.checkpoint import save_device_checkpoint
 
+        self.flush()
         tree = {"ring": self.core.ring, "state": self.core.state}
         if self.core.device_verify:
             # the accumulated first-seen history + mismatch latch resume

@@ -194,35 +194,17 @@ def match_beam(
     return None
 
 
-def match_beam_prefixed(
-    beam_inputs: np.ndarray,
-    prefix_inputs: np.ndarray,
-    actual_inputs: np.ndarray,
-) -> Optional[int]:
-    """Shift-flexible match: the speculation was anchored `S` frames before
-    the rollback's load frame (S = prefix_inputs.shape[0]). A member is
-    adoptable iff its first S rows equal the inputs ACTUALLY PLAYED for the
-    frames between anchor and load (its trajectory baked them in) and its
-    next K rows equal the corrected script.
-
-    prefix_inputs: u8[S, P, I]; actual_inputs: u8[K, P, I]; S + K <= window.
-    """
-    s, k = prefix_inputs.shape[0], actual_inputs.shape[0]
-    for b in range(beam_inputs.shape[0]):
-        if np.array_equal(beam_inputs[b, :s], prefix_inputs) and np.array_equal(
-            beam_inputs[b, s : s + k], actual_inputs
-        ):
-            return b
-    return None
-
-
 def match_beam_longest(
     beam_inputs: np.ndarray,
     prefix_inputs: np.ndarray,
     actual_inputs: np.ndarray,
 ) -> Tuple[int, Optional[int]]:
-    """Longest-prefix variant of match_beam_prefixed: returns (matched,
-    member) where `member` is the played-prefix-compatible member whose rows
+    """Shift-flexible, longest-prefix beam match: the speculation was
+    anchored `S` frames before the rollback's load frame
+    (S = prefix_inputs.shape[0]), so a member is considered only if its
+    first S rows equal the inputs ACTUALLY PLAYED between anchor and load
+    (its trajectory baked them in). Returns (matched, member) where
+    `member` is the played-prefix-compatible member whose rows
     match the LONGEST leading run of the corrected script, and `matched` is
     that run's length (0, None when no member clears the played prefix or
     matches even the first corrected row). The TPU analog of the

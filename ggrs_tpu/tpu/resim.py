@@ -113,6 +113,9 @@ class ResimCore:
         self._tick_fn = jax.jit(
             self._tick_packed_impl, donate_argnums=(0, 1, 3)
         )
+        self._tick_multi_fn = jax.jit(
+            self._tick_multi_impl, donate_argnums=(0, 1, 3)
+        )
         self._speculate_fn = jax.jit(self._speculate_impl)
         self._adopt_fn = jax.jit(self._adopt_impl, donate_argnums=(0, 6))
         # tick's packed control-word layout (pack site: tick(); unpack:
@@ -155,6 +158,36 @@ class ResimCore:
             ring, state, do_load, load_slot, inputs, statuses, save_slots,
             advance_count, start_frame, verify,
         )
+
+    def _tick_multi_impl(self, ring, state, packed, verify):
+        """T buffered ticks as ONE device program: a lax.scan of the packed
+        tick over rows of packed[T, L]. On the tunnel each dispatch costs
+        ~1ms of host time regardless of content, so batching T interactive
+        ticks into one dispatch divides the request path's dominant cost
+        by T (ggrs_tpu/tpu/backend.py lazy_ticks). Padding rows
+        (advance_count=0, scratch-only saves) are true no-ops — the
+        per-slot conds skip all work — so one buffer length compiles
+        once."""
+
+        def body(carry, row):
+            ring, state, verify = carry
+            ring, state, verify, his, los = self._tick_packed_impl(
+                ring, state, row, verify
+            )
+            return (ring, state, verify), (his, los)
+
+        (ring, state, verify), (his, los) = jax.lax.scan(
+            body, (ring, state, verify), packed
+        )
+        return ring, state, verify, his, los
+
+    def tick_multi(self, rows: np.ndarray) -> Tuple[Any, Any]:
+        """Run T packed ticks (layout: see tick()) in one dispatch; returns
+        (checksum_hi[T, W], checksum_lo[T, W]) as device arrays."""
+        self.ring, self.state, self.verify, his, los = self._tick_multi_fn(
+            self.ring, self.state, rows, self.verify
+        )
+        return his, los
 
     def _verify_update(self, verify, frame, hi, lo):
         """First-seen history record/compare + mismatch latch (the device
@@ -249,6 +282,43 @@ class ResimCore:
 
     # ------------------------------------------------------------------
 
+    def pack_tick_row(
+        self,
+        do_load: bool,
+        load_slot: int,
+        inputs: np.ndarray,
+        statuses: np.ndarray,
+        save_slots: np.ndarray,
+        advance_count: int,
+        start_frame: int = 0,
+    ) -> np.ndarray:
+        """Build one tick's packed control-word row (the _tick_packed_impl
+        layout) — dispatched alone by tick() or buffered for a multi-tick
+        dispatch by the backend's lazy batching."""
+        packed = np.empty((self._packed_len,), dtype=np.int32)
+        packed[0] = 1 if do_load else 0
+        packed[1] = load_slot
+        packed[2] = advance_count
+        packed[3] = start_frame
+        packed[self._off_save : self._off_status] = save_slots
+        packed[self._off_status : self._off_input] = statuses.reshape(-1)
+        packed[self._off_input :] = inputs.reshape(-1)
+        return packed
+
+    def pad_tick_row(self) -> np.ndarray:
+        """A true no-op tick row (no load, zero advances, scratch-only
+        saves): pads a partial lazy buffer so one buffer length compiles
+        once."""
+        return self.pack_tick_row(
+            False,
+            0,
+            np.zeros((self.window, self.num_players, self.game.input_size),
+                     dtype=np.uint8),
+            np.zeros((self.window, self.num_players), dtype=np.int32),
+            np.full((self.window,), self.scratch_slot, dtype=np.int32),
+            0,
+        )
+
     def tick(
         self,
         do_load: bool,
@@ -262,14 +332,10 @@ class ResimCore:
         """Run one fused tick; returns (checksum_hi[W], checksum_lo[W]) as
         device arrays (no host sync). `start_frame` feeds the device-verify
         history (slot i saves frame start_frame + i)."""
-        packed = np.empty((self._packed_len,), dtype=np.int32)
-        packed[0] = 1 if do_load else 0
-        packed[1] = load_slot
-        packed[2] = advance_count
-        packed[3] = start_frame
-        packed[self._off_save : self._off_status] = save_slots
-        packed[self._off_status : self._off_input] = statuses.reshape(-1)
-        packed[self._off_input :] = inputs.reshape(-1)
+        packed = self.pack_tick_row(
+            do_load, load_slot, inputs, statuses, save_slots, advance_count,
+            start_frame,
+        )
         self.ring, self.state, self.verify, his, los = self._tick_fn(
             self.ring, self.state, packed, self.verify
         )
