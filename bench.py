@@ -57,6 +57,10 @@ def _game_family(model):
         from ggrs_tpu.models import arena
 
         return arena.Arena, arena, 64  # exercise rally/overdrive bits too
+    if model == "swarm":
+        from ggrs_tpu.models import swarm
+
+        return swarm.Swarm, swarm, 128  # all axis bits + boost
     from ggrs_tpu.models import ex_game
 
     return ex_game.ExGame, ex_game, 16
@@ -1020,6 +1024,12 @@ def main():
         "bench_fused(model='arena', bench_batches=20)[:3]"
     )
     arena_parity = _run_phase("parity_fused_vs_oracle(model='arena')")
+    # third model family (swarm: [N,3] vectors + battery; tileable) on the
+    # same generic pallas path — the adapter contract's bench witness
+    swarm_rate, swarm_ms, swarm_backend = _run_phase(
+        "bench_fused(model='swarm', bench_batches=20)[:3]"
+    )
+    swarm_parity = _run_phase("parity_fused_vs_oracle(model='swarm')")
 
     print(
         json.dumps(
@@ -1053,6 +1063,10 @@ def main():
                 "arena_ms_per_8frame_tick": round(arena_ms, 4),
                 "arena_fused_backend": arena_backend,
                 "arena_parity_vs_oracle": arena_parity,
+                "swarm_frames_per_sec": round(swarm_rate, 1),
+                "swarm_ms_per_8frame_tick": round(swarm_ms, 4),
+                "swarm_fused_backend": swarm_backend,
+                "swarm_parity_vs_oracle": swarm_parity,
                 "parity_vs_oracle": parity,
                 "device": device,
                 "entities": ENTITIES,

@@ -36,7 +36,7 @@ def main() -> int:
     )
     ap.add_argument(
         "--model",
-        choices=["ex_game", "arena"],
+        choices=["ex_game", "arena", "swarm"],
         default="ex_game",
         help="which model family to run (device path only)",
     )
@@ -92,10 +92,10 @@ def main() -> int:
         game = HostGame(args.players, args.entities)
         digest = game.digest
     else:
-        from ggrs_tpu.models import Arena, ExGame
+        from ggrs_tpu.models import Arena, ExGame, Swarm
         from ggrs_tpu.tpu import TpuRollbackBackend
 
-        model_cls = Arena if args.model == "arena" else ExGame
+        model_cls = {"arena": Arena, "swarm": Swarm}.get(args.model, ExGame)
         game = TpuRollbackBackend(
             model_cls(args.players, args.entities),
             max_prediction=args.max_prediction,
@@ -138,11 +138,11 @@ def run_fused(args) -> int:
     """The fully-fused session: batches of 60 ticks per device dispatch."""
     import numpy as np
 
-    from ggrs_tpu.models import Arena, ExGame
+    from ggrs_tpu.models import Arena, ExGame, Swarm
     from ggrs_tpu.tpu import TpuSyncTestSession
     from ggrs_tpu.utils.barrier import true_barrier
 
-    model_cls = Arena if args.model == "arena" else ExGame
+    model_cls = {"arena": Arena, "swarm": Swarm}.get(args.model, ExGame)
     sess = TpuSyncTestSession(
         model_cls(args.players, args.entities),
         num_players=args.players,

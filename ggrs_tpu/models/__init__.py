@@ -1,10 +1,13 @@
 """Deterministic device-game model families (the DeviceGame interface
 consumed by ggrs_tpu.tpu): ex_game (the reference example vectorized, pure
-per-entity physics) and arena (bevy_ggrs-style ECS with health/energy
-components and a cross-entity centroid reduction)."""
+per-entity physics), arena (bevy_ggrs-style ECS with health/energy
+components and a cross-entity centroid reduction), and swarm (3D drones
+with 3-wide state vectors and a battery economy — the adapter-contract
+witness for vector widths beyond 2)."""
 
-from . import arena, ex_game
+from . import arena, ex_game, swarm
 from .arena import Arena
 from .ex_game import ExGame
+from .swarm import Swarm
 
-__all__ = ["Arena", "ExGame", "arena", "ex_game"]
+__all__ = ["Arena", "ExGame", "Swarm", "arena", "ex_game", "swarm"]

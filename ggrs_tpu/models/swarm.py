@@ -1,0 +1,190 @@
+"""Third model family: a 3D drone swarm with a battery economy.
+
+Exists to prove the pallas adapter CONTRACT, not just the two shipped
+games: its state carries per-entity vectors wider than anything ex_game or
+arena declare (pos/vel are [N, 3] — three components per plane key) plus a
+scalar battery track, so a correct adapter cannot be a copy of the
+existing ones. The dynamics are strictly per-entity (no cross-entity
+reductions), which makes the family `tileable` — it runs on the
+whole-batch pallas kernel, the entity-tiled kernel AND the sharded
+composition, end to end, with a numpy oracle as ground truth.
+
+Same reference anchor as the other families: the per-player dynamics of
+examples/ex_game/ex_game.rs:259-321 re-imagined N-entity SoA and
+integer-only (bit-identical CPU/TPU), with arena.py's torus-wrap style
+bounds. Inputs are one bitmask byte per player: six axis bits and BOOST,
+which doubles acceleration while the battery lasts; disconnected players
+sink (DISCONNECT_INPUT, the ex_game.rs:268 dummy-input analog in 3D).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ..ops import fixed_point as fx
+from ..types import InputStatus
+
+INPUT_XP = 1 << 0
+INPUT_XM = 1 << 1
+INPUT_YP = 1 << 2
+INPUT_YM = 1 << 3
+INPUT_ZP = 1 << 4
+INPUT_ZM = 1 << 5
+INPUT_BOOST = 1 << 6
+INPUT_SIZE = 1  # bytes per player per frame
+
+# 512-px torus cube in Q8 subpixels; power of two => branch-free wrap
+SPACE_BITS = 17
+SPACE_MASK = (1 << SPACE_BITS) - 1
+
+ACCEL = 40  # Q8 subpixels/frame^2
+MAX_SPEED = 7 * fx.SUBPIX
+FRICTION_NUM = 248  # ~0.97 as 248/256
+CHARGE_MAX = 192
+CHARGE_DRAIN = 6  # per boosted frame
+CHARGE_REGEN = 2  # per un-boosted frame
+# disconnected drones sink along -z (ex_game.rs:268's dummy-spin analog)
+DISCONNECT_INPUT = INPUT_ZM
+
+State = Dict[str, Any]  # {"frame": i32[], "pos": i32[N,3], "vel": i32[N,3], "charge": i32[N]}
+
+
+def _init_arrays(num_entities: int) -> State:
+    """Deterministic diagonal lattice through the torus volume, zero
+    velocity, full battery. Host-side numpy, transferred once."""
+    i = np.arange(num_entities, dtype=np.int64)
+    # three decorrelated strides through the cube (odd multipliers are
+    # invertible mod 2^SPACE_BITS, so positions never collide structurally)
+    pos = np.stack(
+        [
+            (i * 40503) & SPACE_MASK,
+            (i * 30011) & SPACE_MASK,
+            (i * 24593) & SPACE_MASK,
+        ],
+        axis=1,
+    ).astype(np.int32)
+    return {
+        "frame": np.zeros((), dtype=np.int32),
+        "pos": pos,
+        "vel": np.zeros((num_entities, 3), dtype=np.int32),
+        "charge": np.full((num_entities,), CHARGE_MAX, dtype=np.int32),
+    }
+
+
+def _step_generic(state: State, inputs, statuses, num_players: int, xp) -> State:
+    """One deterministic frame; shared by the jax and numpy backends."""
+    n = state["pos"].shape[0]
+    owner = xp.arange(n, dtype=xp.int32) % num_players
+
+    inp = inputs.astype(xp.int32)[owner]
+    status = statuses.astype(xp.int32)[owner]
+    inp = xp.where(
+        status == int(InputStatus.DISCONNECTED), DISCONNECT_INPUT, inp
+    )
+
+    dx = xp.where((inp & INPUT_XP) != 0, 1, 0) - xp.where((inp & INPUT_XM) != 0, 1, 0)
+    dy = xp.where((inp & INPUT_YP) != 0, 1, 0) - xp.where((inp & INPUT_YM) != 0, 1, 0)
+    dz = xp.where((inp & INPUT_ZP) != 0, 1, 0) - xp.where((inp & INPUT_ZM) != 0, 1, 0)
+
+    charge = state["charge"]
+    boost = ((inp & INPUT_BOOST) != 0) & (charge > 0)
+    accel = xp.where(boost, 2 * ACCEL, ACCEL)
+    charge = xp.where(
+        boost,
+        charge - CHARGE_DRAIN,
+        xp.minimum(charge + CHARGE_REGEN, CHARGE_MAX),
+    )
+    charge = xp.maximum(charge, 0)
+
+    vel = (state["vel"] * FRICTION_NUM) >> 8
+    vel = vel + xp.stack([dx * accel, dy * accel, dz * accel], axis=1)
+
+    # 3D speed clamp, integer sqrt (|v| per axis <= MAX_SPEED + 2*ACCEL, so
+    # m2 <= 3*(MAX_SPEED+80)^2 < 2^24 — inside isqrt24's domain)
+    vx, vy, vz = vel[:, 0], vel[:, 1], vel[:, 2]
+    m2 = vx * vx + vy * vy + vz * vz
+    mag = fx.isqrt24(m2, xp)
+    over = m2 > MAX_SPEED * MAX_SPEED
+    safe = xp.where(mag == 0, 1, mag)
+    vx = xp.where(over, (vx * MAX_SPEED) // safe, vx)
+    vy = xp.where(over, (vy * MAX_SPEED) // safe, vy)
+    vz = xp.where(over, (vz * MAX_SPEED) // safe, vz)
+    vel = xp.stack([vx, vy, vz], axis=1)
+
+    pos = (state["pos"] + vel) & SPACE_MASK  # torus wrap, branch-free
+
+    return {
+        "frame": state["frame"] + xp.int32(1),
+        "pos": pos.astype(xp.int32),
+        "vel": vel.astype(xp.int32),
+        "charge": charge.astype(xp.int32),
+    }
+
+
+# Checksum word order: single source of truth (frame folded in last).
+CHECKSUM_KEYS = ("pos", "vel", "charge")
+
+
+def _checksum_generic(state: State, xp):
+    words = xp.concatenate(
+        [state[k].astype(xp.uint32).reshape(-1) for k in CHECKSUM_KEYS]
+        + [state["frame"].astype(xp.uint32).reshape(-1)]
+    )
+    return fx.weighted_checksum(words, xp)
+
+
+class Swarm:
+    """Device game (DeviceGame interface): pure-jax step/checksum."""
+
+    input_size = INPUT_SIZE
+    checksum_keys = CHECKSUM_KEYS
+    # statuses only substitute DISCONNECTED players' inputs: beam adoption
+    # of all-CONFIRMED rollouts is sound
+    statuses_contract = "disconnect-only"
+
+    def __init__(self, num_players: int = 2, num_entities: int = 4096):
+        self.num_players = num_players
+        self.num_entities = num_entities
+
+    def init_state(self) -> State:
+        import jax
+
+        return jax.device_put(_init_arrays(self.num_entities))
+
+    def step(self, state: State, inputs, statuses) -> State:
+        import jax.numpy as jnp
+
+        return _step_generic(
+            state, inputs.reshape(-1), statuses, self.num_players, jnp
+        )
+
+    def checksum(self, state: State):
+        import jax.numpy as jnp
+
+        return _checksum_generic(state, jnp)
+
+
+# ---------------------------------------------------------------------------
+# Host oracle (numpy) — independent execution path used as ground truth
+# ---------------------------------------------------------------------------
+
+
+def init_oracle(num_players: int = 2, num_entities: int = 4096) -> State:
+    return _init_arrays(num_entities)
+
+
+def step_oracle(
+    state: State, inputs: np.ndarray, statuses: np.ndarray, num_players: int
+) -> State:
+    with np.errstate(over="ignore"):
+        return _step_generic(
+            state, inputs.reshape(-1), statuses, num_players, np
+        )
+
+
+def checksum_oracle(state: State) -> tuple[int, int]:
+    with np.errstate(over="ignore"):
+        hi, lo = _checksum_generic(state, np)
+    return int(hi), int(lo)

@@ -20,9 +20,10 @@ declares how the model's state pytree packs into (N/128, 128) int32 planes
 and (b) re-states the model's step on those planes. The checksum needs no
 per-model code at all: its word weights are derived from the model's
 `checksum_keys` declaration, reproducing `_checksum_generic` bit-for-bit.
-Adapters ship for both model families (ex_game, arena — including arena's
-2-byte analog-throttle inputs); third-party models register via
-`register_adapter`.
+Adapters ship for all three model families (ex_game; arena — including its
+2-byte analog-throttle inputs; swarm — [N,3] vector planes); third-party
+models register via `register_adapter`. The full contract is documented in
+docs/DESIGN.md ("The plane-adapter contract").
 
 Layout: entity arrays are packed to (N/128, 128) int32 tiles, the snapshot
 ring to (ring_len, N/128, 128); inputs, the input ring, the checksum
@@ -127,6 +128,24 @@ class KernelCtx:
         self.isqrt24 = _isqrt24
         self.select_by_owner = _select_by_owner
 
+    def clamp_speed(self, components, max_speed):
+        """Vector-magnitude clamp, any dimensionality: scale `components`
+        (a list of int32 planes) down to |v| <= max_speed via integer sqrt
+        + exact floor division. Caller must keep m2 = sum(c^2) < 2^24
+        (isqrt24's domain) and c*max_speed < 2^24 with the magnitude <
+        2^12 (floor_div's contract) — true for every shipped model's
+        speed envelope."""
+        m2 = components[0] * components[0]
+        for c in components[1:]:
+            m2 = m2 + c * c
+        mag = self.isqrt24(m2)
+        over = m2 > max_speed * max_speed
+        safe = jnp.where(mag == 0, 1, mag)
+        return [
+            jnp.where(over, self.floor_div(c * max_speed, safe), c)
+            for c in components
+        ]
+
 
 class PlaneAdapter:
     """Maps a DeviceGame onto packed planes for the pallas kernel.
@@ -215,12 +234,7 @@ class ExGamePlanes(PlaneAdapter):
         )
         rot = (rot + turn) & (fx.ANGLE_MOD - 1)
 
-        m2 = vx * vx + vy * vy
-        mag = ctx.isqrt24(m2)
-        over = m2 > ex_game.MAX_SPEED * ex_game.MAX_SPEED
-        safe = jnp.where(mag == 0, 1, mag)
-        vx = jnp.where(over, ctx.floor_div(vx * ex_game.MAX_SPEED, safe), vx)
-        vy = jnp.where(over, ctx.floor_div(vy * ex_game.MAX_SPEED, safe), vy)
+        vx, vy = ctx.clamp_speed([vx, vy], ex_game.MAX_SPEED)
 
         px = jnp.clip(px + vx, 0, ex_game.MAX_X)
         py = jnp.clip(py + vy, 0, ex_game.MAX_Y)
@@ -336,12 +350,7 @@ class ArenaPlanes(PlaneAdapter):
         # friction + speed clamp
         vx = (vx * arena.FRICTION_NUM) >> 8
         vy = (vy * arena.FRICTION_NUM) >> 8
-        m2 = vx * vx + vy * vy
-        mag = ctx.isqrt24(m2)
-        too_fast = m2 > arena.MAX_SPEED * arena.MAX_SPEED
-        safe = jnp.where(mag == 0, 1, mag)
-        vx = jnp.where(too_fast, ctx.floor_div(vx * arena.MAX_SPEED, safe), vx)
-        vy = jnp.where(too_fast, ctx.floor_div(vy * arena.MAX_SPEED, safe), vy)
+        vx, vy = ctx.clamp_speed([vx, vy], arena.MAX_SPEED)
 
         # dead entities stop; integrate on the torus
         alive_i = alive.astype(jnp.int32)
@@ -360,6 +369,62 @@ class ArenaPlanes(PlaneAdapter):
 
         return {"px": px, "py": py, "vx": vx, "vy": vy, "hp": hp,
                 "energy": energy}
+
+
+class SwarmPlanes(PlaneAdapter):
+    """ggrs_tpu.models.swarm._step_generic on packed planes: the contract
+    witness for >2-wide per-entity vectors (pos/vel are [N, 3] — three
+    planes per state key) plus a scalar battery plane. Strictly
+    per-entity dynamics => tileable (entity-tiled kernel + sharded
+    composition)."""
+
+    tileable = True
+    planes = (
+        ("px", "pos", 0), ("py", "pos", 1), ("pz", "pos", 2),
+        ("vx", "vel", 0), ("vy", "vel", 1), ("vz", "vel", 2),
+        ("charge", "charge", None),
+    )
+
+    def step(self, pl, inputs, ctx):
+        from ..models import swarm
+
+        px, py, pz = pl["px"], pl["py"], pl["pz"]
+        vx, vy, vz = pl["vx"], pl["vy"], pl["vz"]
+        charge = pl["charge"]
+
+        inp = ctx.select_by_owner(ctx.owner, [b[0] for b in inputs])
+
+        dx = jnp.where((inp & swarm.INPUT_XP) != 0, 1, 0) - jnp.where(
+            (inp & swarm.INPUT_XM) != 0, 1, 0
+        )
+        dy = jnp.where((inp & swarm.INPUT_YP) != 0, 1, 0) - jnp.where(
+            (inp & swarm.INPUT_YM) != 0, 1, 0
+        )
+        dz = jnp.where((inp & swarm.INPUT_ZP) != 0, 1, 0) - jnp.where(
+            (inp & swarm.INPUT_ZM) != 0, 1, 0
+        )
+
+        boost = ((inp & swarm.INPUT_BOOST) != 0) & (charge > 0)
+        accel = jnp.where(boost, 2 * swarm.ACCEL, swarm.ACCEL)
+        charge = jnp.where(
+            boost,
+            charge - swarm.CHARGE_DRAIN,
+            jnp.minimum(charge + swarm.CHARGE_REGEN, swarm.CHARGE_MAX),
+        )
+        charge = jnp.maximum(charge, 0)
+
+        vx = ((vx * swarm.FRICTION_NUM) >> 8) + dx * accel
+        vy = ((vy * swarm.FRICTION_NUM) >> 8) + dy * accel
+        vz = ((vz * swarm.FRICTION_NUM) >> 8) + dz * accel
+
+        vx, vy, vz = ctx.clamp_speed([vx, vy, vz], swarm.MAX_SPEED)
+
+        px = (px + vx) & swarm.SPACE_MASK
+        py = (py + vy) & swarm.SPACE_MASK
+        pz = (pz + vz) & swarm.SPACE_MASK
+
+        return {"px": px, "py": py, "pz": pz, "vx": vx, "vy": vy, "vz": vz,
+                "charge": charge}
 
 
 def derive_checksum_weights(game, adapter):
@@ -394,8 +459,9 @@ _ADAPTERS: Dict[type, Callable] = {}
 def _builtin_adapters() -> Dict[type, Callable]:
     from ..models.arena import Arena
     from ..models.ex_game import ExGame
+    from ..models.swarm import Swarm
 
-    return {ExGame: ExGamePlanes, Arena: ArenaPlanes}
+    return {ExGame: ExGamePlanes, Arena: ArenaPlanes, Swarm: SwarmPlanes}
 
 
 def register_adapter(game_cls: type, adapter_cls) -> None:
