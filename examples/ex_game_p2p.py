@@ -63,7 +63,17 @@ def main() -> int:
         "--beam",
         type=int,
         default=0,
-        help="with --tpu: speculative input-beam width (0 = off)",
+        help="with --tpu: speculative input-beam width (0 = off); the "
+        "speculation launch runs in loop idle time and stands down "
+        "automatically when the frame budget cannot absorb its cost",
+    )
+    ap.add_argument(
+        "--lazy-ticks",
+        type=int,
+        default=0,
+        help="with --tpu: buffer up to N ticks per fused device dispatch "
+        "(amortizes the per-program dispatch floor; the periodic digest "
+        "still flushes, so rendering-style loops behave per-tick)",
     )
     ap.add_argument(
         "--auth-key",
@@ -117,6 +127,12 @@ def main() -> int:
             max_prediction=builder.max_prediction,
             num_players=len(args.players),
             beam_width=args.beam,
+            # real-time loop: launch speculation from idle time, stand
+            # down when the budget can't absorb it, and batch ticks when
+            # nothing needs device results between digests
+            speculation_gate="adaptive",
+            defer_speculation=bool(args.beam),
+            lazy_ticks=args.lazy_ticks,
         )
         # compile before the session even exists: the first jit would stall
         # the 60fps loop past the peers' disconnect timeout
@@ -191,6 +207,10 @@ def main() -> int:
                 pass  # skip a frame; remote is behind
             except NotSynchronized:
                 pass
+        if args.tpu and args.beam:
+            # idle-time work: the deferred speculation launch happens after
+            # the frame's critical path, exactly where a renderer would be
+            backend.launch_pending_speculation()
         time.sleep(0.001)
 
     print("done:", game.digest())

@@ -455,16 +455,28 @@ class ResimCore:
             i, inp, stat, save_slot, spec_hi, spec_lo = xs
             # slots i <= matched enter on the precomputed trajectory state
             # of frame load+i (idx < 0 only at shift=0, i=0: the anchor
-            # snapshot itself); later slots carry the resimulated state
+            # snapshot itself); later slots carry the resimulated state.
+            # The gather is cond-gated and fires ONLY where the trajectory
+            # state is actually consumed — a saved prefix slot, or the
+            # i == matched slot that seeds the resimulated suffix. Prefix
+            # slots that save nothing, suffix slots and scratch padding pay
+            # nothing (an ungated per-slot gather measurably made partial
+            # adoption cost more device time than the resim it replaced).
             idx = shift + i - 1
-            prev = jax.tree.map(
-                lambda t: jax.lax.dynamic_index_in_dim(
-                    t, jnp.maximum(idx, 0), 0, keepdims=False
-                ),
-                mtraj,
+
+            def from_traj(state):
+                prev = jax.tree.map(
+                    lambda t: jax.lax.dynamic_index_in_dim(
+                        t, jnp.maximum(idx, 0), 0, keepdims=False
+                    ),
+                    mtraj,
+                )
+                return _tree_where(idx < 0, loaded, prev)
+
+            need_traj = (i <= matched) & (
+                (save_slot < self.ring_len) | (i == matched)
             )
-            s_pre = _tree_where(idx < 0, loaded, prev)
-            state = _tree_where(i <= matched, s_pre, state)
+            state = jax.lax.cond(need_traj, from_traj, lambda s: s, state)
             use_spec = i <= matched
 
             def save(args):
