@@ -221,7 +221,8 @@ class TpuRollbackBackend:
     def __init__(self, game, max_prediction: int, num_players: int,
                  beam_width: int = 0, mesh=None, device_verify: bool = False,
                  speculation_gate: str = "always",
-                 defer_speculation: bool = False, lazy_ticks: int = 0):
+                 defer_speculation: bool = False, lazy_ticks: int = 0,
+                 spec_backend: str = "auto"):
         """`mesh`: optional jax Mesh with an `entity` axis — the world and
         its snapshot ring shard across it (see ResimCore); the session-facing
         contract (requests in, SnapshotRefs + lazy checksums out) is
@@ -267,7 +268,7 @@ class TpuRollbackBackend:
         dispatch behavior back automatically."""
         self.core = ResimCore(
             game, max_prediction, num_players, mesh=mesh,
-            device_verify=device_verify,
+            device_verify=device_verify, spec_backend=spec_backend,
         )
         if (
             beam_width

@@ -1,0 +1,254 @@
+"""Entity-tiled pallas kernel for the speculative beam rollout.
+
+The beam's device cost is B x L full-world steps per tick. Under the XLA
+vmap+scan path that work runs as dozens of unfused elementwise passes —
+the same per-op overhead that makes the XLA SyncTest scan ~2% of HBM peak
+— so speculation taxed ~15ms/tick on a 65k world (BENCH r3 exec phase),
+swamping what adoption saves. This kernel runs the ENTIRE rollout as one
+pallas program tiled over entities: each grid step streams one entity
+tile's anchor state into VMEM and evaluates all B members x L steps on
+it, writing the per-member per-frame trajectory planes and accumulating
+per-(member, frame) partial checksums across tiles (SMEM revisit buffers,
+exactly like pallas_tiled's save events). Legal for `tileable` adapters
+(per-entity-independent step); the time/member-inside-tile order changes
+nothing the model can observe.
+
+Outputs are bit-identical to ResimCore._speculate_impl's XLA path — same
+adapter math, same derived checksum weights, frame terms folded in the
+post-pass — so adoption (which commits these trajectories into the ring)
+is oblivious to which backend speculated.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .pallas_core import (
+    KernelCtx,
+    derive_checksum_weights,
+    get_adapter,
+    make_gi_owner,
+    partial_checksum_planes,
+)
+
+LANE = 128
+
+
+class PallasBeamRollout:
+    """Beam rollout executor for one (game, beam_width) pair; rollout
+    length is a per-call compile key (the backend coalesces depths so only
+    a handful of lengths ever compile)."""
+
+    VMEM_TILE_BUDGET = 24 * 1024 * 1024
+
+    def __init__(self, game, num_players: int, beam_width: int,
+                 interpret: bool = False, tile_rows: int = 0,
+                 max_rollout: int = 12):
+        """`max_rollout`: the deepest rollout length the caller can
+        request (ResimCore passes its window) — the VMEM tile budget is
+        sized to it, so deep prediction windows get smaller tiles instead
+        of silently oversubscribing the budget."""
+        assert game.num_entities % LANE == 0, "entity count must be 128-aligned"
+        self.game = game
+        self.adapter = get_adapter(game)
+        assert getattr(self.adapter, "tileable", False), (
+            f"{type(self.adapter).__name__} is not tileable; the XLA "
+            "vmap rollout handles this model"
+        )
+        self.num_players = num_players
+        self.input_size = game.input_size
+        self.B = beam_width
+        self.n_rows = game.num_entities // LANE
+        self.interpret = interpret
+        n_planes = len(self.adapter.planes)
+        if tile_rows <= 0:
+            # in: anchor planes; out: B*L trajectory windows per plane —
+            # double-buffered by Mosaic
+            per_row = n_planes * (1 + self.B * max_rollout) * LANE * 4 * 2
+            budget_rows = max(1, self.VMEM_TILE_BUDGET // per_row)
+            candidates = [
+                r
+                for r in range(8, self.n_rows + 1, 8)
+                if self.n_rows % r == 0 and r <= budget_rows
+            ]
+            tile_rows = max(candidates) if candidates else self.n_rows
+        assert self.n_rows % tile_rows == 0
+        assert tile_rows >= 8 or tile_rows == self.n_rows
+        self.tile_rows = tile_rows
+        self.n_tiles = self.n_rows // tile_rows
+        self._run = functools.lru_cache(maxsize=8)(self._build)
+        self._cs_entries, self._cs_frame_weight = derive_checksum_weights(
+            game, self.adapter
+        )
+
+    # -- packing ---------------------------------------------------------
+
+    def pack_state(self, state) -> Dict[str, Any]:
+        rows = self.n_rows
+        packed = {}
+        for name, key, c in self.adapter.planes:
+            plane = state[key] if c is None else state[key][..., c]
+            packed[name] = plane.reshape(rows, LANE)
+        return packed
+
+    def unpack_traj(self, outs, L: int, anchor_frame):
+        """Trajectory planes [B*L, rows, LANE] -> state pytree with leaves
+        [B, L, ...] (+ the scaffolding-managed frame leaf)."""
+        n = self.game.num_entities
+        groups: Dict[str, list] = {}
+        for name, key, c in self.adapter.planes:
+            groups.setdefault(key, []).append((c, name))
+        traj = {}
+        for key, comps in groups.items():
+            if len(comps) == 1 and comps[0][0] is None:
+                traj[key] = outs[comps[0][1]].reshape(self.B, L, n)
+            else:
+                traj[key] = jnp.stack(
+                    [outs[nm].reshape(self.B, L, n) for _, nm in comps],
+                    axis=-1,
+                )
+        steps = jnp.arange(L, dtype=jnp.int32)[None, :]
+        traj["frame"] = jnp.broadcast_to(
+            anchor_frame.astype(jnp.int32) + 1 + steps, (self.B, L)
+        )
+        return traj
+
+    # -- kernel ----------------------------------------------------------
+
+    def _build(self, L: int):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        B, rows, tile_rows = self.B, self.n_rows, self.tile_rows
+        P, I = self.num_players, self.input_size
+        adapter = self.adapter
+        plane_names = [name for name, _, _ in adapter.planes]
+        n_tiles = self.n_tiles
+
+        def kernel(inputs_ref, gi_ref, owner_ref, *refs):
+            n_p = len(plane_names)
+            anchors = dict(zip(plane_names, refs[:n_p]))
+            trajs = dict(zip(plane_names, refs[n_p : 2 * n_p]))
+            parts_hi_ref = refs[2 * n_p]
+            parts_lo_ref = refs[2 * n_p + 1]
+
+            first_tile = pl.program_id(0) == 0
+            ctx = KernelCtx(gi_ref[:], owner_ref[:])
+
+            def partial_checksum(state):
+                return partial_checksum_planes(self._cs_entries, ctx.gi, state)
+
+            anchor = {n_: anchors[n_][:] for n_ in plane_names}
+            for b in range(B):
+                state = anchor
+                for l in range(L):
+                    inps = [
+                        [inputs_ref[b, l, p * I + j] for j in range(I)]
+                        for p in range(P)
+                    ]
+                    state = adapter.step(state, inps, ctx)
+                    for n_ in plane_names:
+                        trajs[n_][pl.ds(b * L + l, 1)] = state[n_][None]
+                    hi, lo = partial_checksum(state)
+                    base_hi = jnp.where(
+                        first_tile, jnp.int32(0), parts_hi_ref[b, l]
+                    )
+                    base_lo = jnp.where(
+                        first_tile, jnp.int32(0), parts_lo_ref[b, l]
+                    )
+                    parts_hi_ref[b, l] = base_hi + hi
+                    parts_lo_ref[b, l] = base_lo + lo
+
+        def state_spec():
+            return pl.BlockSpec(
+                (tile_rows, LANE), lambda g: (g, 0), memory_space=pltpu.VMEM
+            )
+
+        def traj_spec():
+            return pl.BlockSpec(
+                (B * L, tile_rows, LANE),
+                lambda g: (0, g, 0),
+                memory_space=pltpu.VMEM,
+            )
+
+        def run(packed, inputs_i32, gi, owner):
+            in_specs = (
+                [
+                    pl.BlockSpec(memory_space=pltpu.SMEM),  # inputs [B,L,P*I]
+                    state_spec(),  # gi
+                    state_spec(),  # owner
+                ]
+                + [state_spec() for _ in plane_names]
+            )
+            out_specs = [traj_spec() for _ in plane_names] + [
+                # cross-tile checksum accumulators (every grid step maps to
+                # the same block, so partial sums carry across tiles)
+                pl.BlockSpec(
+                    (B, L), lambda g: (0, 0), memory_space=pltpu.SMEM
+                ),
+                pl.BlockSpec(
+                    (B, L), lambda g: (0, 0), memory_space=pltpu.SMEM
+                ),
+            ]
+            out_shapes = [
+                jax.ShapeDtypeStruct((B * L, rows, LANE), jnp.int32)
+                for _ in plane_names
+            ] + [
+                jax.ShapeDtypeStruct((B, L), jnp.int32),
+                jax.ShapeDtypeStruct((B, L), jnp.int32),
+            ]
+            results = pl.pallas_call(
+                kernel,
+                grid=(n_tiles,),
+                in_specs=in_specs,
+                out_specs=out_specs,
+                out_shape=out_shapes,
+                compiler_params=(
+                    None
+                    if self.interpret
+                    else pltpu.CompilerParams(
+                        vmem_limit_bytes=100 * 1024 * 1024
+                    )
+                ),
+                interpret=self.interpret,
+            )(
+                inputs_i32,
+                gi,
+                owner,
+                *[packed[n_] for n_ in plane_names],
+            )
+            outs = dict(zip(plane_names, results[: len(plane_names)]))
+            return outs, results[-2], results[-1]
+
+        return run
+
+    # -- public ----------------------------------------------------------
+
+    def rollout(self, anchor_state, beam_inputs):
+        """anchor_state: the game-state pytree at the anchor frame;
+        beam_inputs: u8[B, L, P, I]. Returns (traj pytree [B, L, ...],
+        his u32[B, L], los u32[B, L]) bit-identical to the XLA vmap+scan
+        rollout under all-CONFIRMED statuses."""
+        B, L = beam_inputs.shape[0], beam_inputs.shape[1]
+        assert B == self.B
+        run = self._run(int(L))
+        packed = self.pack_state(anchor_state)
+        inputs_i32 = beam_inputs.reshape(
+            B, L, self.num_players * self.input_size
+        ).astype(jnp.int32)
+        gi, owner = make_gi_owner(self.n_rows, self.num_players)
+        outs, parts_hi, parts_lo = run(packed, inputs_i32, gi, owner)
+        # frame checksum term folded here, once per (member, step)
+        steps = jnp.arange(L, dtype=jnp.int32)[None, :]
+        frames = anchor_state["frame"].astype(jnp.int32) + 1 + steps
+        his = jax.lax.bitcast_convert_type(
+            parts_hi + frames * self._cs_frame_weight, jnp.uint32
+        )
+        los = jax.lax.bitcast_convert_type(parts_lo + frames, jnp.uint32)
+        traj = self.unpack_traj(outs, int(L), anchor_state["frame"])
+        return traj, his, los

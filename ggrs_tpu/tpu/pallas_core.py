@@ -427,6 +427,31 @@ class SwarmPlanes(PlaneAdapter):
                 "charge": charge}
 
 
+def make_gi_owner(n_rows: int, num_players: int, offset=0):
+    """Global-entity-index and owning-player planes for a packed layout —
+    THE one definition of entity ownership (gi % num_players) shared by
+    every pallas kernel. `offset` shifts gi for a shard's slice of the
+    world (traced or static)."""
+    gi = jnp.asarray(
+        np.arange(n_rows, dtype=np.int32)[:, None] * 128
+        + np.arange(128, dtype=np.int32)[None, :]
+    ) + offset
+    return gi, gi % jnp.int32(num_players)
+
+
+def partial_checksum_planes(cs_entries, gi, state):
+    """Per-entity partial checksum sums over packed planes with GLOBAL
+    weights (no frame term — callers fold it once in their post-pass).
+    THE one weight loop shared by the tiled and beam kernels; a drifted
+    copy would break the bit-parity contract adoption depends on."""
+    hi = jnp.int32(0)
+    lo = jnp.int32(0)
+    for name, w, base in cs_entries:
+        hi = hi + jnp.sum(state[name] * ((w * gi + base) * GOLDEN))
+        lo = lo + jnp.sum(state[name])
+    return hi, lo
+
+
 def derive_checksum_weights(game, adapter):
     """Generic checksum weights for a packed-plane layout: for checksum key
     k of per-entity width w at word offset off_k, plane (k, j) element gi

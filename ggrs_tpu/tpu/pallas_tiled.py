@@ -37,7 +37,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .pallas_core import GOLDEN, KernelCtx, derive_checksum_weights, get_adapter
+from .pallas_core import (
+    KernelCtx,
+    derive_checksum_weights,
+    get_adapter,
+    make_gi_owner,
+    partial_checksum_planes,
+)
 
 LANE = 128
 
@@ -224,12 +230,7 @@ class PallasTiledSyncTestCore:
                 # runs can psum the per-shard partials without multiply-
                 # counting it — int32 wraparound adds commute, keeping the
                 # total bit-identical to the unsharded checksum.
-                hi = jnp.int32(0)
-                lo = jnp.int32(0)
-                for name, w, base in self._cs_entries:
-                    hi = hi + jnp.sum(state[name] * ((w * ctx.gi + base) * GOLDEN))
-                    lo = lo + jnp.sum(state[name])
-                return hi, lo
+                return partial_checksum_planes(self._cs_entries, ctx.gi, state)
 
             def save_tile(state, frame, mask, t, j):
                 """Masked ring write + partial-checksum emission into the
@@ -465,19 +466,11 @@ class PallasTiledSyncTestCore:
 
     # -- public ----------------------------------------------------------
 
-    def base_gi(self) -> np.ndarray:
-        """Local entity-index plane [n_rows, LANE]; a sharded caller adds
-        its global entity offset before handing it to run_kernel."""
-        return (
-            np.arange(self.n_rows, dtype=np.int32)[:, None] * LANE
-            + np.arange(LANE, dtype=np.int32)[None, :]
-        )
-
-    def run_kernel(self, carry, inputs, gi):
+    def run_kernel(self, carry, inputs, gi_offset=0):
         """pack -> kernel -> raw outputs (parts NOT yet verdict-folded).
-        `gi` is the global entity-index plane for this kernel's slice;
-        owner derives from it so round-robin ownership follows GLOBAL
-        entity ids regardless of sharding."""
+        `gi_offset` shifts the global entity-index plane to this kernel's
+        slice of the world; owner derives from it so round-robin ownership
+        follows GLOBAL entity ids regardless of sharding."""
         t = inputs.shape[0]
         run = self._batch(t)
         packed = self.pack(carry)
@@ -485,8 +478,7 @@ class PallasTiledSyncTestCore:
             t, self.num_players * self.input_size
         ).astype(jnp.int32)
         c0 = carry["frame"].reshape(1).astype(jnp.int32)
-        gi = jnp.asarray(gi, dtype=jnp.int32)
-        owner = gi % jnp.int32(self.num_players)
+        gi, owner = make_gi_owner(self.n_rows, self.num_players, gi_offset)
         out = run(packed, inputs_i32, c0, gi, owner)
         out["r_frame"] = out["r_frame_new"]
         out["iring"] = out["iring_new"]
@@ -494,7 +486,7 @@ class PallasTiledSyncTestCore:
 
     def batch(self, carry: Dict[str, Any], inputs) -> Dict[str, Any]:
         t = inputs.shape[0]
-        out = self.run_kernel(carry, inputs, self.base_gi())
+        out = self.run_kernel(carry, inputs)
         verdict = self._verdict(
             carry, out["parts_hi"], out["parts_lo"], carry["frame"], t
         )
@@ -555,14 +547,11 @@ class ShardedPallasTiledCore:
         inner = self.inner
         t = inputs.shape[0]
         specs = self._carry_specs(carry)
-        base_gi = inner.base_gi()
 
         def body(carry, inputs):
             idx = jax.lax.axis_index("entity")
-            gi = jnp.asarray(base_gi) + idx.astype(jnp.int32) * jnp.int32(
-                self.local_n
-            )
-            out = inner.run_kernel(carry, inputs, gi)
+            offset = idx.astype(jnp.int32) * jnp.int32(self.local_n)
+            out = inner.run_kernel(carry, inputs, offset)
             # the ONLY cross-shard collective in the hot loop: wraparound
             # partial-checksum sums ride ICI; everything else is local
             out["parts_hi"] = jax.lax.psum(out["parts_hi"], "entity")

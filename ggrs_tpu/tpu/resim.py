@@ -42,7 +42,7 @@ class ResimCore:
     """
 
     def __init__(self, game, max_prediction: int, num_players: int, mesh=None,
-                 device_verify: bool = False):
+                 device_verify: bool = False, spec_backend: str = "auto"):
         """`mesh`: optional jax Mesh with an `entity` axis — the live state
         AND the snapshot ring shard across it (BASELINE.json configs[4]), so
         a partitioned world can run inside any session that drives this
@@ -117,6 +117,33 @@ class ResimCore:
             self._tick_multi_impl, donate_argnums=(0, 1, 3)
         )
         self._speculate_fn = jax.jit(self._speculate_impl)
+        # speculation backend: the XLA vmap+scan rollout runs the step as
+        # unfused elementwise passes, so B*L speculative steps tax several
+        # ms of device time per tick on mid-size worlds; the entity-tiled
+        # pallas rollout (pallas_beam.py) runs the same math at the fused
+        # kernel's cost for tileable models. "auto" picks pallas when the
+        # model supports it (falling back to XLA otherwise); results are
+        # bit-identical either way (tests enforce it).
+        assert spec_backend in ("auto", "xla", "pallas", "pallas-interpret")
+        assert mesh is None or spec_backend in ("auto", "xla"), (
+            "the pallas beam rollout is single-device; a mesh-sharded core "
+            "speculates via the XLA path (auto resolves this)"
+        )
+        if spec_backend == "auto":
+            use_pallas = False
+            if mesh is None and jax.devices()[0].platform == "tpu":
+                try:
+                    from .pallas_core import get_adapter
+
+                    use_pallas = getattr(
+                        get_adapter(game), "tileable", False
+                    ) and game.num_entities % 128 == 0
+                except Exception:
+                    use_pallas = False
+            spec_backend = "pallas" if use_pallas else "xla"
+        self.spec_backend = spec_backend
+        self._beam_rollouts = {}  # beam_width -> PallasBeamRollout
+        self._speculate_pallas_fns = {}  # beam_width -> jitted wrapper
         self._adopt_fn = jax.jit(self._adopt_impl, donate_argnums=(0, 6))
         # tick's packed control-word layout (pack site: tick(); unpack:
         # _tick_packed_impl): 4 header words (do_load, load_slot,
@@ -389,9 +416,49 @@ class ResimCore:
         traj, his, los = jax.vmap(rollout_one)(beam_inputs, beam_statuses)
         return traj, his, los, a_hi, a_lo
 
+    def _speculate_pallas(self, anchor_slot, beam_inputs):
+        """Pallas-rollout speculation: gather the anchor snapshot, then run
+        the entity-tiled beam kernel on it. Output tuple matches
+        _speculate_impl bit-for-bit (all-CONFIRMED statuses)."""
+        B = int(beam_inputs.shape[0])
+        if B not in self._beam_rollouts:
+            from .pallas_beam import PallasBeamRollout
+
+            self._beam_rollouts[B] = PallasBeamRollout(
+                self.game,
+                self.num_players,
+                B,
+                interpret=self.spec_backend.endswith("-interpret"),
+                max_rollout=self.window,  # VMEM budget sized to worst case
+            )
+            rollout = self._beam_rollouts[B]
+
+            def impl(ring, anchor_slot, beam_inputs):
+                anchor = jax.tree.map(
+                    lambda r: jax.lax.dynamic_index_in_dim(
+                        r, anchor_slot, 0, keepdims=False
+                    ),
+                    ring,
+                )
+                a_hi, a_lo = self.game.checksum(anchor)
+                traj, his, los = rollout.rollout(anchor, beam_inputs)
+                return traj, his, los, a_hi, a_lo
+
+            self._speculate_pallas_fns[B] = jax.jit(impl)
+        return self._speculate_pallas_fns[B](
+            self.ring, np.int32(anchor_slot), beam_inputs
+        )
+
     def speculate(self, anchor_slot: int, beam_inputs: np.ndarray,
                   beam_statuses: np.ndarray):
-        """Dispatch a beam rollout from ring slot `anchor_slot` (async)."""
+        """Dispatch a beam rollout from ring slot `anchor_slot` (async).
+        The pallas backend speculates under the all-CONFIRMED statuses
+        contract (the only way the beam is ever used); rollouts with any
+        non-CONFIRMED status fall back to the XLA path."""
+        if self.spec_backend.startswith("pallas") and not np.any(
+            np.asarray(beam_statuses)
+        ):
+            return self._speculate_pallas(anchor_slot, beam_inputs)
         return self._speculate_fn(
             self.ring, np.int32(anchor_slot), beam_inputs, beam_statuses
         )
