@@ -170,6 +170,9 @@ class ExGame:
     # step reads statuses only to substitute DISCONNECTED players' inputs
     # (the dummy spin, ex_game.rs:268) — the property beam adoption needs
     statuses_contract = "disconnect-only"
+    # the substituted input row itself (lets kernels apply the
+    # substitution per player instead of per entity)
+    disconnect_input = bytes([DISCONNECT_INPUT])
 
     def __init__(
         self, num_players: int = 2, num_entities: int = 4096, substeps: int = 1

@@ -143,6 +143,7 @@ class Swarm:
     # statuses only substitute DISCONNECTED players' inputs: beam adoption
     # of all-CONFIRMED rollouts is sound
     statuses_contract = "disconnect-only"
+    disconnect_input = bytes([DISCONNECT_INPUT])
 
     def __init__(self, num_players: int = 2, num_entities: int = 4096):
         self.num_players = num_players

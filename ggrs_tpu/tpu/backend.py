@@ -222,7 +222,7 @@ class TpuRollbackBackend:
                  beam_width: int = 0, mesh=None, device_verify: bool = False,
                  speculation_gate: str = "always",
                  defer_speculation: bool = False, lazy_ticks: int = 0,
-                 spec_backend: str = "auto"):
+                 spec_backend: str = "auto", tick_backend: str = "auto"):
         """`mesh`: optional jax Mesh with an `entity` axis — the world and
         its snapshot ring shard across it (see ResimCore); the session-facing
         contract (requests in, SnapshotRefs + lazy checksums out) is
@@ -269,6 +269,7 @@ class TpuRollbackBackend:
         self.core = ResimCore(
             game, max_prediction, num_players, mesh=mesh,
             device_verify=device_verify, spec_backend=spec_backend,
+            tick_backend=tick_backend,
         )
         if (
             beam_width
@@ -616,9 +617,10 @@ class TpuRollbackBackend:
         configured buffer depth with no-op rows so one length compiles
         once; materializes the future checksum batch the buffered saves'
         cells already hold. A single-row buffer dispatches through the
-        plain tick program instead — a flush-heavy configuration (e.g.
-        beam speculation forcing a flush every tick) then pays the
-        one-tick program, not the T-deep scan."""
+        plain (warmup-compiled) tick program instead — a flush-heavy
+        configuration (e.g. beam speculation forcing a flush every tick)
+        then pays the one-tick program, not the T-deep scan, and never a
+        mid-session compile."""
         rows, future = self._tick_rows, self._tick_future
         if not rows:
             return
@@ -627,9 +629,7 @@ class TpuRollbackBackend:
         core = self.core
         if len(rows) == 1:
             with GLOBAL_TRACER.span("tpu/fused_tick"):
-                core.ring, core.state, core.verify, his, los = core._tick_fn(
-                    core.ring, core.state, rows[0], core.verify
-                )
+                his, los = core.tick_row(rows[0])
         else:
             buf = np.tile(core.pad_tick_row(), (self.lazy_ticks, 1))
             for j, r in enumerate(rows):

@@ -1,0 +1,409 @@
+"""Entity-tiled pallas kernel for ResimCore's generic tick program.
+
+The request path (P2P rollbacks, plain ticks, the lazy multi-tick buffer)
+runs ResimCore's control-word-driven tick: optional ring load, then W
+masked (save?, advance?) micro-slots. Under XLA that is dozens of unfused
+elementwise passes per step — cheap at 4k entities, several ms at 65k+.
+This kernel runs T packed tick rows per dispatch tiled over entities:
+each grid step streams one tile's state + snapshot ring into VMEM and
+executes every row's window loop on it, with the SAME packed control-word
+layout ResimCore.pack_tick_row builds (rows ride in SMEM), in-kernel
+per-player disconnect-input substitution, and cross-tile partial
+checksums. Scalar lanes (state/ring frame fields, the device-verify
+history, the returned per-slot checksums with their frame terms) are a
+tiny jnp post-pass — a few hundred scalar ops mirroring _tick_impl.
+
+Correctness contract: bit-identical ring/state/checksum outputs to
+ResimCore._tick_impl for session-driven control words (the session
+invariant start_frame == frame of the first window slot holds by
+construction; _verify_update relies on the same invariant). Tileable
+adapters only; the XLA scan remains the fallback and the mesh path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import InputStatus
+from .pallas_core import (
+    KernelCtx,
+    derive_checksum_weights,
+    get_adapter,
+    make_gi_owner,
+    partial_checksum_planes,
+)
+
+LANE = 128
+
+
+class PallasTickCore:
+    """Executor for ResimCore's packed tick rows on the entity-tiled
+    kernel. One instance per ResimCore; T (rows per dispatch) is the
+    compile key (1 for per-tick dispatch, lazy_ticks for the buffer)."""
+
+    VMEM_TILE_BUDGET = 28 * 1024 * 1024
+
+    def __init__(self, core, interpret: bool = False, tile_rows: int = 0):
+        game = core.game
+        assert game.num_entities % LANE == 0
+        self.core = core
+        self.game = game
+        self.adapter = get_adapter(game)
+        assert getattr(self.adapter, "tileable", False)
+        self.num_players = core.num_players
+        self.input_size = game.input_size
+        self.W = core.window
+        self.ring_len = core.ring_len
+        self.n_rows = game.num_entities // LANE
+        self.interpret = interpret
+        # the disconnect-substitution row (the reference's dummy input,
+        # ex_game.rs:268): games declare it; substitution is per player,
+        # exactly the where(status==DISCONNECTED, ...) the model step does
+        disc = getattr(game, "disconnect_input", None)
+        assert disc is not None and len(disc) == self.input_size, (
+            f"{type(game).__name__} must declare disconnect_input "
+            "(bytes, input_size long) for the pallas tick path"
+        )
+        self.disconnect_input = np.frombuffer(
+            bytes(disc), dtype=np.uint8
+        ).astype(np.int32)
+        n_planes = len(self.adapter.planes)
+        if tile_rows <= 0:
+            per_row = n_planes * (1 + self.ring_len + 1) * LANE * 4 * 2
+            budget_rows = max(1, self.VMEM_TILE_BUDGET // per_row)
+            candidates = [
+                r
+                for r in range(8, self.n_rows + 1, 8)
+                if self.n_rows % r == 0 and r <= budget_rows
+            ]
+            tile_rows = max(candidates) if candidates else self.n_rows
+        assert self.n_rows % tile_rows == 0
+        assert tile_rows >= 8 or tile_rows == self.n_rows
+        self.tile_rows = tile_rows
+        self.n_tiles = self.n_rows // tile_rows
+        self._run = functools.lru_cache(maxsize=4)(self._build)
+        self._cs_entries, self._cs_frame_weight = derive_checksum_weights(
+            game, self.adapter
+        )
+
+    # -- packing (ring has ring_len+1 slots; the scratch slot is never
+    # -- read or written by a masked save, but it rides along so the
+    # -- pytree shape matches ResimCore's exactly) -----------------------
+
+    def pack(self, ring, state):
+        rows = self.n_rows
+        packed = {}
+        for name, key, c in self.adapter.planes:
+            s = state[key] if c is None else state[key][..., c]
+            r = ring[key] if c is None else ring[key][..., c]
+            packed[name] = s.reshape(rows, LANE)
+            packed["r_" + name] = r.reshape(r.shape[0], rows, LANE)
+        return packed
+
+    def unpack(self, outs, ring, state):
+        n = self.game.num_entities
+        groups: Dict[str, list] = {}
+        for name, key, c in self.adapter.planes:
+            groups.setdefault(key, []).append((c, name))
+
+        def rebuild(prefix, lead):
+            out = {}
+            for key, comps in groups.items():
+                if len(comps) == 1 and comps[0][0] is None:
+                    out[key] = outs[prefix + comps[0][1]].reshape(lead + (n,))
+                else:
+                    out[key] = jnp.stack(
+                        [
+                            outs[prefix + nm].reshape(lead + (n,))
+                            for _, nm in comps
+                        ],
+                        axis=-1,
+                    )
+            return out
+
+        new_state = rebuild("", ())
+        new_ring = rebuild("r_", (self.ring_len + 1,))
+        return new_ring, new_state
+
+    # -- kernel ----------------------------------------------------------
+
+    def _build(self, T: int):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        W, P, I = self.W, self.num_players, self.input_size
+        ring_len, rows, tile_rows = self.ring_len, self.n_rows, self.tile_rows
+        adapter = self.adapter
+        plane_names = [name for name, _, _ in adapter.planes]
+        core = self.core
+        off_save, off_status, off_input = (
+            core._off_save, core._off_status, core._off_input,
+        )
+        disc = [int(v) for v in self.disconnect_input]
+        disconnected = int(InputStatus.DISCONNECTED)
+
+        def kernel(rows_ref, gi_ref, owner_ref, *refs):
+            n_p = len(plane_names)
+            state_out = dict(zip(plane_names, refs[2 * n_p : 3 * n_p]))
+            ring_out = dict(
+                zip(plane_names, refs[3 * n_p : 4 * n_p])
+            )
+            parts_hi_ref = refs[4 * n_p]
+            parts_lo_ref = refs[4 * n_p + 1]
+
+            first_tile = pl.program_id(0) == 0
+            ctx = KernelCtx(gi_ref[:], owner_ref[:])
+
+            # initialize output windows explicitly from the inputs (the
+            # same Mosaic aliasing caveat pallas_tiled documents)
+            ins_state = dict(zip(plane_names, refs[:n_p]))
+            ins_ring = dict(zip(plane_names, refs[n_p : 2 * n_p]))
+            for n_ in plane_names:
+                state_out[n_][...] = ins_state[n_][...]
+                ring_out[n_][...] = ins_ring[n_][...]
+
+            def ring_slot(name, slot):
+                return ring_out[name][pl.ds(slot, 1)][0]
+
+            def tick(t, _):
+                do_load = rows_ref[t, 0] != 0
+                load_slot = rows_ref[t, 1]
+                advance_count = rows_ref[t, 2]
+                cur = {n_: state_out[n_][:] for n_ in plane_names}
+                loaded = {
+                    n_: ring_slot(n_, load_slot) for n_ in plane_names
+                }
+                state = {
+                    n_: jnp.where(do_load, loaded[n_], cur[n_])
+                    for n_ in plane_names
+                }
+                for i in range(W):
+                    save_slot = rows_ref[t, off_save + i]
+                    do_save = save_slot < ring_len
+                    hi, lo = partial_checksum_planes(
+                        self._cs_entries, ctx.gi, state
+                    )
+                    base_hi = jnp.where(
+                        first_tile, jnp.int32(0), parts_hi_ref[t, i]
+                    )
+                    base_lo = jnp.where(
+                        first_tile, jnp.int32(0), parts_lo_ref[t, i]
+                    )
+                    parts_hi_ref[t, i] = base_hi + jnp.where(do_save, hi, 0)
+                    parts_lo_ref[t, i] = base_lo + jnp.where(do_save, lo, 0)
+                    # masked ring write: scratch-or-beyond slots clamp to 0
+                    # with the mask off, leaving slot 0 unchanged
+                    wslot = jnp.where(do_save, save_slot, 0)
+                    for n_ in plane_names:
+                        old = ring_slot(n_, wslot)
+                        ring_out[n_][pl.ds(wslot, 1)] = jnp.where(
+                            do_save, state[n_], old
+                        )[None]
+                    # masked step with in-kernel disconnect substitution
+                    inps = []
+                    for p in range(P):
+                        status = rows_ref[t, off_status + i * P + p]
+                        row_bytes = []
+                        for j in range(I):
+                            b = rows_ref[t, off_input + (i * P + p) * I + j]
+                            row_bytes.append(
+                                jnp.where(
+                                    status == disconnected, disc[j], b
+                                )
+                            )
+                        inps.append(row_bytes)
+                    nxt = adapter.step(state, inps, ctx)
+                    do_adv = i < advance_count
+                    state = {
+                        n_: jnp.where(do_adv, nxt[n_], state[n_])
+                        for n_ in plane_names
+                    }
+                for n_ in plane_names:
+                    state_out[n_][:] = state[n_]
+                return 0
+
+            jax.lax.fori_loop(0, T, tick, 0)
+
+        def state_spec():
+            return pl.BlockSpec(
+                (tile_rows, LANE), lambda g: (g, 0), memory_space=pltpu.VMEM
+            )
+
+        def ring_spec():
+            return pl.BlockSpec(
+                (ring_len + 1, tile_rows, LANE),
+                lambda g: (0, g, 0),
+                memory_space=pltpu.VMEM,
+            )
+
+        def run(packed, rows_i32, gi, owner):
+            n_p = len(plane_names)
+            in_specs = (
+                [
+                    pl.BlockSpec(memory_space=pltpu.SMEM),  # rows [T, L]
+                    state_spec(),  # gi
+                    state_spec(),  # owner
+                ]
+                + [state_spec() for _ in plane_names]
+                + [ring_spec() for _ in plane_names]
+            )
+            out_specs = (
+                [state_spec() for _ in plane_names]
+                + [ring_spec() for _ in plane_names]
+                + [
+                    pl.BlockSpec(
+                        (T, W), lambda g: (0, 0), memory_space=pltpu.SMEM
+                    ),
+                    pl.BlockSpec(
+                        (T, W), lambda g: (0, 0), memory_space=pltpu.SMEM
+                    ),
+                ]
+            )
+            out_shapes = (
+                [
+                    jax.ShapeDtypeStruct((rows, LANE), jnp.int32)
+                    for _ in plane_names
+                ]
+                + [
+                    jax.ShapeDtypeStruct(
+                        (ring_len + 1, rows, LANE), jnp.int32
+                    )
+                    for _ in plane_names
+                ]
+                + [
+                    jax.ShapeDtypeStruct((T, W), jnp.int32),
+                    jax.ShapeDtypeStruct((T, W), jnp.int32),
+                ]
+            )
+            aliases = {3 + i: i for i in range(2 * n_p)}
+            results = pl.pallas_call(
+                kernel,
+                grid=(self.n_tiles,),
+                in_specs=in_specs,
+                out_specs=out_specs,
+                out_shape=out_shapes,
+                input_output_aliases=aliases,
+                compiler_params=(
+                    None
+                    if self.interpret
+                    else pltpu.CompilerParams(
+                        vmem_limit_bytes=100 * 1024 * 1024
+                    )
+                ),
+                interpret=self.interpret,
+            )(
+                rows_i32,
+                gi,
+                owner,
+                *[packed[n_] for n_ in plane_names],
+                *[packed["r_" + n_] for n_ in plane_names],
+            )
+            outs = dict(zip(plane_names, results[: n_p]))
+            outs.update(
+                zip(["r_" + n_ for n_ in plane_names], results[n_p : 2 * n_p])
+            )
+            return outs, results[-2], results[-1]
+
+        return run
+
+    # -- scalar post-pass: frame fields, verify carry, returned checksums
+
+    def _scalar_pass(self, ring_frame, state_frame, verify, rows, parts_hi,
+                     parts_lo):
+        """jnp mirror of _tick_impl's scalar behavior over the T x W save
+        events: ring/state frame updates, the device-verify first-seen
+        history, and the per-slot (hi, lo) outputs with their frame terms
+        (zeros for skipped saves, exactly like the XLA path)."""
+        core = self.core
+        W, ring_len = self.W, self.ring_len
+        off_save = core._off_save
+
+        def row_body(carry, xs):
+            ring_frame, state_frame, verify = carry
+            row, p_hi, p_lo = xs
+            do_load = row[0] != 0
+            load_slot = row[1]
+            advance_count = row[2]
+            start_frame = row[3]
+            # the state's OWN frame drives saved checksums and ring frame
+            # fields (exactly what the XLA path's game.checksum(state)
+            # reads); the verify history keys on start_frame + i, exactly
+            # like _tick_impl's _verify_update call. Sessions keep the two
+            # identical by construction; matching both independently makes
+            # the backends bit-equal even for hand-driven streams.
+            state_frame = jnp.where(
+                do_load, ring_frame[load_slot], state_frame
+            )
+            his = []
+            los = []
+            for i in range(W):
+                save_slot = row[off_save + i]
+                do_save = save_slot < ring_len
+                # state frame entering slot i: advances stop at
+                # advance_count, exactly like the state itself (a save
+                # past the last advance checksums the frozen state)
+                frame_i = state_frame + jnp.minimum(i, advance_count)
+                hi = jax.lax.bitcast_convert_type(
+                    p_hi[i] + frame_i * self._cs_frame_weight, jnp.uint32
+                )
+                lo = jax.lax.bitcast_convert_type(
+                    p_lo[i] + frame_i, jnp.uint32
+                )
+                hi = jnp.where(do_save, hi, jnp.uint32(0))
+                lo = jnp.where(do_save, lo, jnp.uint32(0))
+                his.append(hi)
+                los.append(lo)
+                wslot = jnp.where(do_save, save_slot, 0)
+                ring_frame = ring_frame.at[wslot].set(
+                    jnp.where(do_save, frame_i, ring_frame[wslot])
+                )
+                if core.device_verify:
+                    upd = core._verify_update(
+                        verify, start_frame + i, hi, lo
+                    )
+                    verify = jax.tree.map(
+                        lambda new, old: jnp.where(do_save, new, old),
+                        upd,
+                        verify,
+                    )
+            state_frame = state_frame + advance_count
+            return (ring_frame, state_frame, verify), (
+                jnp.stack(his), jnp.stack(los),
+            )
+
+        (ring_frame, state_frame, verify), (his, los) = jax.lax.scan(
+            row_body,
+            (ring_frame, state_frame, verify),
+            (rows, parts_hi, parts_lo),
+        )
+        return ring_frame, state_frame, verify, his, los
+
+    # -- public ----------------------------------------------------------
+
+    def tick_multi(self, ring, state, rows, verify):
+        """Run T packed tick rows; returns (ring, state, verify, his[T,W],
+        los[T,W]) with the same semantics as ResimCore._tick_multi_impl."""
+        T = rows.shape[0]
+        run = self._run(int(T))
+        packed = self.pack(ring, state)
+        gi, owner = make_gi_owner(self.n_rows, self.num_players)
+        outs, parts_hi, parts_lo = run(
+            packed, rows.astype(jnp.int32), gi, owner
+        )
+        new_ring, new_state = self.unpack(outs, ring, state)
+        ring_frame, state_frame, verify, his, los = self._scalar_pass(
+            ring["frame"],
+            state["frame"],
+            verify,
+            rows.astype(jnp.int32),
+            parts_hi,
+            parts_lo,
+        )
+        new_ring["frame"] = ring_frame
+        new_state["frame"] = state_frame
+        return new_ring, new_state, verify, his, los

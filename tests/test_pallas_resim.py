@@ -1,0 +1,155 @@
+"""Pallas tick kernel (ggrs_tpu/tpu/pallas_resim.py): ResimCore's generic
+control-word tick — the P2P request path's program — on the entity-tiled
+kernel. Bit parity with the XLA scan is the whole contract: random
+rollback depths, partial saves, disconnect substitution, device-verify
+history, the lazy multi-tick buffer, and live sessions must all be
+indistinguishable across backends."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.tree_util as jtu
+
+from ggrs_tpu import SessionBuilder
+from ggrs_tpu.models.ex_game import ExGame
+from ggrs_tpu.models.swarm import Swarm
+from ggrs_tpu.tpu import TpuRollbackBackend
+from ggrs_tpu.tpu.resim import ResimCore
+from ggrs_tpu.types import InputStatus
+
+P = 2
+
+
+def drive_random(game, tick_backend, batches=8, rows_per_batch=3, seed=7,
+                 mod=16):
+    """Session-shaped random control streams dispatched as MULTI-ROW
+    batches (T > 1 is where the pallas kernel actually engages — lone
+    ticks route to the XLA scan by design): random rollback depths with
+    dense saving (the invariant real sessions maintain), occasional
+    disconnect statuses."""
+    core = ResimCore(game, max_prediction=6, num_players=P,
+                     device_verify=True, tick_backend=tick_backend)
+    W = core.window
+    out = []
+    frame = 0
+    r = np.random.default_rng(seed)
+    for _ in range(batches):
+        rows = []
+        for _ in range(rows_per_batch):
+            depth = int(r.integers(0, 6))
+            do_load = depth > 0 and frame > depth
+            count = depth + 1 if do_load else 1
+            start = frame - depth if do_load else frame
+            inputs = np.zeros((W, P, 1), np.uint8)
+            statuses = np.zeros((W, P), np.int32)
+            for i in range(count):
+                inputs[i] = r.integers(0, mod, (P, 1))
+                if r.random() < 0.15:
+                    statuses[i, r.integers(0, P)] = int(
+                        InputStatus.DISCONNECTED
+                    )
+            slots = np.full((W,), core.scratch_slot, np.int32)
+            for i in range(count):
+                slots[i] = (start + i) % core.ring_len
+            rows.append(
+                core.pack_tick_row(
+                    do_load, (start % core.ring_len) if do_load else 0,
+                    inputs, statuses, slots, count, start_frame=start,
+                )
+            )
+            frame = start + count
+        his, los = core.tick_multi(np.stack(rows))
+        out.append((np.asarray(his), np.asarray(los)))
+    return core, out
+
+
+def assert_core_equal(a, b):
+    la = jtu.tree_leaves_with_path(
+        jax.device_get({"ring": a.ring, "state": a.state, "verify": a.verify})
+    )
+    lb = jtu.tree_leaves(
+        jax.device_get({"ring": b.ring, "state": b.state, "verify": b.verify})
+    )
+    assert len(la) == len(lb)
+    for (path, x), y in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=jtu.keystr(path)
+        )
+
+
+@pytest.mark.parametrize("Game,mod", [(ExGame, 16), (Swarm, 128)])
+def test_tick_kernel_bit_parity_with_xla(Game, mod):
+    game = Game(P, 512)
+    a, ca = drive_random(game, "pallas-interpret", mod=mod)
+    b, cb = drive_random(game, "xla", mod=mod)
+    for t, ((h1, l1), (h2, l2)) in enumerate(zip(ca, cb)):
+        np.testing.assert_array_equal(h1, h2, err_msg=f"his tick {t}")
+        np.testing.assert_array_equal(l1, l2, err_msg=f"los tick {t}")
+    assert_core_equal(a, b)
+
+
+def test_tick_kernel_multi_row_lazy_parity():
+    """The lazy multi-tick buffer through the kernel: a featured backend
+    (pallas ticks + lazy batching) vs a plain XLA per-tick backend over
+    the same SyncTest stream — states and every save's checksum equal."""
+
+    def make_backend(**kw):
+        return TpuRollbackBackend(
+            ExGame(P, 256), max_prediction=6, num_players=P, **kw
+        )
+
+    def make_sess():
+        return (
+            SessionBuilder(input_size=1)
+            .with_num_players(P)
+            .with_max_prediction_window(6)
+            .with_check_distance(4)
+            .start_synctest_session()
+        )
+
+    feat = make_backend(tick_backend="pallas-interpret", lazy_ticks=5)
+    plain = make_backend(tick_backend="xla")
+    sf, sp = make_sess(), make_sess()
+    f_saves, p_saves = [], []
+    for t in range(25):
+        for h in range(P):
+            buf = bytes([(t * (3 + h) + h) % 16])
+            sf.add_local_input(h, buf)
+            sp.add_local_input(h, buf)
+        rf, rp = sf.advance_frame(), sp.advance_frame()
+        feat.handle_requests(rf)
+        plain.handle_requests(rp)
+        f_saves += [
+            (r.cell.frame, r.cell.checksum_getter())
+            for r in rf
+            if hasattr(r, "cell")
+        ]
+        p_saves += [
+            (r.cell.frame, r.cell.checksum_getter())
+            for r in rp
+            if hasattr(r, "cell")
+        ]
+    a, b = feat.state_numpy(), plain.state_numpy()
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=k)
+    assert len(f_saves) == len(p_saves)
+    for (ff, fg), (pf, pg) in zip(f_saves, p_saves):
+        assert ff == pf
+        assert fg() == pg(), f"checksum frame {ff}"
+
+
+def test_tick_kernel_requires_disconnect_input():
+    """A tileable game without a declared disconnect_input row cannot use
+    the kernel explicitly, and auto resolves to xla."""
+
+    class NoDisc(ExGame):
+        disconnect_input = None
+
+    with pytest.raises(AssertionError, match="disconnect_input"):
+        ResimCore(NoDisc(P, 256), max_prediction=6, num_players=P,
+                  tick_backend="pallas-interpret")
+    core = ResimCore(NoDisc(P, 256), max_prediction=6, num_players=P,
+                     tick_backend="auto")
+    assert core.tick_backend == "xla"
