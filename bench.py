@@ -744,8 +744,14 @@ def bench_tunnel_floor():
     tick_program = (time.perf_counter() - t0) / n * 1000.0
 
     # ...and the 16-tick fused program amortizes it: the per-tick floor of
-    # the lazy-batched request path (compare p2p4_lazy16's wall per tick)
-    rows = np.tile(core.pad_tick_row(), (16, 1))
+    # the lazy-batched request path (compare p2p4_lazy16's wall per tick).
+    # Rows carry one real advance + save each — the content a live lazy
+    # buffer actually holds — so the figure is representative for both
+    # the XLA scan and the pallas tick kernel the multi path routes to.
+    slots1 = np.full((W,), core.scratch_slot, np.int32)
+    slots1[0] = 1
+    row = core.pack_tick_row(False, 0, z_in, z_st, slots1, 1)
+    rows = np.tile(row, (16, 1))
     core.tick_multi(rows)
     true_barrier(core.state)
     t0 = time.perf_counter()

@@ -30,10 +30,13 @@ import numpy as np
 
 from .pallas_core import (
     KernelCtx,
+    choose_tile_rows,
     derive_checksum_weights,
     get_adapter,
     make_gi_owner,
     partial_checksum_planes,
+    plane_groups,
+    rebuild_from_planes,
 )
 
 LANE = 128
@@ -70,13 +73,9 @@ class PallasBeamRollout:
             # in: anchor planes; out: B*L trajectory windows per plane —
             # double-buffered by Mosaic
             per_row = n_planes * (1 + self.B * max_rollout) * LANE * 4 * 2
-            budget_rows = max(1, self.VMEM_TILE_BUDGET // per_row)
-            candidates = [
-                r
-                for r in range(8, self.n_rows + 1, 8)
-                if self.n_rows % r == 0 and r <= budget_rows
-            ]
-            tile_rows = max(candidates) if candidates else self.n_rows
+            tile_rows = choose_tile_rows(
+                self.n_rows, per_row, self.VMEM_TILE_BUDGET
+            )
         assert self.n_rows % tile_rows == 0
         assert tile_rows >= 8 or tile_rows == self.n_rows
         self.tile_rows = tile_rows
@@ -100,18 +99,9 @@ class PallasBeamRollout:
         """Trajectory planes [B*L, rows, LANE] -> state pytree with leaves
         [B, L, ...] (+ the scaffolding-managed frame leaf)."""
         n = self.game.num_entities
-        groups: Dict[str, list] = {}
-        for name, key, c in self.adapter.planes:
-            groups.setdefault(key, []).append((c, name))
-        traj = {}
-        for key, comps in groups.items():
-            if len(comps) == 1 and comps[0][0] is None:
-                traj[key] = outs[comps[0][1]].reshape(self.B, L, n)
-            else:
-                traj[key] = jnp.stack(
-                    [outs[nm].reshape(self.B, L, n) for _, nm in comps],
-                    axis=-1,
-                )
+        traj = rebuild_from_planes(
+            plane_groups(self.adapter), lambda nm: outs[nm], (self.B, L), n
+        )
         steps = jnp.arange(L, dtype=jnp.int32)[None, :]
         traj["frame"] = jnp.broadcast_to(
             anchor_frame.astype(jnp.int32) + 1 + steps, (self.B, L)

@@ -427,6 +427,58 @@ class SwarmPlanes(PlaneAdapter):
                 "charge": charge}
 
 
+def choose_tile_rows(n_rows: int, per_row_bytes: int, budget: int) -> int:
+    """Entity-tile sizing shared by every gridded pallas kernel: the
+    largest 8-multiple divisor of n_rows whose streamed windows fit the
+    VMEM budget (bigger tiles = fewer grid steps); a row count with no
+    such divisor falls back to one full tile. The result always satisfies
+    Mosaic's 8-sublane block constraint (>= 8 or == n_rows) and divides
+    n_rows."""
+    budget_rows = max(1, budget // per_row_bytes)
+    candidates = [
+        r
+        for r in range(8, n_rows + 1, 8)
+        if n_rows % r == 0 and r <= budget_rows
+    ]
+    tile = max(candidates) if candidates else n_rows
+    assert n_rows % tile == 0
+    assert tile >= 8 or tile == n_rows
+    return tile
+
+
+def plane_groups(adapter) -> Dict[str, list]:
+    """state_key -> ordered [(component, plane_name)] for an adapter's
+    plane layout, with the component-order contract enforced (components
+    MUST be declared 0..w-1 — out-of-order planes would silently stack
+    into the wrong state columns)."""
+    groups: Dict[str, list] = {}
+    for name, key, c in adapter.planes:
+        groups.setdefault(key, []).append((c, name))
+    for key, comps in groups.items():
+        if not (len(comps) == 1 and comps[0][0] is None):
+            assert [c for c, _ in comps] == list(range(len(comps))), (
+                f"plane components for {key!r} must be declared in order "
+                f"0..{len(comps) - 1}"
+            )
+    return groups
+
+
+def rebuild_from_planes(groups: Dict[str, list], fetch, lead: tuple, n: int):
+    """Inverse of plane packing, shared by every kernel's unpack: fetch
+    each plane by name, reshape to lead + (n,), and stack multi-component
+    keys back into [..., n, w] arrays."""
+    out = {}
+    for key, comps in groups.items():
+        if len(comps) == 1 and comps[0][0] is None:
+            out[key] = fetch(comps[0][1]).reshape(lead + (n,))
+        else:
+            out[key] = jnp.stack(
+                [fetch(nm).reshape(lead + (n,)) for _, nm in comps],
+                axis=-1,
+            )
+    return out
+
+
 def make_gi_owner(n_rows: int, num_players: int, offset=0):
     """Global-entity-index and owning-player planes for a packed layout —
     THE one definition of entity ownership (gi % num_players) shared by
@@ -597,28 +649,12 @@ class PallasSyncTestCore:
 
     def unpack(self, p, _unused=None) -> Dict[str, Any]:
         n = self.game.num_entities
-
-        # group planes back into state arrays, preserving declaration order
-        groups: Dict[str, List[Tuple[Optional[int], str]]] = {}
-        for name, key, c in self.adapter.planes:
-            groups.setdefault(key, []).append((c, name))
-
-        def rebuild(prefix, lead):
-            out = {}
-            for key, comps in groups.items():
-                if len(comps) == 1 and comps[0][0] is None:
-                    out[key] = p[prefix + comps[0][1]].reshape(lead + (n,))
-                else:
-                    assert [c for c, _ in comps] == list(range(len(comps)))
-                    out[key] = jnp.stack(
-                        [p[prefix + nm].reshape(lead + (n,)) for _, nm in comps],
-                        axis=-1,
-                    )
-            return out
-
-        state = rebuild("", ())
+        groups = plane_groups(self.adapter)
+        state = rebuild_from_planes(groups, lambda nm: p[nm], (), n)
         state["frame"] = p["meta"][0]  # state frame == tick frame invariant
-        ring = rebuild("r_", (self.ring_len,))
+        ring = rebuild_from_planes(
+            groups, lambda nm: p["r_" + nm], (self.ring_len,), n
+        )
         ring["frame"] = p["r_frame"]
         return {
             "state": state,

@@ -32,10 +32,13 @@ import numpy as np
 from ..types import InputStatus
 from .pallas_core import (
     KernelCtx,
+    choose_tile_rows,
     derive_checksum_weights,
     get_adapter,
     make_gi_owner,
     partial_checksum_planes,
+    plane_groups,
+    rebuild_from_planes,
 )
 
 LANE = 128
@@ -75,13 +78,9 @@ class PallasTickCore:
         n_planes = len(self.adapter.planes)
         if tile_rows <= 0:
             per_row = n_planes * (1 + self.ring_len + 1) * LANE * 4 * 2
-            budget_rows = max(1, self.VMEM_TILE_BUDGET // per_row)
-            candidates = [
-                r
-                for r in range(8, self.n_rows + 1, 8)
-                if self.n_rows % r == 0 and r <= budget_rows
-            ]
-            tile_rows = max(candidates) if candidates else self.n_rows
+            tile_rows = choose_tile_rows(
+                self.n_rows, per_row, self.VMEM_TILE_BUDGET
+            )
         assert self.n_rows % tile_rows == 0
         assert tile_rows >= 8 or tile_rows == self.n_rows
         self.tile_rows = tile_rows
@@ -107,27 +106,13 @@ class PallasTickCore:
 
     def unpack(self, outs, ring, state):
         n = self.game.num_entities
-        groups: Dict[str, list] = {}
-        for name, key, c in self.adapter.planes:
-            groups.setdefault(key, []).append((c, name))
-
-        def rebuild(prefix, lead):
-            out = {}
-            for key, comps in groups.items():
-                if len(comps) == 1 and comps[0][0] is None:
-                    out[key] = outs[prefix + comps[0][1]].reshape(lead + (n,))
-                else:
-                    out[key] = jnp.stack(
-                        [
-                            outs[prefix + nm].reshape(lead + (n,))
-                            for _, nm in comps
-                        ],
-                        axis=-1,
-                    )
-            return out
-
-        new_state = rebuild("", ())
-        new_ring = rebuild("r_", (self.ring_len + 1,))
+        groups = plane_groups(self.adapter)
+        new_state = rebuild_from_planes(
+            groups, lambda nm: outs[nm], (), n
+        )
+        new_ring = rebuild_from_planes(
+            groups, lambda nm: outs["r_" + nm], (self.ring_len + 1,), n
+        )
         return new_ring, new_state
 
     # -- kernel ----------------------------------------------------------

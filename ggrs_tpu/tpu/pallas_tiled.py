@@ -39,10 +39,13 @@ import numpy as np
 
 from .pallas_core import (
     KernelCtx,
+    choose_tile_rows,
     derive_checksum_weights,
     get_adapter,
     make_gi_owner,
     partial_checksum_planes,
+    plane_groups,
+    rebuild_from_planes,
 )
 
 LANE = 128
@@ -85,17 +88,10 @@ class PallasTiledSyncTestCore:
         self.interpret = interpret
         n_planes = len(self.adapter.planes)
         if tile_rows <= 0:
-            # largest 8-multiple divisor of n_rows fitting the budget
-            # (bigger tiles = fewer grid steps); a world whose row count
-            # has no such divisor falls back to one full tile
             per_row = n_planes * (1 + self.ring_len) * LANE * 4 * 2
-            budget_rows = max(1, self.VMEM_TILE_BUDGET // per_row)
-            candidates = [
-                r
-                for r in range(8, self.n_rows + 1, 8)
-                if self.n_rows % r == 0 and r <= budget_rows
-            ]
-            tile_rows = max(candidates) if candidates else self.n_rows
+            tile_rows = choose_tile_rows(
+                self.n_rows, per_row, self.VMEM_TILE_BUDGET
+            )
         assert self.n_rows % tile_rows == 0, (
             f"tile_rows {tile_rows} must divide {self.n_rows}"
         )
@@ -133,25 +129,12 @@ class PallasTiledSyncTestCore:
 
     def unpack(self, p, carry, verdict):
         n = self.n
-        groups: Dict[str, list] = {}
-        for name, key, c in self.adapter.planes:
-            groups.setdefault(key, []).append((c, name))
-
-        def rebuild(prefix, lead):
-            out = {}
-            for key, comps in groups.items():
-                if len(comps) == 1 and comps[0][0] is None:
-                    out[key] = p[prefix + comps[0][1]].reshape(lead + (n,))
-                else:
-                    out[key] = jnp.stack(
-                        [p[prefix + nm].reshape(lead + (n,)) for _, nm in comps],
-                        axis=-1,
-                    )
-            return out
-
-        state = rebuild("", ())
+        groups = plane_groups(self.adapter)
+        state = rebuild_from_planes(groups, lambda nm: p[nm], (), n)
         state["frame"] = verdict["frame"]
-        ring = rebuild("r_", (self.ring_len,))
+        ring = rebuild_from_planes(
+            groups, lambda nm: p["r_" + nm], (self.ring_len,), n
+        )
         ring["frame"] = p["r_frame"]
         return {
             "state": state,
