@@ -23,7 +23,6 @@ adapters only; the XLA scan remains the fallback and the mesh path.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -20,6 +20,7 @@ from ggrs_tpu import (
 from ggrs_tpu.models import ex_game
 from ggrs_tpu.network.sockets import InMemoryNetwork
 from ggrs_tpu.parallel.mesh import make_mesh
+from ggrs_tpu.tpu import TpuRollbackBackend
 from ggrs_tpu.utils.clock import FakeClock
 
 NUM_PLAYERS = 2
@@ -114,6 +115,26 @@ def test_sharded_backend_with_beam(mesh):
     # a constant script makes the repeat-last member the corrected script:
     # the sharded adopt path must actually run
     assert sharded.beam_hits > 0
+
+
+def test_sharded_backend_with_lazy_ticks(mesh):
+    """Lazy tick batching composes with the mesh-sharded core: the fused
+    multi-tick scan runs under GSPMD over the entity axis, bit-matching
+    the plain per-tick sharded backend (and the unsharded one)."""
+    sharded_plain = make_backend(mesh)
+    sharded_lazy = TpuRollbackBackend(
+        ex_game.ExGame(NUM_PLAYERS, ENTITIES),
+        max_prediction=8,
+        num_players=NUM_PLAYERS,
+        mesh=mesh,
+        lazy_ticks=5,
+    )
+    drive_synctest(sharded_lazy, 30, check_distance=3)
+    drive_synctest(sharded_plain, 30, check_distance=3)
+    assert_state_equal(sharded_lazy.state_numpy(), sharded_plain.state_numpy())
+    unsharded = make_backend(None)
+    drive_synctest(unsharded, 30, check_distance=3)
+    assert_state_equal(sharded_lazy.state_numpy(), unsharded.state_numpy())
 
 
 def test_sharded_checkpoint_roundtrip(tmp_path, mesh):
