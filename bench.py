@@ -120,6 +120,33 @@ def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
     return resim / elapsed, (elapsed / ticks) * 1000.0, backend, sess
 
 
+def bench_fused_default(bench_batches=20):
+    """Out-of-box configuration (VERDICT r2 item 6's done-criterion on
+    record): constructor DEFAULTS only — backend auto-resolves to the
+    fastest supported kernel, the verdict is check()-on-demand. Must sit
+    within run noise of the tuned headline config."""
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.tpu import TpuSyncTestSession
+
+    s = TpuSyncTestSession(
+        ExGame(PLAYERS, ENTITIES),
+        num_players=PLAYERS,
+        check_distance=CHECK_DISTANCE,
+    )
+    f = 0
+    for _ in range(WARMUP_BATCHES):
+        s.advance_frames(input_script(BATCH, f))
+        f += BATCH
+    s.check()
+    t0 = time.perf_counter()
+    for _ in range(bench_batches):
+        s.advance_frames(input_script(BATCH, f))
+        f += BATCH
+    s.check()
+    elapsed = time.perf_counter() - t0
+    return (bench_batches * BATCH * CHECK_DISTANCE) / elapsed, s.backend
+
+
 def bench_roofline():
     """Compute-bound regime (VERDICT r1 item 4): large-world configs with a
     utilization estimate against the chip's HBM roofline.
@@ -978,6 +1005,7 @@ def main():
     soak_rate, soak_ms, _soak_be = _run_phase(
         "bench_fused(bench_batches=12, batch=1920)[:3]"
     )
+    default_rate, default_backend = _run_phase("bench_fused_default()")
     request_rate, request_median_ms = _run_phase("bench_request_path()")
     hostverify_rate, _hv_ms = _run_phase(
         "bench_request_path(device_verify=False)"
@@ -1051,6 +1079,8 @@ def main():
                 "ms_per_8frame_rollback_tick": round(ms_per_tick, 4),
                 "fused_soak_batch1920_frames_per_sec": round(soak_rate, 1),
                 "fused_soak_ms_per_tick": round(soak_ms, 4),
+                "fused_default_config_frames_per_sec": round(default_rate, 1),
+                "fused_default_backend": default_backend,
                 "request_path_frames_per_sec": round(request_rate, 1),
                 "request_path_median_tick_ms": round(request_median_ms, 4),
                 "request_path_hostverify_frames_per_sec": round(hostverify_rate, 1),

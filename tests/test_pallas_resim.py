@@ -22,25 +22,31 @@ P = 2
 
 
 def drive_random(game, tick_backend, batches=8, rows_per_batch=3, seed=7,
-                 mod=16):
+                 mod=16, max_prediction=6):
     """Session-shaped random control streams dispatched as MULTI-ROW
     batches (T > 1 is where the pallas kernel actually engages — lone
-    ticks route to the XLA scan by design): random rollback depths with
-    dense saving (the invariant real sessions maintain), occasional
-    disconnect statuses."""
-    core = ResimCore(game, max_prediction=6, num_players=P,
+    ticks route to the XLA scan by design): random rollback depths up to
+    max_prediction - 1 with dense saving (the invariant real sessions
+    maintain), occasional disconnect statuses. A spin-up of plain rows
+    first grows the frame past the window so the deepest depths are
+    actually reachable (frame only nets +1 per row)."""
+    core = ResimCore(game, max_prediction=max_prediction, num_players=P,
                      device_verify=True, tick_backend=tick_backend)
     W = core.window
     out = []
     frame = 0
     r = np.random.default_rng(seed)
-    for _ in range(batches):
+    deepest = 0
+    for batch in range(batches + 1):
         rows = []
-        for _ in range(rows_per_batch):
-            depth = int(r.integers(0, 6))
+        n_rows = max_prediction + 2 if batch == 0 else rows_per_batch
+        for _ in range(n_rows):
+            depth = 0 if batch == 0 else int(r.integers(0, max_prediction))
             do_load = depth > 0 and frame > depth
             count = depth + 1 if do_load else 1
             start = frame - depth if do_load else frame
+            if do_load:
+                deepest = max(deepest, depth)
             inputs = np.zeros((W, P, 1), np.uint8)
             statuses = np.zeros((W, P), np.int32)
             for i in range(count):
@@ -61,6 +67,9 @@ def drive_random(game, tick_backend, batches=8, rows_per_batch=3, seed=7,
             frame = start + count
         his, los = core.tick_multi(np.stack(rows))
         out.append((np.asarray(his), np.asarray(los)))
+    # the stream must actually exercise deep rollbacks, not just shallow
+    # ones that the smaller-window tests already cover
+    assert deepest >= max_prediction - 2, (deepest, max_prediction)
     return core, out
 
 
@@ -86,6 +95,21 @@ def test_tick_kernel_bit_parity_with_xla(Game, mod):
     for t, ((h1, l1), (h2, l2)) in enumerate(zip(ca, cb)):
         np.testing.assert_array_equal(h1, h2, err_msg=f"his tick {t}")
         np.testing.assert_array_equal(l1, l2, err_msg=f"los tick {t}")
+    assert_core_equal(a, b)
+
+
+def test_tick_kernel_deep_window_parity():
+    """A 16-frame prediction window (W=18, 18-slot ring): the VMEM tile
+    sizing and the frame clamp past advance_count hold at real depth
+    (the driver asserts rollbacks >= max_prediction - 2 actually ran)."""
+    game = ExGame(P, 1024)
+    a, ca = drive_random(game, "pallas-interpret", batches=6,
+                         rows_per_batch=2, seed=13, max_prediction=16)
+    b, cb = drive_random(game, "xla", batches=6, rows_per_batch=2, seed=13,
+                         max_prediction=16)
+    for t, ((h1, l1), (h2, l2)) in enumerate(zip(ca, cb)):
+        np.testing.assert_array_equal(h1, h2, err_msg=f"his batch {t}")
+        np.testing.assert_array_equal(l1, l2, err_msg=f"los batch {t}")
     assert_core_equal(a, b)
 
 

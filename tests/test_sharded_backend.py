@@ -33,8 +33,6 @@ def mesh():
 
 
 def make_backend(mesh=None, beam_width=0, max_prediction=8):
-    from ggrs_tpu.tpu import TpuRollbackBackend
-
     game = ex_game.ExGame(NUM_PLAYERS, ENTITIES)
     return TpuRollbackBackend(
         game,
@@ -138,8 +136,6 @@ def test_sharded_backend_with_lazy_ticks(mesh):
 
 
 def test_sharded_checkpoint_roundtrip(tmp_path, mesh):
-    from ggrs_tpu.tpu import TpuRollbackBackend
-
     backend = make_backend(mesh)
     drive_synctest(backend, 20, check_distance=2)
     path = str(tmp_path / "ckpt.npz")
