@@ -1,0 +1,166 @@
+"""Deterministic match replays: record the confirmed input stream, replay
+to bit-identical state.
+
+The reference has no replay system (nothing survives the process,
+SURVEY.md §5); this is the feature its determinism contract exists to
+enable. The recorder is a pure observer of the session's ordered request
+stream (the same boundary the backends consume): every AdvanceFrame's
+inputs are tracked per frame, later rollbacks overwrite earlier
+predictions, and frames at or below the session's confirmed frontier are
+final — so the recording holds exactly the inputs every peer agrees on,
+regardless of which backend fulfilled the requests or how many rollbacks
+it took to get there. Because the simulation is a pure function of
+(initial state, confirmed inputs), replaying the recording through any
+backend reproduces the match bit-for-bit — the replay twin of the desync
+detector's cross-peer guarantee.
+
+Wire format: npz — inputs u8[F, P, I], statuses i32[F, P], plus the
+model's identity fields for a load-time sanity check.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..types import AdvanceFrame, Frame, LoadGameState, SaveGameState
+
+
+class InputRecorder:
+    """Observes ordered request streams and accumulates the confirmed
+    per-frame input history.
+
+    Usage (alongside any request consumer):
+        recorder = InputRecorder()
+        ...
+        reqs = sess.advance_frame()
+        recorder.observe(reqs)
+        backend.handle_requests(reqs)
+        ...
+        recorder.confirm_through(sess.confirmed_frame())
+        recorder.save("match.npz")
+    """
+
+    def __init__(self):
+        self._rows: Dict[Frame, Tuple[np.ndarray, np.ndarray]] = {}
+        self._confirmed: Frame = -1
+        self._next_frame: Frame = 0  # O(1) anchor for save/load-less ticks
+
+    def observe(self, requests: List[Any]) -> None:
+        """Track every AdvanceFrame's inputs; a rollback's corrected
+        re-advances overwrite the predictions they replace (the same
+        last-write-wins rule the simulation itself follows)."""
+        frame = None
+        for req in requests:
+            if isinstance(req, LoadGameState):
+                frame = req.frame
+            elif isinstance(req, SaveGameState):
+                # the save preceding an advance snapshots that frame
+                # (request grammar [Load?] (Save? Advance)* Save?), so it
+                # anchors the count even for load-less ticks
+                frame = req.frame
+            elif isinstance(req, AdvanceFrame):
+                if frame is None:
+                    frame = self._next_frame
+                inputs = np.stack(
+                    [
+                        np.frombuffer(buf, dtype=np.uint8)
+                        for buf, _ in req.inputs
+                    ]
+                )
+                statuses = np.array(
+                    [int(s) for _, s in req.inputs], dtype=np.int32
+                )
+                self._rows[frame] = (inputs, statuses)
+                frame += 1
+                self._next_frame = max(self._next_frame, frame)
+
+    def confirm_through(self, frame: Frame) -> None:
+        """Mark frames <= `frame` final (the session's confirmed frontier:
+        every connected peer's real input has arrived for them)."""
+        self._confirmed = max(self._confirmed, frame)
+
+    @property
+    def confirmed_frames(self) -> int:
+        """Number of leading frames that are final."""
+        n = 0
+        while n <= self._confirmed and n in self._rows:
+            n += 1
+        return n
+
+    def confirmed_script(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(inputs u8[F, P, I], statuses i32[F, P]) for the confirmed
+        prefix — the replayable recording."""
+        n = self.confirmed_frames
+        if n == 0:
+            raise ValueError("nothing confirmed yet")
+        inputs = np.stack([self._rows[f][0] for f in range(n)])
+        statuses = np.stack([self._rows[f][1] for f in range(n)])
+        return inputs, statuses
+
+    def save(self, path: str, game=None) -> None:
+        """Persist the confirmed prefix; `game` stamps identity fields so
+        load() can refuse a mismatched world."""
+        inputs, statuses = self.confirmed_script()
+        meta = {}
+        if game is not None:
+            meta = {
+                "game_cls": type(game).__name__,
+                "num_players": game.num_players,
+                "num_entities": game.num_entities,
+                "input_size": game.input_size,
+            }
+        np.savez_compressed(path, inputs=inputs, statuses=statuses, **meta)
+
+
+def load_replay(path: str, game=None) -> Tuple[np.ndarray, np.ndarray]:
+    """Load a recording; with `game` given, check it matches the world the
+    recording was made on (a replay against the wrong model would diverge
+    silently — refuse loudly instead)."""
+    z = np.load(path)
+    if game is not None and "game_cls" in z:
+        for field, want in (
+            ("game_cls", type(game).__name__),
+            ("num_players", game.num_players),
+            ("num_entities", game.num_entities),
+            ("input_size", game.input_size),
+        ):
+            got = z[field][()] if z[field].shape == () else z[field]
+            if str(got) != str(want):
+                # a replay against the wrong world diverges silently;
+                # refuse loudly (and not via assert, which -O strips)
+                raise ValueError(
+                    f"replay was recorded on {field}={got}, not {want}"
+                )
+    return np.asarray(z["inputs"]), np.asarray(z["statuses"])
+
+
+def replay_to_state(game, inputs: np.ndarray, statuses: np.ndarray,
+                    tick_backend: str = "auto"):
+    """Re-simulate a recording from the initial world: one fused
+    multi-tick dispatch per chunk through ResimCore (each frame is a
+    plain confirmed tick — no rollbacks in a replay). Returns the final
+    device state pytree, bit-identical to the live session's state at the
+    recording's last frame."""
+    from ..tpu.resim import ResimCore
+
+    F = inputs.shape[0]
+    core = ResimCore(game, max_prediction=2, num_players=game.num_players,
+                     tick_backend=tick_backend)
+    W = core.window
+    chunk = 64
+    for base in range(0, F, chunk):
+        rows = []
+        for f in range(base, min(base + chunk, F)):
+            inp = np.zeros((W, game.num_players, game.input_size), np.uint8)
+            stat = np.zeros((W, game.num_players), np.int32)
+            inp[0] = inputs[f]
+            stat[0] = statuses[f]
+            slots = np.full((W,), core.scratch_slot, np.int32)
+            slots[0] = f % core.ring_len
+            rows.append(core.pack_tick_row(
+                False, 0, inp, stat, slots, 1, start_frame=f,
+            ))
+        core.tick_multi(np.stack(rows))
+    return core.fetch_state()

@@ -1,0 +1,158 @@
+"""Deterministic match replays (ggrs_tpu/utils/replay.py): a recording of
+the confirmed input stream, observed at the request boundary of a LIVE
+session full of rollbacks and mispredictions, must replay from the
+initial world to the exact bit state the live session reached — the
+payoff of the determinism contract, and a feature the reference lacks
+(its snapshots die with the process, SURVEY.md §5)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from ggrs_tpu import PlayerType, SessionBuilder, SessionState
+from ggrs_tpu.models.ex_game import ExGame
+from ggrs_tpu.models.swarm import Swarm
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.tpu import TpuRollbackBackend
+from ggrs_tpu.utils.clock import FakeClock
+from ggrs_tpu.utils.replay import InputRecorder, load_replay, replay_to_state
+
+PLAYERS = 2
+ENTITIES = 64
+
+
+def test_synctest_recording_replays_bitexact(tmp_path):
+    """SyncTest session (forced rollbacks every tick): record at the
+    request boundary, replay from scratch, compare final states."""
+    game = ExGame(PLAYERS, ENTITIES)
+    backend = TpuRollbackBackend(game, max_prediction=6, num_players=PLAYERS)
+    recorder = InputRecorder()
+    sess = (
+        SessionBuilder(input_size=1)
+        .with_num_players(PLAYERS)
+        .with_max_prediction_window(6)
+        .with_check_distance(4)
+        .start_synctest_session()
+    )
+    rng = np.random.default_rng(31)
+    for t in range(40):
+        for h in range(PLAYERS):
+            sess.add_local_input(h, bytes([int(rng.integers(0, 16))]))
+        reqs = sess.advance_frame()
+        recorder.observe(reqs)
+        backend.handle_requests(reqs)
+    recorder.confirm_through(backend.current_frame - 1)
+
+    path = str(tmp_path / "match.npz")
+    recorder.save(path, game)
+    inputs, statuses = load_replay(path, ExGame(PLAYERS, ENTITIES))
+    assert inputs.shape[0] == backend.current_frame
+
+    final = replay_to_state(ExGame(PLAYERS, ENTITIES), inputs, statuses)
+    live = backend.state_numpy()
+    for k in live:
+        np.testing.assert_array_equal(
+            np.asarray(final[k]), np.asarray(live[k]), err_msg=k
+        )
+
+
+def test_live_p2p_recording_replays_bitexact():
+    """The decisive case: a live P2P run full of mispredicted rollbacks
+    (toggling held inputs at lag). Record on peer A; the replay must
+    reproduce the ring snapshot of the last mutually confirmed frame."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock=clock)
+
+    def build(my_addr, other_addr, handle):
+        return (
+            SessionBuilder(input_size=1)
+            .with_num_players(PLAYERS)
+            .with_max_prediction_window(8)
+            .with_clock(clock)
+            .with_rng(random.Random(99 + handle))
+            .add_player(PlayerType.local(), handle)
+            .add_player(PlayerType.remote(other_addr), 1 - handle)
+            .start_p2p_session(net.socket(my_addr))
+        )
+
+    sess_a, sess_b = build("a", "b", 0), build("b", "a", 1)
+    game = ExGame(PLAYERS, ENTITIES)
+    back_a = TpuRollbackBackend(game, max_prediction=8, num_players=PLAYERS)
+    back_b = TpuRollbackBackend(game, max_prediction=8, num_players=PLAYERS)
+    recorder = InputRecorder()
+    for _ in range(400):
+        for s in (sess_a, sess_b):
+            s.poll_remote_clients()
+            s.events()
+        clock.advance(20)
+        if all(
+            s.current_state() == SessionState.RUNNING for s in (sess_a, sess_b)
+        ):
+            break
+    assert sess_a.current_state() == SessionState.RUNNING
+
+    for frame in range(50):
+        for sess, backend, handle in ((sess_a, back_a, 0), (sess_b, back_b, 1)):
+            sess.poll_remote_clients()
+            sess.events()
+            v = 3 if (frame // 5) % 2 == 0 else 11
+            sess.add_local_input(handle, bytes([v + handle]))
+            reqs = sess.advance_frame()
+            if handle == 0:
+                recorder.observe(reqs)
+            backend.handle_requests(reqs)
+        clock.advance(17)
+    for _ in range(10):
+        sess_a.poll_remote_clients()
+        sess_b.poll_remote_clients()
+        clock.advance(17)
+    for sess, backend, handle in ((sess_a, back_a, 0), (sess_b, back_b, 1)):
+        sess.poll_remote_clients()
+        sess.add_local_input(handle, b"\x01")
+        reqs = sess.advance_frame()
+        if handle == 0:
+            recorder.observe(reqs)
+        backend.handle_requests(reqs)
+
+    c = min(sess_a.confirmed_frame(), sess_b.confirmed_frame())
+    recorder.confirm_through(c - 1)
+    inputs, statuses = recorder.confirmed_script()
+    assert inputs.shape[0] >= c  # the confirmed prefix covers frames 0..c-1
+
+    # replay frames 0..c-1: state after them == ring snapshot OF frame c
+    final = replay_to_state(
+        ExGame(PLAYERS, ENTITIES), inputs[:c], statuses[:c]
+    )
+    snap = back_a.core.fetch_ring_slot(c % back_a.core.ring_len)
+    assert int(np.asarray(snap["frame"])) == c
+    for k in snap:
+        np.testing.assert_array_equal(
+            np.asarray(final[k]), np.asarray(snap[k]), err_msg=k
+        )
+
+
+def test_replay_refuses_wrong_world(tmp_path):
+    game = ExGame(PLAYERS, ENTITIES)
+    backend = TpuRollbackBackend(game, max_prediction=6, num_players=PLAYERS)
+    recorder = InputRecorder()
+    sess = (
+        SessionBuilder(input_size=1)
+        .with_num_players(PLAYERS)
+        .with_max_prediction_window(6)
+        .with_check_distance(2)
+        .start_synctest_session()
+    )
+    for t in range(6):
+        for h in range(PLAYERS):
+            sess.add_local_input(h, bytes([t % 7]))
+        reqs = sess.advance_frame()
+        recorder.observe(reqs)
+        backend.handle_requests(reqs)
+    recorder.confirm_through(backend.current_frame - 1)
+    path = str(tmp_path / "m.npz")
+    recorder.save(path, game)
+    with pytest.raises(ValueError, match="recorded on"):
+        load_replay(path, Swarm(PLAYERS, ENTITIES))
+    with pytest.raises(ValueError, match="recorded on"):
+        load_replay(path, ExGame(PLAYERS, ENTITIES * 2))
