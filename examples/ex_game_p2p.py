@@ -94,6 +94,13 @@ def main() -> int:
         help="L1 transport: udp (default) or the TCP-backed datagram "
         "socket (the pluggable-transport seam; all peers must match)",
     )
+    ap.add_argument(
+        "--record",
+        default=None,
+        metavar="PATH",
+        help="record the match: the confirmed input stream saves to PATH "
+        "at exit (replay with examples/replay.py — bit-identical)",
+    )
     args = ap.parse_args()
     if args.replay_protect and not args.auth_key:
         ap.error("--replay-protect requires --auth-key")
@@ -151,6 +158,11 @@ def main() -> int:
             sock, bytes.fromhex(args.auth_key), replay_protect=args.replay_protect
         )
     sess = builder.start_p2p_session(sock)
+    recorder = None
+    if args.record:
+        from ggrs_tpu.utils.replay import InputRecorder
+
+        recorder = InputRecorder()
     if args.tpu:
 
         class DeviceGameDriver:
@@ -199,7 +211,10 @@ def main() -> int:
             try:
                 for handle in local_handles:
                     sess.add_local_input(handle, scripted_input(frame, handle))
-                game.handle_requests(sess.advance_frame())
+                reqs = sess.advance_frame()
+                if recorder is not None:
+                    recorder.observe(reqs)
+                game.handle_requests(reqs)
                 frame += 1
                 if frame % 120 == 0:
                     print(game.digest())
@@ -214,6 +229,24 @@ def main() -> int:
         time.sleep(0.001)
 
     print("done:", game.digest())
+    if recorder is not None:
+        from ggrs_tpu.models.ex_game import ExGame as _ExGame
+
+        recorder.confirm_through(sess.confirmed_frame() - 1)
+        try:
+            # both paths simulate ex_game dynamics (HostGame is its numpy
+            # oracle), so the identity stamp is always ExGame-shaped —
+            # replays against the wrong world must refuse loudly
+            recorder.save(
+                args.record,
+                game=_ExGame(len(args.players), args.entities),
+            )
+            print(
+                f"recorded {recorder.confirmed_frames} confirmed frames -> "
+                f"{args.record}"
+            )
+        except ValueError:
+            print("no confirmed frames at exit; nothing recorded")
     return 0
 
 
