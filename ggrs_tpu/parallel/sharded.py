@@ -25,33 +25,55 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.fixed_point import GOLDEN32
 
 
+def state_specs(state):
+    """PartitionSpec pytree for a game-state pytree: entity arrays split
+    over the `entity` axis on axis 0, scalars replicated. THE sharded-state
+    placement policy as specs — shard_state places with it, and every
+    shard_map consumer (ShardedPallasTiledCore, ShardedPallasTickCore)
+    must build its in/out specs from here so the contract can't drift."""
+    return jax.tree.map(lambda x: P("entity") if x.ndim >= 1 else P(), state)
+
+
+def ring_specs(ring):
+    """PartitionSpec pytree for a snapshot-ring pytree (state leaves with a
+    leading slot axis): entity dims split over `entity` on axis 1, per-slot
+    scalars replicated. The ring twin of `state_specs`."""
+    return jax.tree.map(
+        lambda x: P(None, "entity") if x.ndim >= 2 else P(), ring
+    )
+
+
 def shard_state(state, mesh: Mesh):
-    """Place a game-state pytree on the mesh: entity arrays split over the
-    `entity` axis, scalars replicated.
-
-    This is THE sharded-state placement policy (every consumer — ResimCore,
-    TpuSyncTestSession, the beam rollout — must route through here or
-    `shard_ring` so the contract can't drift between components): every
-    non-scalar state leaf has entities on axis 0, divisible by the `entity`
-    axis size."""
-
-    def put(x):
-        spec = P("entity") if x.ndim >= 1 else P()
-        return jax.device_put(x, NamedSharding(mesh, spec))
-
-    return jax.tree.map(put, state)
+    """Place a game-state pytree on the mesh per `state_specs` (every
+    consumer — ResimCore, TpuSyncTestSession, the beam rollout — must route
+    through here or `shard_ring` so the contract can't drift between
+    components): every non-scalar state leaf has entities on axis 0,
+    divisible by the `entity` axis size."""
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state,
+        state_specs(state),
+    )
 
 
 def shard_ring(ring, mesh: Mesh):
-    """Place a snapshot-ring pytree (state leaves with a leading slot axis)
-    on the mesh: entity dims split over `entity` on axis 1, per-slot scalars
-    replicated. The ring twin of `shard_state`'s placement policy."""
+    """Place a snapshot-ring pytree on the mesh per `ring_specs` — the
+    ring twin of `shard_state`."""
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        ring,
+        ring_specs(ring),
+    )
 
-    def put(x):
-        spec = P(None, "entity") if x.ndim >= 2 else P()
-        return jax.device_put(x, NamedSharding(mesh, spec))
 
-    return jax.tree.map(put, ring)
+def entity_shardable(num_entities: int, mesh: Mesh, lane: int = 128) -> bool:
+    """THE divisibility rule for running one local entity-tiled pallas
+    kernel per mesh device: the world must split into `entity`-axis shards
+    of 128-lane-aligned size. Shared by ResimCore's backend auto-selection
+    and the sharded cores' constructor asserts so the two can't drift."""
+    if "entity" not in mesh.axis_names:
+        return False
+    return num_entities % (mesh.shape["entity"] * lane) == 0
 
 
 def sharded_checksum(state, mesh: Mesh, keys=None):

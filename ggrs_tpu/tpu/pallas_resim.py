@@ -17,7 +17,14 @@ Correctness contract: bit-identical ring/state/checksum outputs to
 ResimCore._tick_impl for session-driven control words (the session
 invariant start_frame == frame of the first window slot holds by
 construction; _verify_update relies on the same invariant). Tileable
-adapters only; the XLA scan remains the fallback and the mesh path.
+adapters only; the XLA scan remains the fallback.
+
+Mesh composition: ShardedPallasTickCore shard_maps one LOCAL kernel per
+device over the `entity` axis (exactly the ShardedPallasTiledCore
+recipe) and psums the per-shard partial checksums — the flagship
+"partitioned world inside a live P2P session" config
+(src/sessions/p2p_session.rs:621-673 scaled multi-chip) then runs at the
+tiled kernel's bandwidth instead of the XLA scan's.
 """
 
 from __future__ import annotations
@@ -50,9 +57,17 @@ class PallasTickCore:
 
     VMEM_TILE_BUDGET = 28 * 1024 * 1024
 
-    def __init__(self, core, interpret: bool = False, tile_rows: int = 0):
+    def __init__(self, core, interpret: bool = False, tile_rows: int = 0,
+                 local_entities: int = 0):
+        """`local_entities`: when nonzero, the kernel operates on that many
+        entities (one shard's slice of the world) while checksum weights
+        keep using the GLOBAL entity count — ShardedPallasTickCore runs one
+        such local kernel per mesh device and psums the partial checksums,
+        which then match the unsharded totals bit-for-bit (the same
+        composition ShardedPallasTiledCore uses for the SyncTest batch)."""
         game = core.game
-        assert game.num_entities % LANE == 0
+        self.n = local_entities or game.num_entities
+        assert self.n % LANE == 0
         self.core = core
         self.game = game
         self.adapter = get_adapter(game)
@@ -61,7 +76,7 @@ class PallasTickCore:
         self.input_size = game.input_size
         self.W = core.window
         self.ring_len = core.ring_len
-        self.n_rows = game.num_entities // LANE
+        self.n_rows = self.n // LANE
         self.interpret = interpret
         # the disconnect-substitution row (the reference's dummy input,
         # ex_game.rs:268): games declare it; substitution is per player,
@@ -104,7 +119,7 @@ class PallasTickCore:
         return packed
 
     def unpack(self, outs, ring, state):
-        n = self.game.num_entities
+        n = self.n
         groups = plane_groups(self.adapter)
         new_state = rebuild_from_planes(
             groups, lambda nm: outs[nm], (), n
@@ -369,16 +384,21 @@ class PallasTickCore:
 
     # -- public ----------------------------------------------------------
 
-    def tick_multi(self, ring, state, rows, verify):
-        """Run T packed tick rows; returns (ring, state, verify, his[T,W],
-        los[T,W]) with the same semantics as ResimCore._tick_multi_impl."""
+    def run_kernel(self, ring, state, rows, gi_offset=0):
+        """pack -> kernel -> (plane outs, partial checksums). `gi_offset`
+        shifts the global entity-index plane to this kernel's slice of the
+        world (the sharded composition's seam); the scalar post-pass is NOT
+        applied — sharded callers psum the partials first."""
         T = rows.shape[0]
         run = self._run(int(T))
         packed = self.pack(ring, state)
-        gi, owner = make_gi_owner(self.n_rows, self.num_players)
-        outs, parts_hi, parts_lo = run(
-            packed, rows.astype(jnp.int32), gi, owner
-        )
+        gi, owner = make_gi_owner(self.n_rows, self.num_players, gi_offset)
+        return run(packed, rows.astype(jnp.int32), gi, owner)
+
+    def tick_multi(self, ring, state, rows, verify):
+        """Run T packed tick rows; returns (ring, state, verify, his[T,W],
+        los[T,W]) with the same semantics as ResimCore._tick_multi_impl."""
+        outs, parts_hi, parts_lo = self.run_kernel(ring, state, rows)
         new_ring, new_state = self.unpack(outs, ring, state)
         ring_frame, state_frame, verify, his, los = self._scalar_pass(
             ring["frame"],
@@ -391,3 +411,77 @@ class PallasTickCore:
         new_ring["frame"] = ring_frame
         new_state["frame"] = state_frame
         return new_ring, new_state, verify, his, los
+
+
+class ShardedPallasTickCore:
+    """The entity-tiled tick kernel composed with a device mesh: shard_map
+    over the `entity` axis runs one local kernel per device on its slice of
+    the world + snapshot ring, psums the per-shard partial checksums (int32
+    wraparound sums are order-invariant, so the totals are bit-identical to
+    the unsharded kernel's), then runs the scalar post-pass on the
+    replicated scalars. Drop-in for ResimCore's (ring, state, rows, verify)
+    tick program under `mesh=` — the request path's multi-chip execution at
+    the tiled kernel's bandwidth (completing for P2P/lazy ticks what
+    ShardedPallasTiledCore did for the fused SyncTest batch)."""
+
+    def __init__(self, core, mesh, interpret: bool = False):
+        from ..parallel.sharded import entity_shardable
+
+        self.mesh = mesh
+        n_shards = mesh.shape.get("entity", 0)
+        game = core.game
+        assert entity_shardable(game.num_entities, mesh, LANE), (
+            f"num_entities {game.num_entities} must split into "
+            f"{n_shards} 128-aligned shards over the mesh's `entity` axis"
+        )
+        self.local_n = game.num_entities // n_shards
+        self.inner = PallasTickCore(
+            core, interpret=interpret, local_entities=self.local_n
+        )
+        self.core = core
+
+    def tick_multi(self, ring, state, rows, verify):
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.sharded import ring_specs, state_specs
+
+        inner = self.inner
+        local_n = self.local_n
+        s_specs = state_specs(state)
+        r_specs = ring_specs(ring)
+        verify_specs = jax.tree.map(lambda x: P(), verify)
+
+        def body(ring, state, rows, verify):
+            idx = jax.lax.axis_index("entity")
+            offset = idx.astype(jnp.int32) * jnp.int32(local_n)
+            outs, parts_hi, parts_lo = inner.run_kernel(
+                ring, state, rows, offset
+            )
+            # the ONLY cross-shard collective in the hot loop: wraparound
+            # partial-checksum sums ride ICI; everything else is local
+            parts_hi = jax.lax.psum(parts_hi, "entity")
+            parts_lo = jax.lax.psum(parts_lo, "entity")
+            new_ring, new_state = inner.unpack(outs, ring, state)
+            ring_frame, state_frame, verify, his, los = inner._scalar_pass(
+                ring["frame"],
+                state["frame"],
+                verify,
+                rows.astype(jnp.int32),
+                parts_hi,
+                parts_lo,
+            )
+            new_ring["frame"] = ring_frame
+            new_state["frame"] = state_frame
+            return new_ring, new_state, verify, his, los
+
+        shard_fn = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(r_specs, s_specs, P(), verify_specs),
+            out_specs=(r_specs, s_specs, verify_specs, P(), P()),
+            # pallas outputs defeat replication inference; the replicated
+            # outs (scalar-pass results) are computed identically on every
+            # shard from replicated inputs (+psum'd totals)
+            check_vma=False,
+        )
+        return shard_fn(ring, state, rows, verify)

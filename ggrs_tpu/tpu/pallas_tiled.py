@@ -489,12 +489,13 @@ class ShardedPallasTiledCore:
 
     def __init__(self, game, num_players: int, check_distance: int,
                  mesh, interpret: bool = False):
-        assert "entity" in mesh.axis_names, "mesh needs an `entity` axis"
+        from ..parallel.sharded import entity_shardable
+
         self.mesh = mesh
-        n_shards = mesh.shape["entity"]
-        assert game.num_entities % (n_shards * LANE) == 0, (
+        n_shards = mesh.shape.get("entity", 0)
+        assert entity_shardable(game.num_entities, mesh, LANE), (
             f"num_entities {game.num_entities} must split into "
-            f"{n_shards} 128-aligned shards"
+            f"{n_shards} 128-aligned shards over the mesh's `entity` axis"
         )
         self.local_n = game.num_entities // n_shards
         self.inner = PallasTiledSyncTestCore(
@@ -506,15 +507,11 @@ class ShardedPallasTiledCore:
     def _carry_specs(self, carry):
         from jax.sharding import PartitionSpec as P
 
+        from ..parallel.sharded import ring_specs, state_specs
+
         return {
-            "state": jax.tree.map(
-                lambda x: P("entity") if x.ndim >= 1 else P(),
-                carry["state"],
-            ),
-            "ring": jax.tree.map(
-                lambda x: P(None, "entity") if x.ndim >= 2 else P(),
-                carry["ring"],
-            ),
+            "state": state_specs(carry["state"]),
+            "ring": ring_specs(carry["ring"]),
             "input_ring": P(),
             "h_tag": P(),
             "h_hi": P(),

@@ -119,13 +119,22 @@ class ResimCore:
         )
         self._speculate_fn = jax.jit(self._speculate_impl)
 
-        def pallas_eligible(extra=lambda: True) -> bool:
-            """Can this (game, mesh) run a single-device pallas kernel?
-            THE one eligibility predicate for both the speculation and
-            tick backends — a drifted copy would send them down different
-            paths for the same game."""
-            if mesh is not None or jax.devices()[0].platform != "tpu":
+        def pallas_eligible(extra=lambda: True, allow_mesh=False) -> bool:
+            """Can this (game, mesh) run a pallas kernel? THE one
+            eligibility predicate for both the speculation and tick
+            backends — a drifted copy would send them down different paths
+            for the same game. `allow_mesh`: the tick kernel composes with
+            a mesh (ShardedPallasTickCore shard_maps local kernels + psums
+            checksum partials); the beam rollout does not yet."""
+            if jax.devices()[0].platform != "tpu":
                 return False
+            if mesh is not None:
+                from ..parallel.sharded import entity_shardable
+
+                if not allow_mesh or not entity_shardable(
+                    game.num_entities, mesh
+                ):
+                    return False
             try:
                 from .pallas_core import get_adapter
 
@@ -158,28 +167,31 @@ class ResimCore:
         # multi-tick buffer) can run on the entity-tiled pallas kernel
         # for tileable models declaring a disconnect_input row —
         # bit-identical to the XLA scan (tests enforce it), at the fused
-        # kernel's device cost instead of unfused per-op overhead.
+        # kernel's device cost instead of unfused per-op overhead. Under a
+        # mesh the kernel composes via ShardedPallasTickCore (one local
+        # kernel per device, psum'd checksum partials).
         assert tick_backend in ("auto", "xla", "pallas", "pallas-interpret")
-        assert mesh is None or tick_backend in ("auto", "xla"), (
-            "the pallas tick kernel is single-device; a mesh-sharded core "
-            "ticks via the XLA path (auto resolves this)"
-        )
         if tick_backend == "auto":
             tick_backend = (
                 "pallas"
                 if pallas_eligible(
                     lambda: getattr(game, "disconnect_input", None) is not None
-                    and len(game.disconnect_input) == game.input_size
+                    and len(game.disconnect_input) == game.input_size,
+                    allow_mesh=True,
                 )
                 else "xla"
             )
         self.tick_backend = tick_backend
         if tick_backend.startswith("pallas"):
-            from .pallas_resim import PallasTickCore
+            interpret = tick_backend.endswith("-interpret")
+            if mesh is not None:
+                from .pallas_resim import ShardedPallasTickCore
 
-            core = PallasTickCore(
-                self, interpret=tick_backend.endswith("-interpret")
-            )
+                core = ShardedPallasTickCore(self, mesh, interpret=interpret)
+            else:
+                from .pallas_resim import PallasTickCore
+
+                core = PallasTickCore(self, interpret=interpret)
             self._tick_pallas_fn = jax.jit(
                 core.tick_multi, donate_argnums=(0, 1, 3)
             )
