@@ -719,6 +719,59 @@ def bench_beam_adoption(frames=200, entities=65536, beam_width=12):
     return out
 
 
+def bench_arena_request_path(entities=ENTITIES, ticks_per_buf=16, n=12):
+    """The reduction-family request path (VERDICT r3 item 3 adjunct): the
+    arena world's generic control-word tick on the single-tile pallas tick
+    kernel vs the XLA scan, amortized per tick over 16-row lazy buffers
+    with an 8-frame rollback in every row. Before r4 arena was excluded
+    from the tick kernel entirely; the ratio here is what its admission
+    bought the P2P path."""
+    from ggrs_tpu.models.arena import Arena
+    from ggrs_tpu.tpu.resim import ResimCore
+    from ggrs_tpu.utils.barrier import true_barrier
+
+    players = 4
+    out = {"entities": entities, "ticks_per_buffer": ticks_per_buf}
+    for label, backend in (("pallas", "pallas"), ("xla", "xla")):
+        core = ResimCore(
+            Arena(players, entities), max_prediction=9, num_players=players,
+            tick_backend=backend,
+        )
+        W = core.window
+        rng = np.random.default_rng(3)
+        rows = []
+        frame = 24
+        for _ in range(ticks_per_buf):
+            inputs = rng.integers(0, 64, size=(W, players, 1), dtype=np.uint8)
+            statuses = np.zeros((W, players), np.int32)
+            slots = np.full((W,), core.scratch_slot, np.int32)
+            depth = 8
+            start = frame - depth
+            for i in range(depth + 1):
+                slots[i] = (start + i) % core.ring_len
+            rows.append(
+                core.pack_tick_row(
+                    True, start % core.ring_len, inputs, statuses, slots,
+                    depth + 1, start_frame=start,
+                )
+            )
+            frame += 1
+        buf = np.stack(rows)
+        core.tick_multi(buf)
+        true_barrier(core.state)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            core.tick_multi(buf)
+        true_barrier(core.state)
+        per_tick = (time.perf_counter() - t0) / (n * ticks_per_buf) * 1000.0
+        out[f"{label}_ms_per_rollback_tick"] = round(per_tick, 4)
+        out[f"{label}_backend"] = core.tick_backend
+    out["speedup"] = round(
+        out["xla_ms_per_rollback_tick"] / out["pallas_ms_per_rollback_tick"], 2
+    )
+    return out
+
+
 def bench_tunnel_floor():
     """Attribution of the interactive floor (VERDICT r2 item 4): what does
     ONE device program cost on this tunnel, independent of the framework?
@@ -1085,6 +1138,7 @@ def main():
         "bench_fused(model='arena', bench_batches=20)[:3]"
     )
     arena_parity = _run_phase("parity_fused_vs_oracle(model='arena')")
+    arena_request = _run_phase("bench_arena_request_path()")
     # third model family (swarm: [N,3] vectors + battery; tileable) on the
     # same generic pallas path — the adapter contract's bench witness
     swarm_rate, swarm_ms, swarm_backend = _run_phase(
@@ -1129,6 +1183,7 @@ def main():
                 "arena_ms_per_8frame_tick": round(arena_ms, 4),
                 "arena_fused_backend": arena_backend,
                 "arena_parity_vs_oracle": arena_parity,
+                "arena_request_path": arena_request,
                 "swarm_frames_per_sec": round(swarm_rate, 1),
                 "swarm_ms_per_8frame_tick": round(swarm_ms, 4),
                 "swarm_fused_backend": swarm_backend,

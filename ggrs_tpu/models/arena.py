@@ -219,6 +219,14 @@ class Arena:
     # — the property beam adoption needs
     statuses_contract = "disconnect-only"
 
+    @property
+    def disconnect_input(self) -> bytes:
+        """The dummy-input row substituted for DISCONNECTED players (the
+        reference's pattern, ex_game.rs:268): byte 0 = no buttons (coast),
+        byte 1 = throttle 4 — exactly what _step_generic substitutes, so
+        in-kernel substitution is bit-identical to the status branch."""
+        return bytes([0, 4][: self.input_size])
+
     def __init__(
         self, num_players: int = 2, num_entities: int = 4096, input_size: int = 1
     ):

@@ -59,22 +59,42 @@ class PallasBeamRollout:
         assert game.num_entities % LANE == 0, "entity count must be 128-aligned"
         self.game = game
         self.adapter = get_adapter(game)
-        assert getattr(self.adapter, "tileable", False), (
-            f"{type(self.adapter).__name__} is not tileable; the XLA "
-            "vmap rollout handles this model"
-        )
+        tileable = getattr(self.adapter, "tileable", False)
+        whole_world = not tileable
+        if whole_world:
+            # reduction-phase adapters (arena): single whole-world tile
+            # only — the rollout's inline full-plane reductions must see
+            # every entity (ResimCore falls back to XLA when rejected here)
+            assert getattr(self.adapter, "reduce_len", 0) > 0, (
+                f"{type(self.adapter).__name__} is neither tileable nor "
+                "reduction-declaring; the XLA vmap rollout handles this model"
+            )
         self.num_players = num_players
         self.input_size = game.input_size
         self.B = beam_width
         self.n_rows = game.num_entities // LANE
         self.interpret = interpret
         n_planes = len(self.adapter.planes)
+        # in: anchor planes; out: B*L trajectory windows per plane —
+        # double-buffered by Mosaic
+        per_row = n_planes * (1 + self.B * max_rollout) * LANE * 4 * 2
         if tile_rows <= 0:
-            # in: anchor planes; out: B*L trajectory windows per plane —
-            # double-buffered by Mosaic
-            per_row = n_planes * (1 + self.B * max_rollout) * LANE * 4 * 2
-            tile_rows = choose_tile_rows(
-                self.n_rows, per_row, self.VMEM_TILE_BUDGET
+            if whole_world:
+                tile_rows = self.n_rows
+            else:
+                tile_rows = choose_tile_rows(
+                    self.n_rows, per_row, self.VMEM_TILE_BUDGET
+                )
+        if whole_world:
+            from .pallas_core import WHOLE_WORLD_TILE_BUDGET
+
+            assert tile_rows == self.n_rows, (
+                "reduction-phase adapters require a single whole-world tile"
+            )
+            assert interpret or per_row * self.n_rows <= WHOLE_WORLD_TILE_BUDGET, (
+                f"B={self.B} x L={max_rollout} trajectory windows "
+                f"(~{per_row * self.n_rows >> 20}MB) exceed the single-tile "
+                "budget for a reduction-phase adapter"
             )
         assert self.n_rows % tile_rows == 0
         assert tile_rows >= 8 or tile_rows == self.n_rows

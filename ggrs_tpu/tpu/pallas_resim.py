@@ -71,7 +71,23 @@ class PallasTickCore:
         self.core = core
         self.game = game
         self.adapter = get_adapter(game)
-        assert getattr(self.adapter, "tileable", False)
+        tileable = getattr(self.adapter, "tileable", False)
+        whole_world = not tileable
+        if whole_world:
+            # reduction-phase adapters (arena): legal ONLY with whole-world
+            # visibility — the kernel runs a single tile so the adapter's
+            # inline full-plane reductions are complete. P2P resim states
+            # are fresh (corrected inputs), so no per-frame cache applies;
+            # a shard's slice would make the sums silently local => wrong.
+            assert getattr(self.adapter, "reduce_len", 0) > 0, (
+                f"{type(self.adapter).__name__} is neither tileable nor "
+                "reduction-declaring; use the XLA backend"
+            )
+            assert self.n == game.num_entities, (
+                "reduction-phase adapters cannot run on a shard's slice "
+                "(local sums would replace the global reduction)"
+            )
+        self.whole_world = whole_world
         self.num_players = core.num_players
         self.input_size = game.input_size
         self.W = core.window
@@ -90,10 +106,24 @@ class PallasTickCore:
             bytes(disc), dtype=np.uint8
         ).astype(np.int32)
         n_planes = len(self.adapter.planes)
+        per_row = n_planes * (1 + self.ring_len + 1) * LANE * 4 * 2
         if tile_rows <= 0:
-            per_row = n_planes * (1 + self.ring_len + 1) * LANE * 4 * 2
-            tile_rows = choose_tile_rows(
-                self.n_rows, per_row, self.VMEM_TILE_BUDGET
+            if whole_world:
+                tile_rows = self.n_rows  # single tile: full-plane sums legal
+            else:
+                tile_rows = choose_tile_rows(
+                    self.n_rows, per_row, self.VMEM_TILE_BUDGET
+                )
+        if whole_world:
+            from .pallas_core import WHOLE_WORLD_TILE_BUDGET
+
+            assert tile_rows == self.n_rows, (
+                "reduction-phase adapters require a single whole-world tile"
+            )
+            assert interpret or per_row * self.n_rows <= WHOLE_WORLD_TILE_BUDGET, (
+                f"world too large for the single-tile reduction path "
+                f"(~{per_row * self.n_rows >> 20}MB of plane windows); use "
+                "the XLA backend"
             )
         assert self.n_rows % tile_rows == 0
         assert tile_rows >= 8 or tile_rows == self.n_rows
@@ -103,6 +133,17 @@ class PallasTickCore:
         self._cs_entries, self._cs_frame_weight = derive_checksum_weights(
             game, self.adapter
         )
+
+    @classmethod
+    def whole_world_fits(cls, game, ring_len) -> bool:
+        """Can a reduction-phase (non-tileable) adapter's world run as ONE
+        VMEM tile? THE sizing rule the constructor enforces, exposed for
+        ResimCore's backend auto-selection."""
+        from .pallas_core import WHOLE_WORLD_TILE_BUDGET
+
+        n_planes = len(get_adapter(game).planes)
+        per_row = n_planes * (1 + ring_len + 1) * LANE * 4 * 2
+        return per_row * (game.num_entities // LANE) <= WHOLE_WORLD_TILE_BUDGET
 
     # -- packing (ring has ring_len+1 slots; the scratch slot is never
     # -- read or written by a masked save, but it rides along so the
@@ -430,6 +471,12 @@ class ShardedPallasTickCore:
         self.mesh = mesh
         n_shards = mesh.shape.get("entity", 0)
         game = core.game
+        assert getattr(get_adapter(game), "tileable", False), (
+            "the sharded tick kernel needs a per-entity-independent "
+            "(tileable) adapter: a reduction-phase adapter's full-plane "
+            "sums would be silently local per shard; sharded reduce models "
+            "run the XLA path (GSPMD inserts the psums)"
+        )
         assert entity_shardable(game.num_entities, mesh, LANE), (
             f"num_entities {game.num_entities} must split into "
             f"{n_shards} 128-aligned shards over the mesh's `entity` axis"

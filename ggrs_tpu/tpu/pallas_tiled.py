@@ -75,10 +75,19 @@ class PallasTiledSyncTestCore:
         assert self.n % LANE == 0, "entity count must be 128-aligned"
         self.game = game
         self.adapter = get_adapter(game)
-        assert getattr(self.adapter, "tileable", False), (
-            f"{type(self.adapter).__name__} is not tileable (the step must "
-            "be per-entity independent); use the whole-batch kernel or XLA"
-        )
+        tileable = getattr(self.adapter, "tileable", False)
+        whole_world = not tileable
+        if whole_world:
+            # reduction-phase adapters (arena): single whole-world tile
+            # only, unsharded only — see PallasTickCore for the rationale
+            assert getattr(self.adapter, "reduce_len", 0) > 0, (
+                f"{type(self.adapter).__name__} is neither tileable nor "
+                "reduction-declaring; use the whole-batch kernel or XLA"
+            )
+            assert self.n == game.num_entities, (
+                "reduction-phase adapters cannot run on a shard's slice "
+                "(local sums would replace the global reduction)"
+            )
         self.num_players = num_players
         self.input_size = game.input_size
         self.d = check_distance
@@ -87,10 +96,24 @@ class PallasTiledSyncTestCore:
         self.n_rows = self.n // LANE
         self.interpret = interpret
         n_planes = len(self.adapter.planes)
+        per_row = n_planes * (1 + self.ring_len) * LANE * 4 * 2
         if tile_rows <= 0:
-            per_row = n_planes * (1 + self.ring_len) * LANE * 4 * 2
-            tile_rows = choose_tile_rows(
-                self.n_rows, per_row, self.VMEM_TILE_BUDGET
+            if whole_world:
+                tile_rows = self.n_rows
+            else:
+                tile_rows = choose_tile_rows(
+                    self.n_rows, per_row, self.VMEM_TILE_BUDGET
+                )
+        if whole_world:
+            from .pallas_core import WHOLE_WORLD_TILE_BUDGET
+
+            assert tile_rows == self.n_rows, (
+                "reduction-phase adapters require a single whole-world tile"
+            )
+            assert interpret or per_row * self.n_rows <= WHOLE_WORLD_TILE_BUDGET, (
+                f"world too large for the single-tile reduction path "
+                f"(~{per_row * self.n_rows >> 20}MB); use the whole-batch "
+                "kernel or XLA"
             )
         assert self.n_rows % tile_rows == 0, (
             f"tile_rows {tile_rows} must divide {self.n_rows}"
@@ -493,6 +516,12 @@ class ShardedPallasTiledCore:
 
         self.mesh = mesh
         n_shards = mesh.shape.get("entity", 0)
+        assert getattr(get_adapter(game), "tileable", False), (
+            "the sharded tiled kernel needs a per-entity-independent "
+            "(tileable) adapter: a reduction-phase adapter's full-plane "
+            "sums would be silently local per shard; sharded reduce models "
+            "run the XLA path (GSPMD inserts the psums)"
+        )
         assert entity_shardable(game.num_entities, mesh, LANE), (
             f"num_entities {game.num_entities} must split into "
             f"{n_shards} 128-aligned shards over the mesh's `entity` axis"

@@ -119,13 +119,18 @@ class ResimCore:
         )
         self._speculate_fn = jax.jit(self._speculate_impl)
 
-        def pallas_eligible(extra=lambda: True, allow_mesh=False) -> bool:
+        def pallas_eligible(extra=lambda: True, allow_mesh=False,
+                            whole_world_fits=None) -> bool:
             """Can this (game, mesh) run a pallas kernel? THE one
             eligibility predicate for both the speculation and tick
             backends — a drifted copy would send them down different paths
             for the same game. `allow_mesh`: the tick kernel composes with
             a mesh (ShardedPallasTickCore shard_maps local kernels + psums
-            checksum partials); the beam rollout does not yet."""
+            checksum partials); the beam rollout does not yet.
+            `whole_world_fits`: for reduction-phase adapters (arena) —
+            non-tileable but runnable as ONE whole-world VMEM tile,
+            unsharded only — the backend's single-tile sizing predicate
+            (None = that backend resolves reduce models at dispatch)."""
             if jax.devices()[0].platform != "tpu":
                 return False
             if mesh is not None:
@@ -138,13 +143,25 @@ class ResimCore:
             try:
                 from .pallas_core import get_adapter
 
-                return (
-                    getattr(get_adapter(game), "tileable", False)
-                    and game.num_entities % 128 == 0
-                    and extra()
-                )
-            except Exception:
+                # same rejection classes _pick_backend honors: KeyError =
+                # no adapter registered; AssertionError/ValueError = a
+                # model-envelope bound (e.g. arena's centroid-division
+                # contract) — all mean "this config runs XLA", never a
+                # construction-time crash
+                adapter = get_adapter(game)
+            except (KeyError, AssertionError, ValueError):
                 return False
+            if game.num_entities % 128 != 0 or not extra():
+                return False
+            if getattr(adapter, "tileable", False):
+                return True
+            if (
+                mesh is not None
+                or getattr(adapter, "reduce_len", 0) <= 0
+                or whole_world_fits is None
+            ):
+                return False
+            return whole_world_fits()
 
         # speculation backend: the XLA vmap+scan rollout runs the step as
         # unfused elementwise passes, so B*L speculative steps tax several
@@ -159,7 +176,14 @@ class ResimCore:
             "speculates via the XLA path (auto resolves this)"
         )
         if spec_backend == "auto":
-            spec_backend = "pallas" if pallas_eligible() else "xla"
+            # reduce-phase adapters (arena): beam width is only known at
+            # speculate time, so single-tile sizing resolves at dispatch —
+            # _speculate_pallas falls back to XLA if the rollout rejects
+            spec_backend = (
+                "pallas"
+                if pallas_eligible(whole_world_fits=lambda: True)
+                else "xla"
+            )
         self.spec_backend = spec_backend
         self._beam_rollouts = {}  # beam_width -> PallasBeamRollout
         self._speculate_pallas_fns = {}  # beam_width -> jitted wrapper
@@ -172,12 +196,17 @@ class ResimCore:
         # kernel per device, psum'd checksum partials).
         assert tick_backend in ("auto", "xla", "pallas", "pallas-interpret")
         if tick_backend == "auto":
+            from .pallas_resim import PallasTickCore
+
             tick_backend = (
                 "pallas"
                 if pallas_eligible(
                     lambda: getattr(game, "disconnect_input", None) is not None
                     and len(game.disconnect_input) == game.input_size,
                     allow_mesh=True,
+                    whole_world_fits=lambda: PallasTickCore.whole_world_fits(
+                        game, self.ring_len
+                    ),
                 )
                 else "xla"
             )
@@ -488,18 +517,39 @@ class ResimCore:
     def _speculate_pallas(self, anchor_slot, beam_inputs):
         """Pallas-rollout speculation: gather the anchor snapshot, then run
         the entity-tiled beam kernel on it. Output tuple matches
-        _speculate_impl bit-for-bit (all-CONFIRMED statuses)."""
+        _speculate_impl bit-for-bit (all-CONFIRMED statuses). A rollout the
+        kernel rejects (reduce-phase adapter whose B*L trajectory windows
+        exceed the single-tile budget) demotes this core to the XLA
+        speculation path permanently — same results, unfused cost."""
         B = int(beam_inputs.shape[0])
         if B not in self._beam_rollouts:
             from .pallas_beam import PallasBeamRollout
 
-            self._beam_rollouts[B] = PallasBeamRollout(
-                self.game,
-                self.num_players,
-                B,
-                interpret=self.spec_backend.endswith("-interpret"),
-                max_rollout=self.window,  # VMEM budget sized to worst case
-            )
+            try:
+                self._beam_rollouts[B] = PallasBeamRollout(
+                    self.game,
+                    self.num_players,
+                    B,
+                    interpret=self.spec_backend.endswith("-interpret"),
+                    max_rollout=self.window,  # VMEM budget sized to worst case
+                )
+            except (AssertionError, ValueError) as e:
+                # narrow on purpose (r3 advisor): a broken adapter should
+                # surface, only a sizing rejection falls back
+                import warnings
+
+                warnings.warn(
+                    f"pallas beam rollout unavailable for "
+                    f"{type(self.game).__name__} (B={B}): {e}; speculating "
+                    "via the XLA path"
+                )
+                self.spec_backend = "xla"
+                return self._speculate_fn(
+                    self.ring,
+                    np.int32(anchor_slot),
+                    beam_inputs,
+                    np.zeros(beam_inputs.shape[:3], dtype=np.int32),
+                )
             rollout = self._beam_rollouts[B]
 
             def impl(ring, anchor_slot, beam_inputs):

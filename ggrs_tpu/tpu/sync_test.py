@@ -43,11 +43,14 @@ def _pick_backend(game, check_distance: int, mesh) -> str:
 
     try:
         # adapter CONSTRUCTION can reject a config outright (no adapter
-        # registered, or a model-envelope assert like arena's centroid
-        # division bound) — any such rejection means "auto" answers "xla",
-        # never a construction-time crash
+        # registered: KeyError; a model-envelope assert like arena's
+        # centroid division bound: AssertionError/ValueError) — any such
+        # rejection means "auto" answers "xla", never a construction-time
+        # crash. Narrow on purpose: an adapter whose construction raises
+        # anything else is BROKEN (e.g. a typo'd third-party registration)
+        # and must surface, not silently demote to the XLA path.
         adapter = get_adapter(game)
-    except Exception:
+    except (KeyError, AssertionError, ValueError):
         return "xla"
     if game.num_entities % 128 != 0:
         return "xla"

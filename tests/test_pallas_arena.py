@@ -137,3 +137,38 @@ def test_unregistered_model_rejected():
 
     with pytest.raises(KeyError):
         get_adapter(MysteryGame())
+
+
+def test_arena_tiled_single_tile_carry_parity():
+    """Arena on the entity-TILED SyncTest kernel: the reduction-phase
+    single-tile path (whole world in one VMEM tile, inline full-plane
+    centroids) must bit-match the XLA scan carry-for-carry."""
+    rng = np.random.default_rng(21)
+    script = rng.integers(0, 64, size=(45, P, 1), dtype=np.uint8)
+    xla = drive(Arena(P, 256), "xla", script, check_distance=4)
+    tiled = drive(
+        Arena(P, 256), "pallas-tiled-interpret", script, check_distance=4
+    )
+    assert_carry_equal(xla.carry, tiled.carry)
+    xla.check()
+    tiled.check()
+
+
+def test_arena_rejected_by_sharded_kernels():
+    """Entity-sharded pallas execution would make arena's full-plane
+    centroid sums silently local (wrong): both sharded cores must refuse,
+    and the sharded session/backend paths run the XLA scan (where GSPMD
+    inserts the psums — tests/test_sharded.py covers that parity)."""
+    from ggrs_tpu.parallel.mesh import make_mesh
+    from ggrs_tpu.tpu.pallas_tiled import ShardedPallasTiledCore
+    from ggrs_tpu.tpu.resim import ResimCore
+    from ggrs_tpu.tpu.pallas_resim import ShardedPallasTickCore
+
+    mesh = make_mesh(8)
+    with pytest.raises(AssertionError, match="tileable"):
+        ShardedPallasTiledCore(Arena(P, 1024), P, 4, mesh)
+    core = ResimCore(Arena(P, 1024), max_prediction=6, num_players=P,
+                     mesh=mesh)
+    assert core.tick_backend == "xla"  # auto refuses the sharded combo
+    with pytest.raises(AssertionError, match="tileable"):
+        ShardedPallasTickCore(core, mesh)

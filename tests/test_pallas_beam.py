@@ -11,6 +11,7 @@ import pytest
 import jax
 import jax.tree_util as jtu
 
+from ggrs_tpu.models.arena import Arena
 from ggrs_tpu.models.ex_game import ExGame
 from ggrs_tpu.models.swarm import Swarm
 from ggrs_tpu.tpu.resim import ResimCore
@@ -43,11 +44,12 @@ def assert_spec_equal(a, b):
         )
 
 
-@pytest.mark.parametrize("Game,mod", [(ExGame, 16), (Swarm, 128)])
+@pytest.mark.parametrize("Game,mod", [(ExGame, 16), (Swarm, 128), (Arena, 64)])
 def test_pallas_rollout_bit_parity_with_xla(Game, mod):
-    """Multi-tile rollout (auto tile sizing over 512-1024 entities): the
-    full speculation tuple — trajectories, per-step checksums, anchor
-    checksum — matches the XLA path leaf-for-leaf, both families."""
+    """Multi-tile rollout (auto tile sizing over 512-1024 entities; arena
+    runs the reduction-phase single-tile path): the full speculation
+    tuple — trajectories, per-step checksums, anchor checksum — matches
+    the XLA path leaf-for-leaf, all three families."""
     game = Game(P, 1024)
     a = make_core(game, "pallas-interpret")
     b = make_core(game, "xla")
@@ -123,10 +125,26 @@ def test_non_confirmed_statuses_fall_back_to_xla():
 
 
 def test_non_tileable_model_auto_falls_back():
-    """Arena (cross-entity centroids) cannot tile: auto must resolve to
-    the XLA rollout, not crash."""
-    from ggrs_tpu.models.arena import Arena
-
+    """On a non-TPU platform auto always resolves to XLA (arena included —
+    its reduction-phase pallas path is opt-in via -interpret in tests)."""
     core = ResimCore(Arena(P, 256), max_prediction=6, num_players=P,
                      spec_backend="auto")
     assert core.spec_backend == "xla"
+
+
+def test_oversized_reduce_rollout_falls_back_to_xla():
+    """A reduction-phase rollout whose B*L trajectory windows exceed the
+    single-tile budget demotes the core to the XLA speculation path with a
+    warning — same speculate() results as a plain-XLA core, no crash."""
+    # 65536 entities x B=16 x L windows is far past the 96MB envelope
+    game = Arena(P, 65536)
+    core = make_core(game, "pallas")
+    rng = np.random.default_rng(4)
+    B, L = 16, 3
+    beam_inputs = rng.integers(0, 64, size=(B, L, P, 1), dtype=np.uint8)
+    beam_statuses = np.zeros((B, L, P), np.int32)
+    with pytest.warns(UserWarning, match="pallas beam rollout unavailable"):
+        spec = core.speculate(1, beam_inputs, beam_statuses)
+    assert core.spec_backend == "xla"  # demoted permanently
+    xla = make_core(Arena(P, 65536), "xla")
+    assert_spec_equal(spec, xla.speculate(1, beam_inputs, beam_statuses))

@@ -12,6 +12,7 @@ import jax
 import jax.tree_util as jtu
 
 from ggrs_tpu import SessionBuilder
+from ggrs_tpu.models.arena import Arena
 from ggrs_tpu.models.ex_game import ExGame
 from ggrs_tpu.models.swarm import Swarm
 from ggrs_tpu.tpu import TpuRollbackBackend
@@ -87,8 +88,14 @@ def assert_core_equal(a, b):
         )
 
 
-@pytest.mark.parametrize("Game,mod", [(ExGame, 16), (Swarm, 128)])
+@pytest.mark.parametrize(
+    "Game,mod", [(ExGame, 16), (Swarm, 128), (Arena, 64)]
+)
 def test_tick_kernel_bit_parity_with_xla(Game, mod):
+    """All three families; arena exercises the reduction-phase single-tile
+    path (inline full-plane centroids inside the kernel — P2P resim states
+    are fresh, so no per-frame cache applies) plus in-kernel disconnect
+    substitution against the XLA status branch."""
     game = Game(P, 512)
     a, ca = drive_random(game, "pallas-interpret", mod=mod)
     b, cb = drive_random(game, "xla", mod=mod)

@@ -128,11 +128,17 @@ def test_sharded_tiled_carry_parity(check_distance):
     assert shard.data.shape[0] == entities // mesh.shape["entity"]
 
 
-def test_tiled_rejects_non_tileable_models():
-    """Arena's per-team centroids are cross-entity reductions: the
-    time-inside-tile order would compute them per tile — rejected."""
+def test_tiled_reduce_model_single_tile_only():
+    """Arena's per-team centroids are cross-entity reductions: legal on
+    the tiled kernel ONLY as one whole-world tile (inline sums complete);
+    a shard's slice — where the sums would be silently local — is
+    rejected."""
     from ggrs_tpu.models.arena import Arena
     from ggrs_tpu.tpu.pallas_tiled import PallasTiledSyncTestCore
 
-    with pytest.raises(AssertionError, match="tileable"):
-        PallasTiledSyncTestCore(Arena(P, 1024), P, 3, interpret=True)
+    core = PallasTiledSyncTestCore(Arena(P, 1024), P, 3, interpret=True)
+    assert core.n_tiles == 1  # forced whole-world tile
+    with pytest.raises(AssertionError, match="shard"):
+        PallasTiledSyncTestCore(
+            Arena(P, 1024), P, 3, interpret=True, local_entities=512
+        )
