@@ -218,6 +218,23 @@ class TpuRollbackBackend:
         backend.handle_requests(requests)
     """
 
+    # adaptive-gate value tracking. Every time a rollback CONSULTS the
+    # standing speculation, one (frames_served, launches_spanned) sample
+    # lands in a trailing window; the gate's economic signal is
+    # sum(served) / sum(launches) — frames adopted per launch paid,
+    # including launches that were superseded before any rollback looked
+    # at them. Below MIN_SERVED_PER_LAUNCH the beam stands down, except
+    # for a PROBE BURST of consecutive launches every
+    # VALUE_PROBE_INTERVAL gated ticks: a burst (not a lone probe)
+    # because a speculation consulted many ticks after its launch is
+    # stale by shift and would miss regardless of the input regime —
+    # recovery needs a consult of a FRESH spec.
+    VALUE_WINDOW = 32  # consult samples retained
+    MIN_SERVED_PER_LAUNCH = 0.3
+    VALUE_MIN_SAMPLES = 8  # consults before the gate may close
+    VALUE_PROBE_INTERVAL = 24
+    VALUE_PROBE_BURST = 3
+
     def __init__(self, game, max_prediction: int, num_players: int,
                  beam_width: int = 0, mesh=None, device_verify: bool = False,
                  speculation_gate: str = "always",
@@ -238,14 +255,20 @@ class TpuRollbackBackend:
 
         `speculation_gate`: "always" launches a speculation every tick
         (pays B*L speculative steps of device time unconditionally);
-        "adaptive" launches only when the measured idle time between ticks
-        covers the measured speculation cost — on a paced loop with spare
-        frame budget the beam rides idle device time for free, on an
-        oversubscribed loop it automatically stands down instead of
-        delaying real work. The cost is measured once in warmup()
-        (required for adaptive mode); host-loop idle is the proxy for
-        device idle — the tunnel's async dispatch hides true device
-        occupancy from the host.
+        "adaptive" launches only when (a) the measured idle time between
+        ticks covers the measured speculation cost — on a paced loop with
+        spare frame budget the beam rides idle device time for free, on
+        an oversubscribed loop it automatically stands down instead of
+        delaying real work — AND (b) recent launches are actually being
+        adopted: a trailing window of frames-served-per-launch below
+        MIN_SERVED_PER_LAUNCH stands the beam down even with idle budget
+        to burn (input statistics the candidate generator cannot predict
+        make every launch pure cost), with a periodic probe launch every
+        VALUE_PROBE_INTERVAL gated ticks so a regime change (a player
+        starts toggling) re-opens the gate. The cost is measured once in
+        warmup() (required for adaptive mode); host-loop idle is the
+        proxy for device idle — the tunnel's async dispatch hides true
+        device occupancy from the host.
 
         `defer_speculation`: keep the speculation launch OFF the tick's
         critical path — handle_requests() only fulfills requests; the
@@ -343,8 +366,19 @@ class TpuRollbackBackend:
         self._tick_future: Optional[_FutureChecksumBatch] = None
         self.beam_gated = 0  # ticks where the gate skipped speculation
         self._spec_cost_s: Optional[float] = None  # measured in warmup()
-        self._idle_ema_s = 0.0
+        # None until the first idle sample lands: seeding the EMA from 0.0
+        # made the gate stand down for the first ~20-30 ticks of a fully
+        # idle loop while the blend warmed up (r3 advisor)
+        self._idle_ema_s: Optional[float] = None
         self._last_tick_end: Optional[float] = None
+        # value tracking for the adaptive gate: (frames_served,
+        # launches_spanned) per consult — see the class-attribute comment
+        from collections import deque
+
+        self._launch_value: deque = deque(maxlen=self.VALUE_WINDOW)
+        self._spec_consulted = False
+        self._launches_since_consult = 0
+        self._value_gated_streak = 0
 
     # ------------------------------------------------------------------
 
@@ -361,8 +395,12 @@ class TpuRollbackBackend:
                 idle = now - self._last_tick_end
                 # EMA over ~10 ticks: reacts to phase changes (a pause
                 # menu, a scene load) without flapping on single-frame
-                # jitter
-                self._idle_ema_s = 0.9 * self._idle_ema_s + 0.1 * idle
+                # jitter; the first sample SEEDS the EMA outright
+                self._idle_ema_s = (
+                    idle
+                    if self._idle_ema_s is None
+                    else 0.9 * self._idle_ema_s + 0.1 * idle
+                )
         segment: List[Request] = []
         for req in requests:
             if isinstance(req, LoadGameState) and segment:
@@ -395,16 +433,46 @@ class TpuRollbackBackend:
             self._last_segment = None
 
     def _speculation_affordable(self) -> bool:
-        """The adaptive gate: speculation is worth launching only when the
-        loop's idle time can absorb its device cost — otherwise the B*L
-        speculative steps delay the NEXT real tick by more than an adopted
-        rollback could ever save. 80% slack biases toward speculating
-        (a near-covered cost still wins when a deep rollback adopts)."""
+        """The adaptive gate, two conditions ANDed:
+
+        BUDGET — speculation is worth launching only when the loop's idle
+        time can absorb its device cost; otherwise the B*L speculative
+        steps delay the NEXT real tick by more than an adopted rollback
+        could ever save. 80% slack biases toward speculating (a
+        near-covered cost still wins when a deep rollback adopts). An
+        unseeded idle EMA (no second tick yet) counts as affordable.
+
+        VALUE — even with idle budget to burn, launches that nothing
+        adopts are pure device cost plus adoption-path latency: once
+        enough consults have sampled the regime and the trailing
+        frames-served-per-launch ratio sits under MIN_SERVED_PER_LAUNCH,
+        stand down. A PROBE BURST of consecutive launches every
+        VALUE_PROBE_INTERVAL gated ticks keeps sampling the input regime
+        with fresh-at-consult specs, so toggling players re-open the gate
+        within a couple of windows.
+        """
         if self.speculation_gate != "adaptive":
             return True
         if self._spec_cost_s is None:
             return True  # not yet measured (warmup pending): don't stall
-        return self._idle_ema_s >= 0.8 * self._spec_cost_s
+        if self._idle_ema_s is not None and (
+            self._idle_ema_s < 0.8 * self._spec_cost_s
+        ):
+            return False
+        if len(self._launch_value) >= self.VALUE_MIN_SAMPLES:
+            served = sum(v for v, _ in self._launch_value)
+            launches = sum(n for _, n in self._launch_value)
+            if served / max(launches, 1) < self.MIN_SERVED_PER_LAUNCH:
+                # close first, then burst at the END of each interval —
+                # a burst of VALUE_PROBE_BURST consecutive launches per
+                # VALUE_PROBE_INTERVAL gated ticks
+                self._value_gated_streak += 1
+                return (
+                    (self._value_gated_streak - 1) % self.VALUE_PROBE_INTERVAL
+                    >= self.VALUE_PROBE_INTERVAL - self.VALUE_PROBE_BURST
+                )
+        self._value_gated_streak = 0
+        return True
 
     def _run_segment(self, requests: List[Request]) -> None:
         load: Optional[LoadGameState] = None
@@ -462,6 +530,17 @@ class TpuRollbackBackend:
             self.rollback_frames += count
         if load is not None and self._spec is not None:
             match = self._match_speculation(load.frame, inputs, statuses, count)
+            if not self._spec_consulted:
+                # one value sample per consulted speculation: frames it
+                # served (0 on a miss) over the launches paid since the
+                # last consult — superseded-unconsulted launches thereby
+                # count as cost without poisoning quiet stretches
+                self._launch_value.append(
+                    (match[2] if match else 0,
+                     max(self._launches_since_consult, 1))
+                )
+                self._launches_since_consult = 0
+                self._spec_consulted = True
             if match is not None:
                 member, shift, matched = match
                 if matched == count:
@@ -685,6 +764,8 @@ class TpuRollbackBackend:
         with GLOBAL_TRACER.span("tpu/beam_speculate"):
             spec = core.speculate(anchor % core.ring_len, beam_inputs, beam_statuses)
         self._spec = (anchor, beam_inputs, spec)
+        self._spec_consulted = False
+        self._launches_since_consult += 1
 
     # ------------------------------------------------------------------
 
@@ -713,8 +794,12 @@ class TpuRollbackBackend:
         self._prev_inputs[:] = 0
         self._played.clear()
         self._depth = 2
-        self._idle_ema_s = 0.0
+        self._idle_ema_s = None
         self._last_tick_end = None
+        self._launch_value.clear()
+        self._spec_consulted = False
+        self._launches_since_consult = 0
+        self._value_gated_streak = 0
 
     def warmup(self) -> None:
         """Compile every device program this backend can dispatch (tick,

@@ -906,7 +906,14 @@ class PallasSyncTestCore:
                         [out["iring"][islot, p * I + j] for j in range(I)]
                         for p in range(P)
                     ]
-                    nxt = adapter.step(state, inps, ctx, red=red_for(f))
+                    # R == 0 calls the bare 3-arg form: pre-reduction-phase
+                    # third-party adapters registered via register_adapter
+                    # keep working unchanged on this kernel
+                    nxt = (
+                        adapter.step(state, inps, ctx, red=red_for(f))
+                        if R
+                        else adapter.step(state, inps, ctx)
+                    )
                     state = where_state(do_rb, nxt, state)
 
                 # save current frame, record input, advance
@@ -919,7 +926,11 @@ class PallasSyncTestCore:
                 for p in range(P):
                     for j in range(I):
                         out["iring"][cslot, p * I + j] = new_inps[p][j]
-                state = adapter.step(state, new_inps, ctx, red=red_for(c))
+                state = (
+                    adapter.step(state, new_inps, ctx, red=red_for(c))
+                    if R
+                    else adapter.step(state, new_inps, ctx)
+                )
                 for n_ in plane_names:
                     out[n_][:] = state[n_]
                 if R:

@@ -365,3 +365,79 @@ def test_arena_beam_adoption_live_p2p():
         plain.handle_requests(s1.advance_frame())
         clock.advance(16)
     assert beam.beam_hits > 0, (beam.beam_hits, beam.beam_misses)
+
+
+def test_value_gate_stands_down_and_probes():
+    """The adaptive gate's VALUE condition: a trailing window of
+    worthless consults (nothing adopted over many launches) closes the
+    gate even with idle budget to burn, a PROBE BURST fires every
+    VALUE_PROBE_INTERVAL gated ticks, and adopted consults re-open it."""
+    backend = TpuRollbackBackend(
+        ExGame(PLAYERS, ENTITIES),
+        max_prediction=6,
+        num_players=PLAYERS,
+        beam_width=4,
+        speculation_gate="adaptive",
+    )
+    backend._spec_cost_s = 0.001
+    backend._idle_ema_s = 1.0  # budget condition comfortably satisfied
+
+    # not enough samples yet: gate open
+    assert backend._speculation_affordable()
+    for _ in range(backend.VALUE_MIN_SAMPLES):
+        backend._launch_value.append((0, 4))  # consults that served nothing
+    decisions = [
+        backend._speculation_affordable()
+        for _ in range(2 * backend.VALUE_PROBE_INTERVAL)
+    ]
+    # closes first, then exactly one burst of probes at the END of each
+    # interval
+    interval, burst = backend.VALUE_PROBE_INTERVAL, backend.VALUE_PROBE_BURST
+    assert decisions.count(True) == 2 * burst
+    assert not any(decisions[: interval - burst])  # stand-down period first
+    assert all(decisions[interval - burst : interval])  # the full burst
+
+    # a regime change: consults adopt again (fresh probe specs hitting)
+    for _ in range(backend.VALUE_WINDOW):
+        backend._launch_value.append((3, 2))
+    assert backend._speculation_affordable()
+    assert backend._value_gated_streak == 0
+
+    # and the budget condition still vetoes on an oversubscribed loop
+    backend._idle_ema_s = 0.0
+    assert not backend._speculation_affordable()
+
+
+def test_value_gate_attribution_live():
+    """Live attribution: on a varying-inputs stream (every launch misses
+    or is superseded) the value window fills with zeros and the gate
+    starts gating launches; states stay bit-identical to the plain
+    backend throughout (gated ticks just resimulate)."""
+    clock, s0, s1 = build_p2p_pair()
+    beam = TpuRollbackBackend(
+        ExGame(PLAYERS, ENTITIES),
+        max_prediction=6,
+        num_players=PLAYERS,
+        beam_width=4,
+        speculation_gate="adaptive",
+    )
+    beam._spec_cost_s = 1e-9  # pretend measured: budget never vetoes
+    plain = TpuRollbackBackend(
+        ExGame(PLAYERS, ENTITIES), max_prediction=6, num_players=PLAYERS
+    )
+    rng = np.random.default_rng(17)
+    for f in range(70):
+        a, b = int(rng.integers(0, 16)), int(rng.integers(0, 16))
+        s0.add_local_input(0, bytes([a]))
+        beam.handle_requests(s0.advance_frame())
+        s1.add_local_input(1, bytes([b]))
+        plain.handle_requests(s1.advance_frame())
+        clock.advance(16)
+    assert len(beam._launch_value) >= beam.VALUE_MIN_SAMPLES
+    served = sum(v for v, _ in beam._launch_value)
+    launches = sum(n for _, n in beam._launch_value)
+    assert served / launches < beam.MIN_SERVED_PER_LAUNCH
+    assert beam.beam_gated > 0, "value gate never stood down"
+    sa, sb = beam.state_numpy(), plain.state_numpy()
+    for key in ("frame", "pos", "vel", "rot"):
+        np.testing.assert_array_equal(np.asarray(sa[key]), np.asarray(sb[key]))

@@ -99,3 +99,33 @@ def test_pallas_rejects_vmem_overflow_configs():
         PallasSyncTestCore(ExGame(P, 524288), num_players=P, check_distance=2)
     # the validated large config constructs fine
     PallasSyncTestCore(ExGame(P, 262144), num_players=P, check_distance=2)
+
+
+def test_legacy_three_arg_adapter_still_runs():
+    """Back-compat: a third-party adapter registered with the
+    pre-reduction-phase step signature (planes, inputs, ctx) must keep
+    working on the whole-batch kernel (it calls the bare 3-arg form for
+    adapters without a reduction phase)."""
+    import numpy as np
+
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.tpu.pallas_core import ExGamePlanes, register_adapter
+
+    class LegacyGame(ExGame):
+        pass
+
+    class LegacyPlanes(ExGamePlanes):
+        def step(self, pl, inputs, ctx):  # old signature: no red kwarg
+            return super().step(pl, inputs, ctx)
+
+    register_adapter(LegacyGame, LegacyPlanes)
+    sess = TpuSyncTestSession(
+        LegacyGame(P, 256),
+        num_players=P,
+        check_distance=2,
+        flush_interval=10_000,
+        backend="pallas-interpret",
+    )
+    rng = np.random.default_rng(3)
+    sess.advance_frames(rng.integers(0, 16, size=(12, P, 1), dtype=np.uint8))
+    sess.check()
