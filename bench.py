@@ -823,6 +823,28 @@ def bench_tunnel_floor():
     true_barrier(core.state)
     tick_program = (time.perf_counter() - t0) / n * 1000.0
 
+    # the same tick through the cond/scan program (the pre-r4 T=1 path):
+    # lax.cond/scan control flow costs dispatch overhead through the
+    # tunnel even when the taken work is tiny, which is why lone ticks
+    # route through the branchless unrolled program on interactive-size
+    # worlds (ResimCore.BRANCHLESS_MAX_ENTITIES). Interleave-measured
+    # here so the artifact shows the delta under the SAME tunnel state.
+    cond_fn = jax.jit(core._tick_packed_impl, donate_argnums=(0, 1, 3))
+    row = core.pack_tick_row(False, 0, z_in, z_st, scratch, 1)
+
+    def cond_tick():
+        core.ring, core.state, core.verify, _h, _l = cond_fn(
+            core.ring, core.state, row, core.verify
+        )
+
+    cond_tick()
+    true_barrier(core.state)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        cond_tick()
+    true_barrier(core.state)
+    tick_program_cond = (time.perf_counter() - t0) / n * 1000.0
+
     # ...and the 16-tick fused program amortizes it: the per-tick floor of
     # the lazy-batched request path (compare p2p4_lazy16's wall per tick).
     # Rows carry one real advance + save each — the content a live lazy
@@ -843,6 +865,7 @@ def bench_tunnel_floor():
         "empty_dispatch_ms": round(per_dispatch, 4),
         "dispatch_readback_roundtrip_ms": round(roundtrip, 4),
         "tick_program_ms": round(tick_program, 4),
+        "tick_program_cond_ms": round(tick_program_cond, 4),
         "fused16_ms_per_tick": round(fused16_per_tick, 4),
     }
 

@@ -184,3 +184,49 @@ def test_tick_kernel_requires_disconnect_input():
     core = ResimCore(NoDisc(P, 256), max_prediction=6, num_players=P,
                      tick_backend="auto")
     assert core.tick_backend == "xla"
+
+
+@pytest.mark.parametrize("Game,mod", [(ExGame, 16), (Arena, 64)])
+def test_branchless_single_tick_bit_parity(Game, mod):
+    """The branchless unrolled T=1 program (the interactive path's
+    dispatch-overhead fix) must be bit-identical to the cond/scan packed
+    program — ring (scratch slot included), state, device-verify carry,
+    and per-slot checksums — over random rollback/save/disconnect
+    streams."""
+    game_a, game_b = Game(P, 256), Game(P, 256)
+    a = ResimCore(game_a, max_prediction=6, num_players=P,
+                  device_verify=True, tick_backend="xla")
+    b = ResimCore(game_b, max_prediction=6, num_players=P,
+                  device_verify=True, tick_backend="xla")
+    assert a._tick_fn.__wrapped__ == a._tick_branchless_impl  # policy: small world
+    b_fn = jax.jit(b._tick_packed_impl, donate_argnums=(0, 1, 3))
+
+    W = a.window
+    r = np.random.default_rng(23)
+    frame = 0
+    for t in range(18):
+        depth = int(r.integers(0, 6))
+        do_load = depth > 0 and frame > depth
+        count = depth + 1 if do_load else 1
+        start = frame - depth if do_load else frame
+        inputs = np.zeros((W, P, 1), np.uint8)
+        statuses = np.zeros((W, P), np.int32)
+        for i in range(count):
+            inputs[i] = r.integers(0, mod, (P, 1))
+            if r.random() < 0.2:
+                statuses[i, r.integers(0, P)] = int(InputStatus.DISCONNECTED)
+        slots = np.full((W,), a.scratch_slot, np.int32)
+        for i in range(count):
+            slots[i] = (start + i) % a.ring_len
+        row = a.pack_tick_row(
+            do_load, (start % a.ring_len) if do_load else 0, inputs,
+            statuses, slots, count, start_frame=start,
+        )
+        ha, la = a.tick_row(row)
+        b.ring, b.state, b.verify, hb, lb = b_fn(
+            b.ring, b.state, row, b.verify
+        )
+        np.testing.assert_array_equal(np.asarray(ha), np.asarray(hb), err_msg=f"his t={t}")
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb), err_msg=f"los t={t}")
+        frame = start + count
+    assert_core_equal(a, b)
