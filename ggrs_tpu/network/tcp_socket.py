@@ -154,12 +154,24 @@ class TcpDatagramSocket:
         return conn
 
     def send_wire(self, wire: bytes, addr: Any) -> None:
-        canon = self._canon(addr)
-        self._alias.setdefault(canon, tuple(addr))
-        addr = canon
-        conn = self._conns.get(addr)
+        orig = tuple(addr)
+        canon = self._canon(orig)
+        self._alias.setdefault(canon, orig)
+        conn = self._conns.get(canon)
+        if conn is not None and conn.dead:
+            # the stream to this IP died: drop the cached resolution and
+            # re-resolve, so a hostname that now points elsewhere (DNS
+            # failover, container restart with a new IP) routes the
+            # reconnect to the CURRENT address instead of the stale one
+            # for the socket's lifetime (r3 advisor)
+            self._resolved.pop(orig[0], None)
+            new_canon = self._canon(orig)
+            if new_canon != canon:
+                self._alias.setdefault(new_canon, orig)
+                canon = new_canon
+                conn = self._conns.get(canon)
         if conn is None or conn.dead:
-            conn = self._connect(addr)
+            conn = self._connect(canon)
         conn.queue(_DATA, wire)
         conn.flush()
 
@@ -212,6 +224,13 @@ class TcpDatagramSocket:
             conn.sock.close()
         for peer in [p for p, c in self._conns.items() if c.dead]:
             del self._conns[peer]
+            # a hostname cached to this now-dead IP must re-resolve on the
+            # next send (DNS failover): dropping it HERE matters because
+            # this reap removes the conn from _conns, which would otherwise
+            # skip send_wire's dead-conn re-resolution branch entirely and
+            # reconnect to the stale IP forever
+            for host in [h for h, ip in self._resolved.items() if ip == peer[0]]:
+                del self._resolved[host]
         return received
 
     def receive_all_messages(self) -> List[Tuple[Any, Message]]:

@@ -915,6 +915,15 @@ class TpuRollbackBackend:
                 "num_players": self.num_players,
                 "beam_width": self.beam_width,
                 "device_verify": self.core.device_verify,
+                # performance knobs ride the checkpoint too: a restored
+                # backend must run with the characteristics of the session
+                # that saved it, not silently revert to defaults (r3
+                # advisor)
+                "lazy_ticks": self.lazy_ticks,
+                "speculation_gate": self.speculation_gate,
+                "defer_speculation": self.defer_speculation,
+                "spec_backend": self.core.spec_backend,
+                "tick_backend": self.core.tick_backend,
             },
         )
 
@@ -924,6 +933,18 @@ class TpuRollbackBackend:
 
         tree, meta = load_device_checkpoint(path)
         assert meta["kind"] == "TpuRollbackBackend"
+        # saved backends resolved concrete spec/tick backends; a restore
+        # onto a different topology (e.g. sharded -> unsharded or another
+        # platform) may not support them, so restore the knob as a REQUEST
+        # ("auto" when the checkpoint predates the fields) and let the
+        # constructor re-resolve — the durable bits are the ring/state,
+        # which are backend-agnostic by the bit-parity contract
+        def _backend_knob(key):
+            # "xla" is honored everywhere; a saved "pallas*" re-resolves
+            # via "auto" (picks pallas wherever the restored platform and
+            # mesh support it, xla otherwise)
+            return "xla" if meta.get(key) == "xla" else "auto"
+
         backend = cls(
             game,
             max_prediction=meta["max_prediction"],
@@ -931,6 +952,11 @@ class TpuRollbackBackend:
             beam_width=meta.get("beam_width", 0),
             mesh=mesh,
             device_verify=meta.get("device_verify", False),
+            lazy_ticks=meta.get("lazy_ticks", 0),
+            speculation_gate=meta.get("speculation_gate", "always"),
+            defer_speculation=meta.get("defer_speculation", False),
+            spec_backend=_backend_knob("spec_backend"),
+            tick_backend=_backend_knob("tick_backend"),
         )
         # re-place onto the freshly-built core's shardings (sharded under a
         # mesh, single-device otherwise) — checkpoints are layout-agnostic

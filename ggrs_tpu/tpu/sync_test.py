@@ -91,9 +91,13 @@ class TpuSyncTestSession:
         with its frame), so nothing is lost by checking late, and the
         out-of-box configuration pays ZERO per-batch host readbacks (on a
         tunneled device each costs ~100ms — the exact overhead the fused
-        design exists to avoid). Pass an integer to auto-check every that
-        many ticks instead (a periodic safety net for long unattended
-        runs).
+        design exists to avoid). BEHAVIOR CHANGE (r3): earlier releases
+        defaulted to flushing every tick, so advance_frames() itself
+        raised on divergence — a driver that never calls check() now
+        silently ignores mismatches; call check() at least once at the
+        end of a run (every in-repo driver does). Pass an integer to
+        auto-check every that many ticks instead (a periodic safety net
+        for long unattended runs).
 
         `backend`: "auto" (the default) resolves to the fastest kernel the
         configuration supports — on TPU, the whole-batch pallas kernel

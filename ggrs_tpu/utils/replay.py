@@ -136,36 +136,153 @@ def load_replay(path: str, game=None) -> Tuple[np.ndarray, np.ndarray]:
     return np.asarray(z["inputs"]), np.asarray(z["statuses"])
 
 
-def replay_to_state(game, inputs: np.ndarray, statuses: np.ndarray,
-                    tick_backend: str = "auto"):
-    """Re-simulate a recording from the initial world: one fused
-    multi-tick dispatch per chunk through ResimCore (each frame is a
-    plain confirmed tick — no rollbacks in a replay). Returns the final
-    device state pytree, bit-identical to the live session's state at the
-    recording's last frame."""
+def _replay_core(game, inputs, statuses, tick_backend, start_state,
+                 start_frame, collect_checksums):
+    """Shared replay driver: fused multi-tick chunks through ResimCore.
+    `start_state`/`start_frame` seek into the recording (the state must be
+    the match's bit-exact frame-`start_frame` state — a seek checkpoint);
+    `collect_checksums` additionally saves every frame's pre-advance state
+    to a rotating ring slot and returns its combined checksum per frame."""
+    import jax
+
+    from ..ops.fixed_point import combine_checksum
     from ..tpu.resim import ResimCore
 
     F = inputs.shape[0]
+    assert 0 <= start_frame <= F, (start_frame, F)
     core = ResimCore(game, max_prediction=2, num_players=game.num_players,
                      tick_backend=tick_backend)
+    if start_state is not None:
+        got = int(np.asarray(start_state["frame"]))
+        if got != start_frame:
+            raise ValueError(
+                f"seek state is frame {got}, recording offset is "
+                f"{start_frame}"
+            )
+        core.state = jax.device_put(
+            start_state, jax.tree.map(lambda a: a.sharding, core.state)
+        )
     W = core.window
     chunk = 64
-    # a replay never loads, so the snapshot ring is dead weight: all-
-    # scratch save slots take the skip branch (no per-frame checksum or
-    # ring write); the final chunk pads with no-op rows so ONE chunk
-    # shape compiles once (compiles cost far more than no-op rows here)
-    slots = np.full((W,), core.scratch_slot, np.int32)
-    for base in range(0, F, chunk):
+    # without checksum collection the snapshot ring is dead weight:
+    # all-scratch save slots take the skip branch; the final chunk pads
+    # with no-op rows so ONE chunk shape compiles once (compiles cost far
+    # more than no-op rows here)
+    scratch = np.full((W,), core.scratch_slot, np.int32)
+    checksums: Dict[Frame, int] = {}
+    for base in range(start_frame, F, chunk):
         rows = []
         for f in range(base, min(base + chunk, F)):
             inp = np.zeros((W, game.num_players, game.input_size), np.uint8)
             stat = np.zeros((W, game.num_players), np.int32)
             inp[0] = inputs[f]
             stat[0] = statuses[f]
+            slots = scratch
+            if collect_checksums:
+                # slot-0 save snapshots the PRE-advance state (= frame f),
+                # exactly what desync detection checksummed live
+                slots = scratch.copy()
+                slots[0] = f % core.ring_len
             rows.append(core.pack_tick_row(
                 False, 0, inp, stat, slots, 1, start_frame=f,
             ))
         while len(rows) < chunk:
             rows.append(core.pad_tick_row())
-        core.tick_multi(np.stack(rows))
-    return core.fetch_state()
+        his, los = core.tick_multi(np.stack(rows))
+        if collect_checksums:
+            his = np.asarray(his)
+            los = np.asarray(los)
+            for j, f in enumerate(range(base, min(base + chunk, F))):
+                checksums[f] = combine_checksum(his[j, 0], los[j, 0])
+    return core.fetch_state(), checksums
+
+
+def replay_to_state(game, inputs: np.ndarray, statuses: np.ndarray,
+                    tick_backend: str = "auto", start_state=None,
+                    start_frame: Frame = 0):
+    """Re-simulate a recording: one fused multi-tick dispatch per chunk
+    through ResimCore (each frame is a plain confirmed tick — no rollbacks
+    in a replay). Returns the final device state pytree, bit-identical to
+    the live session's state at the recording's last frame.
+
+    `start_state`/`start_frame` SEEK: resume from a mid-match state (a
+    `save_seek_checkpoint` file, or any bit-exact frame-`start_frame`
+    state) and replay only the tail — a 10k-frame recording with a
+    checkpoint every 1k frames seeks to any frame in <=1k replayed
+    ticks."""
+    state, _ = _replay_core(
+        game, inputs, statuses, tick_backend, start_state, start_frame,
+        collect_checksums=False,
+    )
+    return state
+
+
+def save_seek_checkpoint(path: str, state, game=None) -> None:
+    """Persist a replay seek point (any bit-exact mid-match state — e.g.
+    `backend.state_numpy()` at a known confirmed frame, or a previous
+    replay's final state). Composes utils.checkpoint with the replay
+    system: durable, layout-agnostic, exact by construction."""
+    from .checkpoint import save_device_checkpoint
+
+    meta = {"kind": "ReplaySeekpoint",
+            "frame": int(np.asarray(state["frame"]))}
+    if game is not None:
+        meta["game_cls"] = type(game).__name__
+        meta["num_entities"] = game.num_entities
+    save_device_checkpoint(path, {"state": state}, meta)
+
+
+def load_seek_checkpoint(path: str, game=None):
+    """(state, frame) from a seek-point file; refuses a mismatched world
+    (same rationale as load_replay's identity check)."""
+    from .checkpoint import load_device_checkpoint
+
+    tree, meta = load_device_checkpoint(path)
+    if meta.get("kind") != "ReplaySeekpoint":
+        raise ValueError(f"not a replay seek point: {meta.get('kind')!r}")
+    if game is not None and "game_cls" in meta:
+        if meta["game_cls"] != type(game).__name__ or meta[
+            "num_entities"
+        ] != game.num_entities:
+            raise ValueError(
+                f"seek point was saved on {meta['game_cls']}"
+                f"/{meta['num_entities']}, not {type(game).__name__}"
+                f"/{game.num_entities}"
+            )
+    return tree["state"], int(meta["frame"])
+
+
+def replay_checksums(game, inputs: np.ndarray, statuses: np.ndarray,
+                     tick_backend: str = "auto", start_state=None,
+                     start_frame: Frame = 0) -> Dict[Frame, int]:
+    """Per-frame combined checksums of the replayed match (frame f ->
+    checksum of the frame-f state), computed on device in the same fused
+    dispatches as the replay itself — the ground truth a desync
+    post-mortem compares peers' live-recorded histories against."""
+    _, checksums = _replay_core(
+        game, inputs, statuses, tick_backend, start_state, start_frame,
+        collect_checksums=True,
+    )
+    return checksums
+
+
+def desync_postmortem(game, inputs: np.ndarray, statuses: np.ndarray,
+                      peer_history: Dict[Frame, int],
+                      tick_backend: str = "auto", start_state=None,
+                      start_frame: Frame = 0) -> Optional[Tuple[Frame, int, int]]:
+    """Replay a recording and compare against a peer's live desync-
+    detection history (`session.local_checksum_history`: frame ->
+    combined checksum). Returns None when every overlapping frame agrees,
+    else (first mismatching frame, replay_checksum, peer_checksum) — the
+    forensic verdict the live detector's DesyncDetected event can only
+    hint at (it reports an interval, the replay pins the exact frame and
+    both values). The snapshot semantics being leveraged are the
+    reference's GameStateCell save/load contract (src/sync_layer.rs:15-52)
+    run to completion: a deterministic match IS its input script."""
+    ours = replay_checksums(
+        game, inputs, statuses, tick_backend, start_state, start_frame,
+    )
+    for f in sorted(k for k in peer_history if k in ours):
+        if int(peer_history[f]) != int(ours[f]):
+            return (f, int(ours[f]), int(peer_history[f]))
+    return None

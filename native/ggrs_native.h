@@ -29,6 +29,31 @@
 //
 // ggrs_native_abi_version() must match the consumer's expectation (the
 // ctypes loader pins it); bump it whenever this surface changes.
+//
+// THREADING CONTRACT (the reference's `sync-send` analog,
+// src/lib.rs:203-237 — there, sessions are Send but not Sync; here the
+// same rules stated for a C ABI):
+//   * Every handle (ggrs_iq_*, ggrs_ep_*, ggrs_udp_*, ggrs_sess_*) is
+//     UNSYNCHRONIZED mutable state: no internal locking, no atomics.
+//     Concurrent calls into the SAME handle from two threads are a data
+//     race and undefined behavior.
+//   * Handles are not thread-AFFINE: any thread may call into a handle
+//     provided calls are externally serialized (a mutex, a channel, or a
+//     migration handoff with a happens-before edge — the C equivalent of
+//     Rust's Send). Creating on one thread and using on another is fine.
+//   * DIFFERENT handles are fully independent: two threads each driving
+//     their own session/endpoint/queue never contend — the library has no
+//     shared mutable globals (verified: the only globals are const
+//     tables; tests/test_native_session.py drives two sessions from two
+//     threads concurrently as the regression gate).
+//   * ggrs_X_free must not race any call on the same handle, including
+//     another free (same rule as above: frees are calls).
+//   * Stateless codec kernels (ggrs_rle_*, ggrs_delta_*,
+//     ggrs_weighted_checksum, ggrs_siphash24) touch only their arguments
+//     and are safe to call from any number of threads concurrently on
+//     disjoint buffers.
+// The Python layer adds its own serialization (the GIL) on top; the
+// contract above is what a C/C++ embedder must uphold.
 
 #ifndef GGRS_NATIVE_H_
 #define GGRS_NATIVE_H_

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# THE one-command repo gate (VERDICT r3 item 7 — the reference gates every
+# push with `cargo test` + a wasm compile check, .github/workflows/rust.yml;
+# this is the equivalent for a dual Python/C++ + device-kernel stack):
+#
+#   1. native build           (g++ -> ggrs_tpu/native/libggrs_native.so)
+#   2. full pytest suite      (8-device virtual CPU mesh; ~15 min)
+#   3. UBSAN pass             (sanitized rebuild + the native/wire tests)
+#   4. README perf table      (gen_perf_table --check: table == bench JSON)
+#   5. multi-chip dryrun      (the driver's compile/execute gate, 8 devices)
+#
+# Any failure fails the script. Usage: scripts/check.sh [--fast]
+#   --fast skips the UBSAN rebuild+retest and the dryrun (inner-loop use).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+echo "== [1/5] native build =="
+make -C native
+
+echo "== [2/5] pytest (full suite, virtual 8-device CPU mesh) =="
+python -m pytest tests/ -q
+
+if [ "$FAST" = "0" ]; then
+  echo "== [3/5] UBSAN build + native/wire tests =="
+  make -C native sanitize
+  python -m pytest tests/test_native.py tests/test_native_endpoint.py \
+    tests/test_native_input_queue.py tests/test_native_session.py \
+    tests/test_native_session_core.py tests/test_wire_fuzz.py \
+    tests/test_soak_parity.py -q
+  make -C native  # restore the normal build
+else
+  echo "== [3/5] UBSAN pass skipped (--fast) =="
+fi
+
+echo "== [4/5] README perf table in sync with the committed bench JSON =="
+LATEST_BENCH=$(ls -1 BENCH_local_r*.json 2>/dev/null | sort | tail -1)
+if [ -n "$LATEST_BENCH" ]; then
+  python scripts/gen_perf_table.py "$LATEST_BENCH" --check
+else
+  echo "no committed BENCH_local_r*.json; skipping table check"
+fi
+
+if [ "$FAST" = "0" ]; then
+  echo "== [5/5] multi-chip dryrun (8 virtual CPU devices) =="
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+else
+  echo "== [5/5] dryrun skipped (--fast) =="
+fi
+
+echo "== check OK =="

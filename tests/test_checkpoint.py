@@ -182,3 +182,53 @@ def test_fused_resume_across_backends(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(x), np.asarray(y), err_msg=jtu.keystr(p)
         )
+
+
+def test_backend_restore_preserves_performance_knobs(tmp_path):
+    """A restored backend must run with the performance characteristics
+    of the session that saved it (r3 advisor): lazy_ticks, the
+    speculation gate, defer_speculation, and an explicit xla backend
+    choice all round-trip through the checkpoint meta (pallas choices
+    re-resolve via auto so a cross-platform restore cannot crash)."""
+    from ggrs_tpu.tpu import TpuRollbackBackend
+
+    game = ex_game.ExGame(PLAYERS, ENTITIES)
+    backend = TpuRollbackBackend(
+        game,
+        max_prediction=6,
+        num_players=PLAYERS,
+        beam_width=4,
+        lazy_ticks=5,
+        speculation_gate="adaptive",
+        defer_speculation=True,
+        tick_backend="xla",
+    )
+    path = str(tmp_path / "knobs.npz")
+    backend.save(path)
+
+    restored = TpuRollbackBackend.restore(
+        path, ex_game.ExGame(PLAYERS, ENTITIES)
+    )
+    assert restored.lazy_ticks == 5
+    assert restored.speculation_gate == "adaptive"
+    assert restored.defer_speculation is True
+    assert restored.beam_width == 4
+    assert restored.core.tick_backend == "xla"
+
+    # pre-knob checkpoints (no fields in meta) restore with defaults
+    from ggrs_tpu.utils.checkpoint import (
+        load_device_checkpoint,
+        save_device_checkpoint,
+    )
+
+    tree, meta = load_device_checkpoint(path)
+    for key in ("lazy_ticks", "speculation_gate", "defer_speculation",
+                "spec_backend", "tick_backend"):
+        meta.pop(key)
+    old_path = str(tmp_path / "old.npz")
+    save_device_checkpoint(old_path, tree, meta)
+    legacy = TpuRollbackBackend.restore(
+        old_path, ex_game.ExGame(PLAYERS, ENTITIES)
+    )
+    assert legacy.lazy_ticks == 0
+    assert legacy.speculation_gate == "always"

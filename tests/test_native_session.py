@@ -101,3 +101,53 @@ def test_native_session_reports_network_stats():
         # parity with the Python endpoint: stats are unavailable within the
         # first second of a session (kbps denominator would be zero)
         assert time.monotonic() - start < 1.5
+
+
+def test_native_sessions_independent_across_threads():
+    """The ABI threading contract's regression gate (ggrs_native.h:
+    handles are unsynchronized but fully independent — no shared mutable
+    globals): two native P2P sessions, one driven per thread, must run a
+    full match concurrently without interference; and a handle CREATED on
+    the main thread may be DRIVEN entirely from a worker (the Send half
+    of the contract — handles are not thread-affine)."""
+    import threading
+    import time
+
+    s0 = make_session(19411, 19412, 0, native=True)
+    s1 = make_session(19412, 19411, 1, native=True)
+    games = {0: GameStub(), 1: GameStub()}
+    errors = []
+    barrier = threading.Barrier(2)
+
+    def drive(sess, handle):
+        try:
+            barrier.wait(timeout=10)
+            for _ in range(600):
+                sess.poll_remote_clients()
+                if sess.current_state() == SessionState.RUNNING:
+                    break
+                time.sleep(0.001)
+            assert sess.current_state() == SessionState.RUNNING
+            for f in range(30):
+                sess.poll_remote_clients()
+                sess.add_local_input(handle, bytes([(f * (handle + 2)) % 7]))
+                games[handle].handle_requests(sess.advance_frame())
+                time.sleep(0.001)
+        except Exception as e:  # surfaced below; a thread must not die silently
+            errors.append((handle, e))
+
+    threads = [
+        threading.Thread(target=drive, args=(s0, 0)),
+        threading.Thread(target=drive, args=(s1, 1)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads), "drive thread hung"
+    # both peers simulated and their confirmed prefixes agree
+    confirmed = min(max(games[0].history) - 3, max(games[1].history) - 3, 25)
+    assert confirmed >= 10
+    for f in range(1, confirmed + 1):
+        assert games[0].history[f] == games[1].history[f], f"frame {f}"

@@ -161,3 +161,52 @@ def test_native_session_over_tcp_transport():
     s0 = build(17961, 17962, 0, native=False)
     s1 = build(17962, 17961, 1, native=True)
     run_lockstep(s0, s1, frames=60)
+
+
+def test_dead_connection_invalidates_dns_cache():
+    """A hostname whose cached resolution points at a dead stream is
+    re-resolved on the next send (DNS failover / container restart with a
+    new IP — r3 advisor): after the stale conn dies, traffic to the
+    hostname reaches the peer at its CURRENT address instead of
+    blackholing for the socket's lifetime."""
+    import socket as _socket
+
+    from ggrs_tpu.network.tcp_socket import TcpDatagramSocket, _Conn
+
+    a = TcpDatagramSocket(0, host="127.0.0.1")
+    b = TcpDatagramSocket(0, host="127.0.0.1")
+    try:
+        port = b.local_port
+        # poison the cache: 'localhost' resolved to a stale address whose
+        # stream is already dead (the failed-over old IP)
+        a._resolved["localhost"] = "192.0.2.1"  # TEST-NET, unroutable
+        stale = _Conn(
+            _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM),
+            ("192.0.2.1", port),
+        )
+        stale.dead = True
+        a._conns[("192.0.2.1", port)] = stale
+
+        # the REAL route: the session's regular receive poll reaps the
+        # dead conn AND drops the hostname's stale resolution with it —
+        # without that, send_wire would find no conn at all and reconnect
+        # to the cached stale IP forever
+        a.receive_all_wire()
+        assert ("192.0.2.1", port) not in a._conns
+        assert "localhost" not in a._resolved
+
+        a.send_wire(b"\x07failover", ("localhost", port))
+        # re-resolution must have replaced the cache entry
+        assert a._resolved["localhost"] == "127.0.0.1"
+        got = []
+        for _ in range(400):
+            a.receive_all_wire()  # drives flushes/accepts on a's side too
+            got = b.receive_all_wire()
+            if got:
+                break
+            time.sleep(0.005)
+        assert got, "message never arrived after DNS-cache invalidation"
+        assert got[0][1] == b"\x07failover"
+    finally:
+        a.close()
+        b.close()
