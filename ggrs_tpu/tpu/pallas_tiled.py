@@ -64,29 +64,46 @@ class PallasTiledSyncTestCore:
 
     def __init__(self, game, num_players: int, check_distance: int,
                  interpret: bool = False, tile_rows: int = 0,
-                 local_entities: int = 0):
+                 local_entities: int = 0, external_reduce: bool = False):
         """`local_entities`: when nonzero, the kernel operates on that many
         entities (one shard's slice of the world) while checksum weights
         keep using the GLOBAL entity count — the sharded composition
         (ShardedPallasTiledCore) runs one such local kernel per mesh device
         and psums the partial checksums, which then match the unsharded
-        total bit-for-bit."""
+        total bit-for-bit.
+
+        `external_reduce`: for reduction-phase adapters — the kernel takes
+        a COMPLETE per-frame raw-reduction table `red_raw [d+1, R]` as an
+        input instead of computing reductions inline (rows 0..d-1: the
+        resim frames base..c-1; row d: the frontier frame c). With the
+        reductions injected, the time-inside-tile order and entity
+        sharding both become legal for reduce models (the injected values
+        don't depend on tile/shard data); the caller owns producing them
+        (ShardedPallasTiledCore: local partial sums + psum per tick).
+        Single-tick batches only — reductions for tick t+1's frontier
+        don't exist at tick t's launch."""
         self.n = local_entities or game.num_entities
         assert self.n % LANE == 0, "entity count must be 128-aligned"
         self.game = game
         self.adapter = get_adapter(game)
         tileable = getattr(self.adapter, "tileable", False)
-        whole_world = not tileable
+        self.R = getattr(self.adapter, "reduce_len", 0)
+        self.external_reduce = external_reduce
+        if external_reduce:
+            assert self.R > 0, "external_reduce needs a reduction adapter"
+        whole_world = not tileable and not external_reduce
         if whole_world:
-            # reduction-phase adapters (arena): single whole-world tile
-            # only, unsharded only — see PallasTickCore for the rationale
-            assert getattr(self.adapter, "reduce_len", 0) > 0, (
+            # reduction-phase adapters computing reductions INLINE
+            # (arena, unsharded): single whole-world tile only — see
+            # PallasTickCore for the rationale
+            assert self.R > 0, (
                 f"{type(self.adapter).__name__} is neither tileable nor "
                 "reduction-declaring; use the whole-batch kernel or XLA"
             )
             assert self.n == game.num_entities, (
                 "reduction-phase adapters cannot run on a shard's slice "
-                "(local sums would replace the global reduction)"
+                "(local sums would replace the global reduction); use "
+                "external_reduce for the sharded composition"
             )
         self.num_players = num_players
         self.input_size = game.input_size
@@ -184,11 +201,17 @@ class PallasTiledSyncTestCore:
         adapter = self.adapter
         plane_names = [name for name, _, _ in adapter.planes]
         n_tiles = self.n_tiles
+        R = self.R if self.external_reduce else 0
+        if R:
+            assert t_ticks == 1, (
+                "external-reduce kernels are single-tick (tick t+1's "
+                "frontier reduction doesn't exist at launch)"
+            )
 
         vmem_names = plane_names + ["r_" + n_ for n_ in plane_names]
 
-        def kernel(inputs_ref, c0_ref, iring0_ref, rframe0_ref, gi_ref,
-                   owner_ref, *refs):
+        def kernel(inputs_ref, c0_ref, iring0_ref, rframe0_ref, red_ref,
+                   gi_ref, owner_ref, *refs):
             n_io = len(vmem_names)
             ins = dict(zip(vmem_names, refs[:n_io]))
             outs = dict(zip(vmem_names, refs[n_io : 2 * n_io]))
@@ -259,6 +282,16 @@ class PallasTiledSyncTestCore:
                 parts_hi_ref[t, j] = base_hi + jnp.where(mask, hi, 0)
                 parts_lo_ref[t, j] = base_lo + jnp.where(mask, lo, 0)
 
+            def red_for(row):
+                """Finalized reduction values from the injected COMPLETE
+                raw sums (row i: resim frame base+i; row d: the frontier).
+                None for non-reduce / inline-reduce kernels — step then
+                takes its default path."""
+                if not R:
+                    return None
+                raw = [red_ref[row, j] for j in range(R)]
+                return adapter.reduce_finalize(raw, ctx)
+
             def tick(t, _):
                 c = c0_ref[0] + t
                 do_rb = c > d
@@ -282,7 +315,11 @@ class PallasTiledSyncTestCore:
                         [iring_scratch[islot, p * I + j] for j in range(I)]
                         for p in range(P)
                     ]
-                    nxt = adapter.step(state, inps, ctx)
+                    nxt = (
+                        adapter.step(state, inps, ctx, red=red_for(i))
+                        if R
+                        else adapter.step(state, inps, ctx)
+                    )
                     state = {
                         n_: jnp.where(do_rb, nxt[n_], state[n_])
                         for n_ in plane_names
@@ -297,7 +334,11 @@ class PallasTiledSyncTestCore:
                 for p in range(P):
                     for j in range(I):
                         iring_scratch[cslot, p * I + j] = new_inps[p][j]
-                state = adapter.step(state, new_inps, ctx)
+                state = (
+                    adapter.step(state, new_inps, ctx, red=red_for(d))
+                    if R
+                    else adapter.step(state, new_inps, ctx)
+                )
                 for n_ in plane_names:
                     out[n_][:] = state[n_]
                 return 0
@@ -322,13 +363,23 @@ class PallasTiledSyncTestCore:
                 memory_space=pltpu.VMEM,
             )
 
-        def run(packed, inputs_i32, c0, gi, owner):
+        def run(packed, inputs_i32, c0, gi, owner, red_raw=None):
+            assert not R or red_raw is not None, (
+                "external_reduce kernel launched without its red_raw "
+                "table — the caller owns producing the complete per-frame "
+                "reduction sums (see ShardedPallasTiledCore)"
+            )
+            if red_raw is None:
+                # dummy row so the operand list is shape-stable across
+                # reduce and non-reduce kernels (never read when R == 0)
+                red_raw = jnp.zeros((1, 1), jnp.int32)
             in_specs = (
                 [
                     pl.BlockSpec(memory_space=pltpu.SMEM),  # inputs [T, P*I]
                     pl.BlockSpec(memory_space=pltpu.SMEM),  # c0 [1]
                     pl.BlockSpec(memory_space=pltpu.SMEM),  # iring0
                     pl.BlockSpec(memory_space=pltpu.SMEM),  # rframe0
+                    pl.BlockSpec(memory_space=pltpu.SMEM),  # red_raw [d+1, R]
                     state_spec(),  # gi
                     state_spec(),  # owner
                 ]
@@ -372,8 +423,8 @@ class PallasTiledSyncTestCore:
                 ]
             )
             n_p = len(plane_names)
-            # alias state+ring ins (after the 6 leading operands) onto outs
-            aliases = {6 + i: i for i in range(2 * n_p)}
+            # alias state+ring ins (after the 7 leading operands) onto outs
+            aliases = {7 + i: i for i in range(2 * n_p)}
             results = pl.pallas_call(
                 kernel,
                 grid=(n_tiles,),
@@ -397,6 +448,7 @@ class PallasTiledSyncTestCore:
                 c0,
                 packed["iring"],
                 packed["r_frame"],
+                red_raw,
                 gi,
                 owner,
                 *[packed[n_] for n_ in plane_names],
@@ -472,11 +524,56 @@ class PallasTiledSyncTestCore:
 
     # -- public ----------------------------------------------------------
 
-    def run_kernel(self, carry, inputs, gi_offset=0):
+    def _planes_at(self, source, slot=None):
+        rows = self.n_rows
+        out = {}
+        for name, key, comp in self.adapter.planes:
+            arr = source[key]
+            if slot is not None:
+                arr = jax.lax.dynamic_index_in_dim(
+                    arr, slot, 0, keepdims=False
+                )
+            plane = arr if comp is None else arr[..., comp]
+            out[name] = plane.reshape(rows, LANE)
+        return out
+
+    def frontier_partial(self, carry, ctx):
+        """Raw reduction partials of the LIVE state (the frontier frame)
+        over this core's slice — the one genuinely new row per tick."""
+        return jnp.stack(
+            self.adapter.reduce_partial(self._planes_at(carry["state"]), ctx)
+        )
+
+    def reduce_sources(self, carry, ctx):
+        """Per-frame raw-reduction partials over THIS core's (possibly
+        local) slice, for one tick at carry["frame"]: rows 0..d-1 from the
+        ring slots holding the resim frames base..c-1 (bit-identical to
+        the resimulated states by determinism), row d from the live
+        state. Early-session rows read zero-init slots — consumed only by
+        masked-off resim steps. Sums only: sharded callers psum the
+        stacked result before injecting it."""
+        d, ring_len = self.d, self.ring_len
+        c = carry["frame"]
+        base = jnp.maximum(c - d, 0)
+        raw = []
+        for i in range(d):
+            slot = (base + i) % ring_len
+            raw.append(
+                jnp.stack(
+                    self.adapter.reduce_partial(
+                        self._planes_at(carry["ring"], slot), ctx
+                    )
+                )
+            )
+        raw.append(self.frontier_partial(carry, ctx))
+        return jnp.stack(raw)  # [d+1, R]
+
+    def run_kernel(self, carry, inputs, gi_offset=0, red_raw=None):
         """pack -> kernel -> raw outputs (parts NOT yet verdict-folded).
         `gi_offset` shifts the global entity-index plane to this kernel's
         slice of the world; owner derives from it so round-robin ownership
-        follows GLOBAL entity ids regardless of sharding."""
+        follows GLOBAL entity ids regardless of sharding. `red_raw`: the
+        COMPLETE per-frame reduction table for external_reduce kernels."""
         t = inputs.shape[0]
         run = self._batch(t)
         packed = self.pack(carry)
@@ -485,7 +582,7 @@ class PallasTiledSyncTestCore:
         ).astype(jnp.int32)
         c0 = carry["frame"].reshape(1).astype(jnp.int32)
         gi, owner = make_gi_owner(self.n_rows, self.num_players, gi_offset)
-        out = run(packed, inputs_i32, c0, gi, owner)
+        out = run(packed, inputs_i32, c0, gi, owner, red_raw)
         out["r_frame"] = out["r_frame_new"]
         out["iring"] = out["iring_new"]
         return out
@@ -516,20 +613,29 @@ class ShardedPallasTiledCore:
 
         self.mesh = mesh
         n_shards = mesh.shape.get("entity", 0)
-        assert getattr(get_adapter(game), "tileable", False), (
-            "the sharded tiled kernel needs a per-entity-independent "
-            "(tileable) adapter: a reduction-phase adapter's full-plane "
-            "sums would be silently local per shard; sharded reduce models "
-            "run the XLA path (GSPMD inserts the psums)"
-        )
         assert entity_shardable(game.num_entities, mesh, LANE), (
             f"num_entities {game.num_entities} must split into "
             f"{n_shards} 128-aligned shards over the mesh's `entity` axis"
         )
         self.local_n = game.num_entities // n_shards
+        adapter = get_adapter(game)
+        # reduction-phase adapters (arena) CAN shard — via reduce
+        # injection: per tick, every reduction the SyncTest resim needs is
+        # computable at launch (resim frames' states sit in the snapshot
+        # ring bit-identically; the frontier is the live state), so each
+        # tick psums the per-shard raw partials and hands the COMPLETE
+        # table to a local external_reduce kernel. Single-tick kernel
+        # calls in a scan replace the T-tick batch (the only extra
+        # collective is the [d+1, R] psum per tick).
+        self.reduce_mode = not getattr(adapter, "tileable", False)
+        if self.reduce_mode:
+            assert getattr(adapter, "reduce_len", 0) > 0, (
+                f"{type(adapter).__name__} is neither tileable nor "
+                "reduction-declaring; use the XLA backend"
+            )
         self.inner = PallasTiledSyncTestCore(
             game, num_players, check_distance, interpret=interpret,
-            local_entities=self.local_n,
+            local_entities=self.local_n, external_reduce=self.reduce_mode,
         )
         self.game = game
 
@@ -553,6 +659,8 @@ class ShardedPallasTiledCore:
     def batch(self, carry: Dict[str, Any], inputs) -> Dict[str, Any]:
         from jax.sharding import PartitionSpec as P
 
+        from .pallas_core import KernelCtx
+
         inner = self.inner
         t = inputs.shape[0]
         specs = self._carry_specs(carry)
@@ -560,15 +668,66 @@ class ShardedPallasTiledCore:
         def body(carry, inputs):
             idx = jax.lax.axis_index("entity")
             offset = idx.astype(jnp.int32) * jnp.int32(self.local_n)
-            out = inner.run_kernel(carry, inputs, offset)
-            # the ONLY cross-shard collective in the hot loop: wraparound
-            # partial-checksum sums ride ICI; everything else is local
-            out["parts_hi"] = jax.lax.psum(out["parts_hi"], "entity")
-            out["parts_lo"] = jax.lax.psum(out["parts_lo"], "entity")
-            verdict = inner._verdict(
-                carry, out["parts_hi"], out["parts_lo"], carry["frame"], t
+            if not self.reduce_mode:
+                out = inner.run_kernel(carry, inputs, offset)
+                # the ONLY cross-shard collective in the hot loop:
+                # wraparound partial-checksum sums ride ICI; everything
+                # else is local
+                out["parts_hi"] = jax.lax.psum(out["parts_hi"], "entity")
+                out["parts_lo"] = jax.lax.psum(out["parts_lo"], "entity")
+                verdict = inner._verdict(
+                    carry, out["parts_hi"], out["parts_lo"], carry["frame"],
+                    t,
+                )
+                return inner.unpack(out, carry, verdict)
+
+            # reduce injection: one kernel call per tick, with the
+            # per-frame reduction table carried ROLLING through the scan —
+            # in steady state (c >= d) this tick's rows 1..d become the
+            # next tick's rows 0..d-1 verbatim (same frames, same complete
+            # sums), so each tick pays ONE new frontier row + one [R] psum
+            # instead of recomputing and psumming all d+1 rows; before the
+            # window fills (base pinned at 0, no row shift) the table is
+            # rebuilt in full. The boundary tick is exercised by the
+            # parity tests (40 frames, d=4).
+            gi, owner = make_gi_owner(
+                inner.n_rows, self.inner.num_players, offset
             )
-            return inner.unpack(out, carry, verdict)
+            ctx = KernelCtx(gi, owner)
+            d = inner.d
+
+            def tick(carry_red, inp_row):
+                carry, red_raw = carry_red
+                out = inner.run_kernel(
+                    carry, inp_row[None], offset, red_raw=red_raw
+                )
+                out["parts_hi"] = jax.lax.psum(out["parts_hi"], "entity")
+                out["parts_lo"] = jax.lax.psum(out["parts_lo"], "entity")
+                verdict = inner._verdict(
+                    carry, out["parts_hi"], out["parts_lo"], carry["frame"],
+                    1,
+                )
+                new_carry = inner.unpack(out, carry, verdict)
+                next_red = jax.lax.cond(
+                    carry["frame"] >= d,  # next base = base + 1: rows shift
+                    lambda nc: jnp.concatenate(
+                        [
+                            red_raw[1:],
+                            jax.lax.psum(
+                                inner.frontier_partial(nc, ctx), "entity"
+                            )[None],
+                        ]
+                    ),
+                    lambda nc: jax.lax.psum(
+                        inner.reduce_sources(nc, ctx), "entity"
+                    ),
+                    new_carry,
+                )
+                return (new_carry, next_red), None
+
+            red0 = jax.lax.psum(inner.reduce_sources(carry, ctx), "entity")
+            (carry, _red), _ = jax.lax.scan(tick, (carry, red0), inputs)
+            return carry
 
         shard_fn = jax.shard_map(
             body,

@@ -60,10 +60,16 @@ def _pick_backend(game, check_distance: int, mesh) -> str:
         )
         if vmem_est <= PallasSyncTestCore.VMEM_BUDGET_BYTES:
             return "pallas"
-    if getattr(adapter, "tileable", False) and (
-        mesh is None
-        or game.num_entities % (mesh.shape["entity"] * 128) == 0
-    ):
+        if getattr(adapter, "tileable", False):
+            return "pallas-tiled"
+        return "xla"
+    # sharded: tileable adapters run the shard_map'd tiled kernel;
+    # reduction-phase adapters (arena) run it too via per-tick reduce
+    # injection (ShardedPallasTiledCore.reduce_mode)
+    if (
+        getattr(adapter, "tileable", False)
+        or getattr(adapter, "reduce_len", 0) > 0
+    ) and game.num_entities % (mesh.shape["entity"] * 128) == 0:
         return "pallas-tiled"
     return "xla"
 

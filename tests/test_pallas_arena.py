@@ -154,21 +154,57 @@ def test_arena_tiled_single_tile_carry_parity():
     tiled.check()
 
 
-def test_arena_rejected_by_sharded_kernels():
-    """Entity-sharded pallas execution would make arena's full-plane
-    centroid sums silently local (wrong): both sharded cores must refuse,
-    and the sharded session/backend paths run the XLA scan (where GSPMD
-    inserts the psums — tests/test_sharded.py covers that parity)."""
+def test_arena_sharded_kernel_support_matrix():
+    """The SyncTest tiled core shards arena via reduce INJECTION (the
+    per-frame reductions a resim needs are computable at tick launch —
+    ring snapshots + live state — so complete psum'd sums are handed to
+    the kernel); the request-path tick core must still refuse (P2P resim
+    states are fresh under corrected inputs, so there is nothing to
+    inject), and its auto resolves sharded arena to XLA."""
     from ggrs_tpu.parallel.mesh import make_mesh
     from ggrs_tpu.tpu.pallas_tiled import ShardedPallasTiledCore
     from ggrs_tpu.tpu.resim import ResimCore
     from ggrs_tpu.tpu.pallas_resim import ShardedPallasTickCore
 
     mesh = make_mesh(8)
+    core = ShardedPallasTiledCore(Arena(P, 1024), P, 4, mesh, interpret=True)
+    assert core.reduce_mode and core.inner.external_reduce
+    rcore = ResimCore(Arena(P, 1024), max_prediction=6, num_players=P,
+                      mesh=mesh)
+    assert rcore.tick_backend == "xla"  # auto refuses the sharded combo
     with pytest.raises(AssertionError, match="tileable"):
-        ShardedPallasTiledCore(Arena(P, 1024), P, 4, mesh)
-    core = ResimCore(Arena(P, 1024), max_prediction=6, num_players=P,
-                     mesh=mesh)
-    assert core.tick_backend == "xla"  # auto refuses the sharded combo
-    with pytest.raises(AssertionError, match="tileable"):
-        ShardedPallasTickCore(core, mesh)
+        ShardedPallasTickCore(rcore, mesh)
+
+
+def test_arena_sharded_tiled_carry_parity():
+    """Sharded arena on the tiled kernel (reduce injection) must bit-match
+    the sharded XLA scan AND the unsharded whole-batch kernel,
+    carry-for-carry, over a forced-rollback run."""
+    from ggrs_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(33)
+    script = rng.integers(0, 64, size=(40, P, 1), dtype=np.uint8)
+
+    def drive_mesh(backend):
+        sess = TpuSyncTestSession(
+            Arena(P, 1024),
+            num_players=P,
+            check_distance=4,
+            flush_interval=10_000,
+            backend=backend,
+            mesh=mesh,
+        )
+        for i in range(4):
+            sess.advance_frames(script[i * 10 : (i + 1) * 10])
+        return sess
+
+    tiled = drive_mesh("pallas-tiled-interpret")
+    xla = drive_mesh("xla")
+    assert_carry_equal(tiled.carry, xla.carry)
+    tiled.check()
+    plain = drive(Arena(P, 1024), "pallas-interpret", script, 4, batches=4)
+    assert_carry_equal(tiled.carry, plain.carry)
+    # the sharded carry is actually partitioned over the mesh
+    shard = tiled.carry["state"]["pos"].addressable_shards[0]
+    assert shard.data.shape[0] == 1024 // mesh.shape["entity"]
