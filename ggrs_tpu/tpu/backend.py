@@ -819,6 +819,11 @@ class TpuRollbackBackend:
         ring0 = jax.tree.map(jnp.copy, core.ring)
         state0 = jax.tree.map(jnp.copy, core.state)
         core.tick(False, 0, inputs, statuses, scratch, 0)
+        if core._tick_branchless_fn is not None:
+            # row-content routing sends rollback rows to the branchless
+            # program — compile it too, or the first real rollback pays
+            # the mid-session compile stall warmup exists to prevent
+            core.tick(True, 0, inputs, statuses, scratch, 2)
         if self.lazy_ticks:
             # compile the fused multi-tick program at the buffer depth
             # (all-padding rows: a true no-op on the game state)

@@ -116,28 +116,34 @@ class ResimCore:
             self.verify = verify
         else:
             self.verify = {}
-        # The T=1 interactive program: lax.cond/lax.scan control flow costs
-        # ~1.5-2ms of per-dispatch overhead through the tunnel EVEN WHEN
-        # THE TAKEN WORK IS TINY (measured: a scan-of-conds program with
-        # trivial compute dispatches at ~3.0ms vs ~1.5ms for the same I/O
-        # branchless), so a lone tick pays more for its control flow than
-        # for its math. Below BRANCHLESS_MAX_ENTITIES the single-tick
-        # program is fully UNROLLED and MASKED (jnp.where everywhere, all
-        # W steps+checksums always execute): the wasted FLOPs are free at
-        # interactive world sizes and the dispatch cost drops to near the
-        # empty-program floor (measured 3.8 -> 1.5ms for an 8-frame
-        # rollback tick at 4k entities). Bit-identical to the cond path —
-        # masked saves write the OLD value back to slot 0, so even the
-        # ring's scratch bytes match. Larger worlds keep the cond program
-        # (skipped work there is real bandwidth).
+        # The T=1 interactive programs. lax.cond/lax.scan control flow
+        # costs ~1.5-2ms of per-dispatch overhead through the tunnel EVEN
+        # WHEN THE TAKEN WORK IS TINY (measured: a scan-of-conds program
+        # with trivial compute dispatches at ~3.0ms vs ~1.5ms for the same
+        # I/O branchless) — but cond SKIPPING also genuinely saves device
+        # work when most of the window is skipped. So lone ticks route by
+        # ROW CONTENT (host-side, both programs compiled): rollback /
+        # multi-advance rows — which execute most of the window anyway —
+        # run the fully UNROLLED, jnp.where-MASKED program (measured
+        # 3.8 -> 1.5ms for an 8-frame rollback tick at 4k entities,
+        # interleaved in a quiet tunnel window); trivial rows (one
+        # advance, no load) keep the cond program, whose 14-of-15-slot
+        # skip beats the masked full window (measured ~1.2ms the other
+        # way, same methodology — bench tunnel_floor carries both).
+        # Bit-identical either way: masked saves write the OLD value back
+        # to slot 0, so even the ring's scratch bytes match. Worlds past
+        # BRANCHLESS_MAX_ENTITIES always run cond (masked work there is
+        # real bandwidth).
         n_entities = getattr(game, "num_entities", None)
-        single_impl = (
-            self._tick_branchless_impl
+        self._tick_fn = jax.jit(
+            self._tick_packed_impl, donate_argnums=(0, 1, 3)
+        )
+        self._tick_branchless_fn = (
+            jax.jit(self._tick_branchless_impl, donate_argnums=(0, 1, 3))
             if n_entities is not None
             and n_entities <= self.BRANCHLESS_MAX_ENTITIES
-            else self._tick_packed_impl
+            else None
         )
-        self._tick_fn = jax.jit(single_impl, donate_argnums=(0, 1, 3))
         self._tick_multi_fn = jax.jit(
             self._tick_multi_impl, donate_argnums=(0, 1, 3)
         )
@@ -372,12 +378,22 @@ class ResimCore:
         )
         return ring, state, verify, his, los
 
+    def _single_tick_fn(self, row: np.ndarray):
+        """Row-content routing for lone ticks (rationale: the __init__
+        comment): rollback / multi-advance rows run the branchless
+        program when the world supports it; trivial rows keep cond."""
+        if self._tick_branchless_fn is not None and (
+            row[0] != 0 or row[2] > 1
+        ):
+            return self._tick_branchless_fn
+        return self._tick_fn
+
     def tick_row(self, row: np.ndarray) -> Tuple[Any, Any]:
         """One packed tick row through the (warmup-compiled) single-tick
         program; returns (checksum_hi[W], checksum_lo[W])."""
-        self.ring, self.state, self.verify, his, los = self._tick_fn(
-            self.ring, self.state, row, self.verify
-        )
+        self.ring, self.state, self.verify, his, los = self._single_tick_fn(
+            row
+        )(self.ring, self.state, row, self.verify)
         return his, los
 
     def tick_multi(self, rows: np.ndarray) -> Tuple[Any, Any]:
@@ -543,9 +559,9 @@ class ResimCore:
             do_load, load_slot, inputs, statuses, save_slots, advance_count,
             start_frame,
         )
-        self.ring, self.state, self.verify, his, los = self._tick_fn(
-            self.ring, self.state, packed, self.verify
-        )
+        self.ring, self.state, self.verify, his, los = self._single_tick_fn(
+            packed
+        )(self.ring, self.state, packed, self.verify)
         return his, los
 
     def check_device_verdict(self) -> Tuple[bool, int]:

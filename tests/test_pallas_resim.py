@@ -198,7 +198,10 @@ def test_branchless_single_tick_bit_parity(Game, mod):
                   device_verify=True, tick_backend="xla")
     b = ResimCore(game_b, max_prediction=6, num_players=P,
                   device_verify=True, tick_backend="xla")
-    assert a._tick_fn.__wrapped__ == a._tick_branchless_impl  # policy: small world
+    # policy: small world builds the branchless program; the drive below
+    # exercises the ROW-CONTENT ROUTING (rollback rows -> branchless,
+    # trivial rows -> cond) against a pure-cond twin
+    assert a._tick_branchless_fn is not None
     b_fn = jax.jit(b._tick_packed_impl, donate_argnums=(0, 1, 3))
 
     W = a.window
