@@ -75,7 +75,8 @@ def _game_family(model):
 
 def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
                 bench_batches=BENCH_BATCHES, backend="pallas",
-                model="ex_game", batch=BATCH, mesh=None, repeats=1):
+                model="ex_game", batch=BATCH, mesh=None, repeats=1,
+                mesh_devices=0):
     """backend="pallas" runs the whole batch as one TPU kernel with carries
     resident in VMEM (~3x the XLA scan on the 4k world; bit-identical —
     tests/test_pallas_core.py, tests/test_pallas_arena.py); falls back to
@@ -91,6 +92,10 @@ def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
     differences — see docs/DESIGN.md "Reading the bench numbers"."""
     from ggrs_tpu.tpu import TpuSyncTestSession
 
+    if mesh_devices and mesh is None:
+        from ggrs_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(mesh_devices)
     Game, _, mod = _game_family(model)
 
     def build_and_warm(b):
@@ -849,12 +854,18 @@ def bench_tunnel_floor():
     z_in = np.zeros((W, 4, 1), np.uint8)
     z_st = np.zeros((W, 4), np.int32)
     scratch = np.full((W,), core.scratch_slot, np.int32)
-    core.tick(False, 0, z_in, z_st, scratch, 1)
+    # an 8-frame-ROLLBACK-shaped row: the configuration the interactive
+    # floor is about, and (since r4's row-content routing) the row shape
+    # that exercises the BRANCHLESS T=1 program — a trivial one-advance
+    # row would route to the cond program and measure it twice
+    rb_slots = np.full((W,), core.scratch_slot, np.int32)
+    rb_slots[:9] = (np.arange(9) + 1) % core.ring_len
+    core.tick(True, 0, z_in, z_st, rb_slots, 9)
     true_barrier(core.state)
     n = 100
     t0 = time.perf_counter()
     for _ in range(n):
-        core.tick(False, 0, z_in, z_st, scratch, 1)
+        core.tick(True, 0, z_in, z_st, rb_slots, 9)
     true_barrier(core.state)
     tick_program = (time.perf_counter() - t0) / n * 1000.0
 
@@ -865,7 +876,7 @@ def bench_tunnel_floor():
     # worlds (ResimCore.BRANCHLESS_MAX_ENTITIES). Interleave-measured
     # here so the artifact shows the delta under the SAME tunnel state.
     cond_fn = jax.jit(core._tick_packed_impl, donate_argnums=(0, 1, 3))
-    row = core.pack_tick_row(False, 0, z_in, z_st, scratch, 1)
+    row = core.pack_tick_row(True, 0, z_in, z_st, rb_slots, 9)
 
     def cond_tick():
         core.ring, core.state, core.verify, _h, _l = cond_fn(
@@ -1235,6 +1246,17 @@ def main():
     arena = _run_phase(
         f"bench_fused_stats(model='arena', bench_batches={4 if SMOKE else 20})"
     )
+    # the reduction family's multi-chip story (r4): arena entity-sharded
+    # over a single-chip mesh on the tiled kernel via per-tick reduce
+    # injection — measured 1.9x the sharded XLA scan it replaces (19.0k
+    # vs 10.0k frames/s, interleaved same-process); the remaining delta
+    # vs the unsharded arena number is one kernel launch + one [d+1, R]
+    # psum per tick instead of the whole-batch kernel's cached inline
+    # reductions
+    arena_sharded = _run_phase(
+        f"bench_fused_stats(model='arena', backend='pallas-tiled', "
+        f"mesh_devices=1, bench_batches={4 if SMOKE else 20})"
+    )
     arena_parity = _run_phase("parity_fused_vs_oracle(model='arena')")
     arena_request = _run_phase(f"bench_arena_request_path(n={3 if SMOKE else 12})")
     # third model family (swarm: [N,3] vectors + battery; tileable) on the
@@ -1280,6 +1302,7 @@ def main():
         "arena_frames_per_sec": arena["frames_per_sec_p50"],
         "arena_ms_per_8frame_tick": arena["ms_per_tick_p50"],
         "arena_stats": arena,
+        "arena_sharded_stats": arena_sharded,
         "arena_fused_backend": arena["backend"],
         "arena_parity_vs_oracle": arena_parity,
         "arena_request_path": arena_request,
