@@ -119,6 +119,48 @@ def test_warmup_compiles_without_state_change():
     drive_synctest_pair(warmed, fresh, lambda t, h: bytes([t % 5]), ticks=15)
 
 
+def test_warmup_covers_every_tick_program():
+    """warmup() must compile EVERY program a live loop can dispatch.
+    Since T=1 row-content routing (ResimCore._single_tick_fn), rollback
+    rows run a different compiled program (_tick_branchless_fn) than
+    trivial one-advance rows (_tick_fn) — a warmup that misses one leaves
+    a multi-second compile stall inside the session (exactly the defect
+    that inflated the r4 p2p4 bench 30x until its measurement loop called
+    warmup()). Drive both row shapes plus the lazy multi-tick buffer
+    after warmup and require that no new executable gets compiled."""
+    backend = TpuRollbackBackend(
+        ExGame(num_players=PLAYERS, num_entities=ENTITIES),
+        max_prediction=6,
+        num_players=PLAYERS,
+        lazy_ticks=3,
+    )
+    backend.warmup()
+    core = backend.core
+    # the interactive world is small enough for the branchless program
+    assert core._tick_branchless_fn is not None
+    fns = {
+        "tick_cond": core._tick_fn,
+        "tick_branchless": core._tick_branchless_fn,
+        "tick_multi": core._tick_multi_fn,
+    }
+    warmed = {name: fn._cache_size() for name, fn in fns.items()}
+    for name, size in warmed.items():
+        assert size >= 1, f"warmup() never compiled {name}"
+
+    sess = make_synctest(check_distance=4, max_prediction=6)
+    for t in range(12):
+        for h in range(PLAYERS):
+            sess.add_local_input(h, bytes([t % 5]))
+        backend.handle_requests(sess.advance_frame())
+    backend.flush()
+    for name, fn in fns.items():
+        assert fn._cache_size() == warmed[name], (
+            f"{name} compiled a new executable after warmup() "
+            f"({warmed[name]} -> {fn._cache_size()}): warmup no longer "
+            "covers every dispatchable program"
+        )
+
+
 def test_beam_hits_on_steady_inputs_and_matches_resim():
     """Constant inputs: every forced SyncTest rollback's script equals the
     repeat-last beam member, so after the first speculation every tick is
