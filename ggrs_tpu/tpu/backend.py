@@ -744,6 +744,26 @@ class TpuRollbackBackend:
         # consecutive depths coalesce to one length (5,5,7,7,...) so jit
         # compiles O(1) rollout-length variants as the depth jitters
         rollout = min(self._depth + 3 + (self._depth & 1), core.window)
+        # pin known history (beam.branching_beam): the frames between the
+        # anchor and now were already played, and their rows are recorded —
+        # local inputs and confirmed remote inputs are ground truth every
+        # member must reproduce verbatim (the played-prefix compatibility
+        # check rejects anything else), while unconfirmed remote
+        # predictions are exactly the cells worth branching on. Without
+        # the pin, the local player's newest input (already folded into
+        # _last_inputs) stamps over prefix frames where the old value was
+        # played, and every family member dies on the prefix check.
+        S = current_after - anchor
+        base_rows = np.empty((S,) + self._last_inputs.shape, dtype=np.uint8)
+        fixed = np.empty((S, self.num_players), dtype=bool)
+        for j in range(S):
+            rec = self._played.get(anchor + j)
+            if rec is None:  # GC'd past the horizon: no context to pin
+                base_rows = fixed = None
+                break
+            pin, pst = rec
+            base_rows[j] = pin
+            fixed[j] = pst != int(InputStatus.PREDICTED)
         beam_inputs = branching_beam(
             self._last_inputs,
             self._prev_inputs,
@@ -752,6 +772,8 @@ class TpuRollbackBackend:
             # branches must cover prefix + script anywhere the rollout can
             # be matched (offset 0 first: the likeliest switch point)
             max_offset=rollout,
+            base_rows=base_rows,
+            fixed=fixed,
         )
         # roll out only as deep as a rollback can reach while this
         # speculation stands (shift ~1 + depth + reuse/growth margin): on

@@ -85,6 +85,8 @@ def branching_beam(
     window: int,
     beam_width: int,
     max_offset: Optional[int] = None,
+    base_rows: Optional[np.ndarray] = None,
+    fixed: Optional[np.ndarray] = None,
 ) -> np.ndarray:
     """Candidate generator for live sessions: per-frame branching scripts.
 
@@ -105,16 +107,48 @@ def branching_beam(
 
     Offsets are covered breadth-first from 0 (the first unconfirmed frame,
     the most likely switch point) and capped at `max_offset` (pass the
-    expected rollback depth: a branch at an offset the rollback never
+    expected rollout depth: a branch at an offset the rollback never
     replays can only duplicate member 0's matched prefix). Players with no
     toggle history yet (prev == last) have no meaningful branch, so the
-    remaining members fall back to whole-window single-pattern XOR
-    perturbations (value diversity over timing diversity).
+    remaining members fall back to single-pattern XOR perturbations (value
+    diversity over timing diversity).
+
+    KNOWN HISTORY IS PINNED. The speculation anchors `S` frames in the
+    past, and the caller already knows what happened there: `base_rows`
+    (u8[S, P, I]) carries the rows actually fed for frames anchor..anchor+S
+    and `fixed` (bool[S, P]) marks the cells that are ground truth — the
+    local players' own inputs and every confirmed remote input. Every
+    member reproduces `base_rows` verbatim at fixed cells, and branch
+    families only ever rewrite free cells (unconfirmed remote predictions,
+    and everything at offsets >= S). Without this, candidates re-guess
+    history the session already played: the tracked `last` for a LOCAL
+    player includes its newest input, so every branch family stamps that
+    value over prefix frames where the OLD value was played, the
+    played-prefix compatibility check (match_beam_longest) rejects the
+    member, and live adoption collapses to near zero on exactly the
+    scripts the beam exists for (measured: 1 hit / 9 misses on a 2-player
+    4-frame-hold toggle, every miss a prefix mismatch of this shape).
+
+    Distinctness is enforced by construction: members that collapse to an
+    already-emitted candidate (e.g. a switch at an offset whose cells are
+    all fixed) are skipped, not kept as dead weight.
 
     last_inputs/prev_inputs: u8[P, I]. Returns u8[B, W, P, I].
     """
     p, _i = last_inputs.shape
+    S = 0 if base_rows is None else int(base_rows.shape[0])
+    assert S <= window, (S, window)
+    if fixed is None:
+        fixed = np.zeros((S, p), dtype=bool)
     beam = np.tile(last_inputs, (beam_width, window, 1, 1))
+    if S:
+        beam[:, :S] = np.asarray(base_rows, dtype=np.uint8)[None]
+    # [W, P] mask of cells a family may rewrite: everything at offsets
+    # >= S, plus unconfirmed predictions inside the pinned prefix
+    free_mask = np.ones((window, p), dtype=bool)
+    if S:
+        free_mask[:S] = ~np.asarray(fixed, dtype=bool)
+
     has_hist = [
         not np.array_equal(prev_inputs[pl], last_inputs[pl]) for pl in range(p)
     ]
@@ -131,28 +165,33 @@ def branching_beam(
         if has_hist[pl]:
             for k in range(max_offset):
                 yield ("one", k, False, pl)
-                if k > 0:  # one-back@0 duplicates member 0 (all-last)
-                    yield ("one", k, True, pl)
-        k = 0
-        while True:
-            # cycle over every input byte (arena's analog throttle byte gets
-            # candidate diversity too) with XOR values in [1, 255] — a zero
-            # value would emit a duplicate of member 0
+                yield ("one", k, True, pl)
+        # cycle over every input byte (arena's analog throttle byte gets
+        # candidate diversity too) with XOR values in [1, 255] — a zero
+        # value would emit a duplicate of member 0. ONE full cycle only:
+        # yields past 255 * input_size are byte-identical repeats, and with
+        # duplicates skipped (not padded) an endless stream would spin the
+        # fill loop forever once beam_width exceeds the distinct pool.
+        for k in range(255 * _i):
             yield ("xor", pl, (k // 255) % _i, k % 255 + 1)
-            k += 1
 
     def all_stream():
         for k in range(max_offset):
             yield ("all", k, False)
-            if k > 0:
-                yield ("all", k, True)
+            yield ("all", k, True)
 
     streams = [player_stream(pl) for pl in range(p)]
     if len(toggling) >= 2:
         streams.insert(0, all_stream())
 
+    seen = {beam[0].tobytes()}
     b = 1
+    iota = np.arange(window)
     exhausted = [False] * len(streams)
+    # every stream is finite (offset families bounded by max_offset, XOR
+    # bounded to one distinct cycle), so this terminates even when
+    # beam_width exceeds the distinct candidate pool — the surplus members
+    # simply stay copies of member 0, as before dedup existed
     while b < beam_width and not all(exhausted):
         for si, stream in enumerate(streams):
             if b >= beam_width:
@@ -161,9 +200,10 @@ def branching_beam(
             if spec is None:
                 exhausted[si] = True
                 continue
+            cand = beam[0].copy()
             if spec[0] == "xor":
                 _, pl, byte, pattern = spec
-                beam[b, :, pl, byte] ^= np.uint8(pattern)
+                cand[free_mask[:, pl], pl, byte] ^= np.uint8(pattern)
             else:
                 kind, k, back = spec[0], spec[1], spec[2]
                 players = toggling if kind == "all" else [spec[3]]
@@ -173,8 +213,14 @@ def branching_beam(
                         if back
                         else (last_inputs[pl], prev_inputs[pl])
                     )
-                    beam[b, :k, pl] = before
-                    beam[b, k:, pl] = after
+                    rows = np.where((iota >= k)[:, None], after, before)
+                    m = free_mask[:, pl]
+                    cand[m, pl] = rows[m]
+            key = cand.tobytes()
+            if key in seen:
+                continue
+            seen.add(key)
+            beam[b] = cand
             b += 1
     return beam
 

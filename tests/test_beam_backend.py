@@ -179,15 +179,32 @@ def test_beam_hits_on_steady_inputs_and_matches_resim():
     assert plain.beam_hits == 0
 
 
-def test_beam_misses_on_varying_inputs_and_matches_resim():
-    """Per-frame-varying inputs never match repeat-based candidates: every
-    rollback falls back to resimulation, still bit-identical."""
+def test_beam_serves_known_history_on_varying_inputs_and_matches_resim():
+    """Per-frame-varying inputs defeat every *prediction* — but a SyncTest
+    rollback's script is PLAYED HISTORY, and known history is pinned into
+    every member (beam.branching_beam base_rows/fixed): the known prefix
+    is served from the precomputed trajectory and only the genuinely
+    unknown newest frame resimulates, fused in the adopt dispatch. Before
+    history pinning this exact stream was wall-to-wall misses; the pin
+    turns it into the partial-adoption fast path — still bit-identical to
+    plain resimulation (drive_synctest_pair asserts states every tick)."""
     beam, plain = make_backend(beam_width=8), make_backend(beam_width=0)
     drive_synctest_pair(
         beam, plain, lambda t, h: bytes([(t * (h + 3) + h) % 16]), ticks=25
     )
-    assert beam.beam_misses >= 15
-    assert beam.beam_hits == 0
+    rollbacks = beam.beam_hits + beam.beam_partial_hits + beam.beam_misses
+    assert rollbacks >= 18, rollbacks
+    # nearly every rollback adopts its known prefix (the first consulted
+    # speculation may predate the ring snapshot it needs)
+    adopted = beam.beam_hits + beam.beam_partial_hits
+    assert adopted >= rollbacks - 2, (
+        beam.beam_hits, beam.beam_partial_hits, beam.beam_misses,
+    )
+    # the adopted prefixes are real frames, not empty matches
+    assert beam.rollback_frames_adopted >= 2 * rollbacks, (
+        beam.rollback_frames_adopted, rollbacks,
+    )
+    assert plain.beam_hits == 0
 
 
 def test_beam_perturbed_member_hits_in_p2p():
@@ -268,6 +285,44 @@ def test_branching_beam_generator_shapes():
         (beam[b, :, 0, 0] == 5 ^ 1).all() and (beam[b, :, 1, 0] == 9).all()
         for b in range(16)
     )
+
+
+def test_branching_beam_pins_known_history():
+    from ggrs_tpu.tpu.beam import branching_beam
+
+    # anchor sits 2 frames in the past: those rows were played. Player 0
+    # is local (both cells ground truth); player 1's rows are unconfirmed
+    # predictions (free to branch). The local player toggled 3->5 at the
+    # newest played frame, so its tracked last (5) differs from the older
+    # played row (3) — the exact shape that used to kill every member on
+    # the played-prefix check.
+    last = np.array([[5], [3]], dtype=np.uint8)
+    prev = np.array([[3], [5]], dtype=np.uint8)
+    base = np.array([[[3], [3]], [[5], [3]]], dtype=np.uint8)  # [S=2, P, I]
+    fixed = np.array([[True, False], [True, False]])
+    beam = branching_beam(
+        last, prev, window=6, beam_width=16, base_rows=base, fixed=fixed
+    )
+    assert beam.shape == (16, 6, 2, 1)
+    # EVERY member reproduces the fixed cells verbatim
+    assert (beam[:, 0, 0, 0] == 3).all() and (beam[:, 1, 0, 0] == 5).all()
+    # member 0 = played history + repeat-last future
+    assert (beam[0, :2, 1, 0] == 3).all()
+    assert (beam[0, 2:, 0, 0] == 5).all() and (beam[0, 2:, 1, 0] == 3).all()
+    # some member covers the remote player's true value being 5 from the
+    # newest played frame on (the toggle the prediction missed), while
+    # keeping the local player's played+future rows intact — the member a
+    # boundary rollback adopts
+    assert any(
+        (beam[b, 0, 1, 0] == 3)
+        and (beam[b, 1:, 1, 0] == 5).all()
+        and (beam[b, 0, 0, 0] == 3)
+        and (beam[b, 1:, 0, 0] == 5).all()
+        for b in range(16)
+    ), beam[:, :, :, 0]
+    # no two members are identical (duplicates are skipped at generation)
+    keys = {beam[b].tobytes() for b in range(16)}
+    assert len(keys) == 16
 
 
 def test_partial_prefix_adoption_core_parity():
