@@ -325,6 +325,51 @@ def test_branching_beam_pins_known_history():
     assert len(keys) == 16
 
 
+def test_branching_beam_invariants_fuzz():
+    """Randomized generator invariants: any (last, prev, base, fixed,
+    window, width) combination must (1) terminate, (2) reproduce every
+    fixed cell verbatim in every member, (3) keep member 0 = pinned base
+    + repeat-last future, and (4) emit no duplicate members except
+    surplus copies of member 0 once the distinct pool is exhausted."""
+    from ggrs_tpu.tpu.beam import branching_beam
+
+    rng = np.random.default_rng(7)
+    for _ in range(40):
+        p = int(rng.integers(1, 5))
+        i = int(rng.integers(1, 3))
+        window = int(rng.integers(2, 12))
+        width = int(rng.integers(1, 40))
+        last = rng.integers(0, 256, size=(p, i)).astype(np.uint8)
+        prev = rng.integers(0, 256, size=(p, i)).astype(np.uint8)
+        if rng.random() < 0.5:
+            S = int(rng.integers(0, window + 1))
+            base = rng.integers(0, 256, size=(S, p, i)).astype(np.uint8)
+            fixed = rng.random(size=(S, p)) < rng.random()
+        else:
+            S, base, fixed = 0, None, None
+        beam = branching_beam(
+            last, prev, window, width,
+            max_offset=int(rng.integers(1, window + 1)),
+            base_rows=base, fixed=fixed,
+        )
+        assert beam.shape == (width, window, p, i)
+        if S:
+            for pl in range(p):
+                rows = np.nonzero(fixed[:, pl])[0]
+                assert np.array_equal(
+                    beam[:, rows, pl],
+                    np.broadcast_to(base[rows, pl], (width,) + base[rows, pl].shape),
+                ), "a member rewrote a fixed cell"
+            assert np.array_equal(beam[0, :S], base)
+        assert (beam[0, S:] == last[None]).all()
+        keys = [beam[b].tobytes() for b in range(width)]
+        member0 = keys[0]
+        non_surplus = [k for k in keys[1:] if k != member0]
+        assert len(non_surplus) == len(set(non_surplus)), (
+            "duplicate non-member-0 candidates"
+        )
+
+
 def test_partial_prefix_adoption_core_parity():
     """core.adopt with matched < count: the served prefix comes from the
     trajectory, the suffix resimulates in the same dispatch — ring, live
