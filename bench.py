@@ -492,15 +492,22 @@ def bench_beam_exec(entities=65536, depth=3, beam_width=12):
 
     spec_holder = [spec]
 
-    def run_spec():
-        spec_holder[0] = core.speculate(0, beam_inputs, beam_statuses)
+    def time_spec(b_inputs, b_statuses):
+        spec_holder[0] = core.speculate(0, b_inputs, b_statuses)
+        true_barrier(spec_holder[0][0])
+        t0 = time.perf_counter()
+        n = 25
+        for _ in range(n):
+            spec_holder[0] = core.speculate(0, b_inputs, b_statuses)
+        true_barrier(spec_holder[0][0])
+        return (time.perf_counter() - t0) / n * 1000.0
 
-    t0 = time.perf_counter()
-    n = 25
-    for _ in range(n):
-        run_spec()
-    true_barrier(spec_holder[0][0])
-    speculate_ms = (time.perf_counter() - t0) / n * 1000.0
+    speculate_ms = time_spec(beam_inputs, beam_statuses)
+    # the adaptive gate's width-1 HISTORY-ONLY launch (member 0 alone):
+    # what a value-gated tick pays to keep prefix adoption alive
+    speculate1_ms = time_spec(
+        beam_inputs[:1], np.zeros((1, rollout, players), np.int32)
+    )
 
     return {
         "entities": entities,
@@ -511,6 +518,7 @@ def bench_beam_exec(entities=65536, depth=3, beam_width=12):
         "exec_partial_adopted_rollback_ms": round(partial_ms, 3),
         "exec_plain_tick_ms": round(plain_ms, 3),
         "exec_speculation_ms": round(speculate_ms, 3),
+        "exec_speculation_history_ms": round(speculate1_ms, 3),
         "adopt_speedup": round(resim_ms / max(adopt_ms, 1e-9), 2),
     }
 
@@ -639,7 +647,7 @@ def _run_live_p2p(script, beam_width, budget_ms, frames=200, lag=2,
     # smoke runs with frames <= warmup_frames measure the whole run
     wall_t0 = time.perf_counter()
     base = {"rb": 0, "served": 0, "gated": 0, "ticks": 0,
-            "hits": 0, "partial": 0, "misses": 0}
+            "hits": 0, "partial": 0, "misses": 0, "history": 0}
     for f in range(frames):
         if f == warmup_frames:
             base = {
@@ -650,6 +658,7 @@ def _run_live_p2p(script, beam_width, budget_ms, frames=200, lag=2,
                 "hits": backend.beam_hits,
                 "partial": backend.beam_partial_hits,
                 "misses": backend.beam_misses,
+                "history": backend.beam_history_launches,
             }
             wall_t0 = time.perf_counter()
         t0 = time.perf_counter()
@@ -701,8 +710,15 @@ def _run_live_p2p(script, beam_width, budget_ms, frames=200, lag=2,
         "full_hits": backend.beam_hits - base["hits"],
         "partial_hits": backend.beam_partial_hits - base["partial"],
         "misses": backend.beam_misses - base["misses"],
+        # gated = FULL-width launch withheld; most gated ticks still get
+        # the width-1 history-only launch (member 0's pinned history),
+        # whose rate rides below
         "gated_rate": round(
             (backend.beam_gated - base["gated"]) / max(ticks, 1), 3
+        ),
+        "history_launch_rate": round(
+            (backend.beam_history_launches - base["history"]) / max(ticks, 1),
+            3,
         ),
         "dispatch_p50_ms": round(med(dispatch_ms), 4),
         "rollback_dispatch_p50_ms": round(
@@ -1238,9 +1254,13 @@ def main():
         served_per_tick = (
             on["frames_served_from_speculation"] / max(on["measured_ticks"], 1)
         )
-        launch_rate = 1.0 - on["gated_rate"]
+        # value-gated ticks launch the width-1 history-only rollout
+        # instead of standing down: tax them at ITS measured cost
+        full_rate = 1.0 - on["gated_rate"]
+        hist_rate = on.get("history_launch_rate", 0.0)
         beam_live[label]["net_device_ms_per_tick"] = round(
-            launch_rate * beam_exec["exec_speculation_ms"]
+            full_rate * beam_exec["exec_speculation_ms"]
+            + hist_rate * beam_exec["exec_speculation_history_ms"]
             - served_per_tick * save_per_frame_ms,
             3,
         )

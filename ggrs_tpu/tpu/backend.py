@@ -219,16 +219,24 @@ class TpuRollbackBackend:
     """
 
     # adaptive-gate value tracking. Every time a rollback CONSULTS the
-    # standing speculation, one (frames_served, launches_spanned) sample
-    # lands in a trailing window; the gate's economic signal is
-    # sum(served) / sum(launches) — frames adopted per launch paid,
-    # including launches that were superseded before any rollback looked
-    # at them. Below MIN_SERVED_PER_LAUNCH the beam stands down, except
-    # for a PROBE BURST of consecutive launches every
-    # VALUE_PROBE_INTERVAL gated ticks: a burst (not a lone probe)
-    # because a speculation consulted many ticks after its launch is
-    # stale by shift and would miss regardless of the input regime —
-    # recovery needs a consult of a FRESH spec.
+    # standing speculation, one (branch_frames_served, member0_frames_
+    # served, launches_spanned) sample lands in a trailing window —
+    # launches superseded before any rollback looked at them count as
+    # cost. Two economic signals, one per launch width (_launch_width):
+    # branch-member serves justify the FULL width; member-0 serves
+    # justify the width-1 HISTORY-ONLY launch (pinned history +
+    # repeat-last at 1/B the rollout FLOPs — the measured costs decide
+    # what that is worth: on the tunnel per-program overhead dominates
+    # at interactive sizes and the widths price nearly the same, on
+    # bigger worlds the B-fold device work is real). Below
+    # MIN_SERVED_PER_LAUNCH
+    # on both, the beam stands down entirely, except for a PROBE BURST
+    # of consecutive full-width launches every VALUE_PROBE_INTERVAL
+    # value-gated ticks: a burst (not a lone probe) because a speculation
+    # consulted many ticks after its launch is stale by shift and would
+    # miss regardless of the input regime — recovery needs a consult of
+    # a FRESH spec (and member 0 rides in every full probe, so both
+    # signals stay sampled).
     VALUE_WINDOW = 32  # consult samples retained
     MIN_SERVED_PER_LAUNCH = 0.3
     VALUE_MIN_SAMPLES = 8  # consults before the gate may close
@@ -253,19 +261,22 @@ class TpuRollbackBackend:
         tunneled device. Only for confirmed-input replay (SyncTest): P2P
         rollbacks legitimately re-save corrected frames.
 
-        `speculation_gate`: "always" launches a speculation every tick
-        (pays B*L speculative steps of device time unconditionally);
-        "adaptive" launches only when (a) the measured idle time between
-        ticks covers the measured speculation cost — on a paced loop with
-        spare frame budget the beam rides idle device time for free, on
-        an oversubscribed loop it automatically stands down instead of
-        delaying real work — AND (b) recent launches are actually being
-        adopted: a trailing window of frames-served-per-launch below
-        MIN_SERVED_PER_LAUNCH stands the beam down even with idle budget
-        to burn (input statistics the candidate generator cannot predict
-        make every launch pure cost), with a periodic probe launch every
-        VALUE_PROBE_INTERVAL gated ticks so a regime change (a player
-        starts toggling) re-opens the gate. The cost is measured once in
+        `speculation_gate`: "always" launches a full-width speculation
+        every tick (pays B*L speculative steps of device time
+        unconditionally); "adaptive" picks a LAUNCH WIDTH per tick
+        (_launch_width): the full beam when (a) the measured idle time
+        between ticks covers the measured full-rollout cost — on a paced
+        loop with spare frame budget the beam rides idle device time for
+        free — and (b) recent launches' BRANCH members are actually
+        being adopted (a trailing window of branch-frames-served-per-
+        launch over MIN_SERVED_PER_LAUNCH); the width-1 HISTORY-ONLY
+        rollout (member 0: pinned history + repeat-last, 1/B the FLOPs)
+        when branch value is absent but member-0 serves aren't —
+        forced-replay workloads where the corrected script IS played
+        history; nothing at all when neither width earns its cost, with
+        a periodic full-width probe burst every VALUE_PROBE_INTERVAL
+        gated ticks so a regime change (a player starts toggling)
+        re-opens the gate. Both widths' costs are measured once in
         warmup() (required for adaptive mode); host-loop idle is the
         proxy for device idle — the tunnel's async dispatch hides true
         device occupancy from the host.
@@ -364,8 +375,18 @@ class TpuRollbackBackend:
         self.lazy_ticks = lazy_ticks
         self._tick_rows: List[np.ndarray] = []  # packed rows awaiting dispatch
         self._tick_future: Optional[_FutureChecksumBatch] = None
-        self.beam_gated = 0  # ticks where the gate skipped speculation
+        self.beam_gated = 0  # ticks where the FULL-width launch was withheld
+        # width-1 history-only launches (member 0: pinned history +
+        # repeat-last). Under a beam-sharded mesh the minimal legal width
+        # is the beam axis (an indivisible width would run replicated)
+        self.beam_history_launches = 0
+        self._history_width = (
+            mesh.shape["beam"]
+            if beam_width and self.core._beam_sharding is not None
+            else 1
+        )
         self._spec_cost_s: Optional[float] = None  # measured in warmup()
+        self._spec_hist_cost_s: Optional[float] = None  # width-1, warmup()
         # None until the first idle sample lands: seeding the EMA from 0.0
         # made the gate stand down for the first ~20-30 ticks of a fully
         # idle loop while the blend warmed up (r3 advisor)
@@ -426,53 +447,100 @@ class TpuRollbackBackend:
         frame's critical path; otherwise handle_requests calls it
         automatically."""
         if self.beam_width and self._last_segment is not None:
-            if self._speculation_affordable():
-                self._launch_speculation(*self._last_segment)
-            else:
+            if self._last_segment[2] == 0:  # count: nothing to anchor on
+                self._last_segment = None
+                return
+            width = self._launch_width()
+            if width != self.beam_width:
                 self.beam_gated += 1
+            if width:
+                if width != self.beam_width:
+                    self.beam_history_launches += 1
+                self._launch_speculation(*self._last_segment, width=width)
             self._last_segment = None
 
-    def _speculation_affordable(self) -> bool:
-        """The adaptive gate, two conditions ANDed:
+    def _launch_width(self) -> int:
+        """The adaptive gate. Returns the width to launch at — the full
+        beam, the width-1 history-only rollout, or 0 for no launch.
 
         BUDGET — speculation is worth launching only when the loop's idle
-        time can absorb its device cost; otherwise the B*L speculative
-        steps delay the NEXT real tick by more than an adopted rollback
-        could ever save. 80% slack biases toward speculating (a
-        near-covered cost still wins when a deep rollback adopts). An
-        unseeded idle EMA (no second tick yet) counts as affordable.
+        time can absorb its device cost; otherwise the speculative steps
+        delay the NEXT real tick by more than an adopted rollback could
+        ever save. 80% slack biases toward speculating (a near-covered
+        cost still wins when a deep rollback adopts). An unseeded idle
+        EMA (no second tick yet) counts as affordable. The full and the
+        history widths are budgeted separately (both costs measured in
+        warmup()): an idle budget too thin for the B-wide rollout often
+        still covers the width-1 one.
 
-        VALUE — even with idle budget to burn, launches that nothing
-        adopts are pure device cost plus adoption-path latency: once
-        enough consults have sampled the regime and the trailing
-        frames-served-per-launch ratio sits under MIN_SERVED_PER_LAUNCH,
-        stand down. A PROBE BURST of consecutive launches every
-        VALUE_PROBE_INTERVAL gated ticks keeps sampling the input regime
-        with fresh-at-consult specs, so toggling players re-open the gate
-        within a couple of windows.
-        """
+        VALUE — two signals from the consult trail, one per width. Full
+        width is justified only by BRANCH-member adoptions (trailing
+        branch-frames-served-per-launch >= MIN_SERVED_PER_LAUNCH); when
+        that fails, a PROBE BURST of consecutive full-width launches
+        every VALUE_PROBE_INTERVAL value-gated ticks keeps sampling the
+        regime with fresh-at-consult specs so toggling players re-open
+        it. The history width is justified by MEMBER-0 adoptions —
+        SyncTest-style replays where the corrected script is played
+        history and the pinned member serves it at 1/B the rollout
+        FLOPs (the measured per-width costs price what that is worth);
+        in P2P regimes member 0 serves nothing by construction (the load
+        frame is the first incorrect frame), the history signal decays,
+        and value-gated ticks stand fully down exactly as before this
+        width existed (full probes keep sampling BOTH signals: member 0
+        rides in every full launch)."""
+        full, hist = self.beam_width, self._history_width
         if self.speculation_gate != "adaptive":
-            return True
+            return full
         if self._spec_cost_s is None:
-            return True  # not yet measured (warmup pending): don't stall
-        if self._idle_ema_s is not None and (
-            self._idle_ema_s < 0.8 * self._spec_cost_s
-        ):
-            return False
+            return full  # not yet measured (warmup pending): don't stall
+        idle = self._idle_ema_s
+        full_affordable = idle is None or idle >= 0.8 * self._spec_cost_s
+        hist_cost = (
+            self._spec_hist_cost_s
+            if self._spec_hist_cost_s is not None
+            # unmeasured (older checkpoint): estimate by scaling with width
+            else self._spec_cost_s * hist / max(full, 1)
+        )
+        hist_affordable = idle is None or idle >= 0.8 * hist_cost
         if len(self._launch_value) >= self.VALUE_MIN_SAMPLES:
-            served = sum(v for v, _ in self._launch_value)
-            launches = sum(n for _, n in self._launch_value)
-            if served / max(launches, 1) < self.MIN_SERVED_PER_LAUNCH:
-                # close first, then burst at the END of each interval —
-                # a burst of VALUE_PROBE_BURST consecutive launches per
-                # VALUE_PROBE_INTERVAL gated ticks
-                self._value_gated_streak += 1
-                return (
-                    (self._value_gated_streak - 1) % self.VALUE_PROBE_INTERVAL
-                    >= self.VALUE_PROBE_INTERVAL - self.VALUE_PROBE_BURST
-                )
-        self._value_gated_streak = 0
-        return True
+            launches = max(sum(n for _, _, n in self._launch_value), 1)
+            branch_rate = sum(b for b, _, _ in self._launch_value) / launches
+            hist_rate = sum(h for _, h, _ in self._launch_value) / launches
+            hist_ok = hist_rate >= self.MIN_SERVED_PER_LAUNCH
+            # full width earns its keep when its MARGINAL value over the
+            # history width (branch serves) clears the bar — or, in
+            # blended regimes where neither signal alone clears it, when
+            # the TOTAL does (the pre-split gate's signal: width-1 alone
+            # would forfeit the branch share). When member-0 serves
+            # dominate and the branch marginal is under the bar, full is
+            # NOT ok even though the total is huge: that's exactly the
+            # regime the cheaper history width exists for.
+            branch_ok = branch_rate >= self.MIN_SERVED_PER_LAUNCH or (
+                not hist_ok
+                and branch_rate + hist_rate >= self.MIN_SERVED_PER_LAUNCH
+            )
+        else:
+            branch_ok = hist_ok = True
+        if branch_ok:
+            self._value_gated_streak = 0
+            if full_affordable:
+                return full
+            if hist_ok and hist_affordable:
+                return hist
+            return 0
+        # full width value-gated: probe at the END of each interval (the
+        # streak keeps counting through probes — it clears only when
+        # branch adoptions lift the trailing ratio back over the bar)
+        self._value_gated_streak += 1
+        probing = (
+            (self._value_gated_streak - 1) % self.VALUE_PROBE_INTERVAL
+            >= self.VALUE_PROBE_INTERVAL - self.VALUE_PROBE_BURST
+        )
+        if probing and full_affordable:
+            return full
+        if hist_ok and hist_affordable:
+            return hist
+        return 0
 
     def _run_segment(self, requests: List[Request]) -> None:
         load: Optional[LoadGameState] = None
@@ -531,12 +599,23 @@ class TpuRollbackBackend:
         if load is not None and self._spec is not None:
             match = self._match_speculation(load.frame, inputs, statuses, count)
             if not self._spec_consulted:
-                # one value sample per consulted speculation: frames it
-                # served (0 on a miss) over the launches paid since the
-                # last consult — superseded-unconsulted launches thereby
-                # count as cost without poisoning quiet stretches
+                # one value sample per consulted speculation, split by
+                # WHO served: (branch_frames, member0_frames, launches
+                # paid since the last consult) — superseded-unconsulted
+                # launches count as cost without poisoning quiet
+                # stretches. The split is the width decision's signal:
+                # member-0 serves are what the width-1 history launch
+                # provides at 1/B the rollout FLOPs (SyncTest-style replays,
+                # where the corrected script IS played history), while
+                # only branch-member adoptions justify the full width
+                # (P2P toggles — there the load frame is the first
+                # INCORRECT frame, so member 0's pinned rows mismatch at
+                # offset 0 by construction and serve nothing)
+                served = match[2] if match else 0
+                is_branch = bool(match) and match[0] != 0
                 self._launch_value.append(
-                    (match[2] if match else 0,
+                    (served if is_branch else 0,
+                     0 if is_branch else served,
                      max(self._launches_since_consult, 1))
                 )
                 self._launches_since_consult = 0
@@ -719,7 +798,8 @@ class TpuRollbackBackend:
 
     def _launch_speculation(self, load: Optional[LoadGameState],
                             start_frame: Frame, count: int,
-                            inputs: np.ndarray, statuses: np.ndarray) -> None:
+                            inputs: np.ndarray, statuses: np.ndarray,
+                            width: Optional[int] = None) -> None:
         """Anchor one frame DEEPER than the observed rollback depth
         predicts for the next tick, so the next load lands at shift 1 and
         depth jitter of ±1 still falls inside the member window (the
@@ -727,12 +807,16 @@ class TpuRollbackBackend:
         ring by dense-saving construction. Candidate scripts branch between
         each player's last and previous-distinct inputs at every plausible
         offset (see beam.branching_beam); member 0 is the reference's
-        repeat-last prediction."""
+        repeat-last prediction. `width` (default: the full beam_width) is
+        the adaptive gate's launch width — the history width rolls out
+        member 0 alone at 1/B the rollout FLOPs."""
         from .beam import branching_beam
 
         core = self.core
         if count == 0:
             return
+        if width is None:
+            width = self.beam_width
         # the rollout anchors on a ring snapshot: buffered ticks must land
         self.flush()
         current_after = start_frame + count
@@ -768,7 +852,7 @@ class TpuRollbackBackend:
             self._last_inputs,
             self._prev_inputs,
             core.window,
-            self.beam_width,
+            width,
             # branches must cover prefix + script anywhere the rollout can
             # be matched (offset 0 first: the likeliest switch point)
             max_offset=rollout,
@@ -781,7 +865,7 @@ class TpuRollbackBackend:
         # overhead, so L tracks need, not the window
         beam_inputs = beam_inputs[:, :rollout]
         beam_statuses = np.zeros(
-            (self.beam_width, rollout, self.num_players), dtype=np.int32
+            (width, rollout, self.num_players), dtype=np.int32
         )
         with GLOBAL_TRACER.span("tpu/beam_speculate"):
             spec = core.speculate(anchor % core.ring_len, beam_inputs, beam_statuses)
@@ -810,6 +894,7 @@ class TpuRollbackBackend:
         self.beam_partial_hits = 0
         self.beam_misses = 0
         self.beam_gated = 0
+        self.beam_history_launches = 0
         self.rollback_frames = 0
         self.rollback_frames_adopted = 0
         self._last_inputs[:] = 0
@@ -855,45 +940,61 @@ class TpuRollbackBackend:
         if self.beam_width:
             from .beam import branching_beam
 
-            # compile EVERY rollout length the live path can dispatch
-            # (depth coalescing yields 5, 7, 9, ... up to the window) —
-            # a mid-session depth change must not pay the seconds-long
-            # speculate/adopt compile stall warmup exists to prevent
-            full_beam = branching_beam(
-                np.zeros((P, I), dtype=np.uint8),
-                np.zeros((P, I), dtype=np.uint8),
-                W,
-                self.beam_width,
-            )
+            # compile EVERY (width, rollout length) the live path can
+            # dispatch — widths: the full beam and the adaptive gate's
+            # history-only width; lengths: depth coalescing yields
+            # 5, 7, 9, ... up to the window. A mid-session width or depth
+            # change must not pay the seconds-long speculate/adopt compile
+            # stall warmup exists to prevent (adopt's jit keys on the
+            # trajectory's member-axis shape, so BOTH widths need it)
             rollouts = sorted(
                 {min(d + 3 + (d & 1), W) for d in range(1, W + 1)}
             )
-            for rollout in rollouts:
-                beam_statuses = np.zeros(
-                    (self.beam_width, rollout, P), dtype=np.int32
+            widths = sorted({self.beam_width, self._history_width})
+            beams = {
+                width: branching_beam(
+                    np.zeros((P, I), dtype=np.uint8),
+                    np.zeros((P, I), dtype=np.uint8),
+                    W,
+                    width,
                 )
-                spec = core.speculate(0, full_beam[:, :rollout], beam_statuses)
-                core.adopt(spec, 0, 0, scratch, 1)
-            # measure the post-compile speculation cost for the adaptive
-            # gate: a few amortized dispatches at the mid rollout length
-            # under a TRUE barrier (block_until_ready is dispatch-ack only
-            # on the tunnel)
+                for width in widths
+            }
+            for width in widths:
+                for rollout in rollouts:
+                    beam_statuses = np.zeros(
+                        (width, rollout, P), dtype=np.int32
+                    )
+                    spec = core.speculate(
+                        0, beams[width][:, :rollout], beam_statuses
+                    )
+                    core.adopt(spec, 0, 0, scratch, 1)
+            # measure the post-compile speculation cost PER WIDTH for the
+            # adaptive gate's budget conditions: a few amortized
+            # dispatches at the mid rollout length under a TRUE barrier
+            # (block_until_ready is dispatch-ack only on the tunnel)
             import time as _time
 
             from ..utils.barrier import true_barrier
 
             rollout = rollouts[len(rollouts) // 2]
-            beam_statuses = np.zeros(
-                (self.beam_width, rollout, P), dtype=np.int32
-            )
-            spec = core.speculate(0, full_beam[:, :rollout], beam_statuses)
-            true_barrier(spec[1])
-            n = 5
-            t0 = _time.perf_counter()
-            for _ in range(n):
-                spec = core.speculate(0, full_beam[:, :rollout], beam_statuses)
-            true_barrier(spec[1])
-            self._spec_cost_s = (_time.perf_counter() - t0) / n
+            costs = {}
+            for width in widths:
+                beam_statuses = np.zeros((width, rollout, P), dtype=np.int32)
+                spec = core.speculate(
+                    0, beams[width][:, :rollout], beam_statuses
+                )
+                true_barrier(spec[1])
+                n = 5
+                t0 = _time.perf_counter()
+                for _ in range(n):
+                    spec = core.speculate(
+                        0, beams[width][:, :rollout], beam_statuses
+                    )
+                true_barrier(spec[1])
+                costs[width] = (_time.perf_counter() - t0) / n
+            self._spec_cost_s = costs[self.beam_width]
+            self._spec_hist_cost_s = costs[self._history_width]
         core.ring, core.state = ring0, state0
         self.block_until_ready()
 

@@ -509,11 +509,15 @@ def test_arena_beam_adoption_live_p2p():
     assert beam.beam_hits > 0, (beam.beam_hits, beam.beam_misses)
 
 
-def test_value_gate_stands_down_and_probes():
-    """The adaptive gate's VALUE condition: a trailing window of
-    worthless consults (nothing adopted over many launches) closes the
-    gate even with idle budget to burn, a PROBE BURST fires every
-    VALUE_PROBE_INTERVAL gated ticks, and adopted consults re-open it."""
+def test_value_gate_two_signals_and_probes():
+    """The adaptive gate's VALUE conditions, one per launch width: no
+    branch serves + no member-0 serves -> full stand-down with periodic
+    full-width probe bursts (the pre-width behavior); no branch serves
+    but member-0 serves (SyncTest-style replays) -> width-1 history-only
+    launches between probes; branch serves -> full width, streak clears.
+    The budget condition is per width: an idle budget too thin for the
+    full rollout but thick enough for width-1 history launches gets
+    them."""
     backend = TpuRollbackBackend(
         ExGame(PLAYERS, ENTITIES),
         max_prediction=6,
@@ -522,32 +526,60 @@ def test_value_gate_stands_down_and_probes():
         speculation_gate="adaptive",
     )
     backend._spec_cost_s = 0.001
+    backend._spec_hist_cost_s = 0.00025
     backend._idle_ema_s = 1.0  # budget condition comfortably satisfied
-
-    # not enough samples yet: gate open
-    assert backend._speculation_affordable()
-    for _ in range(backend.VALUE_MIN_SAMPLES):
-        backend._launch_value.append((0, 4))  # consults that served nothing
-    decisions = [
-        backend._speculation_affordable()
-        for _ in range(2 * backend.VALUE_PROBE_INTERVAL)
-    ]
-    # closes first, then exactly one burst of probes at the END of each
-    # interval
     interval, burst = backend.VALUE_PROBE_INTERVAL, backend.VALUE_PROBE_BURST
-    assert decisions.count(True) == 2 * burst
-    assert not any(decisions[: interval - burst])  # stand-down period first
-    assert all(decisions[interval - burst : interval])  # the full burst
 
-    # a regime change: consults adopt again (fresh probe specs hitting)
+    # not enough samples yet: full width
+    assert backend._launch_width() == 4
+
+    # regime 1: nothing serves at all (P2P neutral statistics) — the
+    # value-gated ticks stand fully down; probes burst at interval ends
+    for _ in range(backend.VALUE_MIN_SAMPLES):
+        backend._launch_value.append((0, 0, 4))
+    decisions = [backend._launch_width() for _ in range(2 * interval)]
+    assert decisions.count(4) == 2 * burst
+    assert decisions.count(0) == 2 * (interval - burst)
+    assert set(decisions[: interval - burst]) == {0}
+    assert decisions[interval - burst : interval] == [4] * burst
+
+    # regime 2: member 0 serves (forced-replay workload) but branches
+    # don't — value-gated ticks drop to width-1 history launches instead
+    # of standing down; probes still fire
     for _ in range(backend.VALUE_WINDOW):
-        backend._launch_value.append((3, 2))
-    assert backend._speculation_affordable()
+        backend._launch_value.append((0, 3, 2))
+    backend._value_gated_streak = 0
+    decisions = [backend._launch_width() for _ in range(interval)]
+    assert decisions.count(4) == burst
+    assert decisions.count(1) == interval - burst
+    assert set(decisions[: interval - burst]) == {1}
+
+    # regime 3: branch members adopt again — full width, streak clears
+    for _ in range(backend.VALUE_WINDOW):
+        backend._launch_value.append((3, 0, 2))
+    assert backend._launch_width() == 4
     assert backend._value_gated_streak == 0
 
-    # and the budget condition still vetoes on an oversubscribed loop
+    # regime 4 (blended): neither signal alone clears the bar but the
+    # total does — width-1 would forfeit the branch share, so the gate
+    # keeps the full width (the pre-split combined signal)
+    backend._idle_ema_s = 1.0
+    for _ in range(backend.VALUE_WINDOW):
+        backend._launch_value.append((1, 1, 5))  # 0.2 + 0.2 per launch
+    assert backend._launch_width() == 4
+    assert backend._value_gated_streak == 0
+
+    # budget: an oversubscribed loop that can't cover even the history
+    # width launches nothing...
     backend._idle_ema_s = 0.0
-    assert not backend._speculation_affordable()
+    assert backend._launch_width() == 0
+    # ...and one that covers width-1 but not the full rollout gets
+    # history launches ONLY when member-0 value supports them
+    backend._idle_ema_s = 0.0005
+    assert backend._launch_width() == 0  # branch regime: width 1 is useless
+    for _ in range(backend.VALUE_WINDOW):
+        backend._launch_value.append((0, 3, 2))
+    assert backend._launch_width() == 1
 
 
 def test_value_gate_attribution_live():
@@ -576,10 +608,50 @@ def test_value_gate_attribution_live():
         plain.handle_requests(s1.advance_frame())
         clock.advance(16)
     assert len(beam._launch_value) >= beam.VALUE_MIN_SAMPLES
-    served = sum(v for v, _ in beam._launch_value)
-    launches = sum(n for _, n in beam._launch_value)
-    assert served / launches < beam.MIN_SERVED_PER_LAUNCH
+    branch = sum(b for b, _, _ in beam._launch_value)
+    hist = sum(h for _, h, _ in beam._launch_value)
+    launches = sum(n for _, _, n in beam._launch_value)
+    assert branch / launches < beam.MIN_SERVED_PER_LAUNCH
+    # P2P rollbacks load at the FIRST INCORRECT frame, so member 0's
+    # pinned (played) rows mismatch at offset 0 by construction: the
+    # history signal must decay too, and value-gated ticks stand fully
+    # down instead of paying for useless width-1 launches
+    assert hist / launches < beam.MIN_SERVED_PER_LAUNCH
     assert beam.beam_gated > 0, "value gate never stood down"
+    assert beam.beam_history_launches == 0, (
+        "width-1 launches fired in a regime where member 0 cannot serve"
+    )
     sa, sb = beam.state_numpy(), plain.state_numpy()
     for key in ("frame", "pos", "vel", "rot"):
         np.testing.assert_array_equal(np.asarray(sa[key]), np.asarray(sb[key]))
+
+
+def test_history_width_serves_forced_replays_live():
+    """The width-1 history-only launch earning its keep: on a SyncTest
+    stream with per-frame-varying inputs every adoption is a member-0
+    (pinned-history) serve, so the adaptive gate drops the full width
+    but KEEPS launching at width 1 — adoption throughput survives at
+    1/B the rollout FLOPs, bit-identical to plain resimulation."""
+    beam = TpuRollbackBackend(
+        ExGame(PLAYERS, ENTITIES),
+        max_prediction=6,
+        num_players=PLAYERS,
+        beam_width=8,
+        speculation_gate="adaptive",
+    )
+    beam._spec_cost_s = 1e-9  # pretend measured: budget never vetoes
+    beam._spec_hist_cost_s = 1e-9
+    plain = make_backend(beam_width=0)
+    drive_synctest_pair(
+        beam, plain, lambda t, h: bytes([(t * (h + 3) + h) % 16]), ticks=60
+    )
+    assert beam.beam_gated > 0, "full width never dropped"
+    assert beam.beam_history_launches > 0, (
+        "history-only launches never fired in a member-0-serving regime"
+    )
+    # adoption kept working THROUGH the width drop: serves continued
+    # after the first gated tick
+    assert beam.beam_hits + beam.beam_partial_hits > beam.beam_misses
+    hist = sum(h for _, h, _ in beam._launch_value)
+    launches = sum(n for _, _, n in beam._launch_value)
+    assert hist / launches >= beam.MIN_SERVED_PER_LAUNCH
