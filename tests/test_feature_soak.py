@@ -60,8 +60,12 @@ def hold_script(rng, ticks):
         {"lazy_ticks": 5},
         {"beam_width": 16},
         {"lazy_ticks": 3, "beam_width": 16},
+        # the adaptive gate's width decisions (full / width-1 history /
+        # none, value-attributed by member) under the same random
+        # streams: every choice must stay bit-identical to plain resim
+        {"beam_width": 8, "speculation_gate": "adaptive"},
     ],
-    ids=["lazy", "beam", "lazy+beam"],
+    ids=["lazy", "beam", "lazy+beam", "beam-adaptive"],
 )
 @pytest.mark.parametrize("seed", [1, 2])
 def test_feature_synctest_soak_bit_parity(kw, seed):
@@ -82,6 +86,11 @@ def test_feature_synctest_soak_bit_parity(kw, seed):
         )
 
     featured, plain = make_backend(**kw), make_backend()
+    if kw.get("speculation_gate") == "adaptive":
+        # pretend-measured costs: the VALUE conditions (not the budget)
+        # drive the width choices under this soak's timing-free loop
+        featured._spec_cost_s = 1e-9
+        featured._spec_hist_cost_s = 1e-9
     sf, sp = make_sess(), make_sess()
     # capture (frame, checksum_getter) AT SAVE TIME: ring cells are reused
     # every max_prediction+2 frames, so late cell reads would only compare
