@@ -173,7 +173,10 @@ def main() -> int:
                 st = backend.state_numpy()
                 p0 = st["pos"][0]
                 hits = (
-                    f" beam {backend.beam_hits}/{backend.beam_hits + backend.beam_misses}"
+                    f" beam {backend.beam_hits}+{backend.beam_partial_hits}p"
+                    f"/{backend.beam_hits + backend.beam_partial_hits + backend.beam_misses}"
+                    f" served {backend.rollback_frames_adopted}"
+                    f"/{backend.rollback_frames} gated {backend.beam_gated}"
                     if args.beam
                     else ""
                 )
