@@ -364,6 +364,15 @@ class TpuRollbackBackend:
         # against this history (frames before the load are confirmed-
         # correct, so what was played is what happened)
         self._played: dict = {}
+        # online hold-length/transition statistics per player, learned
+        # from FINALIZED rows (frames beyond rollback reach, so nothing
+        # a later correction can rewrite ever enters the statistics);
+        # ranks the beam's branch candidates by measured likelihood
+        # instead of a uniform offset sweep (input_model.py)
+        from .input_model import InputHistoryModel
+
+        self.input_model = InputHistoryModel(num_players, game.input_size)
+        self._finalized_to = -1  # newest frame already fed to the model
         # observed rollback depth (current-after-tick minus load frame);
         # the next speculation anchors one frame deeper than the depth
         # predicts so ±1 jitter still lands inside the member window
@@ -498,8 +507,13 @@ class TpuRollbackBackend:
         hist_cost = (
             self._spec_hist_cost_s
             if self._spec_hist_cost_s is not None
-            # unmeasured (older checkpoint): estimate by scaling with width
-            else self._spec_cost_s * hist / max(full, 1)
+            # unmeasured (older checkpoint): assume the FULL cost. Per-
+            # dispatch overhead dominates at interactive sizes, so a
+            # linear width/full scaling would admit history launches into
+            # idle budgets that cannot actually absorb them (r4 advisor);
+            # the conservative fallback only ever under-launches until
+            # warmup() measures the real width-1 cost
+            else self._spec_cost_s
         )
         hist_affordable = idle is None or idle >= 0.8 * hist_cost
         if len(self._launch_value) >= self.VALUE_MIN_SAMPLES:
@@ -712,7 +726,39 @@ class TpuRollbackBackend:
                     inputs[f].copy(),
                     statuses[f].copy(),
                 )
+            # feed the input model every newly-FINALIZED frame, in order:
+            # a rollback can load at most max_prediction behind the
+            # current frame, so rows older than that are what really
+            # happened — even rows played as predictions (never corrected
+            # means correct). Disconnected cells break the run instead of
+            # polluting the hold statistics with dummy inputs.
+            final_horizon = self.current_frame - core.max_prediction
+            f = self._finalized_to + 1
+            # a gap (restored checkpoint, pre-beam history) can't be
+            # learned from: jump past it, severing runs so stale run
+            # state never bridges unobserved frames. `horizon` (below)
+            # is the _played GC cutoff — the jump guard must use the
+            # same expression or the two drift.
             horizon = self.current_frame - core.window - core.max_prediction
+            oldest_kept = horizon
+            if f < oldest_kept:
+                f = oldest_kept
+                for p in range(self.num_players):
+                    self.input_model.break_run(p)
+            while f < final_horizon:
+                rec = self._played.get(f)
+                if rec is None:
+                    for p in range(self.num_players):
+                        self.input_model.break_run(p)
+                else:
+                    pin, pst = rec
+                    for p in range(self.num_players):
+                        if pst[p] >= int(InputStatus.DISCONNECTED):
+                            self.input_model.break_run(p)
+                        else:
+                            self.input_model.observe(p, pin[p].tobytes())
+                self._finalized_to = f
+                f += 1
             for key in [k for k in self._played if k < horizon]:
                 del self._played[key]
 
@@ -796,6 +842,57 @@ class TpuRollbackBackend:
                 his, los = core.tick_multi(buf)
         future.batch = _ChecksumBatch(his, los, self.ledger)
 
+    def _ranked_predictions(self, anchor: Frame, rollout: int, width: int):
+        """Likelihood-ranked (player, offset, value_row) switch specs for
+        branching_beam's prediction stream. The per-player hazard clock
+        starts at the CONFIRMED frontier — rows played after it repeat the
+        last confirmed value by prediction, so the real switch (the thing
+        a rollback corrects) can land at any not-yet-confirmed frame.
+        Frontier and run length come from the recorded play-time statuses
+        in _played; frames confirmed only implicitly (predicted, never
+        corrected) keep the frontier conservative, which merely shifts
+        probability toward earlier offsets."""
+        frontiers = []
+        for p in range(self.num_players):
+            frontier = None
+            for f in range(self.current_frame - 1, -1, -1):
+                rec = self._played.get(f)
+                if rec is None:
+                    break
+                if rec[1][p] == int(InputStatus.CONFIRMED):
+                    frontier = f
+                    break
+            if frontier is None:
+                frontiers.append(None)
+                continue
+            value = self._played[frontier][0][p].tobytes()
+            run = 1
+            f = frontier - 1
+            while f >= 0:
+                rec = self._played.get(f)
+                if (
+                    rec is None
+                    or rec[1][p] != int(InputStatus.CONFIRMED)
+                    or rec[0][p].tobytes() != value
+                ):
+                    break
+                run += 1
+                f -= 1
+            frontiers.append((frontier, value, run))
+        if all(fr is None for fr in frontiers):
+            return None
+        # cap the model's share at ~2/3 of the branch members: the
+        # ranked specs come first, but the uniform offset families and
+        # XOR novel-value perturbations must keep guaranteed coverage —
+        # a confidently wrong model (opponent switches to a value the
+        # transition table has never seen) would otherwise monopolize
+        # every member and turn recoverable partial hits into full misses
+        preds = self.input_model.rank_branches(
+            frontiers, anchor, rollout,
+            limit=max((width - 1) * 2 // 3, 1),
+        )
+        return preds or None
+
     def _launch_speculation(self, load: Optional[LoadGameState],
                             start_frame: Frame, count: int,
                             inputs: np.ndarray, statuses: np.ndarray,
@@ -858,7 +955,25 @@ class TpuRollbackBackend:
             max_offset=rollout,
             base_rows=base_rows,
             fixed=fixed,
+            # only full-width launches carry branch members; history
+            # launches (width-1 / replicated member 0) would discard the
+            # ranking, so don't pay the host-side scoring for them
+            predictions=(
+                self._ranked_predictions(anchor, rollout, width)
+                if width == self.beam_width
+                else None
+            ),
         )
+        if width != self.beam_width and width > 1:
+            # sharded history launch: the minimal legal width is the beam
+            # axis, but a history launch means MEMBER 0 SEMANTICS — so
+            # replicate member 0 across the shard axis instead of letting
+            # branching_beam fill the extra slots with branch candidates.
+            # A serve from this launch then always attributes as a
+            # member-0 (history) serve, matching what the launch paid for
+            # (r4 advisor: branch serves from a history launch reopened
+            # full width while crediting history-launch cost)
+            beam_inputs[1:] = beam_inputs[0]
         # roll out only as deep as a rollback can reach while this
         # speculation stands (shift ~1 + depth + reuse/growth margin): on
         # big worlds the speculation's B*L step cost is the beam's
@@ -900,6 +1015,14 @@ class TpuRollbackBackend:
         self._last_inputs[:] = 0
         self._prev_inputs[:] = 0
         self._played.clear()
+        # the input model SURVIVES reset on purpose: hold/transition
+        # statistics describe the players, not the session — a rematch
+        # (or a benchmark arm) keeps what it learned, exactly like the
+        # measured speculation costs. Frame bookkeeping restarts; the
+        # jump-past-gap guard severs runs at the discontinuity.
+        for p in range(self.num_players):
+            self.input_model.break_run(p)
+        self._finalized_to = -1
         self._depth = 2
         self._idle_ema_s = None
         self._last_tick_end = None
@@ -950,7 +1073,15 @@ class TpuRollbackBackend:
             rollouts = sorted(
                 {min(d + 3 + (d & 1), W) for d in range(1, W + 1)}
             )
-            widths = sorted({self.beam_width, self._history_width})
+            # only the adaptive gate ever dispatches the history width;
+            # with gate='always' compiling+timing it would roughly double
+            # warmup's beam section (seconds per program on the tunnel)
+            # for programs that never run (r4 advisor)
+            widths = (
+                sorted({self.beam_width, self._history_width})
+                if self.speculation_gate == "adaptive"
+                else [self.beam_width]
+            )
             beams = {
                 width: branching_beam(
                     np.zeros((P, I), dtype=np.uint8),
@@ -968,7 +1099,15 @@ class TpuRollbackBackend:
                     spec = core.speculate(
                         0, beams[width][:, :rollout], beam_statuses
                     )
+                    # full hits route to the branchless adopt program and
+                    # partial hits to the cond one (ResimCore.adopt):
+                    # compile BOTH, or the first live partial hit pays a
+                    # mid-session compile
                     core.adopt(spec, 0, 0, scratch, 1)
+                    core.adopt(
+                        spec, 0, 0, scratch, 2,
+                        inputs=inputs, statuses=statuses, matched=1,
+                    )
             # measure the post-compile speculation cost PER WIDTH for the
             # adaptive gate's budget conditions: a few amortized
             # dispatches at the mid rollout length under a TRUE barrier
@@ -994,7 +1133,9 @@ class TpuRollbackBackend:
                 true_barrier(spec[1])
                 costs[width] = (_time.perf_counter() - t0) / n
             self._spec_cost_s = costs[self.beam_width]
-            self._spec_hist_cost_s = costs[self._history_width]
+            # None when the history width wasn't timed (gate != adaptive);
+            # _launch_width's conservative fallback covers that case
+            self._spec_hist_cost_s = costs.get(self._history_width)
         core.ring, core.state = ring0, state0
         self.block_until_ready()
 

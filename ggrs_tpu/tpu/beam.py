@@ -87,6 +87,7 @@ def branching_beam(
     max_offset: Optional[int] = None,
     base_rows: Optional[np.ndarray] = None,
     fixed: Optional[np.ndarray] = None,
+    predictions: Optional[List[Tuple[int, int, np.ndarray]]] = None,
 ) -> np.ndarray:
     """Candidate generator for live sessions: per-frame branching scripts.
 
@@ -132,6 +133,21 @@ def branching_beam(
     Distinctness is enforced by construction: members that collapse to an
     already-emitted candidate (e.g. a switch at an offset whose cells are
     all fixed) are skipped, not kept as dead weight.
+
+    MODEL-RANKED CANDIDATES COME FIRST. `predictions` is an ordered list of
+    (player, offset, value_row) switch specs from the online input model
+    (input_model.InputHistoryModel.rank_branches): "player p's next real
+    input is value_row, first visible at beam row `offset`". When present,
+    members are allocated to these likelihood-ranked specs BEFORE the
+    uniform offset sweep — the first prediction member combines every
+    player's top-ranked spec (the joint future: multiple players switching
+    inside one rollback window needs one member carrying all the
+    switches), then each spec lands in its own member in rank order. The
+    caller caps the prediction share (TpuRollbackBackend passes at most
+    ~2/3 of the branch members) so the uniform families and XOR
+    perturbations always keep guaranteed coverage of novel values and
+    unranked offsets; a cold model (predictions=None) degrades to
+    exactly the pre-model generator.
 
     last_inputs/prev_inputs: u8[P, I]. Returns u8[B, W, P, I].
     """
@@ -180,6 +196,18 @@ def branching_beam(
             yield ("all", k, False)
             yield ("all", k, True)
 
+    def prediction_stream():
+        """Model-ranked switch specs, joint-first (see docstring)."""
+        assert predictions
+        top: dict = {}
+        for pl, k, row in predictions:
+            if pl not in top:
+                top[pl] = (k, row)
+        if len(top) >= 2:
+            yield ("predjoint", tuple(sorted(top.items())))
+        for pl, k, row in predictions:
+            yield ("pred", pl, k, row)
+
     streams = [player_stream(pl) for pl in range(p)]
     if len(toggling) >= 2:
         streams.insert(0, all_stream())
@@ -187,6 +215,34 @@ def branching_beam(
     seen = {beam[0].tobytes()}
     b = 1
     iota = np.arange(window)
+
+    def apply_switch(cand, pl, k, row):
+        """Rows >= k take `row` for player pl (free cells only)."""
+        rows = np.where((iota >= k)[:, None], row, beam[0][:, pl])
+        m = free_mask[:, pl]
+        cand[m, pl] = rows[m]
+
+    if predictions:
+        # drain the ranked stream exhaustively before the generic
+        # round-robin: these members are ordered by measured likelihood,
+        # which is the whole point of the model
+        for spec in prediction_stream():
+            if b >= beam_width:
+                break
+            cand = beam[0].copy()
+            if spec[0] == "predjoint":
+                for pl, (k, row) in spec[1]:
+                    apply_switch(cand, pl, k, row)
+            else:
+                _, pl, k, row = spec
+                apply_switch(cand, pl, k, row)
+            key = cand.tobytes()
+            if key in seen:
+                continue
+            seen.add(key)
+            beam[b] = cand
+            b += 1
+
     exhausted = [False] * len(streams)
     # every stream is finite (offset families bounded by max_offset, XOR
     # bounded to one distinct cycle), so this terminates even when

@@ -1,0 +1,199 @@
+"""Online per-player input statistics feeding the speculation candidates.
+
+The reference predicts one future per player — repeat the last confirmed
+input (src/input_queue.rs:126-139) — and that floor is exactly what the
+beam's member 0 already provides. What the branch members need is a model
+of WHEN a player will stop repeating and WHAT they will switch to. Real
+input streams are runs of held values; this module learns, per player,
+
+- the HOLD-LENGTH distribution (how long values get held before a switch),
+  turned into a discrete hazard: given the current value has been held r
+  frames, the probability the switch lands exactly k frames out; and
+- the VALUE-TRANSITION distribution (given the held value, which values
+  follow it), learned from observed switches.
+
+Both are learned online from FINALIZED history only — frames old enough
+that no rollback can rewrite them — so the statistics never ingest a
+prediction that later turns out wrong. The product of the two
+distributions ranks every (player, switch offset, next value) branch
+candidate; `TpuRollbackBackend` hands the top of that ranking to
+`beam.branching_beam(predictions=...)`, which allocates beam members by
+likelihood instead of sweeping offsets uniformly. The uniform sweep and
+the XOR perturbations remain the fallback for players with no history.
+"""
+
+from __future__ import annotations
+
+from collections import Counter, deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Smoothing pseudo-count for the hazard estimate: with few observed holds
+# the model should spread probability over nearby offsets rather than
+# spike on the single length it happened to see first.
+HAZARD_PRIOR = 0.5
+
+
+class _PlayerStats:
+    """Sequential run tracker + bounded hold/transition statistics for one
+    player. observe() consumes finalized rows strictly in frame order."""
+
+    __slots__ = (
+        "cur_value", "cur_len", "holds", "hold_counts", "transitions",
+        "trans_log", "max_holds", "max_transitions",
+    )
+
+    def __init__(self, max_holds: int = 64, max_transitions: int = 64):
+        self.cur_value: Optional[bytes] = None
+        self.cur_len = 0
+        # trailing window of hold lengths; the Counter mirrors the deque so
+        # hazard queries are O(support), not O(window)
+        self.holds: deque = deque()
+        self.hold_counts: Counter = Counter()
+        # value -> Counter of successor values, with a trailing log so old
+        # behavior ages out of the counts
+        self.transitions: Dict[bytes, Counter] = {}
+        self.trans_log: deque = deque()
+        self.max_holds = max_holds
+        self.max_transitions = max_transitions
+
+    def observe(self, row: bytes) -> None:
+        if row == self.cur_value:
+            self.cur_len += 1
+            return
+        if self.cur_value is not None:
+            self._record_hold(self.cur_len)
+            self._record_transition(self.cur_value, row)
+        self.cur_value = row
+        self.cur_len = 1
+
+    def _record_hold(self, length: int) -> None:
+        self.holds.append(length)
+        self.hold_counts[length] += 1
+        if len(self.holds) > self.max_holds:
+            old = self.holds.popleft()
+            self.hold_counts[old] -= 1
+            if self.hold_counts[old] <= 0:
+                del self.hold_counts[old]
+
+    def _record_transition(self, src: bytes, dst: bytes) -> None:
+        self.transitions.setdefault(src, Counter())[dst] += 1
+        self.trans_log.append((src, dst))
+        if len(self.trans_log) > self.max_transitions:
+            osrc, odst = self.trans_log.popleft()
+            c = self.transitions.get(osrc)
+            if c is not None:
+                c[odst] -= 1
+                if c[odst] <= 0:
+                    del c[odst]
+                if not c:
+                    del self.transitions[osrc]
+
+    # -- queries -------------------------------------------------------
+
+    def n_holds(self) -> int:
+        return len(self.holds)
+
+    def hazard(self, t: int) -> float:
+        """P(hold == t | hold >= t) from the trailing hold window, with a
+        flat pseudo-count so sparse data yields a spread, not a spike."""
+        if not self.holds:
+            return 0.0
+        support = len(self.hold_counts) + 1  # +1: unseen-length mass
+        at = self.hold_counts.get(t, 0) + HAZARD_PRIOR
+        ge = sum(c for ln, c in self.hold_counts.items() if ln >= t)
+        ge += HAZARD_PRIOR * support
+        return at / ge
+
+    def next_values(self, src: bytes, limit: int = 3) -> List[Tuple[bytes, float]]:
+        """Ranked successor values for `src` with probability shares."""
+        c = self.transitions.get(src)
+        if not c:
+            return []
+        total = sum(c.values())
+        if total <= 0:
+            return []
+        ranked = c.most_common(limit)
+        return [(v, n / total) for v, n in ranked if n > 0]
+
+
+class InputHistoryModel:
+    """Per-player hold/transition statistics over finalized input rows.
+
+    Feed rows with `observe(player, row)` strictly in frame order (the
+    backend does this for frames beyond rollback reach). Query ranked
+    branch candidates with `rank_branches`.
+    """
+
+    # minimum observed holds before a player's hazard ranking is trusted;
+    # below this the generic offset sweep covers the player instead
+    MIN_HOLDS = 3
+
+    def __init__(self, num_players: int, input_size: int):
+        self.num_players = num_players
+        self.input_size = input_size
+        self._stats = [_PlayerStats() for _ in range(num_players)]
+
+    def observe(self, player: int, row: bytes) -> None:
+        self._stats[player].observe(row)
+
+    def break_run(self, player: int) -> None:
+        """Sever the run without recording anything (disconnect dummy
+        rows are not player behavior)."""
+        st = self._stats[player]
+        st.cur_value = None
+        st.cur_len = 0
+
+    def reset(self) -> None:
+        self._stats = [_PlayerStats() for _ in self._stats]
+
+    def rank_branches(
+        self,
+        confirmed: List[Optional[Tuple[int, bytes, int]]],
+        anchor_frame: int,
+        rollout: int,
+        limit: int,
+    ) -> List[Tuple[int, int, np.ndarray]]:
+        """Rank (player, beam-row offset, next value) switch candidates.
+
+        `confirmed[p]` is (frontier_frame, value_bytes, run_len): the last
+        frame whose input for player p is confirmed, the value held there,
+        and how many consecutive confirmed frames it has been held. None
+        means no confirmed signal for that player (no candidates emitted).
+        Beam row j carries the input fed at frame anchor_frame + j, so a
+        switch first visible at frame F maps to offset F - anchor_frame.
+
+        Returns up to `limit` (player, offset, value_row) specs ordered by
+        joint probability hazard(run + delta) * P(value | held value); only
+        offsets inside [0, rollout) survive. The caller composes them into
+        beam members (beam.branching_beam's prediction stream)."""
+        scored: List[Tuple[float, int, int, bytes]] = []
+        for p in range(self.num_players):
+            if confirmed[p] is None:
+                continue
+            st = self._stats[p]
+            if st.n_holds() < self.MIN_HOLDS:
+                continue
+            frontier, value, run = confirmed[p]
+            succ = st.next_values(value)
+            if not succ:
+                continue
+            # the switch can land at any not-yet-confirmed frame: frame
+            # frontier + d (d >= 1) means the value was held run + d - 1
+            # frames in total before switching
+            for d in range(1, rollout + 1):
+                offset = frontier + d - anchor_frame
+                if offset < 0 or offset >= rollout:
+                    continue
+                h = st.hazard(run + d - 1)
+                if h <= 0.0:
+                    continue
+                for v, pv in succ:
+                    scored.append((h * pv, p, offset, v))
+        scored.sort(key=lambda t: (-t[0], t[1], t[2]))
+        out: List[Tuple[int, int, np.ndarray]] = []
+        for _w, p, offset, v in scored[:limit]:
+            row = np.frombuffer(v, dtype=np.uint8).copy()
+            out.append((p, offset, row))
+        return out

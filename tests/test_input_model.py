@@ -1,0 +1,161 @@
+"""The history-informed candidate model (ggrs_tpu/tpu/input_model.py).
+
+The reference's prediction floor is repeat-last
+(/root/reference/src/input_queue.rs:126-139); the beam's branch members
+exist to beat it. These tests pin the model's two learned distributions
+(hold-length hazard, value transitions), the likelihood ranking they
+produce, the branching_beam prediction stream that consumes it, and the
+end-to-end payoff: a NARROW beam adopting mid-window toggles that the
+uniform offset sweep cannot cover at that width.
+"""
+
+import numpy as np
+
+from ggrs_tpu.models.ex_game import ExGame
+from ggrs_tpu.tpu import TpuRollbackBackend
+from ggrs_tpu.tpu.beam import branching_beam
+from ggrs_tpu.tpu.input_model import InputHistoryModel
+
+from test_beam_backend import drive_synctest_pair, make_backend
+
+PLAYERS = 2
+ENTITIES = 64
+
+
+def feed_toggle(model, player, a=5, b=9, hold=6, cycles=6):
+    """hold frames of a, hold of b, repeated."""
+    for _ in range(cycles):
+        for _ in range(hold):
+            model.observe(player, bytes([a]))
+        for _ in range(hold):
+            model.observe(player, bytes([b]))
+
+
+def test_model_learns_holds_and_transitions():
+    m = InputHistoryModel(PLAYERS, 1)
+    feed_toggle(m, 0, hold=6)
+    st = m._stats[0]
+    assert st.n_holds() >= 8
+    # the hazard must spike at the true hold length...
+    assert st.hazard(6) > 0.7
+    # ...and stay low just before it
+    assert st.hazard(4) < 0.2
+    # transitions: from 5 the only observed successor is 9 (and vice versa)
+    assert st.next_values(bytes([5]))[0][0] == bytes([9])
+    assert st.next_values(bytes([9]))[0][0] == bytes([5])
+
+
+def test_model_break_run_severs_without_recording():
+    m = InputHistoryModel(1, 1)
+    for _ in range(5):
+        m.observe(0, bytes([3]))
+    m.break_run(0)
+    # the severed run must not have produced a 5-frame hold record or a
+    # transition
+    assert m._stats[0].n_holds() == 0
+    assert m._stats[0].next_values(bytes([3])) == []
+    # and the next value starts a fresh run
+    m.observe(0, bytes([7]))
+    assert m._stats[0].cur_value == bytes([7])
+    assert m._stats[0].cur_len == 1
+
+
+def test_rank_branches_puts_true_switch_first():
+    m = InputHistoryModel(PLAYERS, 1)
+    feed_toggle(m, 0, a=5, b=9, hold=6)
+    # player 0 confirmed through frame 99, holding 5 for 4 frames: with
+    # hold=6 learned, frames 100-101 complete the hold and the first frame
+    # of 9 is frame 102. anchor at frame 98 => beam row offset 4.
+    confirmed = [(99, bytes([5]), 4), None]
+    preds = m.rank_branches(confirmed, anchor_frame=98, rollout=8, limit=6)
+    assert preds, "model with history must emit candidates"
+    p, offset, row = preds[0]
+    assert (p, offset) == (0, 4) and row[0] == 9
+    # a player with no signal emits nothing
+    assert all(pp == 0 for pp, _, _ in preds)
+
+
+def test_rank_branches_respects_rollout_bounds():
+    m = InputHistoryModel(1, 1)
+    feed_toggle(m, 0, hold=6)
+    # frontier far behind the anchor: every candidate offset would be
+    # negative => nothing emitted rather than a clamped lie
+    preds = m.rank_branches([(10, bytes([5]), 6)], 30, 4, 8)
+    assert preds == []
+
+
+def test_branching_beam_prediction_stream_joint_first():
+    last = np.array([[5], [9]], dtype=np.uint8)
+    prev = np.array([[0], [0]], dtype=np.uint8)
+    preds = [
+        (0, 2, np.array([7], dtype=np.uint8)),
+        (1, 4, np.array([3], dtype=np.uint8)),
+    ]
+    beam = branching_beam(
+        last, prev, window=6, beam_width=8, predictions=preds
+    )
+    # member 0 stays repeat-last
+    assert (beam[0, :, 0, 0] == 5).all() and (beam[0, :, 1, 0] == 9).all()
+    # member 1 is the JOINT future: both players' top-ranked switches
+    assert (beam[1, :2, 0, 0] == 5).all() and (beam[1, 2:, 0, 0] == 7).all()
+    assert (beam[1, :4, 1, 0] == 9).all() and (beam[1, 4:, 1, 0] == 3).all()
+    # each individual spec also gets a member
+    w0 = np.array([5, 5, 7, 7, 7, 7], dtype=np.uint8)
+    assert any(
+        np.array_equal(beam[b, :, 0, 0], w0) and (beam[b, :, 1, 0] == 9).all()
+        for b in range(8)
+    )
+    w1 = np.array([9, 9, 9, 9, 3, 3], dtype=np.uint8)
+    assert any(
+        np.array_equal(beam[b, :, 1, 0], w1) and (beam[b, :, 0, 0] == 5).all()
+        for b in range(8)
+    )
+
+
+def test_branching_beam_cold_model_unchanged():
+    """predictions=None must reproduce the pre-model generator exactly."""
+    last = np.array([[5], [9]], dtype=np.uint8)
+    prev = np.array([[5], [2]], dtype=np.uint8)
+    a = branching_beam(last, prev, window=6, beam_width=16)
+    b = branching_beam(
+        last, prev, window=6, beam_width=16, predictions=None
+    )
+    assert np.array_equal(a, b)
+
+
+def test_narrow_beam_adopts_with_model_ranking():
+    """The payoff case: at beam_width=4 the uniform sweep only covers
+    switch offsets 0-1 (three branch members round-robined over three
+    streams), so a 6-frame-hold toggle whose switches land across the
+    whole 4-frame rollback window mostly misses. The model learns the
+    hold length within a few cycles and the joint prediction member nails
+    the exact switch offset — a majority of rollbacks must adopt, while
+    staying bit-identical to plain resimulation (drive_synctest_pair
+    asserts states every tick)."""
+    beam, plain = make_backend(beam_width=4), make_backend(beam_width=0)
+    script = lambda t, h: bytes([(5 if (t // 6) % 2 == 0 else 9) + h])
+    drive_synctest_pair(beam, plain, script, ticks=60)
+    adopted = beam.beam_hits + beam.beam_partial_hits
+    assert adopted > beam.beam_misses, (
+        beam.beam_hits, beam.beam_partial_hits, beam.beam_misses,
+    )
+    # the model actually observed finalized history (not just cold)
+    assert beam.input_model._stats[0].n_holds() >= 3
+
+
+def test_model_feeds_only_finalized_frames():
+    """Frames inside the rollback window must not enter the statistics:
+    the backend's _finalized_to pointer trails current_frame by
+    max_prediction."""
+    backend = make_backend(beam_width=4)
+    sess_inputs = lambda t, h: bytes([t % 3])
+    from test_beam_backend import make_synctest
+
+    sess = make_synctest()
+    for t in range(20):
+        for h in range(PLAYERS):
+            sess.add_local_input(h, sess_inputs(t, h))
+        backend.handle_requests(sess.advance_frame())
+    assert backend._finalized_to == backend.current_frame - 7, (
+        backend._finalized_to, backend.current_frame,
+    )
