@@ -51,12 +51,19 @@ class PallasBeamRollout:
 
     def __init__(self, game, num_players: int, beam_width: int,
                  interpret: bool = False, tile_rows: int = 0,
-                 max_rollout: int = 12):
+                 max_rollout: int = 12, local_entities: int = 0):
         """`max_rollout`: the deepest rollout length the caller can
         request (ResimCore passes its window) — the VMEM tile budget is
         sized to it, so deep prediction windows get smaller tiles instead
-        of silently oversubscribing the budget."""
-        assert game.num_entities % LANE == 0, "entity count must be 128-aligned"
+        of silently oversubscribing the budget.
+
+        `local_entities`: when nonzero, the kernel operates on that many
+        entities (one shard's slice of the world) while checksum weights
+        keep using the GLOBAL entity count — ShardedPallasBeamRollout
+        runs one such local kernel per mesh device and psums the partial
+        checksums, the same composition ShardedPallasTickCore uses."""
+        self.n = local_entities or game.num_entities
+        assert self.n % LANE == 0, "entity count must be 128-aligned"
         self.game = game
         self.adapter = get_adapter(game)
         tileable = getattr(self.adapter, "tileable", False)
@@ -69,10 +76,14 @@ class PallasBeamRollout:
                 f"{type(self.adapter).__name__} is neither tileable nor "
                 "reduction-declaring; the XLA vmap rollout handles this model"
             )
+            assert self.n == game.num_entities, (
+                "reduction-phase adapters cannot run on a shard's slice "
+                "(local sums would replace the global reduction)"
+            )
         self.num_players = num_players
         self.input_size = game.input_size
         self.B = beam_width
-        self.n_rows = game.num_entities // LANE
+        self.n_rows = self.n // LANE
         self.interpret = interpret
         n_planes = len(self.adapter.planes)
         # in: anchor planes; out: B*L trajectory windows per plane —
@@ -118,7 +129,7 @@ class PallasBeamRollout:
     def unpack_traj(self, outs, L: int, anchor_frame):
         """Trajectory planes [B*L, rows, LANE] -> state pytree with leaves
         [B, L, ...] (+ the scaffolding-managed frame leaf)."""
-        n = self.game.num_entities
+        n = self.n
         traj = rebuild_from_planes(
             plane_groups(self.adapter), lambda nm: outs[nm], (self.B, L), n
         )
@@ -239,11 +250,11 @@ class PallasBeamRollout:
 
     # -- public ----------------------------------------------------------
 
-    def rollout(self, anchor_state, beam_inputs):
-        """anchor_state: the game-state pytree at the anchor frame;
-        beam_inputs: u8[B, L, P, I]. Returns (traj pytree [B, L, ...],
-        his u32[B, L], los u32[B, L]) bit-identical to the XLA vmap+scan
-        rollout under all-CONFIRMED statuses."""
+    def run_kernel(self, anchor_state, beam_inputs, gi_offset=0):
+        """pack -> kernel -> (plane outs, partial checksums). `gi_offset`
+        shifts the global entity-index plane to this kernel's slice of
+        the world (the sharded composition's seam); the frame fold is NOT
+        applied — sharded callers psum the partials first."""
         B, L = beam_inputs.shape[0], beam_inputs.shape[1]
         assert B == self.B
         run = self._run(int(L))
@@ -251,14 +262,109 @@ class PallasBeamRollout:
         inputs_i32 = beam_inputs.reshape(
             B, L, self.num_players * self.input_size
         ).astype(jnp.int32)
-        gi, owner = make_gi_owner(self.n_rows, self.num_players)
-        outs, parts_hi, parts_lo = run(packed, inputs_i32, gi, owner)
-        # frame checksum term folded here, once per (member, step)
+        gi, owner = make_gi_owner(self.n_rows, self.num_players, gi_offset)
+        return run(packed, inputs_i32, gi, owner)
+
+    def finish(self, outs, parts_hi, parts_lo, anchor_frame, L: int):
+        """Fold the frame checksum terms (once per member x step, exactly
+        like the XLA path's game.checksum of the stepped state) and
+        rebuild the trajectory pytree. Sharded callers pass psum'd
+        partials; the fold then matches the unsharded totals bit-for-bit."""
         steps = jnp.arange(L, dtype=jnp.int32)[None, :]
-        frames = anchor_state["frame"].astype(jnp.int32) + 1 + steps
+        frames = anchor_frame.astype(jnp.int32) + 1 + steps
         his = jax.lax.bitcast_convert_type(
             parts_hi + frames * self._cs_frame_weight, jnp.uint32
         )
         los = jax.lax.bitcast_convert_type(parts_lo + frames, jnp.uint32)
-        traj = self.unpack_traj(outs, int(L), anchor_state["frame"])
+        traj = self.unpack_traj(outs, L, anchor_frame)
         return traj, his, los
+
+    def rollout(self, anchor_state, beam_inputs):
+        """anchor_state: the game-state pytree at the anchor frame;
+        beam_inputs: u8[B, L, P, I]. Returns (traj pytree [B, L, ...],
+        his u32[B, L], los u32[B, L]) bit-identical to the XLA vmap+scan
+        rollout under all-CONFIRMED statuses."""
+        outs, parts_hi, parts_lo = self.run_kernel(anchor_state, beam_inputs)
+        return self.finish(
+            outs, parts_hi, parts_lo, anchor_state["frame"],
+            int(beam_inputs.shape[1]),
+        )
+
+
+class ShardedPallasBeamRollout:
+    """The entity-tiled beam rollout composed with a device mesh: one
+    LOCAL kernel per device over the `entity` axis (each device rolls out
+    every beam member on its slice of the world — the beam axis needs no
+    collective), per-(member, frame) partial checksums psum'd across
+    shards (int32 wraparound sums are order-invariant, so the totals are
+    bit-identical to the unsharded kernel's). Exactly the
+    ShardedPallasTickCore recipe applied to speculation — the flagship
+    sharded config then speculates at the fused kernel's cost instead of
+    the unfused XLA vmap+scan's (the restriction VERDICT r4 flagged at
+    resim.py:204-207). The adopted trajectory keeps its entity sharding,
+    so the (XLA) adopt dispatch consumes it in place under GSPMD."""
+
+    def __init__(self, game, num_players: int, beam_width: int, mesh,
+                 interpret: bool = False, max_rollout: int = 12):
+        from ..parallel.sharded import entity_shardable
+
+        self.mesh = mesh
+        n_shards = mesh.shape.get("entity", 0)
+        assert getattr(get_adapter(game), "tileable", False), (
+            "the sharded beam rollout needs a per-entity-independent "
+            "(tileable) adapter: a reduction-phase adapter's full-plane "
+            "sums would be silently local per shard; sharded reduce "
+            "models speculate via the XLA path (GSPMD inserts the psums)"
+        )
+        assert entity_shardable(game.num_entities, mesh, LANE), (
+            f"num_entities {game.num_entities} must split into "
+            f"{n_shards} 128-aligned shards over the mesh's `entity` axis"
+        )
+        self.local_n = game.num_entities // n_shards
+        self.inner = PallasBeamRollout(
+            game, num_players, beam_width,
+            interpret=interpret, max_rollout=max_rollout,
+            local_entities=self.local_n,
+        )
+        self.game = game
+
+    def rollout(self, anchor_state, beam_inputs):
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.sharded import state_specs
+
+        inner = self.inner
+        local_n = self.local_n
+        L = int(beam_inputs.shape[1])
+        s_specs = state_specs(anchor_state)
+        # trajectory leaves carry a leading [B, L] over each state leaf;
+        # the frame leaf ([B, L], built from the replicated anchor frame)
+        # is replicated
+        t_specs = jax.tree.map(
+            lambda x: P(None, None, "entity") if x.ndim >= 1 else P(),
+            anchor_state,
+        )
+
+        def body(anchor, inputs):
+            idx = jax.lax.axis_index("entity")
+            offset = idx.astype(jnp.int32) * jnp.int32(local_n)
+            outs, parts_hi, parts_lo = inner.run_kernel(
+                anchor, inputs, offset
+            )
+            # the ONLY cross-shard collective: wraparound partial-checksum
+            # sums ride ICI; the rollout itself is embarrassingly local
+            parts_hi = jax.lax.psum(parts_hi, "entity")
+            parts_lo = jax.lax.psum(parts_lo, "entity")
+            return inner.finish(outs, parts_hi, parts_lo, anchor["frame"], L)
+
+        shard_fn = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(s_specs, P()),
+            out_specs=(t_specs, P(), P()),
+            # pallas outputs defeat replication inference; the replicated
+            # outs (checksums) are computed identically on every shard
+            # from psum'd totals
+            check_vma=False,
+        )
+        return shard_fn(anchor_state, beam_inputs)

@@ -45,6 +45,17 @@ class ResimCore:
     # unrolled program (see the _tick_fn comment in __init__): ~0.5ms of
     # worst-case masked work buys ~2ms of control-flow dispatch overhead
     BRANCHLESS_MAX_ENTITIES = 1 << 18
+    # worlds at or past this size route lone ticks through the pallas
+    # tick kernel (as a 1-row multi dispatch) when the core has one: the
+    # XLA T=1 programs run the step as unfused elementwise passes whose
+    # cost grows with the world, while the kernel streams state+ring
+    # through VMEM once. Measured crossover on the v5e tunnel (chained
+    # dispatch, one barrier): 65k entities XLA-branchless 7.8ms vs
+    # kernel 8.9ms; 262k XLA-branchless 19.5ms / XLA-cond 33.1ms vs
+    # kernel 9.9ms — the kernel's cost is nearly size-flat, so route
+    # everything from 128k up (including worlds past the branchless cap,
+    # which previously fell back to the cond program).
+    PALLAS_T1_MIN_ENTITIES = 1 << 17
 
     def __init__(self, game, max_prediction: int, num_players: int, mesh=None,
                  device_verify: bool = False, spec_backend: str = "auto",
@@ -154,9 +165,10 @@ class ResimCore:
             """Can this (game, mesh) run a pallas kernel? THE one
             eligibility predicate for both the speculation and tick
             backends — a drifted copy would send them down different paths
-            for the same game. `allow_mesh`: the tick kernel composes with
-            a mesh (ShardedPallasTickCore shard_maps local kernels + psums
-            checksum partials); the beam rollout does not yet.
+            for the same game. `allow_mesh`: both the tick kernel and the
+            beam rollout compose with a mesh (ShardedPallasTickCore /
+            ShardedPallasBeamRollout shard_map local kernels + psum
+            checksum partials) for tileable adapters.
             `whole_world_fits`: for reduction-phase adapters (arena) —
             non-tileable but runnable as ONE whole-world VMEM tile,
             unsharded only — the backend's single-tile sizing predicate
@@ -201,17 +213,19 @@ class ResimCore:
         # model supports it (falling back to XLA otherwise); results are
         # bit-identical either way (tests enforce it).
         assert spec_backend in ("auto", "xla", "pallas", "pallas-interpret")
-        assert mesh is None or spec_backend in ("auto", "xla"), (
-            "the pallas beam rollout is single-device; a mesh-sharded core "
-            "speculates via the XLA path (auto resolves this)"
-        )
         if spec_backend == "auto":
             # reduce-phase adapters (arena): beam width is only known at
             # speculate time, so single-tile sizing resolves at dispatch —
-            # _speculate_pallas falls back to XLA if the rollout rejects
+            # _speculate_pallas falls back to XLA if the rollout rejects.
+            # Under a mesh, tileable models run ShardedPallasBeamRollout
+            # (one local kernel per device over the `entity` axis, psum'd
+            # checksum partials); reduce models keep the XLA path, whose
+            # GSPMD-inserted psums handle their global sums.
             spec_backend = (
                 "pallas"
-                if pallas_eligible(whole_world_fits=lambda: True)
+                if pallas_eligible(
+                    allow_mesh=True, whole_world_fits=lambda: True
+                )
                 else "xla"
             )
         self.spec_backend = spec_backend
@@ -257,6 +271,23 @@ class ResimCore:
         else:
             self._tick_pallas_fn = None
         self._adopt_fn = jax.jit(self._adopt_impl, donate_argnums=(0, 6))
+        # FULL-hit adoption is pure data movement: every corrected frame
+        # is served from the precomputed trajectory, so the program is
+        # selects + masked ring writes + the speculation's checksums — no
+        # game.step, no checksum math, no control flow. The cond/scan
+        # adopt program costs ~2x the branchless dispatch floor through
+        # the tunnel (the same overhead _tick_branchless_impl exists to
+        # avoid) AND reruns nothing, so on full hits the unrolled program
+        # is strictly cheaper; partial hits keep the cond program (their
+        # suffix genuinely resimulates, and masking W steps would cost
+        # more than cond's skip). Same entity-count gate as the
+        # branchless tick: past it the masked gathers are real bandwidth.
+        self._adopt_full_fn = (
+            jax.jit(self._adopt_full_impl, donate_argnums=(0, 6))
+            if n_entities is not None
+            and n_entities <= self.BRANCHLESS_MAX_ENTITIES
+            else None
+        )
         # tick's packed control-word layout (pack site: tick(); unpack:
         # _tick_packed_impl): 4 header words (do_load, load_slot,
         # advance_count, start_frame), then save_slots[W], statuses[W*P],
@@ -388,9 +419,27 @@ class ResimCore:
             return self._tick_branchless_fn
         return self._tick_fn
 
+    def _pallas_t1(self) -> bool:
+        """Do lone ticks route through the pallas tick kernel? Size-aware
+        (see PALLAS_T1_MIN_ENTITIES): on big worlds the kernel's
+        size-flat VMEM streaming beats every XLA T=1 program."""
+        n = getattr(self.game, "num_entities", None)
+        return (
+            self._tick_pallas_fn is not None
+            and n is not None
+            and n >= self.PALLAS_T1_MIN_ENTITIES
+        )
+
     def tick_row(self, row: np.ndarray) -> Tuple[Any, Any]:
         """One packed tick row through the (warmup-compiled) single-tick
         program; returns (checksum_hi[W], checksum_lo[W])."""
+        if self._pallas_t1():
+            self.ring, self.state, self.verify, his, los = (
+                self._tick_pallas_fn(
+                    self.ring, self.state, row[None, :], self.verify
+                )
+            )
+            return his[0], los[0]
         self.ring, self.state, self.verify, his, los = self._single_tick_fn(
             row
         )(self.ring, self.state, row, self.verify)
@@ -402,10 +451,15 @@ class ResimCore:
         dispatches route to the pallas tick kernel when the core has one:
         streaming state + ring through VMEM amortizes over the rows, and
         the kernel wins from T=2 up (measured 2.3x at T=4, 3-4x at T=16 on
-        a 65k world). T=1 stays on the XLA scan, whose lax.cond slot
-        skipping beats the kernel's masked full window for a lone tick."""
+        a 65k world). T=1 stays on the XLA scan on small/mid worlds,
+        whose lax.cond slot skipping beats the kernel's masked full
+        window for a lone tick — but routes to the kernel from
+        PALLAS_T1_MIN_ENTITIES up, where every XLA T=1 program's unfused
+        passes cost more than the kernel's size-flat streaming."""
         fn = self._tick_multi_fn
-        if self._tick_pallas_fn is not None and rows.shape[0] > 1:
+        if self._tick_pallas_fn is not None and (
+            rows.shape[0] > 1 or self._pallas_t1()
+        ):
             fn = self._tick_pallas_fn
         self.ring, self.state, self.verify, his, los = fn(
             self.ring, self.state, rows, self.verify
@@ -559,10 +613,7 @@ class ResimCore:
             do_load, load_slot, inputs, statuses, save_slots, advance_count,
             start_frame,
         )
-        self.ring, self.state, self.verify, his, los = self._single_tick_fn(
-            packed
-        )(self.ring, self.state, packed, self.verify)
-        return his, los
+        return self.tick_row(packed)
 
     def check_device_verdict(self) -> Tuple[bool, int]:
         """Fetch the device-verify latch: (mismatch?, first bad frame).
@@ -621,16 +672,26 @@ class ResimCore:
         speculation path permanently — same results, unfused cost."""
         B = int(beam_inputs.shape[0])
         if B not in self._beam_rollouts:
-            from .pallas_beam import PallasBeamRollout
+            from .pallas_beam import PallasBeamRollout, ShardedPallasBeamRollout
 
             try:
-                self._beam_rollouts[B] = PallasBeamRollout(
-                    self.game,
-                    self.num_players,
-                    B,
-                    interpret=self.spec_backend.endswith("-interpret"),
-                    max_rollout=self.window,  # VMEM budget sized to worst case
-                )
+                if self.mesh is not None:
+                    self._beam_rollouts[B] = ShardedPallasBeamRollout(
+                        self.game,
+                        self.num_players,
+                        B,
+                        self.mesh,
+                        interpret=self.spec_backend.endswith("-interpret"),
+                        max_rollout=self.window,
+                    )
+                else:
+                    self._beam_rollouts[B] = PallasBeamRollout(
+                        self.game,
+                        self.num_players,
+                        B,
+                        interpret=self.spec_backend.endswith("-interpret"),
+                        max_rollout=self.window,  # VMEM budget sized to worst case
+                    )
             except (AssertionError, ValueError) as e:
                 # narrow on purpose (r3 advisor): a broken adapter should
                 # surface, only a sizing rejection falls back
@@ -806,6 +867,78 @@ class ResimCore:
         )
         return ring, state, verify, out_his, out_los
 
+    def _adopt_full_impl(self, ring, traj, spec_his, spec_los, a_hi, a_lo,
+                         verify, packed):
+        """Branchless FULL-hit adoption: bit-identical to _adopt_impl when
+        matched == advance_count (adopt() routes only that case here).
+        Every slot's state is a select over the member trajectory, every
+        saved checksum comes from the speculation, masked saves write the
+        OLD value back to slot 0 — no scan, no cond, no game math. The
+        packed layout is _adopt_impl's; the suffix input/status words ride
+        along unused so both programs share one host-side pack."""
+        member = packed[0]
+        load_slot = packed[1]
+        shift = packed[3]
+        load_frame = packed[4]
+        matched = packed[5]
+        save_slots = packed[self._aoff_save : self._aoff_status]
+        loaded = jax.tree.map(
+            lambda r: jax.lax.dynamic_index_in_dim(
+                r, load_slot, 0, keepdims=False
+            ),
+            ring,
+        )
+        mtraj = jax.tree.map(
+            lambda t: jax.lax.dynamic_index_in_dim(t, member, 0, keepdims=False),
+            traj,
+        )
+        mhis = jax.lax.dynamic_index_in_dim(spec_his, member, 0, keepdims=False)
+        mlos = jax.lax.dynamic_index_in_dim(spec_los, member, 0, keepdims=False)
+        pad = jnp.zeros((self.window - 1,), dtype=a_hi.dtype)
+        full_hi = jnp.concatenate([a_hi[None], mhis, pad])
+        full_lo = jnp.concatenate([a_lo[None], mlos, pad])
+        his_w = jax.lax.dynamic_slice(full_hi, (shift,), (self.window,))
+        los_w = jax.lax.dynamic_slice(full_lo, (shift,), (self.window,))
+
+        his, los = [], []
+        state = loaded
+        for i in range(self.window):
+            # with no suffix to resimulate, the state entering slot i is
+            # trajectory index shift + min(i, matched) - 1 (the anchor
+            # snapshot itself when that is negative: shift == 0, i == 0)
+            eff = shift + jnp.minimum(i, matched) - 1
+            prev = jax.tree.map(
+                lambda t: jax.lax.dynamic_index_in_dim(
+                    t, jnp.maximum(eff, 0), 0, keepdims=False
+                ),
+                mtraj,
+            )
+            state = _tree_where(eff < 0, loaded, prev)
+            save_slot = save_slots[i]
+            do_save = save_slot < self.ring_len
+            hi = jnp.where(do_save, his_w[i], jnp.uint32(0))
+            lo = jnp.where(do_save, los_w[i], jnp.uint32(0))
+            wslot = jnp.where(do_save, save_slot, 0)
+            old = jax.tree.map(
+                lambda r: jax.lax.dynamic_index_in_dim(
+                    r, wslot, 0, keepdims=False
+                ),
+                ring,
+            )
+            ring = jax.tree.map(
+                lambda r, s: jax.lax.dynamic_update_index_in_dim(
+                    r, s, wslot, 0
+                ),
+                ring,
+                _tree_where(do_save, state, old),
+            )
+            if self.device_verify:
+                upd = self._verify_update(verify, load_frame + i, hi, lo)
+                verify = _tree_where(do_save, upd, verify)
+            his.append(hi)
+            los.append(lo)
+        return ring, state, verify, jnp.stack(his), jnp.stack(los)
+
     def adopt(self, spec, member: int, load_slot: int, save_slots: np.ndarray,
               advance_count: int, shift: int = 0, load_frame: int = 0,
               inputs: Optional[np.ndarray] = None,
@@ -838,7 +971,15 @@ class ResimCore:
             packed[self._aoff_status : self._aoff_input] = statuses.reshape(-1)
         if inputs is not None:
             packed[self._aoff_input :] = inputs.reshape(-1)
-        self.ring, self.state, self.verify, his, los = self._adopt_fn(
+        # full hits route to the branchless pure-data-movement program
+        # (see the _adopt_full_fn comment in __init__); partial hits keep
+        # the cond program for its genuine suffix resimulation
+        fn = (
+            self._adopt_full_fn
+            if matched == advance_count and self._adopt_full_fn is not None
+            else self._adopt_fn
+        )
+        self.ring, self.state, self.verify, his, los = fn(
             self.ring, traj, spec_his, spec_los, a_hi, a_lo, self.verify,
             packed,
         )

@@ -437,6 +437,100 @@ def test_partial_prefix_adoption_core_parity():
             )
 
 
+def test_full_adoption_branchless_core_parity():
+    """Full hits route to the branchless pure-data-movement adopt program
+    (ResimCore._adopt_full_impl): ring, live state and per-slot checksums
+    must be bit-identical to BOTH a plain fused resim of the same script
+    and the cond adopt program's results — at shift 0 and shift 1, with
+    device_verify on (the verify carry masks the same way)."""
+    from ggrs_tpu.tpu.resim import ResimCore
+
+    game = ExGame(num_players=PLAYERS, num_entities=ENTITIES)
+    W = 8
+    played = np.random.default_rng(42).integers(
+        0, 16, size=(4, PLAYERS, 1), dtype=np.uint8
+    )
+
+    def fresh_core():
+        core = ResimCore(
+            game, max_prediction=6, num_players=PLAYERS, device_verify=True
+        )
+        for f in range(4):
+            inputs = np.zeros((W, PLAYERS, 1), dtype=np.uint8)
+            inputs[0] = played[f]
+            statuses = np.zeros((W, PLAYERS), dtype=np.int32)
+            save_slots = np.full((W,), core.scratch_slot, dtype=np.int32)
+            save_slots[0] = f % core.ring_len
+            core.tick(False, 0, inputs, statuses, save_slots, 1, start_frame=f)
+        return core
+
+    for shift in (0, 1):
+        anchor = 3 - shift
+        rng = np.random.default_rng(7)
+        B, L = 4, 6
+        beam_inputs = rng.integers(
+            0, 16, size=(B, L, PLAYERS, 1), dtype=np.uint8
+        )
+        # the adoption contract: the member's first `shift` rows must be
+        # the inputs actually played between anchor and load
+        beam_inputs[:, :shift] = played[anchor : anchor + shift]
+        beam_statuses = np.zeros((B, L, PLAYERS), dtype=np.int32)
+        count, member = 4, 2
+        actual = np.zeros((W, PLAYERS, 1), dtype=np.uint8)
+        actual[:count] = beam_inputs[member, shift : shift + count]
+        statuses = np.zeros((W, PLAYERS), dtype=np.int32)
+        save_slots = np.full((W,), 99, dtype=np.int32)
+
+        results = {}
+        for mode in ("branchless", "cond", "resim"):
+            core = fresh_core()
+            save_slots = np.full((W,), core.scratch_slot, dtype=np.int32)
+            for i in range(count + 1):
+                save_slots[i] = (3 + i) % core.ring_len
+            if mode == "resim":
+                his, los = core.tick(
+                    True, 3 % core.ring_len, actual, statuses, save_slots,
+                    count, start_frame=3,
+                )
+            else:
+                if mode == "cond":
+                    core._adopt_full_fn = None  # force the cond program
+                else:
+                    assert core._adopt_full_fn is not None
+                spec = core.speculate(
+                    anchor % core.ring_len, beam_inputs, beam_statuses
+                )
+                his, los = core.adopt(
+                    spec, member, 3 % core.ring_len, save_slots, count,
+                    shift=shift, load_frame=3, inputs=actual,
+                    statuses=statuses,
+                )
+            results[mode] = (
+                core.fetch_state(),
+                [core.fetch_ring_slot(s) for s in range(core.ring_len)],
+                np.asarray(his),
+                np.asarray(los),
+                core.check_device_verdict(),
+            )
+
+        ref = results["resim"]
+        for mode in ("branchless", "cond"):
+            got = results[mode]
+            for k in ref[0]:
+                assert np.array_equal(
+                    np.asarray(got[0][k]), np.asarray(ref[0][k])
+                ), f"live state[{k}] diverged ({mode}, shift={shift})"
+            for slot in range(len(ref[1])):
+                for k in ref[1][slot]:
+                    assert np.array_equal(
+                        np.asarray(got[1][slot][k]),
+                        np.asarray(ref[1][slot][k]),
+                    ), f"ring[{slot}][{k}] diverged ({mode}, shift={shift})"
+            assert np.array_equal(got[2], ref[2]), (mode, shift, "his")
+            assert np.array_equal(got[3], ref[3]), (mode, shift, "los")
+            assert got[4] == ref[4], (mode, shift, "verify verdict")
+
+
 def test_partial_prefix_adoption_in_synctest_pair():
     """Players toggling at DIFFERENT offsets inside the same rollback
     window: no single branching member covers both switches, so full

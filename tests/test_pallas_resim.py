@@ -120,6 +120,45 @@ def test_tick_kernel_deep_window_parity():
     assert_core_equal(a, b)
 
 
+def test_pallas_t1_routing_bit_parity():
+    """Size-aware T=1 routing (ResimCore.PALLAS_T1_MIN_ENTITIES): on big
+    worlds lone ticks dispatch through the pallas tick kernel as a 1-row
+    multi instead of the XLA T=1 programs. Lower the threshold on the
+    instance so the route engages on a test-sized world, then drive
+    LONE ticks (trivial advances AND rollbacks) and require bit-parity
+    with the XLA core — ring, state, verify, and returned checksums."""
+    r = np.random.default_rng(23)
+    games = [ExGame(P, 512) for _ in range(2)]
+    pallas = ResimCore(games[0], max_prediction=6, num_players=P,
+                       device_verify=True, tick_backend="pallas-interpret")
+    pallas.PALLAS_T1_MIN_ENTITIES = 256  # instance override: engage at 512
+    assert pallas._pallas_t1()
+    xla = ResimCore(games[1], max_prediction=6, num_players=P,
+                    device_verify=True, tick_backend="xla")
+    W = pallas.window
+    frame = 0
+    for t in range(14):
+        depth = 0 if frame < 6 else int(r.integers(0, 5))
+        do_load = depth > 0
+        count = depth + 1 if do_load else 1
+        start = frame - depth if do_load else frame
+        inputs = np.zeros((W, P, 1), np.uint8)
+        statuses = np.zeros((W, P), np.int32)
+        for i in range(count):
+            inputs[i] = r.integers(0, 16, (P, 1))
+        slots = np.full((W,), pallas.scratch_slot, np.int32)
+        for i in range(count):
+            slots[i] = (start + i) % pallas.ring_len
+        args = (do_load, (start % pallas.ring_len) if do_load else 0,
+                inputs, statuses, slots, count)
+        ha, la = pallas.tick(*args, start_frame=start)
+        hb, lb = xla.tick(*args, start_frame=start)
+        np.testing.assert_array_equal(np.asarray(ha), np.asarray(hb))
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        frame = start + count
+    assert_core_equal(pallas, xla)
+
+
 def test_tick_kernel_multi_row_lazy_parity():
     """The lazy multi-tick buffer through the kernel: a featured backend
     (pallas ticks + lazy batching) vs a plain XLA per-tick backend over

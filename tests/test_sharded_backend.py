@@ -176,6 +176,64 @@ def test_sharded_pallas_tick_bit_parity(mesh):
     assert shard.data.shape[0] == 512 // mesh.shape["entity"]
 
 
+def test_sharded_pallas_beam_bit_parity(mesh):
+    """The SHARDED pallas beam rollout (ShardedPallasBeamRollout: one
+    local entity-tiled rollout per device, psum'd checksum partials —
+    the restriction VERDICT r4 flagged at resim.py:204-207, lifted): a
+    mesh-sharded backend speculating through the pallas kernel must
+    adopt trajectories bit-identical to the sharded XLA speculation AND
+    the unsharded backend."""
+    from ggrs_tpu.tpu.pallas_beam import ShardedPallasBeamRollout
+
+    def drive_constant(handler, frames):
+        sess = (
+            SessionBuilder(input_size=1)
+            .with_num_players(NUM_PLAYERS)
+            .with_max_prediction_window(8)
+            .with_check_distance(3)
+            .start_synctest_session()
+        )
+        for _ in range(frames):
+            for h in range(NUM_PLAYERS):
+                sess.add_local_input(h, bytes([h + 1]))
+            handler.handle_requests(sess.advance_frame())
+
+    def build(mesh_, spec_backend):
+        return TpuRollbackBackend(
+            ex_game.ExGame(NUM_PLAYERS, 512),
+            max_prediction=8,
+            num_players=NUM_PLAYERS,
+            beam_width=8,
+            mesh=mesh_,
+            spec_backend=spec_backend,
+        )
+
+    sharded_pallas = build(mesh, "pallas-interpret")
+    drive_constant(sharded_pallas, 40)
+    # the sharded rollout actually ran (no silent XLA demotion) and the
+    # constant script made the repeat-last member adopt
+    assert sharded_pallas.core.spec_backend == "pallas-interpret"
+    assert any(
+        isinstance(r, ShardedPallasBeamRollout)
+        for r in sharded_pallas.core._beam_rollouts.values()
+    ), "mesh-sharded speculation did not use ShardedPallasBeamRollout"
+    assert sharded_pallas.beam_hits > 0
+
+    sharded_xla = build(mesh, "xla")
+    drive_constant(sharded_xla, 40)
+    assert_state_equal(
+        sharded_pallas.state_numpy(), sharded_xla.state_numpy()
+    )
+    unsharded = TpuRollbackBackend(
+        ex_game.ExGame(NUM_PLAYERS, 512),
+        max_prediction=8,
+        num_players=NUM_PLAYERS,
+        beam_width=8,
+    )
+    drive_constant(unsharded, 40)
+    assert_state_equal(sharded_pallas.state_numpy(), unsharded.state_numpy())
+
+
 def test_sharded_pallas_tick_checksums_and_verify(mesh):
     """Checksum values read back through the lazy ledger and the on-device
     verify verdict must agree between the sharded pallas tick kernel and
