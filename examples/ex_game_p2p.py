@@ -101,7 +101,29 @@ def main() -> int:
         help="record the match: the confirmed input stream saves to PATH "
         "at exit (replay with examples/replay.py — bit-identical)",
     )
+    ap.add_argument(
+        "--trace",
+        default=None,
+        metavar="PATH",
+        help="drive local players from a recorded human input trace (JSON "
+        "{fps, players: [[byte,...],...]}; see examples/traces/) instead "
+        "of the scripted stream — the latency-demo configuration "
+        "(reference analog: the playable ex_game_p2p.rs driver)",
+    )
+    ap.add_argument(
+        "--budget-report",
+        action="store_true",
+        help="at exit, print per-frame critical-path latency stats and "
+        "the 60fps frame-budget hit rate as one JSON line",
+    )
     args = ap.parse_args()
+    trace = None
+    if args.trace:
+        import json as _json
+
+        with open(args.trace) as fh:
+            trace = _json.load(fh)
+        assert trace.get("players"), "trace has no player streams"
     if args.replay_protect and not args.auth_key:
         ap.error("--replay-protect requires --auth-key")
 
@@ -189,10 +211,19 @@ def main() -> int:
     else:
         game = HostGame(len(args.players), args.entities)
 
+    def local_input(frame: int, handle: int) -> bytes:
+        if trace is not None:
+            stream = trace["players"][handle % len(trace["players"])]
+            return bytes([stream[frame % len(stream)] & 0x0F])
+        return scripted_input(frame, handle)
+
     # accumulator loop (ex_game_p2p.rs:80-129)
     frame = 0
     last = time.perf_counter()
     accumulator = 0.0
+    frame_ms = []  # per-frame critical-path time (inputs -> requests done)
+    skipped = 0  # prediction-threshold stalls (remote too far behind)
+    wall_t0 = time.perf_counter()
     while frame < args.frames:
         now = time.perf_counter()
         accumulator += now - last
@@ -212,17 +243,19 @@ def main() -> int:
             if sess.current_state() != SessionState.RUNNING:
                 continue
             try:
+                t0 = time.perf_counter()
                 for handle in local_handles:
-                    sess.add_local_input(handle, scripted_input(frame, handle))
+                    sess.add_local_input(handle, local_input(frame, handle))
                 reqs = sess.advance_frame()
                 if recorder is not None:
                     recorder.observe(reqs)
                 game.handle_requests(reqs)
+                frame_ms.append((time.perf_counter() - t0) * 1000.0)
                 frame += 1
                 if frame % 120 == 0:
                     print(game.digest())
             except PredictionThreshold:
-                pass  # skip a frame; remote is behind
+                skipped += 1  # skip a frame; remote is behind
             except NotSynchronized:
                 pass
         if args.tpu and args.beam:
@@ -231,7 +264,36 @@ def main() -> int:
             backend.launch_pending_speculation()
         time.sleep(0.001)
 
+    wall_s = time.perf_counter() - wall_t0
     print("done:", game.digest())
+    if args.budget_report and frame_ms:
+        import json as _json
+
+        xs = sorted(frame_ms)
+        q = lambda p: round(xs[min(int(p * len(xs)), len(xs) - 1)], 3)
+        budget = 1000.0 / FPS
+        print(
+            _json.dumps(
+                {
+                    "frames": len(xs),
+                    "budget_ms": round(budget, 3),
+                    # the latency-demo headline: fraction of frames whose
+                    # critical path (input ingest -> session advance ->
+                    # request fulfillment dispatch) fit the 60fps budget
+                    "budget_hit_rate": round(
+                        sum(x <= budget for x in xs) / len(xs), 4
+                    ),
+                    "frame_p50_ms": q(0.50),
+                    "frame_p95_ms": q(0.95),
+                    "frame_p99_ms": q(0.99),
+                    "frame_max_ms": round(xs[-1], 3),
+                    "skipped_frames": skipped,
+                    "achieved_fps": round(len(xs) / wall_s, 1),
+                    "trace": args.trace or "scripted",
+                }
+            ),
+            flush=True,
+        )
     if recorder is not None:
         from ggrs_tpu.models.ex_game import ExGame as _ExGame
 

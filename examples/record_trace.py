@@ -1,0 +1,134 @@
+"""Generate (or record) a human input trace for the latency demo.
+
+The reference's playable driver reads a keyboard at 60 fps
+(/root/reference/examples/ex_game/ex_game_p2p.rs:160-321 key polling via
+macroquad); a TPU host has no keyboard, so the latency demo
+(`ex_game_p2p.py --trace`) replays a RECORDED trace instead. Two sources:
+
+- `--from-tty`: record a real keyboard session — raw-mode stdin sampled at
+  60 fps for `--seconds`; keys a/d/w/s map to the ex_game direction bits,
+  space to thrust. Requires a TTY.
+- default (no TTY): synthesize from a human-motor model — per-player
+  press/hold/release processes with lognormal hold lengths (median ~280 ms
+  — held inputs, not per-frame noise), reaction-time gaps, occasional
+  double-taps, and value persistence (players re-press recent chords).
+  Deterministic under --seed.
+
+Output: JSON {fps, seconds, players: [[byte/frame...], ...]} consumed by
+`ex_game_p2p.py --trace`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import random
+import sys
+
+
+def synth_player(rng: random.Random, frames: int) -> list:
+    out = []
+    recent = [1, 4]  # recently-used chords (direction bits)
+    t = 0
+    cur = 0
+    while t < frames:
+        if cur == 0:
+            # idle gap: reaction time + decision, 60-400 ms
+            gap = int(rng.lognormvariate(math.log(0.12), 0.5) * 60) + 1
+            out += [0] * min(gap, frames - t)
+            t += gap
+            # choose next chord: mostly a recent one (motor habit)
+            if rng.random() < 0.7 and recent:
+                cur = rng.choice(recent)
+            else:
+                cur = rng.randrange(1, 16)
+                recent = ([cur] + recent)[:3]
+        else:
+            # hold: lognormal, median ~280 ms
+            hold = int(rng.lognormvariate(math.log(0.28), 0.6) * 60) + 1
+            out += [cur] * min(hold, frames - t)
+            t += hold
+            if rng.random() < 0.15:
+                # double-tap: brief release then re-press the same chord
+                gap = 1 + int(rng.random() * 3)
+                out += [0] * min(gap, max(frames - t, 0))
+                t += gap
+                # cur unchanged -> re-press on next loop iteration
+            else:
+                cur = 0
+    return out[:frames]
+
+
+def record_tty(seconds: float, fps: int) -> list:
+    import select
+    import termios
+    import time
+    import tty
+
+    fd = sys.stdin.fileno()
+    old = termios.tcgetattr(fd)
+    frames = int(seconds * fps)
+    out = []
+    keymap = {"w": 1, "s": 2, "a": 4, "d": 8}
+    held = 0
+    print(f"recording {seconds:.0f}s at {fps}fps; keys wasd, q to stop")
+    try:
+        tty.setcbreak(fd)
+        t0 = time.perf_counter()
+        for i in range(frames):
+            while select.select([sys.stdin], [], [], 0)[0]:
+                ch = sys.stdin.read(1)
+                if ch == "q":
+                    return out
+                held = keymap.get(ch, held and 0)
+            out.append(held)
+            target = t0 + (i + 1) / fps
+            dt = target - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out", help="output trace path (JSON)")
+    ap.add_argument("--players", type=int, default=2)
+    ap.add_argument("--seconds", type=float, default=30.0)
+    ap.add_argument("--fps", type=int, default=60)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--from-tty", action="store_true")
+    args = ap.parse_args()
+
+    frames = int(args.seconds * args.fps)
+    if args.from_tty:
+        streams = [record_tty(args.seconds, args.fps)]
+        streams += [
+            synth_player(random.Random(args.seed + p), frames)
+            for p in range(1, args.players)
+        ]
+    else:
+        streams = [
+            synth_player(random.Random(args.seed + p), frames)
+            for p in range(args.players)
+        ]
+    with open(args.out, "w") as fh:
+        json.dump(
+            {"fps": args.fps, "seconds": args.seconds, "players": streams},
+            fh,
+        )
+    holds = [
+        sum(1 for i in range(1, len(s)) if s[i] != s[i - 1])
+        for s in streams
+    ]
+    print(
+        f"wrote {args.out}: {len(streams)} players x {frames} frames, "
+        f"~{[h // 2 for h in holds]} presses"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
