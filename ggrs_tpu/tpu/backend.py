@@ -239,6 +239,18 @@ class TpuRollbackBackend:
     # signals stay sampled).
     VALUE_WINDOW = 32  # consult samples retained
     MIN_SERVED_PER_LAUNCH = 0.3
+    # the soft bar, applied when the MEASURED idle covers the measured
+    # launch cost: a budget-covered launch costs the session nothing it
+    # cares about (the beam is a latency feature riding idle), so value
+    # gating then only protects against pointlessness — streams where
+    # speculation serves literally nothing. The hard bar above prices
+    # launches that the frame budget cannot absorb. Without the split,
+    # streams with RARE rollbacks (one per ~10 ticks) could never clear
+    # 0.3 frames/launch even with perfect candidates — every launch
+    # superseded before a rollback counts as cost — and the gate locked
+    # out exactly the serves it existed to enable (measured: neutral arm
+    # 0.19 served at 71% gated vs 0.56 with fresh launches).
+    MIN_SERVED_IDLE = 0.02
     VALUE_MIN_SAMPLES = 8  # consults before the gate may close
     VALUE_PROBE_INTERVAL = 24
     VALUE_PROBE_BURST = 3
@@ -409,6 +421,17 @@ class TpuRollbackBackend:
         self._spec_consulted = False
         self._launches_since_consult = 0
         self._value_gated_streak = 0
+        # tick counter + the tick of the standing spec's launch: value
+        # samples are recorded only from FRESH consults (spec launched
+        # the immediately-preceding tick). A gated stretch leaves a stale
+        # spec standing, and a stale spec misses BY SHIFT regardless of
+        # candidate quality — sampling those misses as evidence against
+        # the candidates locked the gate shut on exactly the regimes the
+        # probe bursts exist to re-open (measured: the neutral arm sat at
+        # 0.19 frames-served with 71% gating while the same candidates
+        # served 0.56+ when launched fresh).
+        self._tick_index = 0
+        self._spec_tick = -10
 
     # ------------------------------------------------------------------
 
@@ -431,6 +454,7 @@ class TpuRollbackBackend:
                     if self._idle_ema_s is None
                     else 0.9 * self._idle_ema_s + 0.1 * idle
                 )
+        self._tick_index += 1
         segment: List[Request] = []
         for req in requests:
             if isinstance(req, LoadGameState) and segment:
@@ -520,7 +544,19 @@ class TpuRollbackBackend:
             launches = max(sum(n for _, _, n in self._launch_value), 1)
             branch_rate = sum(b for b, _, _ in self._launch_value) / launches
             hist_rate = sum(h for _, h, _ in self._launch_value) / launches
-            hist_ok = hist_rate >= self.MIN_SERVED_PER_LAUNCH
+            # bar per width: soft when measured idle covers that width's
+            # measured cost (see MIN_SERVED_IDLE), hard otherwise
+            full_bar = (
+                self.MIN_SERVED_IDLE
+                if idle is not None and idle >= 0.8 * self._spec_cost_s
+                else self.MIN_SERVED_PER_LAUNCH
+            )
+            hist_bar = (
+                self.MIN_SERVED_IDLE
+                if idle is not None and idle >= 0.8 * hist_cost
+                else self.MIN_SERVED_PER_LAUNCH
+            )
+            hist_ok = hist_rate >= hist_bar
             # full width earns its keep when its MARGINAL value over the
             # history width (branch serves) clears the bar — or, in
             # blended regimes where neither signal alone clears it, when
@@ -529,9 +565,9 @@ class TpuRollbackBackend:
             # dominate and the branch marginal is under the bar, full is
             # NOT ok even though the total is huge: that's exactly the
             # regime the cheaper history width exists for.
-            branch_ok = branch_rate >= self.MIN_SERVED_PER_LAUNCH or (
+            branch_ok = branch_rate >= full_bar or (
                 not hist_ok
-                and branch_rate + hist_rate >= self.MIN_SERVED_PER_LAUNCH
+                and branch_rate + hist_rate >= full_bar
             )
         else:
             branch_ok = hist_ok = True
@@ -612,8 +648,14 @@ class TpuRollbackBackend:
             self.rollback_frames += count
         if load is not None and self._spec is not None:
             match = self._match_speculation(load.frame, inputs, statuses, count)
-            if not self._spec_consulted:
-                # one value sample per consulted speculation, split by
+            if not self._spec_consulted and (
+                self._tick_index - self._spec_tick <= 1
+            ):
+                # one value sample per FRESH consulted speculation (stale
+                # specs — left standing by gated ticks — miss by shift
+                # regardless of candidate quality and say nothing; their
+                # launch cost stays in _launches_since_consult and rides
+                # the next fresh sample), split by
                 # WHO served: (branch_frames, member0_frames, launches
                 # paid since the last consult) — superseded-unconsulted
                 # launches count as cost without poisoning quiet
@@ -986,6 +1028,7 @@ class TpuRollbackBackend:
             spec = core.speculate(anchor % core.ring_len, beam_inputs, beam_statuses)
         self._spec = (anchor, beam_inputs, spec)
         self._spec_consulted = False
+        self._spec_tick = self._tick_index
         self._launches_since_consult += 1
 
     # ------------------------------------------------------------------
@@ -1030,6 +1073,8 @@ class TpuRollbackBackend:
         self._spec_consulted = False
         self._launches_since_consult = 0
         self._value_gated_streak = 0
+        self._tick_index = 0
+        self._spec_tick = -10
 
     def warmup(self) -> None:
         """Compile every device program this backend can dispatch (tick,

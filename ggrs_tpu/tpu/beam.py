@@ -211,6 +211,16 @@ def branching_beam(
     streams = [player_stream(pl) for pl in range(p)]
     if len(toggling) >= 2:
         streams.insert(0, all_stream())
+    if predictions:
+        # the model stream rides the round-robin WITH the generic
+        # families, first slot each round (joint member leads) — it must
+        # supplement coverage, not displace it: draining the ranked
+        # specs exhaustively first measurably LOST adoptions on
+        # staggered multi-player scripts (the smeared hazard of one
+        # player's switch crowded out the uniform offset families that
+        # were serving everyone else), while interleaving keeps both
+        # coverage classes alive at every width
+        streams.insert(0, prediction_stream())
 
     seen = {beam[0].tobytes()}
     b = 1
@@ -221,27 +231,6 @@ def branching_beam(
         rows = np.where((iota >= k)[:, None], row, beam[0][:, pl])
         m = free_mask[:, pl]
         cand[m, pl] = rows[m]
-
-    if predictions:
-        # drain the ranked stream exhaustively before the generic
-        # round-robin: these members are ordered by measured likelihood,
-        # which is the whole point of the model
-        for spec in prediction_stream():
-            if b >= beam_width:
-                break
-            cand = beam[0].copy()
-            if spec[0] == "predjoint":
-                for pl, (k, row) in spec[1]:
-                    apply_switch(cand, pl, k, row)
-            else:
-                _, pl, k, row = spec
-                apply_switch(cand, pl, k, row)
-            key = cand.tobytes()
-            if key in seen:
-                continue
-            seen.add(key)
-            beam[b] = cand
-            b += 1
 
     exhausted = [False] * len(streams)
     # every stream is finite (offset families bounded by max_offset, XOR
@@ -257,7 +246,13 @@ def branching_beam(
                 exhausted[si] = True
                 continue
             cand = beam[0].copy()
-            if spec[0] == "xor":
+            if spec[0] == "predjoint":
+                for pl, (k, row) in spec[1]:
+                    apply_switch(cand, pl, k, row)
+            elif spec[0] == "pred":
+                _, pl, k, row = spec
+                apply_switch(cand, pl, k, row)
+            elif spec[0] == "xor":
                 _, pl, byte, pattern = spec
                 cand[free_mask[:, pl], pl, byte] ^= np.uint8(pattern)
             else:

@@ -129,6 +129,10 @@ class InputHistoryModel:
     # minimum observed holds before a player's hazard ranking is trusted;
     # below this the generic offset sweep covers the player instead
     MIN_HOLDS = 3
+    # per-player cap on emitted specs: the hazard of one imminent switch
+    # smears over adjacent offsets, and members are too scarce to spend
+    # more than this on a single player's timing uncertainty
+    MAX_SPECS_PER_PLAYER = 3
 
     def __init__(self, num_players: int, input_size: int):
         self.num_players = num_players
@@ -164,11 +168,17 @@ class InputHistoryModel:
         Beam row j carries the input fed at frame anchor_frame + j, so a
         switch first visible at frame F maps to offset F - anchor_frame.
 
-        Returns up to `limit` (player, offset, value_row) specs ordered by
-        joint probability hazard(run + delta) * P(value | held value); only
-        offsets inside [0, rollout) survive. The caller composes them into
-        beam members (beam.branching_beam's prediction stream)."""
-        scored: List[Tuple[float, int, int, bytes]] = []
+        Returns up to `limit` (player, offset, value_row) specs, allocated
+        ROUND-ROBIN across players (ordered by each player's top score,
+        hazard(run + delta) * P(value | held value)) with at most
+        MAX_SPECS_PER_PLAYER specs each; only offsets inside [0, rollout)
+        survive. Round-robin, not global rank order: hazard mass smears
+        over adjacent offsets of the SAME imminent switch, and a pure
+        rank sort lets one player's smear crowd every other player out of
+        the beam entirely (measured: a 4-player staggered toggle lost a
+        third of its adoptions that way). The caller composes the specs
+        into beam members (beam.branching_beam's prediction stream)."""
+        per_player: List[List[Tuple[float, int, int, bytes]]] = []
         for p in range(self.num_players):
             if confirmed[p] is None:
                 continue
@@ -179,6 +189,7 @@ class InputHistoryModel:
             succ = st.next_values(value)
             if not succ:
                 continue
+            scored: List[Tuple[float, int, int, bytes]] = []
             # the switch can land at any not-yet-confirmed frame: frame
             # frontier + d (d >= 1) means the value was held run + d - 1
             # frames in total before switching
@@ -191,9 +202,19 @@ class InputHistoryModel:
                     continue
                 for v, pv in succ:
                     scored.append((h * pv, p, offset, v))
-        scored.sort(key=lambda t: (-t[0], t[1], t[2]))
+            if scored:
+                scored.sort(key=lambda t: (-t[0], t[2]))
+                per_player.append(scored[: self.MAX_SPECS_PER_PLAYER])
+        # players ordered by their best score; then take one spec per
+        # player per round so every predicted switch keeps coverage
+        per_player.sort(key=lambda specs: -specs[0][0])
         out: List[Tuple[int, int, np.ndarray]] = []
-        for _w, p, offset, v in scored[:limit]:
-            row = np.frombuffer(v, dtype=np.uint8).copy()
-            out.append((p, offset, row))
+        rank = 0
+        while len(out) < limit and any(rank < len(s) for s in per_player):
+            for specs in per_player:
+                if rank < len(specs) and len(out) < limit:
+                    _w, p, offset, v = specs[rank]
+                    row = np.frombuffer(v, dtype=np.uint8).copy()
+                    out.append((p, offset, row))
+            rank += 1
         return out

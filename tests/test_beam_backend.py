@@ -667,13 +667,30 @@ def test_value_gate_two_signals_and_probes():
     # width launches nothing...
     backend._idle_ema_s = 0.0
     assert backend._launch_width() == 0
-    # ...and one that covers width-1 but not the full rollout gets
-    # history launches ONLY when member-0 value supports them
+    # ...one that covers width-1 but not the full rollout harvests the
+    # blended window's member-0 share at width 1 (its 0.2/launch clears
+    # the idle-covered SOFT bar; the branch share is forfeited since the
+    # full rollout doesn't fit the budget)
     backend._idle_ema_s = 0.0005
-    assert backend._launch_width() == 0  # branch regime: width 1 is useless
+    assert backend._launch_width() == 1
+    # ...but when member 0 serves NOTHING (pure branch value), width-1
+    # is useless and the gate stands down despite the affordable cost
+    for _ in range(backend.VALUE_WINDOW):
+        backend._launch_value.append((1, 0, 5))
+    assert backend._launch_width() == 0
     for _ in range(backend.VALUE_WINDOW):
         backend._launch_value.append((0, 3, 2))
     assert backend._launch_width() == 1
+
+    # regime 5 (the soft bar's reason to exist): idle comfortably covers
+    # the full cost and a RARE-rollback stream serves only 0.125
+    # frames/launch — far under the hard bar, but real value at covered
+    # cost, so the gate stays open instead of locking out the serves
+    backend._idle_ema_s = 1.0
+    for _ in range(backend.VALUE_WINDOW):
+        backend._launch_value.append((1, 0, 8))
+    assert backend._launch_width() == 4
+    assert backend._value_gated_streak == 0
 
 
 def test_value_gate_attribution_live():
