@@ -1169,14 +1169,30 @@ class TpuRollbackBackend:
                     0, beams[width][:, :rollout], beam_statuses
                 )
                 true_barrier(spec[1])
-                n = 5
+                # the barrier itself costs a device->host round trip
+                # (~100ms on the tunnel); measure it on the already-ready
+                # result and subtract, or every per-launch cost inflates
+                # by rtt/n — enough to make the adaptive gate see a ~1ms
+                # width-1 launch as a ~20ms one and veto it forever. The
+                # rtt sample is itself noisy (a single reading can exceed
+                # the whole chain's barrier), so take the MEDIAN of three
+                # and never let the subtraction push the estimate below
+                # 1/4 of the raw per-dispatch figure.
+                rtts = []
+                for _ in range(3):
+                    t0 = _time.perf_counter()
+                    true_barrier(spec[1])
+                    rtts.append(_time.perf_counter() - t0)
+                rtt = sorted(rtts)[1]
+                n = 10
                 t0 = _time.perf_counter()
                 for _ in range(n):
                     spec = core.speculate(
                         0, beams[width][:, :rollout], beam_statuses
                     )
                 true_barrier(spec[1])
-                costs[width] = (_time.perf_counter() - t0) / n
+                raw = (_time.perf_counter() - t0) / n
+                costs[width] = max(raw - rtt / n, raw / 4)
             self._spec_cost_s = costs[self.beam_width]
             # None when the history width wasn't timed (gate != adaptive);
             # _launch_width's conservative fallback covers that case
