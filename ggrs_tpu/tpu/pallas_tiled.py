@@ -568,6 +568,111 @@ class PallasTiledSyncTestCore:
         raw.append(self.frontier_partial(carry, ctx))
         return jnp.stack(raw)  # [d+1, R]
 
+    def _build_reduce_table(self, S: int):
+        """Entity-tiled pallas pre-pass: raw [S, R] reduction tables from S
+        stacked plane sources in ONE sweep. Exists because the XLA
+        equivalents are pathological at scale on this backend — measured
+        at 512k entities / 16 teams: reduce_sources 294 ms and
+        frontier_partial 24 ms as unfused masked sums, vs ~1-30 ms for
+        the same math streamed through VMEM (the whole 512k 'injection
+        boundary' of r4 was THIS, not ring restreaming)."""
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        plane_names = [name for name, _, _ in self.adapter.planes]
+        R, tile_rows, rows = self.R, self.tile_rows, self.n_rows
+        adapter = self.adapter
+
+        def kernel(gi_ref, owner_ref, *refs):
+            n_p = len(plane_names)
+            srcs = dict(zip(plane_names, refs[:n_p]))
+            out_ref = refs[n_p]
+            first = pl.program_id(0) == 0
+            ctx = KernelCtx(gi_ref[:], owner_ref[:])
+            for s in range(S):
+                planes = {n_: srcs[n_][s] for n_ in plane_names}
+                vals = adapter.reduce_partial(planes, ctx)
+                for j, v in enumerate(vals):
+                    base = jnp.where(first, jnp.int32(0), out_ref[s, j])
+                    out_ref[s, j] = base + v
+
+        def state_spec():
+            return pl.BlockSpec(
+                (tile_rows, LANE), lambda g: (g, 0), memory_space=pltpu.VMEM
+            )
+
+        def src_spec():
+            return pl.BlockSpec(
+                (S, tile_rows, LANE),
+                lambda g: (0, g, 0),
+                memory_space=pltpu.VMEM,
+            )
+
+        def run(sources, gi, owner):
+            return pl.pallas_call(
+                kernel,
+                grid=(self.n_tiles,),
+                in_specs=[state_spec(), state_spec()]
+                + [src_spec() for _ in plane_names],
+                out_specs=[
+                    pl.BlockSpec(
+                        (S, R), lambda g: (0, 0), memory_space=pltpu.SMEM
+                    )
+                ],
+                out_shape=[jax.ShapeDtypeStruct((S, R), jnp.int32)],
+                compiler_params=(
+                    None
+                    if self.interpret
+                    else pltpu.CompilerParams(
+                        vmem_limit_bytes=100 * 1024 * 1024
+                    )
+                ),
+                interpret=self.interpret,
+            )(gi, owner, *[sources[n_] for n_ in plane_names])[0]
+
+        return run
+
+    def _reduce_runs(self, S: int):
+        if not hasattr(self, "_reduce_cache"):
+            self._reduce_cache = {}
+        if S not in self._reduce_cache:
+            self._reduce_cache[S] = self._build_reduce_table(S)
+        return self._reduce_cache[S]
+
+    def reduce_sources_kernel(self, carry, gi_offset=0):
+        """Kernelized reduce_sources: same [d+1, R] raw table,
+        bit-identical (int32 wraparound sums are order-invariant), at
+        streaming cost instead of the XLA masked-sum pathology."""
+        d, ring_len = self.d, self.ring_len
+        c = carry["frame"]
+        base = jnp.maximum(c - d, 0)
+        sources = {}
+        for name, key, comp in self.adapter.planes:
+            parts = []
+            for i in range(d):
+                slot = (base + i) % ring_len
+                arr = jax.lax.dynamic_index_in_dim(
+                    carry["ring"][key], slot, 0, keepdims=False
+                )
+                plane = arr if comp is None else arr[..., comp]
+                parts.append(plane.reshape(self.n_rows, LANE))
+            sp = carry["state"][key]
+            plane = sp if comp is None else sp[..., comp]
+            parts.append(plane.reshape(self.n_rows, LANE))
+            sources[name] = jnp.stack(parts)
+        gi, owner = make_gi_owner(self.n_rows, self.num_players, gi_offset)
+        return self._reduce_runs(d + 1)(sources, gi, owner)
+
+    def frontier_partial_kernel(self, carry, gi_offset=0):
+        """Kernelized frontier_partial: the live state's raw [R] row."""
+        sources = {}
+        for name, key, comp in self.adapter.planes:
+            sp = carry["state"][key]
+            plane = sp if comp is None else sp[..., comp]
+            sources[name] = plane.reshape(1, self.n_rows, LANE)
+        gi, owner = make_gi_owner(self.n_rows, self.num_players, gi_offset)
+        return self._reduce_runs(1)(sources, gi, owner)[0]
+
     def run_kernel(self, carry, inputs, gi_offset=0, red_raw=None):
         """pack -> kernel -> raw outputs (parts NOT yet verdict-folded).
         `gi_offset` shifts the global entity-index plane to this kernel's
@@ -638,6 +743,15 @@ class ShardedPallasTiledCore:
             local_entities=self.local_n, external_reduce=self.reduce_mode,
         )
         self.game = game
+        # reduce-injection cores manage their own jitted programs: the
+        # boot-phase table rebuild rides a lax.cond whose both branches
+        # execute under SPMD, so steady-state batches compile a SEPARATE
+        # program without the cond — selected by a host-tracked frame
+        # count an outer jit could never see (sync_test honors
+        # self_jitting by not wrapping batch)
+        self.self_jitting = self.reduce_mode
+        self._frames_seen = 0
+        self._programs: Dict[Any, Any] = {}
 
     def _carry_specs(self, carry):
         from jax.sharding import PartitionSpec as P
@@ -657,6 +771,23 @@ class ShardedPallasTiledCore:
         }
 
     def batch(self, carry: Dict[str, Any], inputs) -> Dict[str, Any]:
+        if self.self_jitting:
+            t = int(inputs.shape[0])
+            boot = self._frames_seen < self.inner.d
+            key = (t, boot)
+            if key not in self._programs:
+                import functools
+
+                self._programs[key] = jax.jit(
+                    functools.partial(self._batch_program, boot=boot),
+                    donate_argnums=(0,),
+                )
+            self._frames_seen += t
+            return self._programs[key](carry, inputs)
+        return self._batch_program(carry, inputs, boot=True)
+
+    def _batch_program(self, carry: Dict[str, Any], inputs,
+                       boot: bool = True) -> Dict[str, Any]:
         from jax.sharding import PartitionSpec as P
 
         from .pallas_core import KernelCtx
@@ -689,12 +820,27 @@ class ShardedPallasTiledCore:
             # instead of recomputing and psumming all d+1 rows; before the
             # window fills (base pinned at 0, no row shift) the table is
             # rebuilt in full. The boundary tick is exercised by the
-            # parity tests (40 frames, d=4).
-            gi, owner = make_gi_owner(
-                inner.n_rows, self.inner.num_players, offset
-            )
-            ctx = KernelCtx(gi, owner)
+            # parity tests (40 frames, d=4). The table math runs through
+            # the kernelized pre-passes (reduce_sources_kernel /
+            # frontier_partial_kernel) — the XLA masked-sum equivalents
+            # cost 294 ms / 24 ms at 512k entities on this backend. The
+            # boot-phase rebuild rides a lax.cond whose BOTH branches
+            # execute under SPMD (collectives must run uniformly), so the
+            # steady-state program (self._booted, host-tracked) drops the
+            # cond entirely: once every frame in a batch is >= d, only
+            # the frontier row is ever new.
             d = inner.d
+
+            def roll(new_carry, red_raw):
+                return jnp.concatenate(
+                    [
+                        red_raw[1:],
+                        jax.lax.psum(
+                            inner.frontier_partial_kernel(new_carry, offset),
+                            "entity",
+                        )[None],
+                    ]
+                )
 
             def tick(carry_red, inp_row):
                 carry, red_raw = carry_red
@@ -708,24 +854,22 @@ class ShardedPallasTiledCore:
                     1,
                 )
                 new_carry = inner.unpack(out, carry, verdict)
-                next_red = jax.lax.cond(
-                    carry["frame"] >= d,  # next base = base + 1: rows shift
-                    lambda nc: jnp.concatenate(
-                        [
-                            red_raw[1:],
-                            jax.lax.psum(
-                                inner.frontier_partial(nc, ctx), "entity"
-                            )[None],
-                        ]
-                    ),
-                    lambda nc: jax.lax.psum(
-                        inner.reduce_sources(nc, ctx), "entity"
-                    ),
-                    new_carry,
-                )
+                if boot:
+                    next_red = jax.lax.cond(
+                        carry["frame"] >= d,  # next base = base+1: rows shift
+                        lambda nc: roll(nc, red_raw),
+                        lambda nc: jax.lax.psum(
+                            inner.reduce_sources_kernel(nc, offset), "entity"
+                        ),
+                        new_carry,
+                    )
+                else:
+                    next_red = roll(new_carry, red_raw)
                 return (new_carry, next_red), None
 
-            red0 = jax.lax.psum(inner.reduce_sources(carry, ctx), "entity")
+            red0 = jax.lax.psum(
+                inner.reduce_sources_kernel(carry, offset), "entity"
+            )
             (carry, _red), _ = jax.lax.scan(tick, (carry, red0), inputs)
             return carry
 

@@ -176,7 +176,14 @@ class TpuSyncTestSession:
                     check_distance,
                     interpret=backend.endswith("-interpret"),
                 )
-            self._batch_fn = jax.jit(core.batch, donate_argnums=(0,))
+            # self-jitting cores (the sharded reduce-injection path)
+            # manage their own boot/steady programs — a host-tracked
+            # static that an outer jit would bake at first trace
+            self._batch_fn = (
+                core.batch
+                if getattr(core, "self_jitting", False)
+                else jax.jit(core.batch, donate_argnums=(0,))
+            )
         else:
             from .pallas_core import PallasSyncTestCore
 
