@@ -527,7 +527,14 @@ class TpuRollbackBackend:
         if self._spec_cost_s is None:
             return full  # not yet measured (warmup pending): don't stall
         idle = self._idle_ema_s
-        full_affordable = idle is None or idle >= 0.8 * self._spec_cost_s
+        # ONE covered-by-idle predicate per width, reused by both the
+        # affordability decision and the soft/hard bar choice below so
+        # the two can never drift (a soft bar for a width the budget
+        # then refuses to launch would be incoherent). `idle is None`
+        # (no second tick yet) counts as affordable but NOT as measured
+        # coverage — the soft bar requires evidence.
+        full_covered = idle is not None and idle >= 0.8 * self._spec_cost_s
+        full_affordable = idle is None or full_covered
         hist_cost = (
             self._spec_hist_cost_s
             if self._spec_hist_cost_s is not None
@@ -539,7 +546,8 @@ class TpuRollbackBackend:
             # warmup() measures the real width-1 cost
             else self._spec_cost_s
         )
-        hist_affordable = idle is None or idle >= 0.8 * hist_cost
+        hist_covered = idle is not None and idle >= 0.8 * hist_cost
+        hist_affordable = idle is None or hist_covered
         if len(self._launch_value) >= self.VALUE_MIN_SAMPLES:
             launches = max(sum(n for _, _, n in self._launch_value), 1)
             branch_rate = sum(b for b, _, _ in self._launch_value) / launches
@@ -548,12 +556,12 @@ class TpuRollbackBackend:
             # measured cost (see MIN_SERVED_IDLE), hard otherwise
             full_bar = (
                 self.MIN_SERVED_IDLE
-                if idle is not None and idle >= 0.8 * self._spec_cost_s
+                if full_covered
                 else self.MIN_SERVED_PER_LAUNCH
             )
             hist_bar = (
                 self.MIN_SERVED_IDLE
-                if idle is not None and idle >= 0.8 * hist_cost
+                if hist_covered
                 else self.MIN_SERVED_PER_LAUNCH
             )
             hist_ok = hist_rate >= hist_bar

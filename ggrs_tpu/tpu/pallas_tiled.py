@@ -776,8 +776,6 @@ class ShardedPallasTiledCore:
             boot = self._frames_seen < self.inner.d
             key = (t, boot)
             if key not in self._programs:
-                import functools
-
                 self._programs[key] = jax.jit(
                     functools.partial(self._batch_program, boot=boot),
                     donate_argnums=(0,),
