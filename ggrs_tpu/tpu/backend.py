@@ -1104,9 +1104,11 @@ class TpuRollbackBackend:
         core.tick(False, 0, inputs, statuses, scratch, 0)
         if core._tick_branchless_fn is not None:
             # row-content routing sends rollback rows to the branchless
-            # program — compile it too, or the first real rollback pays
-            # the mid-session compile stall warmup exists to prevent
-            core.tick(True, 0, inputs, statuses, scratch, 2)
+            # program at a depth-coalesced slot variant — compile EVERY
+            # variant, or the first rollback of a new depth pays the
+            # mid-session compile stall warmup exists to prevent
+            for v in core.branchless_variants():
+                core.tick(True, 0, inputs, statuses, scratch, v)
         if self.lazy_ticks:
             # compile the fused multi-tick program at the buffer depth
             # (all-padding rows: a true no-op on the game state)

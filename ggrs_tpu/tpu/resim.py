@@ -149,8 +149,14 @@ class ResimCore:
         self._tick_fn = jax.jit(
             self._tick_packed_impl, donate_argnums=(0, 1, 3)
         )
+        # nslots is a STATIC jit key: one executable per coalesced
+        # depth variant (branchless_variants), all compiled by warmup
         self._tick_branchless_fn = (
-            jax.jit(self._tick_branchless_impl, donate_argnums=(0, 1, 3))
+            jax.jit(
+                self._tick_branchless_impl,
+                static_argnums=(4,),
+                donate_argnums=(0, 1, 3),
+            )
             if n_entities is not None
             and n_entities <= self.BRANCHLESS_MAX_ENTITIES
             else None
@@ -329,15 +335,20 @@ class ResimCore:
             advance_count, start_frame, verify,
         )
 
-    def _tick_branchless_impl(self, ring, state, packed, verify):
-        """The T=1 tick with NO device control flow: the W-slot window is
-        unrolled, every slot's checksum and step always execute, and
-        masking is jnp.where selects. Same packed layout and bit-identical
-        outputs to _tick_packed_impl (tests drive random streams through
-        both): skipped saves emit (0, 0) checksums and write the OLD value
-        back to ring slot 0; skipped steps' results are where()-discarded.
-        Rationale and the measured dispatch numbers: the _tick_fn comment
-        in __init__."""
+    def _tick_branchless_impl(self, ring, state, packed, verify, nslots):
+        """The T=1 tick with NO device control flow: `nslots` window slots
+        are unrolled, every unrolled slot's checksum and step always
+        execute, and masking is jnp.where selects. Same packed layout and
+        bit-identical outputs to _tick_packed_impl (tests drive random
+        streams through both): skipped saves emit (0, 0) checksums and
+        write the OLD value back to ring slot 0; skipped steps' results
+        are where()-discarded; slots past `nslots` (a STATIC jit key) are
+        provably inert for the row being dispatched — the host router
+        picks the smallest coalesced variant covering the row's last
+        active slot (depth specialization: unrolling the full window cost
+        ~1 ms of masked step+checksum work per rollback tick at 65k that
+        a depth-5 rollback never needed). Rationale and the measured
+        dispatch numbers: the _tick_fn comment in __init__."""
         W, P, I = self.window, self.num_players, self.game.input_size
         do_load = packed[0] != 0
         load_slot = packed[1]
@@ -358,7 +369,7 @@ class ResimCore:
         )
         state = _tree_where(do_load, loaded, state)
         his, los = [], []
-        for i in range(W):
+        for i in range(nslots):
             save_slot = save_slots[i]
             do_save = save_slot < self.ring_len
             hi, lo = self.game.checksum(state)
@@ -385,7 +396,26 @@ class ResimCore:
             state = _tree_where(i < advance_count, nxt, state)
             his.append(hi)
             los.append(lo)
-        return ring, state, verify, jnp.stack(his), jnp.stack(los)
+        zero = [jnp.uint32(0)] * (W - nslots)
+        return (
+            ring,
+            state,
+            verify,
+            jnp.stack(his + zero),
+            jnp.stack(los + zero),
+        )
+
+    def branchless_variants(self):
+        """The coalesced slot counts the branchless T=1 program compiles
+        for (3, 6, 9, ..., W; always ends in W): a handful of variants
+        covers every depth while warmup stays a few compiles, and the
+        router rounds a row's last active slot UP to the next variant."""
+        if not hasattr(self, "_bl_variants"):
+            W = self.window
+            self._bl_variants = sorted(
+                {min(3 * k, W) for k in range(1, (W + 2) // 3 + 1)}
+            )
+        return self._bl_variants
 
     def _tick_multi_impl(self, ring, state, packed, verify):
         """T buffered ticks as ONE device program: a lax.scan of the packed
@@ -409,15 +439,20 @@ class ResimCore:
         )
         return ring, state, verify, his, los
 
-    def _single_tick_fn(self, row: np.ndarray):
-        """Row-content routing for lone ticks (rationale: the __init__
-        comment): rollback / multi-advance rows run the branchless
-        program when the world supports it; trivial rows keep cond."""
-        if self._tick_branchless_fn is not None and (
-            row[0] != 0 or row[2] > 1
-        ):
-            return self._tick_branchless_fn
-        return self._tick_fn
+    def _branchless_nslots(self, row: np.ndarray) -> int:
+        """Smallest coalesced variant covering the row's last active slot
+        (its advance count and its highest real save)."""
+        save_slots = np.asarray(row[self._off_save : self._off_status])
+        active = max(int(row[2]), 1)
+        valid = np.nonzero(save_slots < self.ring_len)[0]
+        if valid.size:
+            active = max(active, int(valid[-1]) + 1)
+        for v in self.branchless_variants():
+            if v >= active:
+                return v
+        raise AssertionError(
+            f"no variant covers {active} slots (variants end in window)"
+        )
 
     def _pallas_t1(self) -> bool:
         """Do lone ticks route through the pallas tick kernel? Size-aware
@@ -440,9 +475,22 @@ class ResimCore:
                 )
             )
             return his[0], los[0]
-        self.ring, self.state, self.verify, his, los = self._single_tick_fn(
-            row
-        )(self.ring, self.state, row, self.verify)
+        # row-content routing (rationale: the __init__ comment): rollback
+        # / multi-advance rows run the branchless program at the smallest
+        # depth variant covering the row; trivial rows keep cond
+        if self._tick_branchless_fn is not None and (
+            row[0] != 0 or row[2] > 1
+        ):
+            self.ring, self.state, self.verify, his, los = (
+                self._tick_branchless_fn(
+                    self.ring, self.state, row, self.verify,
+                    self._branchless_nslots(row),
+                )
+            )
+            return his, los
+        self.ring, self.state, self.verify, his, los = self._tick_fn(
+            self.ring, self.state, row, self.verify
+        )
         return his, los
 
     def tick_multi(self, rows: np.ndarray) -> Tuple[Any, Any]:

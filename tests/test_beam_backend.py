@@ -121,7 +121,7 @@ def test_warmup_compiles_without_state_change():
 
 def test_warmup_covers_every_tick_program():
     """warmup() must compile EVERY program a live loop can dispatch.
-    Since T=1 row-content routing (ResimCore._single_tick_fn), rollback
+    Since T=1 row-content routing (ResimCore.tick_row), rollback
     rows run a different compiled program (_tick_branchless_fn) than
     trivial one-advance rows (_tick_fn) — a warmup that misses one leaves
     a multi-second compile stall inside the session (exactly the defect

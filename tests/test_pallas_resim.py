@@ -120,6 +120,46 @@ def test_tick_kernel_deep_window_parity():
     assert_core_equal(a, b)
 
 
+def test_branchless_depth_variants_bit_parity():
+    """Depth-specialized branchless T=1 programs (static nslots variants,
+    ResimCore.branchless_variants): every rollback depth must produce
+    ring/state/verify/checksums bit-identical to the cond program —
+    including rows whose last save sits past the advance count."""
+    r = np.random.default_rng(31)
+    bl_core = ResimCore(ExGame(P, 256), max_prediction=8, num_players=P,
+                        device_verify=True)
+    cond_core = ResimCore(ExGame(P, 256), max_prediction=8, num_players=P,
+                          device_verify=True)
+    assert bl_core._tick_branchless_fn is not None
+    cond_fn = cond_core._tick_fn
+    W = bl_core.window
+    frame = 0
+    for t in range(20):
+        depth = 0 if frame < 8 else int(r.integers(1, 8))
+        do_load = depth > 0
+        count = depth + 1 if do_load else 1
+        start = frame - depth if do_load else frame
+        inputs = np.zeros((W, P, 1), np.uint8)
+        statuses = np.zeros((W, P), np.int32)
+        for i in range(count):
+            inputs[i] = r.integers(0, 16, (P, 1))
+        slots = np.full((W,), bl_core.scratch_slot, np.int32)
+        for i in range(count + (1 if do_load and count < W else 0)):
+            slots[i] = (start + i) % bl_core.ring_len
+        row = bl_core.pack_tick_row(
+            do_load, (start % bl_core.ring_len) if do_load else 0,
+            inputs, statuses, slots, count, start_frame=start,
+        )
+        ha, la = bl_core.tick_row(row)
+        (cond_core.ring, cond_core.state, cond_core.verify, hb, lb) = (
+            cond_fn(cond_core.ring, cond_core.state, row, cond_core.verify)
+        )
+        np.testing.assert_array_equal(np.asarray(ha), np.asarray(hb))
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        frame = start + count
+    assert_core_equal(bl_core, cond_core)
+
+
 def test_pallas_t1_routing_bit_parity():
     """Size-aware T=1 routing (ResimCore.PALLAS_T1_MIN_ENTITIES): on big
     worlds lone ticks dispatch through the pallas tick kernel as a 1-row
