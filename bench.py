@@ -250,14 +250,18 @@ def bench_roofline(bench_batches=10):
 
 
 def bench_request_path(device_verify=True, lazy_ticks=0,
-                       ticks=REQUEST_PATH_TICKS):
+                       ticks=REQUEST_PATH_TICKS, async_mode=False):
     """Interactive path: one dispatch per tick. `device_verify=True` keeps
     the SyncTest verdict on device (zero per-run checksum readbacks; the
     final backend.check() is the run's one transfer and its true barrier);
     False uses the host-side deferred-burst verification, whose per-burst
     ~100ms readbacks are the number to compare against. `lazy_ticks=N`
     batches N session ticks into one fused dispatch (the per-program
-    tunnel floor amortizes N-fold; see bench_tunnel_floor)."""
+    tunnel floor amortizes N-fold; see bench_tunnel_floor).
+    `async_mode=True` runs the async device-resident dispatch pipeline
+    (TpuRollbackBackend(async_dispatch=True): fused multi-tick batches,
+    an in-flight fence instead of per-tick drain, plan-cached parsing) —
+    bit-identical checksums to the eager path (parity_async_vs_eager)."""
     from ggrs_tpu import SessionBuilder
     from ggrs_tpu.models.ex_game import ExGame
     from ggrs_tpu.tpu import TpuRollbackBackend
@@ -268,6 +272,7 @@ def bench_request_path(device_verify=True, lazy_ticks=0,
         num_players=PLAYERS,
         device_verify=device_verify,
         lazy_ticks=lazy_ticks,
+        async_dispatch=async_mode,
     )
     b = (
         SessionBuilder(input_size=1)
@@ -398,6 +403,57 @@ def parity_fused_vs_oracle(model="ex_game"):
         ):
             return False
     return True
+
+
+def parity_async_vs_eager(ticks=120, entities=512):
+    """Bit-parity witness for the async dispatch pipeline (the acceptance
+    bar behind request_path_async / p2p4_async): identical SyncTest
+    request streams — a forced rollback every tick once past
+    check_distance — through an eager and an async backend; EVERY saved
+    checksum (captured per save via stable getters, not re-read from
+    reused ring cells) and the final state must match bit for bit. The
+    fuller parity evidence (P2P disconnect forced rollback, desync-report
+    ordering under lazy drain) lives in tests/test_async_dispatch.py."""
+    from ggrs_tpu import SaveGameState, SessionBuilder
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.tpu import TpuRollbackBackend
+
+    script = input_script(ticks)
+    streams = {}
+    finals = {}
+    for async_mode in (False, True):
+        backend = TpuRollbackBackend(
+            ExGame(PLAYERS, entities),
+            max_prediction=MAX_PREDICTION,
+            num_players=PLAYERS,
+            async_dispatch=async_mode,
+        )
+        sess = (
+            SessionBuilder(input_size=1)
+            .with_num_players(PLAYERS)
+            .with_max_prediction_window(MAX_PREDICTION)
+            .with_check_distance(CHECK_DISTANCE)
+            .start_synctest_session()
+        )
+        getters = []
+        for f in range(ticks):
+            for h in range(PLAYERS):
+                sess.add_local_input(h, bytes(script[f, h]))
+            reqs = sess.advance_frame()
+            backend.handle_requests(reqs)
+            getters += [
+                (r.frame, r.cell.checksum_getter())
+                for r in reqs
+                if isinstance(r, SaveGameState)
+            ]
+        streams[async_mode] = [(f, g()) for f, g in getters]
+        finals[async_mode] = backend.state_numpy()
+    if streams[False] != streams[True]:
+        return False
+    return all(
+        np.array_equal(np.asarray(finals[False][k]), np.asarray(finals[True][k]))
+        for k in finals[False]
+    )
 
 
 def bench_beam():
@@ -1349,7 +1405,7 @@ def bench_tunnel_floor():
 
 
 def bench_p2p4_rollback(rounds=12, burst=12, lazy_ticks=0, mesh_devices=0,
-                        tick_backend="auto"):
+                        tick_backend="auto", async_mode=False):
     """BASELINE configs[3]: 4-player P2PSession, 12-frame rollback window,
     TpuRollbackBackend. A real 4-session mesh (native C++ control plane)
     over the in-memory network; session 0 runs the 4096-entity flagship
@@ -1442,6 +1498,7 @@ def bench_p2p4_rollback(rounds=12, burst=12, lazy_ticks=0, mesh_devices=0,
         lazy_ticks=lazy_ticks,
         mesh=mesh,
         tick_backend=tick_backend,
+        async_dispatch=async_mode,
     )
     # compile EVERY program the live loop can dispatch before measuring.
     # Round 0 below only exercises the programs its own tick sequence
@@ -1525,9 +1582,23 @@ def bench_p2p4_rollback(rounds=12, burst=12, lazy_ticks=0, mesh_devices=0,
     peer_ms_per_tick = peer_phase_s / max(n_ticks, 1) * 1000.0
     sess0_advance_ms = float(np.mean(sess0_advance_s)) * 1000.0
     wall_ms = elapsed / max(n_ticks, 1) * 1000.0
+    parse_span = GLOBAL_TRACER.stats.get("tpu/host_parse")
+    fence_span = GLOBAL_TRACER.stats.get("tpu/async_fence")
     breakdown = {
         "tick_backend": backend.core.tick_backend,
         "sharded": mesh is not None,
+        "async": async_mode,
+        "lazy_ticks": backend.lazy_ticks,
+        # directly-spanned request parsing (the derived tick_host_parse_ms
+        # below is the residual, which also absorbs scheduling jitter)
+        "tick_parse_span_ms": round(
+            (parse_span.total_ms / max(n_ticks, 1)) if parse_span else 0.0, 4
+        ),
+        # async fence stalls: the device time the pipeline FAILED to hide
+        # behind host work (0 in eager mode, where nothing fences)
+        "async_fence_ms_per_tick": round(
+            (fence_span.total_ms / max(n_ticks, 1)) if fence_span else 0.0, 4
+        ),
         "tick_mean_ms": round(mean_tick_ms, 4),
         # inside tick_mean: the session's own advance (pump + sync layer)
         # vs the backend's request handling + dispatch
@@ -1601,22 +1672,68 @@ def device_name():
 def main():
     # If the driver's budget expires mid-run, still emit ONE parseable
     # line (r3's artifact recorded raw text because nothing parseable ever
-    # reached stdout). SIGTERM is what `timeout` and most supervisors send
-    # first; SIGKILL can't be helped.
+    # reached stdout) — AND flush every phase already measured (r5's
+    # BENCH_r05.json came back rc=124/value=null despite hours of
+    # completed phases: the old handler threw them away). `full` is built
+    # incrementally, one phase at a time; the handler writes it to
+    # bench_full.json and summarizes what landed. SIGTERM is what
+    # `timeout` and most supervisors send first; SIGKILL can't be helped.
     import signal
 
+    full = {
+        "metric": "rollback-frames resimulated/sec "
+                  "(8-frame window, 4k-entity state)",
+        "value": None,
+        "unit": "frames/sec",
+        "vs_baseline": None,
+        "entities": ENTITIES,
+        "check_distance": CHECK_DISTANCE,
+        "batch_ticks": BATCH,
+        "phases_completed": [],
+    }
+    full_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_full.json"
+    )
+    # short-line fields promoted from full when (and only when) measured:
+    # an interrupted run's line carries every headline number it reached
+    _SHORT_KEYS = (
+        "spread_pct", "arena_fps_p50", "swarm_fps_p50", "cfg4_fps_p50",
+        "request_path_fps", "request_path_async_fps", "p2p4_fps",
+        "p2p4_async_fps", "p2p4_lazy16_fps", "interleaved_headline_fps_p50",
+        "interleaved_spread_pct", "beam_ab_delta_ms", "beam_ab_wins",
+        "history_b8_rate", "parity", "async_parity",
+    )
+
+    def _short_line(partial=False, error=None):
+        line = {
+            "metric": full["metric"],
+            "value": full["value"],
+            "unit": full["unit"],
+            "vs_baseline": full["vs_baseline"],
+        }
+        for k in _SHORT_KEYS:
+            if k in full:
+                line[k] = full[k]
+        if partial:
+            line["partial"] = True
+            line["error"] = error
+            line["phases_completed"] = list(full["phases_completed"])
+        line["full"] = "bench_full.json"
+        return json.dumps(line)
+
+    def _flush_full():
+        with open(full_path, "w") as f:
+            json.dump(full, f, indent=1)
+
     def _on_term(_signum, _frame):
+        try:
+            _flush_full()
+        except Exception:
+            pass
         print(
-            json.dumps(
-                {
-                    "metric": "rollback-frames resimulated/sec "
-                              "(8-frame window, 4k-entity state)",
-                    "value": None,
-                    "unit": "frames/sec",
-                    "vs_baseline": None,
-                    "error": "terminated before completion "
-                             "(runner budget/timeout)",
-                }
+            _short_line(
+                partial=True,
+                error="terminated before completion (runner budget/timeout)",
             ),
             flush=True,
         )
@@ -1627,67 +1744,145 @@ def main():
     except ValueError:
         pass  # non-main thread (embedded use): skip the handler
 
+    def phase(name, expr, timeout_s=480):
+        """One measured phase: result recorded into `full` (under `name`
+        when given) BEFORE the next phase starts, so a mid-run SIGTERM
+        flushes it. Also checkpoints bench_full.json after each phase —
+        a SIGKILL still leaves the last checkpoint on disk."""
+        value = _run_phase(expr, timeout_s)
+        if name is not None:
+            full[name] = value
+        full["phases_completed"].append(name or expr.split("(")[0])
+        _flush_full()
+        return value
+
     # the parent never touches the device: only one device-attached process
     # exists at any moment (sequential phase subprocesses)
-    device = _run_phase("device_name()")
+    device = phase("device", "device_name()")
     # BENCH_SMOKE=1 shrinks the measurement durations to validate the
     # whole pipeline quickly (numbers not comparable to full runs)
-    headline = _run_phase(
-        f"bench_fused_stats(bench_batches={4 if SMOKE else BENCH_BATCHES})"
+    headline = phase(
+        "headline_stats",
+        f"bench_fused_stats(bench_batches={4 if SMOKE else BENCH_BATCHES})",
     )
     rate, ms_per_tick, fused_backend = (
         headline["frames_per_sec_p50"],
         headline["ms_per_tick_p50"],
         headline["backend"],
     )
+    full["value"] = round(rate, 1)
+    full["vs_baseline"] = round(rate / NORTH_STAR_FRAMES_PER_SEC, 3)
+    full["ms_per_8frame_rollback_tick"] = round(ms_per_tick, 4)
+    full["fused_backend"] = fused_backend
+    full["spread_pct"] = headline.get("spread_pct")
     # max-throughput determinism soak: same kernel, 1920 ticks per dispatch
     # (32s of simulated gameplay) — amortizes the tunnel's per-program
     # floor to reveal the kernel's true per-tick cost (~microseconds)
-    soak_rate, soak_ms, _soak_be = _run_phase(
-        f"bench_fused(bench_batches={3 if SMOKE else 12}, batch=1920)[:3]"
+    soak_rate, soak_ms, _soak_be = phase(
+        "_soak", f"bench_fused(bench_batches={3 if SMOKE else 12}, batch=1920)[:3]"
     )
-    default_rate, default_backend = _run_phase(f"bench_fused_default(bench_batches={4 if SMOKE else 20})")
-    request_rate, request_median_ms = _run_phase(f"bench_request_path(ticks={120 if SMOKE else 600})")
-    hostverify_rate, _hv_ms = _run_phase(
-        f"bench_request_path(device_verify=False, ticks={120 if SMOKE else 600})"
+    full["fused_soak_batch1920_frames_per_sec"] = round(soak_rate, 1)
+    full["fused_soak_ms_per_tick"] = round(soak_ms, 4)
+    default_rate, default_backend = phase(
+        "_default", f"bench_fused_default(bench_batches={4 if SMOKE else 20})"
     )
-    host_rate = _run_phase(f"bench_host_python(ticks={40 if SMOKE else 160})")
-    beam_rate = _run_phase("bench_beam()")
-    parity = _run_phase("parity_fused_vs_oracle()")
-    tunnel_floor = _run_phase("bench_tunnel_floor()")
-    p2p4_rate, p2p4_ms, p2p4_breakdown = _run_phase(f"bench_p2p4_rollback(rounds={3 if SMOKE else 12})")
+    full["fused_default_config_frames_per_sec"] = round(default_rate, 1)
+    full["fused_default_backend"] = default_backend
+    request_rate, request_median_ms = phase(
+        "_request_path", f"bench_request_path(ticks={120 if SMOKE else 600})"
+    )
+    full["request_path_frames_per_sec"] = round(request_rate, 1)
+    full["request_path_median_tick_ms"] = round(request_median_ms, 4)
+    full["request_path_fps"] = round(request_rate, 1)
+    # the same interactive loop on the ASYNC dispatch pipeline (fused
+    # multi-tick batches + in-flight fence + plan-cached parsing);
+    # parity_async_vs_eager below is its bit-identity witness
+    request_async_rate, request_async_ms = phase(
+        "_request_path_async",
+        f"bench_request_path(ticks={120 if SMOKE else 600}, async_mode=True)",
+    )
+    full["request_path_async_frames_per_sec"] = round(request_async_rate, 1)
+    full["request_path_async_median_tick_ms"] = round(request_async_ms, 4)
+    full["request_path_async_fps"] = round(request_async_rate, 1)
+    hostverify_rate, _hv_ms = phase(
+        "_request_path_hostverify",
+        f"bench_request_path(device_verify=False, ticks={120 if SMOKE else 600})",
+    )
+    full["request_path_hostverify_frames_per_sec"] = round(hostverify_rate, 1)
+    host_rate = phase(
+        "_host_python", f"bench_host_python(ticks={40 if SMOKE else 160})"
+    )
+    full["host_python_frames_per_sec"] = round(host_rate, 1)
+    beam_rate = phase("_beam16", "bench_beam()")
+    full["beam16_frames_per_sec"] = round(beam_rate, 1)
+    parity = phase("parity_vs_oracle", "parity_fused_vs_oracle()")
+    async_parity = phase("async_parity", "parity_async_vs_eager()")
+    tunnel_floor = phase("tunnel_floor", "bench_tunnel_floor()")
+    p2p4_rate, p2p4_ms, p2p4_breakdown = phase(
+        "_p2p4", f"bench_p2p4_rollback(rounds={3 if SMOKE else 12})"
+    )
+    full["p2p4_12frame_rollback_frames_per_sec"] = round(p2p4_rate, 1)
+    full["p2p4_rollback_dispatch_p50_ms"] = round(p2p4_ms, 4)
+    full["p2p4_tick_breakdown"] = p2p4_breakdown
+    full["p2p4_fps"] = round(p2p4_rate, 1)
+    # the same 4-player mesh on the async pipeline: the rollback burst and
+    # the speculative ticks ride fused batches behind the in-flight fence
+    p2p4_async_rate, p2p4_async_ms, p2p4_async_breakdown = phase(
+        "_p2p4_async",
+        f"bench_p2p4_rollback(rounds={3 if SMOKE else 12}, async_mode=True)",
+    )
+    full["p2p4_async_rollback_frames_per_sec"] = round(p2p4_async_rate, 1)
+    full["p2p4_async_rollback_dispatch_p50_ms"] = round(p2p4_async_ms, 4)
+    full["p2p4_async_tick_breakdown"] = p2p4_async_breakdown
+    full["p2p4_async_fps"] = round(p2p4_async_rate, 1)
     # the attack on the floor: lazy tick batching (16-deep buffer) — N
     # session ticks ride ONE device dispatch, so the per-dispatch tunnel
     # floor amortizes across the buffer
-    p2p4_lazy_rate, p2p4_lazy_ms, p2p4_lazy_breakdown = _run_phase(
-        f"bench_p2p4_rollback(rounds={3 if SMOKE else 12}, lazy_ticks=16)"
+    p2p4_lazy_rate, p2p4_lazy_ms, p2p4_lazy_breakdown = phase(
+        "_p2p4_lazy16",
+        f"bench_p2p4_rollback(rounds={3 if SMOKE else 12}, lazy_ticks=16)",
     )
+    full["p2p4_lazy16_rollback_frames_per_sec"] = round(p2p4_lazy_rate, 1)
+    full["p2p4_lazy16_rollback_dispatch_p50_ms"] = round(p2p4_lazy_ms, 4)
+    full["p2p4_lazy16_tick_breakdown"] = p2p4_lazy_breakdown
+    full["p2p4_lazy16_fps"] = round(p2p4_lazy_rate, 1)
     # the sharded request path on the entity-tiled pallas TICK kernel
     # (VERDICT r3 item 1): same p2p4 lazy arm, backend entity-sharded over
     # a single-chip mesh with tick_backend=pallas — the delta vs
     # p2p4_lazy16 is the mesh plumbing; the tick kernel replaces the XLA
     # scan the sharded path used to inherit
-    p2p4_shard_rate, p2p4_shard_ms, p2p4_shard_breakdown = _run_phase(
+    p2p4_shard_rate, p2p4_shard_ms, p2p4_shard_breakdown = phase(
+        "_p2p4_sharded",
         f"bench_p2p4_rollback(rounds={3 if SMOKE else 12}, lazy_ticks=16, "
-        f"mesh_devices=1, tick_backend='pallas')"
+        f"mesh_devices=1, tick_backend='pallas')",
     )
-    beam_exec = _run_phase("bench_beam_exec()")
-    beam_live = _run_phase(
+    full["p2p4_sharded_pallas_tick_frames_per_sec"] = round(p2p4_shard_rate, 1)
+    full["p2p4_sharded_pallas_tick_dispatch_p50_ms"] = round(p2p4_shard_ms, 4)
+    full["p2p4_sharded_pallas_tick_breakdown"] = p2p4_shard_breakdown
+    beam_exec = phase("_beam_exec", "bench_beam_exec()")
+    beam_live = phase(
+        "_beam_live",
         f"bench_beam_adoption(frames={80 if SMOKE else 200})", timeout_s=900
     )
+    full["beam_adoption"] = {"live": beam_live, "exec": beam_exec}
     # the beam-economics decision arm (VERDICT r4 item 1): interleaved
     # ABBA on/off with barriered ticks on the adoption-favorable regime
-    beam_ab = _run_phase(
+    beam_ab = phase(
+        "beam_ab",
         f"bench_beam_ab(frames={40 if SMOKE else 120}, "
         f"reps={1 if SMOKE else 3})",
         timeout_s=1800,
     )
+    full["beam_ab_delta_ms"] = beam_ab["rollback_p50_delta_ms"]
+    full["beam_ab_wins"] = beam_ab["verdict"]
     # the width-1 history launch under a real 8 ms budget (item 2): the
     # forced-replay regime it exists for
-    history_b8 = _run_phase(
+    history_b8 = phase(
+        "history_launch_b8",
         f"bench_history_launch_b8(frames={100 if SMOKE else 240})",
         timeout_s=900,
     )
+    full["history_b8_rate"] = history_b8["history_launch_rate"]
     # net device time per tick, FIRST-CLASS (VERDICT r2 item 2c):
     # speculation tax actually paid (launch rate x measured speculation
     # cost) minus adoption savings actually realized (frames served x
@@ -1715,30 +1910,47 @@ def main():
             - served_per_tick * save_per_frame_ms,
             3,
         )
-    roofline = _run_phase(f"bench_roofline(bench_batches={2 if SMOKE else 10})")
+    roofline = phase(
+        "roofline", f"bench_roofline(bench_batches={2 if SMOKE else 10})"
+    )
     # ABBA-interleaved headline rows (VERDICT r4 item 4): the four
     # headline configs measured as interleaved passes in one process —
     # the committed p50s/spreads come from THIS, not best-window runs
-    interleaved = _run_phase(
+    interleaved = phase(
+        "headline_interleaved",
         f"bench_headline_interleaved(reps={2 if SMOKE else 5}, "
         f"bench_batches={3 if SMOKE else 10})",
         timeout_s=1800,
     )
+    full["interleaved_headline_fps_p50"] = interleaved["headline"][
+        "frames_per_sec_p50"
+    ]
+    full["interleaved_spread_pct"] = interleaved["headline"]["spread_pct"]
     # BASELINE configs[4], single-chip slice: ~64k int32 components (5 words
     # per entity), 16-frame rollback. The 4-chip psum-checksum variant of
     # the same config runs on the virtual mesh in tests/test_sharded.py and
     # __graft_entry__.dryrun_multichip (no multi-chip hardware here).
     # 13056 = 102*128 entities keeps the pallas kernel's tiling envelope;
     # 5 int32 words each = 65280 components
-    cfg4 = _run_phase(
+    cfg4 = phase(
+        "cfg4_stats",
         f"bench_fused_stats(entities=13056, check_distance=16, "
-        f"bench_batches={4 if SMOKE else 20})"
+        f"bench_batches={4 if SMOKE else 20})",
     )
+    full["cfg4_64k_16frame_frames_per_sec"] = cfg4["frames_per_sec_p50"]
+    full["cfg4_ms_per_16frame_tick"] = cfg4["ms_per_tick_p50"]
+    full["cfg4_backend"] = cfg4["backend"]
+    full["cfg4_fps_p50"] = cfg4["frames_per_sec_p50"]
     # second model family on the generic pallas path (arena: cross-entity
     # centroid reductions + combat; adapter in ggrs_tpu/tpu/pallas_core.py)
-    arena = _run_phase(
-        f"bench_fused_stats(model='arena', bench_batches={4 if SMOKE else 20})"
+    arena = phase(
+        "arena_stats",
+        f"bench_fused_stats(model='arena', bench_batches={4 if SMOKE else 20})",
     )
+    full["arena_frames_per_sec"] = arena["frames_per_sec_p50"]
+    full["arena_ms_per_8frame_tick"] = arena["ms_per_tick_p50"]
+    full["arena_fused_backend"] = arena["backend"]
+    full["arena_fps_p50"] = arena["frames_per_sec_p50"]
     # the reduction family's multi-chip story (r4): arena entity-sharded
     # over a single-chip mesh on the tiled kernel via per-tick reduce
     # injection — measured 1.9x the sharded XLA scan it replaces (19.0k
@@ -1746,109 +1958,37 @@ def main():
     # vs the unsharded arena number is one kernel launch + one [d+1, R]
     # psum per tick instead of the whole-batch kernel's cached inline
     # reductions
-    arena_sharded = _run_phase(
+    arena_sharded = phase(
+        "arena_sharded_stats",
         f"bench_fused_stats(model='arena', backend='pallas-tiled', "
-        f"mesh_devices=1, bench_batches={4 if SMOKE else 20})"
+        f"mesh_devices=1, bench_batches={4 if SMOKE else 20})",
     )
-    arena_parity = _run_phase("parity_fused_vs_oracle(model='arena')")
-    arena_request = _run_phase(f"bench_arena_request_path(n={3 if SMOKE else 12})")
+    arena_parity = phase(
+        "arena_parity_vs_oracle", "parity_fused_vs_oracle(model='arena')"
+    )
+    arena_request = phase(
+        "arena_request_path", f"bench_arena_request_path(n={3 if SMOKE else 12})"
+    )
     # third model family (swarm: [N,3] vectors + battery; tileable) on the
     # same generic pallas path — the adapter contract's bench witness
-    swarm = _run_phase(
-        f"bench_fused_stats(model='swarm', bench_batches={4 if SMOKE else 20})"
+    swarm = phase(
+        "swarm_stats",
+        f"bench_fused_stats(model='swarm', bench_batches={4 if SMOKE else 20})",
     )
-    swarm_parity = _run_phase("parity_fused_vs_oracle(model='swarm')")
+    full["swarm_frames_per_sec"] = swarm["frames_per_sec_p50"]
+    full["swarm_ms_per_8frame_tick"] = swarm["ms_per_tick_p50"]
+    full["swarm_fused_backend"] = swarm["backend"]
+    full["swarm_fps_p50"] = swarm["frames_per_sec_p50"]
+    swarm_parity = phase(
+        "swarm_parity_vs_oracle", "parity_fused_vs_oracle(model='swarm')"
+    )
+    full["parity"] = bool(parity and arena_parity and swarm_parity)
 
-    full = {
-        "metric": "rollback-frames resimulated/sec (8-frame window, 4k-entity state)",
-        "value": round(rate, 1),
-        "unit": "frames/sec",
-        "vs_baseline": round(rate / NORTH_STAR_FRAMES_PER_SEC, 3),
-        "ms_per_8frame_rollback_tick": round(ms_per_tick, 4),
-        "headline_stats": headline,
-        "fused_soak_batch1920_frames_per_sec": round(soak_rate, 1),
-        "fused_soak_ms_per_tick": round(soak_ms, 4),
-        "fused_default_config_frames_per_sec": round(default_rate, 1),
-        "fused_default_backend": default_backend,
-        "request_path_frames_per_sec": round(request_rate, 1),
-        "request_path_median_tick_ms": round(request_median_ms, 4),
-        "request_path_hostverify_frames_per_sec": round(hostverify_rate, 1),
-        "host_python_frames_per_sec": round(host_rate, 1),
-        "beam16_frames_per_sec": round(beam_rate, 1),
-        "p2p4_12frame_rollback_frames_per_sec": round(p2p4_rate, 1),
-        "p2p4_rollback_dispatch_p50_ms": round(p2p4_ms, 4),
-        "p2p4_tick_breakdown": p2p4_breakdown,
-        "p2p4_lazy16_rollback_frames_per_sec": round(p2p4_lazy_rate, 1),
-        "p2p4_lazy16_rollback_dispatch_p50_ms": round(p2p4_lazy_ms, 4),
-        "p2p4_lazy16_tick_breakdown": p2p4_lazy_breakdown,
-        "p2p4_sharded_pallas_tick_frames_per_sec": round(p2p4_shard_rate, 1),
-        "p2p4_sharded_pallas_tick_dispatch_p50_ms": round(p2p4_shard_ms, 4),
-        "p2p4_sharded_pallas_tick_breakdown": p2p4_shard_breakdown,
-        "tunnel_floor": tunnel_floor,
-        "beam_adoption": {"live": beam_live, "exec": beam_exec},
-        "beam_ab": beam_ab,
-        "history_launch_b8": history_b8,
-        "headline_interleaved": interleaved,
-        "roofline": roofline,
-        "cfg4_64k_16frame_frames_per_sec": cfg4["frames_per_sec_p50"],
-        "cfg4_ms_per_16frame_tick": cfg4["ms_per_tick_p50"],
-        "cfg4_stats": cfg4,
-        "fused_backend": fused_backend,
-        "cfg4_backend": cfg4["backend"],
-        "arena_frames_per_sec": arena["frames_per_sec_p50"],
-        "arena_ms_per_8frame_tick": arena["ms_per_tick_p50"],
-        "arena_stats": arena,
-        "arena_sharded_stats": arena_sharded,
-        "arena_fused_backend": arena["backend"],
-        "arena_parity_vs_oracle": arena_parity,
-        "arena_request_path": arena_request,
-        "swarm_frames_per_sec": swarm["frames_per_sec_p50"],
-        "swarm_ms_per_8frame_tick": swarm["ms_per_tick_p50"],
-        "swarm_stats": swarm,
-        "swarm_fused_backend": swarm["backend"],
-        "swarm_parity_vs_oracle": swarm_parity,
-        "parity_vs_oracle": parity,
-        "device": device,
-        "entities": ENTITIES,
-        "check_distance": CHECK_DISTANCE,
-        "batch_ticks": BATCH,
-    }
     # full results to a file; stdout gets ONE SHORT line the driver's tail
     # capture can always parse (r3's BENCH artifact recorded raw text
     # because the full line was truncated mid-JSON)
-    full_path = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "bench_full.json"
-    )
-    with open(full_path, "w") as f:
-        json.dump(full, f, indent=1)
-    print(
-        json.dumps(
-            {
-                "metric": full["metric"],
-                "value": full["value"],
-                "unit": full["unit"],
-                "vs_baseline": full["vs_baseline"],
-                "spread_pct": headline.get("spread_pct"),
-                "arena_fps_p50": arena["frames_per_sec_p50"],
-                "swarm_fps_p50": swarm["frames_per_sec_p50"],
-                "cfg4_fps_p50": cfg4["frames_per_sec_p50"],
-                "request_path_fps": round(request_rate, 1),
-                "p2p4_lazy16_fps": round(p2p4_lazy_rate, 1),
-                "interleaved_headline_fps_p50": interleaved["headline"][
-                    "frames_per_sec_p50"
-                ],
-                "interleaved_spread_pct": interleaved["headline"][
-                    "spread_pct"
-                ],
-                "beam_ab_delta_ms": beam_ab["rollback_p50_delta_ms"],
-                "beam_ab_wins": beam_ab["verdict"],
-                "history_b8_rate": history_b8["history_launch_rate"],
-                "parity": bool(parity and arena_parity and swarm_parity),
-                "full": "bench_full.json",
-            }
-        ),
-        flush=True,
-    )
+    _flush_full()
+    print(_short_line(), flush=True)
 
 
 if __name__ == "__main__":

@@ -29,6 +29,7 @@ from ..network.protocol import (
     PeerEndpoint,
 )
 from ..sync_layer import ConnectionStatus, PendingChecksumReport, SyncLayer
+from ..utils.tracing import GLOBAL_TRACER
 from ..types import (
     NULL_FRAME,
     AdvanceFrame,
@@ -156,7 +157,18 @@ class P2PSession:
         )
 
     def advance_frame(self) -> List[Request]:
-        """The per-tick pipeline (src/sessions/p2p_session.rs:253-371)."""
+        """The per-tick pipeline (src/sessions/p2p_session.rs:253-371).
+
+        The whole method is host work with no device dependency: under the
+        async dispatch pipeline it runs while the PREVIOUS tick's fused
+        rollback batch is still executing on device (the session/advance
+        and session/pump spans are the overlap phases — compare their
+        total against the backend's tpu/async_fence stalls to see how much
+        of the device time the host actually hid)."""
+        with GLOBAL_TRACER.span("session/advance"):
+            return self._advance_frame_impl()
+
+    def _advance_frame_impl(self) -> List[Request]:
         self.poll_remote_clients()
         if self.state != SessionState.RUNNING:
             raise NotSynchronized()
@@ -237,6 +249,13 @@ class P2PSession:
 
     def poll_remote_clients(self) -> None:
         """Message pump (src/sessions/p2p_session.rs:375-423)."""
+        # absolute: the pump runs both standalone (idle loop) and inside
+        # advance_frame's session/advance span — one stats row for both,
+        # so the documented pump-vs-async_fence comparison reads the total
+        with GLOBAL_TRACER.span("session/pump", absolute=True):
+            self._poll_remote_clients_impl()
+
+    def _poll_remote_clients_impl(self) -> None:
         if self._wire_dispatch is None:
             # all-native fast path: raw datagrams flow socket -> C++ endpoint
             # without touching the Python codec

@@ -105,7 +105,7 @@ class GameStateCell:
 
 
 class PendingChecksumReport:
-    """Deferred desync-detection report, shared by the Python and native P2P
+    """Deferred desync-detection reports, shared by the Python and native P2P
     sessions (p2p_session.py / native/session.py).
 
     Capture the *cell* at tick t; bind its checksum getter on the first
@@ -114,38 +114,70 @@ class PendingChecksumReport:
     (reading it in the same tick can publish a mid-correction checksum and
     raise false desyncs); then keep the getter, because getters are stable
     across later overwrites of the reused ring slot (GameStateCell
-    .checksum_getter) while the cell itself is not. Emit once the value is
-    host-ready; `force` bounds the delay to one desync interval."""
+    .checksum_getter) while the cell itself is not.
+
+    Multiple reports can be outstanding at once (a queue, not a single
+    slot): under the async dispatch pipeline a checksum may still be
+    in-flight on the device when the next observation interval arrives,
+    and the old single-slot design silently dropped the unflushed report.
+    Reports drain in capture (frame) order, emitting every host-ready
+    value in one pass; a not-yet-ready head starts a background prefetch
+    and stops the drain — nothing forces a device sync until `force`
+    bounds the delay to one desync interval. Reports whose ring slot was
+    reused before the first read are dropped, as before."""
+
+    # outstanding-report bound: ~two ring rotations of observations. Past
+    # it the oldest report's slot is long reused and it would drop at
+    # binding time anyway; the bound just keeps a never-flushing caller
+    # from accumulating cells.
+    MAX_PENDING = 16
 
     __slots__ = ("_pending",)
 
     def __init__(self) -> None:
-        self._pending = None
+        from collections import deque
+
+        self._pending = deque()
+
+    def __len__(self) -> int:
+        return len(self._pending)
 
     def capture(self, frame: Frame, cell: GameStateCell) -> None:
-        self._pending = (frame, cell, None)
+        self._pending.append([frame, cell, None])
+        while len(self._pending) > self.MAX_PENDING:
+            self._pending.popleft()
 
     def flush(self, force: bool, emit) -> None:
-        """emit(frame, checksum) is called at most once per captured report."""
-        pending = self._pending
-        if pending is None:
-            return
-        frame, cell, getter = pending
-        if getter is None:
-            if cell.frame != frame:  # ring slot reused before the first read
-                self._pending = None
+        """emit(frame, checksum) is called at most once per captured report,
+        in capture order."""
+        from collections import deque
+
+        # bind a getter for EVERY queued report first, not just the head:
+        # binding is cheap and non-blocking, getters are stable across
+        # later ring-slot reuse, and a younger report's slot can be
+        # overwritten while an older value is still in flight — binding
+        # lazily at the head would drop reports that were perfectly
+        # capturable when they queued
+        bound = deque()
+        for entry in self._pending:
+            frame, cell, getter = entry
+            if getter is None:
+                if cell.frame != frame:  # ring slot reused before first read
+                    continue
+                entry[2] = cell.checksum_getter()
+            bound.append(entry)
+        self._pending = bound
+        while self._pending:
+            frame, _cell, getter = self._pending[0]
+            if not force and not getattr(getter, "ready", True):
+                prefetch = getattr(getter, "prefetch", None)
+                if callable(prefetch):
+                    prefetch()
                 return
-            getter = cell.checksum_getter()
-            self._pending = (frame, cell, getter)
-        if not force and not getattr(getter, "ready", True):
-            prefetch = getattr(getter, "prefetch", None)
-            if callable(prefetch):
-                prefetch()
-            return
-        checksum = getter()
-        if checksum is not None:
-            emit(frame, checksum)
-        self._pending = None
+            self._pending.popleft()
+            checksum = getter()
+            if checksum is not None:
+                emit(frame, checksum)
 
 
 class SavedStates:

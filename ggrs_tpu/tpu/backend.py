@@ -255,11 +255,18 @@ class TpuRollbackBackend:
     VALUE_PROBE_INTERVAL = 24
     VALUE_PROBE_BURST = 3
 
+    # async_dispatch with lazy_ticks unset batches this many ticks per
+    # fused dispatch: deep enough to amortize the per-dispatch tunnel
+    # floor ~an order of magnitude, shallow enough that the live state
+    # lags the session by at most ~half a max_prediction window
+    ASYNC_DEFAULT_LAZY_TICKS = 8
+
     def __init__(self, game, max_prediction: int, num_players: int,
                  beam_width: int = 0, mesh=None, device_verify: bool = False,
                  speculation_gate: str = "always",
                  defer_speculation: bool = False, lazy_ticks: int = 0,
-                 spec_backend: str = "auto", tick_backend: str = "auto"):
+                 spec_backend: str = "auto", tick_backend: str = "auto",
+                 async_dispatch: bool = False, async_inflight: int = 2):
         """`mesh`: optional jax Mesh with an `entity` axis — the world and
         its snapshot ring shard across it (see ResimCore); the session-facing
         contract (requests in, SnapshotRefs + lazy checksums out) is
@@ -299,6 +306,38 @@ class TpuRollbackBackend:
         launch_pending_speculation(). The launch costs ~1ms of host time
         (candidate generation + dispatch), which a real-time loop should
         pay after presenting the frame, not before.
+
+        `async_dispatch`: the ASYNC DEVICE-RESIDENT DISPATCH PIPELINE.
+        Three coupled behaviors, all bit-identical to the eager path
+        (tests/test_async_dispatch.py is the proof):
+        (1) device residency — lazy_ticks defaults to
+        ASYNC_DEFAULT_LAZY_TICKS when unset, so the carry/state batch
+        stays on device across ticks and dispatches as fused multi-tick
+        programs; host protocol code keeps consuming the same lazy
+        checksum futures it already does, drained in batches only when a
+        SyncTest comparison or desync report actually reads a value.
+        (2) overlap — dispatches are fenced at `async_inflight` in-flight
+        batches (a small double-buffered carry at the default of 2): the
+        host runs the NEXT tick's message pump / input prediction /
+        request generation while the device executes the previous batch,
+        and only when a third batch would enter the window does the host
+        wait — on the OLDEST batch, not a full drain (the stall is
+        spanned as tpu/async_fence: it is exactly the device time the
+        pipeline failed to overlap). The fence also bounds how far the
+        dispatch queue can run ahead (an unfenced loop can queue seconds
+        of device work and then pay it all inside one blocking read).
+        Host-side staging (parse buffers, the flush's multi-tick row
+        buffer) rotates through async_inflight+1 pooled buffers instead
+        of allocating per tick — safe to reuse precisely because the
+        fence proves the dispatch that read a buffer has retired before
+        the pool rotates back to it.
+        (3) canonicalized dispatch signatures — request lists parse once
+        into packed control rows via signature-keyed plans (the parse
+        knows each row's last active slot, so branchless-variant routing
+        skips its rescan), and repeated rollback blocks
+        (Load + N x Save/Advance) of the same shape hit the same cached
+        jitted program; distinct signatures are counted in
+        dispatch_signatures for inspection.
 
         `lazy_ticks`: > 0 enables LAZY TICK BATCHING — ticks (rollbacks
         included) accumulate as packed control words on the host and
@@ -393,9 +432,34 @@ class TpuRollbackBackend:
         self.speculation_gate = speculation_gate
         self.defer_speculation = defer_speculation
         assert lazy_ticks >= 0
+        assert async_inflight >= 1
+        self.async_dispatch = async_dispatch
+        self.async_inflight = async_inflight
+        if async_dispatch and lazy_ticks == 0:
+            lazy_ticks = self.ASYNC_DEFAULT_LAZY_TICKS
         self.lazy_ticks = lazy_ticks
         self._tick_rows: List[np.ndarray] = []  # packed rows awaiting dispatch
         self._tick_future: Optional[_FutureChecksumBatch] = None
+        # async pipeline state: the in-flight dispatch fence (device result
+        # handles, oldest first) and the rotating host staging pools —
+        # parse triples reused every segment (they never escape: packing
+        # copies them into the dispatch row), multi-tick flush buffers
+        # reused only under the fence guarantee (they DO escape into the
+        # dispatch, where jax may alias aligned host memory)
+        from collections import deque as _deque
+
+        self._inflight: "_deque" = _deque()
+        self._stage_pool: Optional[list] = None
+        self._stage_flip = 0
+        self._multi_bufs: Optional[list] = None
+        self._multi_flip = 0
+        self._multi_active: Optional[np.ndarray] = None
+        self._multi_count = 0
+        self._pad_row: Optional[np.ndarray] = None
+        # canonicalized dispatch signatures observed (async bookkeeping /
+        # test hook): (has_load, advance_count, last_active, trailing?) ->
+        # dispatch count. Bounded: the grammar admits O(window^2) shapes.
+        self.dispatch_signatures: dict = {}
         self.beam_gated = 0  # ticks where the FULL-width launch was withheld
         # width-1 history-only launches (member 0: pinned history +
         # repeat-last). Under a beam-sharded mesh the minimal legal width
@@ -600,7 +664,42 @@ class TpuRollbackBackend:
             return hist
         return 0
 
-    def _run_segment(self, requests: List[Request]) -> None:
+    def _next_stage(self):
+        """Rotate the pooled (inputs, statuses, save_slots) parse triple.
+        The triple never reaches the device: every dispatch path copies it
+        host-side first — pack_tick_row/pack_tick_row_into for ticks,
+        adopt's own packed buffer for beam adoption — so reuse needs no
+        fence and is safe in eager mode too. The pool is
+        async_inflight + 1 deep only so the CURRENT segment's triple (read
+        by the beam bookkeeping until the tick ends) is never the one
+        being refilled; one spare would do, the depth just mirrors the
+        multi-buf pool."""
+        core = self.core
+        if self._stage_pool is None:
+            W, P, I = core.window, self.num_players, self.input_size
+            self._stage_pool = [
+                (
+                    np.zeros((W, P, I), dtype=np.uint8),
+                    np.zeros((W, P), dtype=np.int32),
+                    np.full((W,), core.scratch_slot, dtype=np.int32),
+                )
+                for _ in range(self.async_inflight + 1)
+            ]
+        self._stage_flip = (self._stage_flip + 1) % len(self._stage_pool)
+        inputs, statuses, save_slots = self._stage_pool[self._stage_flip]
+        inputs.fill(0)
+        statuses.fill(0)
+        save_slots.fill(core.scratch_slot)
+        return inputs, statuses, save_slots
+
+    def _parse_segment(self, requests: List[Request]):
+        """One pass over a request segment into packed-dispatch staging.
+        Returns (load, start_frame, count, inputs, statuses, save_slots,
+        saves, last_active): `last_active` is the row's 1-based last
+        active slot, handed to the core so branchless-variant routing
+        skips its save-slot rescan; the (shape-level) signature is tallied
+        in dispatch_signatures — repeated rollback blocks of one shape
+        reuse one cached jitted program."""
         load: Optional[LoadGameState] = None
         slots: List[Tuple[Optional[SaveGameState], AdvanceFrame]] = []
         pending_save: Optional[SaveGameState] = None
@@ -624,14 +723,12 @@ class TpuRollbackBackend:
         trailing_save = pending_save
 
         core = self.core
-        W, P, I = core.window, self.num_players, self.input_size
+        W = core.window
         count = len(slots)
         assert count <= core.max_prediction + 1, "tick exceeds the fused window"
         assert trailing_save is None or count < W
 
-        inputs = np.zeros((W, P, I), dtype=np.uint8)
-        statuses = np.zeros((W, P), dtype=np.int32)
-        save_slots = np.full((W,), core.scratch_slot, dtype=np.int32)
+        inputs, statuses, save_slots = self._next_stage()
 
         start_frame = load.frame if load is not None else self.current_frame
         saves: List[Tuple[int, SaveGameState]] = []
@@ -650,6 +747,45 @@ class TpuRollbackBackend:
             assert trailing_save.frame == start_frame + count
             save_slots[count] = trailing_save.frame % core.ring_len
             saves.append((count, trailing_save))
+
+        last_active = max(count, 1)
+        if saves:
+            last_active = max(last_active, saves[-1][0] + 1)
+        sig = (
+            load is not None,
+            count,
+            last_active,
+            trailing_save is not None,
+        )
+        self.dispatch_signatures[sig] = self.dispatch_signatures.get(sig, 0) + 1
+        return (
+            load, start_frame, count, inputs, statuses, save_slots, saves,
+            last_active,
+        )
+
+    def _note_inflight(self, handle) -> None:
+        """Fence an async dispatch: admit `handle` (any device array of the
+        dispatch's result) to the in-flight window; once a dispatch beyond
+        `async_inflight` would be outstanding, wait for the OLDEST — the
+        host stays one-to-two batches ahead of the device instead of
+        either running unboundedly ahead or draining after every batch.
+        No-op in eager mode (eager callers rely on jax's own queue)."""
+        if not self.async_dispatch:
+            return
+        self._inflight.append(handle)
+        GLOBAL_TRACER.mark("tpu/async_dispatch", absolute=True)
+        while len(self._inflight) > self.async_inflight:
+            oldest = self._inflight.popleft()
+            with GLOBAL_TRACER.span("tpu/async_fence", absolute=True):
+                jax.block_until_ready(oldest)
+
+    def _run_segment(self, requests: List[Request]) -> None:
+        with GLOBAL_TRACER.span("tpu/host_parse", absolute=True):
+            (
+                load, start_frame, count, inputs, statuses, save_slots,
+                saves, last_active,
+            ) = self._parse_segment(requests)
+        core = self.core
 
         his = los = None
         if load is not None:
@@ -693,7 +829,7 @@ class TpuRollbackBackend:
                 self.rollback_frames_adopted += matched
                 # adoption reads the ring: buffered ticks must land first
                 self.flush()
-                with GLOBAL_TRACER.span("tpu/beam_adopt"):
+                with GLOBAL_TRACER.span("tpu/beam_adopt", absolute=True):
                     his, los = core.adopt(
                         self._spec[2],
                         member,
@@ -706,6 +842,7 @@ class TpuRollbackBackend:
                         statuses=statuses,
                         matched=matched,
                     )
+                self._note_inflight(his)
             else:
                 self.beam_misses += 1
         batch = None
@@ -715,23 +852,44 @@ class TpuRollbackBackend:
             # multi-tick dispatch happens at flush() (buffer full or first
             # device-result need). Rollback rows buffer like any other —
             # the load executes in order inside the multi-tick scan.
-            row = core.pack_tick_row(
-                do_load=load is not None,
-                load_slot=(load.frame % core.ring_len) if load is not None else 0,
-                inputs=inputs,
-                statuses=statuses,
-                save_slots=save_slots,
-                advance_count=count,
-                start_frame=start_frame,
-            )
             if self._tick_future is None:
                 self._tick_future = _FutureChecksumBatch(self.flush)
             batch = self._tick_future
-            base_idx = len(self._tick_rows) * core.window
-            self._tick_rows.append(row)
+            if self.async_dispatch:
+                # pack straight into the pooled multi-tick buffer: no
+                # per-tick row allocation, no flush-time copy
+                buf = self._acquire_multi_buf()
+                base_idx = self._multi_count * core.window
+                core.pack_tick_row_into(
+                    buf[self._multi_count],
+                    do_load=load is not None,
+                    load_slot=(load.frame % core.ring_len)
+                    if load is not None
+                    else 0,
+                    inputs=inputs,
+                    statuses=statuses,
+                    save_slots=save_slots,
+                    advance_count=count,
+                    start_frame=start_frame,
+                )
+                self._multi_count += 1
+            else:
+                row = core.pack_tick_row(
+                    do_load=load is not None,
+                    load_slot=(load.frame % core.ring_len)
+                    if load is not None
+                    else 0,
+                    inputs=inputs,
+                    statuses=statuses,
+                    save_slots=save_slots,
+                    advance_count=count,
+                    start_frame=start_frame,
+                )
+                base_idx = len(self._tick_rows) * core.window
+                self._tick_rows.append(row)
         elif his is None:
-            with GLOBAL_TRACER.span("tpu/fused_tick"):
-                his, los = core.tick(
+            with GLOBAL_TRACER.span("tpu/fused_tick", absolute=True):
+                row = core.pack_tick_row(
                     do_load=load is not None,
                     load_slot=(load.frame % core.ring_len) if load is not None else 0,
                     inputs=inputs,
@@ -740,6 +898,8 @@ class TpuRollbackBackend:
                     advance_count=count,
                     start_frame=start_frame,
                 )
+                his, los = core.tick_row(row, last_active)
+            self._note_inflight(his)
         self.current_frame = start_frame + count
 
         if batch is None:
@@ -749,7 +909,7 @@ class TpuRollbackBackend:
             save.cell.save_lazy(
                 save.frame, ref, _LazyChecksum(batch, base_idx + idx)
             )
-        if self._tick_rows and len(self._tick_rows) >= self.lazy_ticks:
+        if len(self._tick_rows) + self._multi_count >= self.lazy_ticks > 0:
             self.flush()
 
         if self.beam_width:
@@ -764,7 +924,10 @@ class TpuRollbackBackend:
                 and load.frame <= self._spec[0]
             ):
                 self._spec = None
-            self._last_segment = (load, start_frame, count, inputs, statuses)
+            # only the shape survives the tick (the staging triple is
+            # pooled and will be reused): the deferred launch needs the
+            # frontier frame and count, nothing from the input rows
+            self._last_segment = (load, start_frame, count)
             if load is not None:
                 self._depth = count  # observed rollback depth
             for f in range(count):
@@ -876,21 +1039,57 @@ class TpuRollbackBackend:
         then pays the one-tick program, not the T-deep scan, and never a
         mid-session compile."""
         rows, future = self._tick_rows, self._tick_future
-        if not rows:
+        n_staged = self._multi_count
+        if not rows and not n_staged:
             return
         self._tick_rows = []
         self._tick_future = None
         core = self.core
-        if len(rows) == 1:
-            with GLOBAL_TRACER.span("tpu/fused_tick"):
+        if n_staged:  # async: rows were packed straight into the pool
+            buf = self._multi_active
+            self._multi_active = None
+            self._multi_count = 0
+            if n_staged == 1:
+                with GLOBAL_TRACER.span("tpu/fused_tick", absolute=True):
+                    his, los = core.tick_row(buf[0])
+            else:
+                buf[n_staged:] = self._pad_row
+                with GLOBAL_TRACER.span("tpu/fused_multi_tick", absolute=True):
+                    his, los = core.tick_multi(buf)
+        elif len(rows) == 1:
+            with GLOBAL_TRACER.span("tpu/fused_tick", absolute=True):
                 his, los = core.tick_row(rows[0])
         else:
+            # eager mode has no fence bounding when a dispatch's read of
+            # host memory retires (jax may alias aligned buffers), so the
+            # staging is allocated fresh per flush
             buf = np.tile(core.pad_tick_row(), (self.lazy_ticks, 1))
             for j, r in enumerate(rows):
                 buf[j] = r
-            with GLOBAL_TRACER.span("tpu/fused_multi_tick"):
+            with GLOBAL_TRACER.span("tpu/fused_multi_tick", absolute=True):
                 his, los = core.tick_multi(buf)
+        self._note_inflight(his)
         future.batch = _ChecksumBatch(his, los, self.ledger)
+
+    def _acquire_multi_buf(self) -> np.ndarray:
+        """The active [lazy_ticks, L] staging buffer the async lazy path
+        packs tick rows into directly (pack_tick_row_into). Rotates
+        async_inflight + 1 pooled buffers — reuse is safe because the
+        fence proves the dispatch that read a buffer retired before the
+        pool comes back around. Rows past the staged count keep stale
+        bytes until flush() pads the tail."""
+        if self._multi_active is not None:
+            return self._multi_active
+        if self._multi_bufs is None:
+            pad = self.core.pad_tick_row()
+            self._multi_bufs = [
+                np.tile(pad, (self.lazy_ticks, 1))
+                for _ in range(self.async_inflight + 1)
+            ]
+            self._pad_row = pad
+        self._multi_flip = (self._multi_flip + 1) % len(self._multi_bufs)
+        self._multi_active = self._multi_bufs[self._multi_flip]
+        return self._multi_active
 
     def _ranked_predictions(self, anchor: Frame, rollout: int, width: int):
         """Likelihood-ranked (player, offset, value_row) switch specs for
@@ -945,7 +1144,6 @@ class TpuRollbackBackend:
 
     def _launch_speculation(self, load: Optional[LoadGameState],
                             start_frame: Frame, count: int,
-                            inputs: np.ndarray, statuses: np.ndarray,
                             width: Optional[int] = None) -> None:
         """Anchor one frame DEEPER than the observed rollback depth
         predicts for the next tick, so the next load lands at shift 1 and
@@ -1032,7 +1230,7 @@ class TpuRollbackBackend:
         beam_statuses = np.zeros(
             (width, rollout, self.num_players), dtype=np.int32
         )
-        with GLOBAL_TRACER.span("tpu/beam_speculate"):
+        with GLOBAL_TRACER.span("tpu/beam_speculate", absolute=True):
             spec = core.speculate(anchor % core.ring_len, beam_inputs, beam_statuses)
         self._spec = (anchor, beam_inputs, spec)
         self._spec_consulted = False
@@ -1054,6 +1252,8 @@ class TpuRollbackBackend:
         self.core.reset()
         self.current_frame = 0
         self.ledger = ChecksumLedger()
+        self._inflight.clear()
+        self.dispatch_signatures.clear()
         self._spec = None
         self._last_segment = None
         self.beam_hits = 0
@@ -1229,6 +1429,7 @@ class TpuRollbackBackend:
     def block_until_ready(self) -> None:
         self.flush()
         jax.block_until_ready(self.core.state)
+        self._inflight.clear()  # everything older than the state retired
 
     # ------------------------------------------------------------------
     # durable checkpoint/resume (beyond the reference, SURVEY.md §5)
@@ -1260,6 +1461,8 @@ class TpuRollbackBackend:
                 # that saved it, not silently revert to defaults (r3
                 # advisor)
                 "lazy_ticks": self.lazy_ticks,
+                "async_dispatch": self.async_dispatch,
+                "async_inflight": self.async_inflight,
                 "speculation_gate": self.speculation_gate,
                 "defer_speculation": self.defer_speculation,
                 "spec_backend": self.core.spec_backend,
@@ -1293,6 +1496,8 @@ class TpuRollbackBackend:
             mesh=mesh,
             device_verify=meta.get("device_verify", False),
             lazy_ticks=meta.get("lazy_ticks", 0),
+            async_dispatch=meta.get("async_dispatch", False),
+            async_inflight=meta.get("async_inflight", 2),
             speculation_gate=meta.get("speculation_gate", "always"),
             defer_speculation=meta.get("defer_speculation", False),
             spec_backend=_backend_knob("spec_backend"),

@@ -177,7 +177,19 @@ class InputHistoryModel:
         rank sort lets one player's smear crowd every other player out of
         the beam entirely (measured: a 4-player staggered toggle lost a
         third of its adoptions that way). The caller composes the specs
-        into beam members (beam.branching_beam's prediction stream)."""
+        into beam members (beam.branching_beam's prediction stream).
+
+        APPROXIMATION NOTE: the score uses the raw hazard h(run + d - 1)
+        alone — the exact switch-at-offset-d probability is that hazard
+        times the survival product over the intervening frames,
+        prod(1 - h(t)) for t in [run, run + d - 1). Dropping the survival
+        factor biases scores toward LATER offsets whenever hazard rises
+        with hold length (the product shrinks as d grows, and later
+        offsets skip more of it). Ranking-only — adoption correctness
+        never depends on it, and the round-robin allocation plus
+        MAX_SPECS_PER_PLAYER bound the damage to spec ordering within one
+        player; multiply in the survival product if ranking quality on
+        long rollouts ever matters."""
         per_player: List[List[Tuple[float, int, int, bytes]]] = []
         for p in range(self.num_players):
             if confirmed[p] is None:

@@ -750,8 +750,35 @@ class ShardedPallasTiledCore:
         # count an outer jit could never see (sync_test honors
         # self_jitting by not wrapping batch)
         self.self_jitting = self.reduce_mode
+        # host-side frame counter DRIVING PROGRAM SELECTION for the
+        # self-jitting reduce path: once it passes d, batch() dispatches
+        # the steady-state (cond-free) program, whose rolling reduction
+        # table assumes every frame in the batch is >= d. It therefore
+        # MUST track the carry's frame: reusing this core with a fresh or
+        # restored carry without reset() would select the wrong program
+        # and emit wrong checksums with no error (the owning session
+        # asserts the two counters agree before every dispatch).
         self._frames_seen = 0
         self._programs: Dict[Any, Any] = {}
+
+    def reset(self, start_frame: int = 0) -> None:
+        """Re-arm program selection for a fresh or restored carry whose
+        frame is `start_frame`: compiled programs survive (they are keyed
+        on (batch length, boot?) and carry no frame state), only the
+        host-side frame counter rewinds. Call whenever a new carry is
+        installed into a reused core — a fresh carry under a stale
+        steady-state selection would roll a reduction table whose base the
+        boot phase never pinned, silently corrupting checksums."""
+        assert start_frame >= 0
+        self._frames_seen = start_frame
+
+    @property
+    def frames_seen(self) -> int:
+        """Frames this core has dispatched (or was reset() to): the owning
+        session cross-checks it against its own frame counter so a
+        core/carry mismatch trips an assertion instead of selecting the
+        wrong program."""
+        return self._frames_seen
 
     def _carry_specs(self, carry):
         from jax.sharding import PartitionSpec as P
@@ -871,7 +898,9 @@ class ShardedPallasTiledCore:
             (carry, _red), _ = jax.lax.scan(tick, (carry, red0), inputs)
             return carry
 
-        shard_fn = jax.shard_map(
+        from ..parallel.sharded import shard_map as _shard_map
+
+        shard_fn = _shard_map(
             body,
             mesh=self.mesh,
             in_specs=(specs, P()),

@@ -439,19 +439,24 @@ class ResimCore:
         )
         return ring, state, verify, his, los
 
-    def _branchless_nslots(self, row: np.ndarray) -> int:
+    def _branchless_nslots(
+        self, row: np.ndarray, last_active: Optional[int] = None
+    ) -> int:
         """Smallest coalesced variant covering the row's last active slot
-        (its advance count and its highest real save)."""
-        save_slots = np.asarray(row[self._off_save : self._off_status])
-        active = max(int(row[2]), 1)
-        valid = np.nonzero(save_slots < self.ring_len)[0]
-        if valid.size:
-            active = max(active, int(valid[-1]) + 1)
+        (its advance count and its highest real save). `last_active` is the
+        caller's precomputed 1-based last active slot (the backend's parse
+        already knows it), skipping the save-slot rescan."""
+        if last_active is None:
+            save_slots = np.asarray(row[self._off_save : self._off_status])
+            last_active = max(int(row[2]), 1)
+            valid = np.nonzero(save_slots < self.ring_len)[0]
+            if valid.size:
+                last_active = max(last_active, int(valid[-1]) + 1)
         for v in self.branchless_variants():
-            if v >= active:
+            if v >= last_active:
                 return v
         raise AssertionError(
-            f"no variant covers {active} slots (variants end in window)"
+            f"no variant covers {last_active} slots (variants end in window)"
         )
 
     def _pallas_t1(self) -> bool:
@@ -465,9 +470,13 @@ class ResimCore:
             and n >= self.PALLAS_T1_MIN_ENTITIES
         )
 
-    def tick_row(self, row: np.ndarray) -> Tuple[Any, Any]:
+    def tick_row(
+        self, row: np.ndarray, last_active: Optional[int] = None
+    ) -> Tuple[Any, Any]:
         """One packed tick row through the (warmup-compiled) single-tick
-        program; returns (checksum_hi[W], checksum_lo[W])."""
+        program; returns (checksum_hi[W], checksum_lo[W]). `last_active`
+        (optional) is the row's 1-based last active slot, precomputed by
+        the backend's parse so variant routing skips a save-slot rescan."""
         if self._pallas_t1():
             self.ring, self.state, self.verify, his, los = (
                 self._tick_pallas_fn(
@@ -484,7 +493,7 @@ class ResimCore:
             self.ring, self.state, self.verify, his, los = (
                 self._tick_branchless_fn(
                     self.ring, self.state, row, self.verify,
-                    self._branchless_nslots(row),
+                    self._branchless_nslots(row, last_active),
                 )
             )
             return his, los
@@ -621,14 +630,36 @@ class ResimCore:
         layout) — dispatched alone by tick() or buffered for a multi-tick
         dispatch by the backend's lazy batching."""
         packed = np.empty((self._packed_len,), dtype=np.int32)
-        packed[0] = 1 if do_load else 0
-        packed[1] = load_slot
-        packed[2] = advance_count
-        packed[3] = start_frame
-        packed[self._off_save : self._off_status] = save_slots
-        packed[self._off_status : self._off_input] = statuses.reshape(-1)
-        packed[self._off_input :] = inputs.reshape(-1)
+        self.pack_tick_row_into(
+            packed, do_load, load_slot, inputs, statuses, save_slots,
+            advance_count, start_frame,
+        )
         return packed
+
+    def pack_tick_row_into(
+        self,
+        out: np.ndarray,
+        do_load: bool,
+        load_slot: int,
+        inputs: np.ndarray,
+        statuses: np.ndarray,
+        save_slots: np.ndarray,
+        advance_count: int,
+        start_frame: int = 0,
+    ) -> np.ndarray:
+        """pack_tick_row writing into a caller-owned buffer. The async
+        dispatch pipeline stages rows in a small rotating pool instead of
+        allocating per tick; the buffer handed to a dispatch must not be
+        reused until that dispatch's slot rotates back around (the backend's
+        double-buffering guarantees it)."""
+        out[0] = 1 if do_load else 0
+        out[1] = load_slot
+        out[2] = advance_count
+        out[3] = start_frame
+        out[self._off_save : self._off_status] = save_slots
+        out[self._off_status : self._off_input] = statuses.reshape(-1)
+        out[self._off_input :] = inputs.reshape(-1)
+        return out
 
     def pad_tick_row(self) -> np.ndarray:
         """A true no-op tick row (no load, zero advances, scratch-only
@@ -1027,6 +1058,21 @@ class ResimCore:
             if matched == advance_count and self._adopt_full_fn is not None
             else self._adopt_fn
         )
+        if fn is self._adopt_full_fn:
+            # contract guard: _adopt_full_impl sources EVERY saved slot's
+            # checksum from the speculation window (his_w[i]), while
+            # _adopt_impl computes fresh checksums for slots past
+            # `matched`. The two are bit-identical only because no caller
+            # requests a real save past advance_count on a full hit — a
+            # caller violating that would get speculation checksums for
+            # frames the speculation never covered, silently.
+            assert (
+                save_slots[advance_count + 1 :] >= self.ring_len
+            ).all(), (
+                "full-hit adoption requires every save slot past "
+                "advance_count to be scratch (speculation checksums do "
+                "not cover frames beyond the adopted window)"
+            )
         self.ring, self.state, self.verify, his, los = fn(
             self.ring, traj, spec_his, spec_los, a_hi, a_lo, self.verify,
             packed,

@@ -154,6 +154,7 @@ class TpuSyncTestSession:
             self.carry = None
         else:
             self._build_initial_carry()
+        self._core = None  # kernel core owning host-side program selection
         if backend == "xla":
             self._batch_fn = jax.jit(self._batch_impl, donate_argnums=(0,))
         elif backend.startswith("pallas-tiled"):
@@ -184,6 +185,7 @@ class TpuSyncTestSession:
                 if getattr(core, "self_jitting", False)
                 else jax.jit(core.batch, donate_argnums=(0,))
             )
+            self._core = core
         else:
             from .pallas_core import PallasSyncTestCore
 
@@ -329,6 +331,18 @@ class TpuSyncTestSession:
             )
         else:
             eff = np.asarray(raw_inputs, dtype=np.uint8)
+        if self._core is not None and getattr(self._core, "self_jitting", False):
+            # the reduce-injection core picks its boot/steady program from
+            # a HOST frame counter: a drift from the carry's frame (core
+            # reused with a fresh carry, restored checkpoint without
+            # reset()) would select the steady program for a boot-phase
+            # carry and roll a reduction table whose base was never
+            # pinned — wrong checksums, no error. Trip here instead.
+            assert self._core.frames_seen == self.current_frame, (
+                f"core program-selection counter ({self._core.frames_seen}) "
+                f"out of sync with the session frame ({self.current_frame}); "
+                "call core.reset(start_frame) when installing a new carry"
+            )
         self.carry = self._batch_fn(self.carry, jnp.asarray(eff))
         self.current_frame += t
         self._ticks_since_flush += t
@@ -391,5 +405,10 @@ class TpuSyncTestSession:
         )
         sess.carry = _jax.device_put(tree)
         sess.current_frame = meta["current_frame"]
+        if sess._core is not None and hasattr(sess._core, "reset"):
+            # re-arm host-side program selection to the restored carry's
+            # frame (the reduce-injection core would otherwise boot-select
+            # for a mid-run carry, or worse on later re-restores)
+            sess._core.reset(meta["current_frame"])
         sess._raw_inputs = [np.asarray(r, dtype=np.uint8) for r in meta["raw_inputs"]]
         return sess

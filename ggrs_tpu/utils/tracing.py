@@ -65,12 +65,33 @@ class Tracer:
 
             self._annotation_cls = jax.profiler.TraceAnnotation
 
+    def mark(self, name: str, n: int = 1, absolute: bool = False) -> None:
+        """Count an event with no duration (e.g. an async dispatch entering
+        or leaving the in-flight window). Shares the stats table with
+        span(): a mark's row reports count only (zero time), so the async
+        pipeline's occupancy counters line up with its stall spans in one
+        report. `absolute` as in span()."""
+        if not self.enabled:
+            return
+        if absolute:
+            path = name
+        else:
+            path = ("/".join(self._stack + [name])) if self._stack else name
+        self.stats[path].count += n
+
     @contextmanager
-    def span(self, name: str) -> Iterator[None]:
+    def span(self, name: str, absolute: bool = False) -> Iterator[None]:
+        """`absolute` records under `name` alone regardless of the active
+        span stack — for phases reached through multiple parents (e.g. the
+        P2P message pump, called both standalone and inside the advance
+        span) whose totals must land in ONE stats row to be comparable."""
         if not self.enabled:
             yield
             return
-        path = ("/".join(self._stack + [name])) if self._stack else name
+        if absolute:
+            path = name
+        else:
+            path = ("/".join(self._stack + [name])) if self._stack else name
         annotation = None
         if self._xprof and self._annotation_cls is not None:
             # shows up as a named region in xprof / TensorBoard profiles,
