@@ -1642,6 +1642,39 @@ def bench_p2p4_rollback(rounds=12, burst=12, lazy_ticks=0, mesh_devices=0,
     return (rounds * burst) / elapsed, median_s * 1000.0, breakdown
 
 
+# --telemetry (set in main): each phase subprocess enables the session
+# telemetry subsystem and appends its snapshot to bench_telemetry.json, so
+# a perf regression ships with its counters (rollback depths, fence
+# stalls, plan-cache misses, per-peer wire stats) attached
+_TELEMETRY = False
+_TELEMETRY_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_telemetry.json"
+)
+
+
+def _obs_enable():
+    """Called inside a phase subprocess (see _run_phase)."""
+    from ggrs_tpu.obs import enable_global_telemetry
+
+    enable_global_telemetry()
+
+
+def _obs_flush_phase(name):
+    """Append this phase's telemetry snapshot to bench_telemetry.json —
+    one key per phase expression, merged across the sequential phase
+    subprocesses of a single bench run."""
+    from ggrs_tpu.obs import GLOBAL_TELEMETRY
+
+    try:
+        with open(_TELEMETRY_PATH) as f:
+            merged = json.load(f)
+    except (OSError, ValueError):
+        merged = {}
+    merged[name] = GLOBAL_TELEMETRY.snapshot()
+    with open(_TELEMETRY_PATH, "w") as f:
+        json.dump(merged, f, indent=1)
+
+
 def _run_phase(expr, timeout_s=480):
     """Run one bench phase in its own (sequential) subprocess: the tunneled
     device's dispatch latency degrades measurably across a long-lived
@@ -1650,8 +1683,16 @@ def _run_phase(expr, timeout_s=480):
     import subprocess
     import sys
 
+    if _TELEMETRY:
+        prog = (
+            "import json, bench; bench._obs_enable(); "
+            f"_r = bench.{expr}; bench._obs_flush_phase({expr!r}); "
+            "print('@@' + json.dumps(_r))"
+        )
+    else:
+        prog = f"import json, bench; print('@@' + json.dumps(bench.{expr}))"
     proc = subprocess.run(
-        [sys.executable, "-c", f"import json, bench; print('@@' + json.dumps(bench.{expr}))"],
+        [sys.executable, "-c", prog],
         capture_output=True,
         text=True,
         cwd=os.path.dirname(os.path.abspath(__file__)),
@@ -1679,10 +1720,21 @@ def main():
     # bench_full.json and summarizes what landed. SIGTERM is what
     # `timeout` and most supervisors send first; SIGKILL can't be helped.
     import signal
+    import sys
+
+    global _TELEMETRY
+    _TELEMETRY = "--telemetry" in sys.argv
+    if _TELEMETRY:
+        # fresh file per run: phases append into it as they complete
+        try:
+            os.remove(_TELEMETRY_PATH)
+        except OSError:
+            pass
 
     full = {
         "metric": "rollback-frames resimulated/sec "
                   "(8-frame window, 4k-entity state)",
+        "telemetry": "bench_telemetry.json" if _TELEMETRY else None,
         "value": None,
         "unit": "frames/sec",
         "vs_baseline": None,

@@ -18,8 +18,10 @@ from .errors import (
     NotSynchronized,
     PredictionThreshold,
     SpectatorTooFarBehind,
+    StatsWindowTooYoung,
 )
 from .frame_info import GameState, PlayerInput
+from .obs import GLOBAL_TELEMETRY, Telemetry, enable_global_telemetry
 from .sessions.builder import SessionBuilder
 from .sync_layer import ConnectionStatus, GameStateCell
 from .types import (
@@ -53,6 +55,7 @@ __all__ = [
     "Disconnected",
     "Frame",
     "GGRSError",
+    "GLOBAL_TELEMETRY",
     "GameState",
     "GameStateCell",
     "InputStatus",
@@ -70,7 +73,10 @@ __all__ = [
     "SessionBuilder",
     "SessionState",
     "SpectatorTooFarBehind",
+    "StatsWindowTooYoung",
     "Synchronized",
     "Synchronizing",
+    "Telemetry",
     "WaitRecommendation",
+    "enable_global_telemetry",
 ]

@@ -38,6 +38,14 @@ class NotSynchronized(GGRSError):
     (src/error.rs:27)."""
 
 
+class StatsWindowTooYoung(NotSynchronized):
+    """network_stats() was called before the first full second of the stats
+    window elapsed — the kbps figures would divide by zero. A subclass of
+    NotSynchronized so existing catch-all callers keep working, but
+    distinguishable: the endpoint IS synchronized, just too fresh to
+    report rates."""
+
+
 class SpectatorTooFarBehind(GGRSError):
     """The spectator fell further behind the host than its input buffer can
     cover; catching up is impossible (src/error.rs:29)."""

@@ -13,14 +13,26 @@ from __future__ import annotations
 from typing import List, Tuple
 
 from .frame_info import PlayerInput
+from .obs import GLOBAL_TELEMETRY
 from .types import NULL_FRAME, Frame, InputStatus
 
 INPUT_QUEUE_LENGTH = 128
 
 
 class InputQueue:
+    # player handle for telemetry labels; stamped by SyncLayer after
+    # construction (the queue itself has no notion of its owner)
+    obs_player = -1
+
     def __init__(self, input_size: int):
         self.input_size = input_size
+        self._m_pred = None  # lazily bound: obs_player is stamped post-init
+        # queue-local prediction tallies, always on (two int adds): the
+        # session's per-player accuracy comes from THESE, not the global
+        # labeled counters — multiple sessions in one process share the
+        # registry's player labels, but each session owns its queues
+        self.predictions_served = 0
+        self.mispredictions = 0
         self.head = 0
         self.tail = 0
         self.length = 0
@@ -99,7 +111,28 @@ class InputQueue:
             )
 
         assert self.prediction.frame != NULL_FRAME
+        self.predictions_served += 1
+        if GLOBAL_TELEMETRY.enabled:
+            self._obs().inc()
         return self.prediction.buf, InputStatus.PREDICTED
+
+    def _obs(self):
+        """Bound prediction/misprediction counters for this player; bound
+        on first use because obs_player is stamped after construction."""
+        if self._m_pred is None:
+            label = str(self.obs_player)
+            reg = GLOBAL_TELEMETRY.registry
+            self._m_pred = reg.counter(
+                "ggrs_predictions_total",
+                "predicted input frames served, per player",
+                ("player",),
+            ).labels(label)
+            self._m_mispred = reg.counter(
+                "ggrs_mispredictions_total",
+                "mispredicted frames detected on late real input, per player",
+                ("player",),
+            ).labels(label)
+        return self._m_pred
 
     def add_input(self, inp: PlayerInput) -> Frame:
         """Add the next sequential input; returns the frame it landed on after
@@ -140,6 +173,18 @@ class InputQueue:
                 )
             ):
                 self.first_incorrect_frame = frame_number
+                self.mispredictions += 1
+                tel = GLOBAL_TELEMETRY
+                if tel.enabled:
+                    self._obs()
+                    self._m_mispred.inc()
+                    tel.record(
+                        "misprediction",
+                        frame=frame_number,
+                        player=self.obs_player,
+                        predicted=self.prediction.buf,
+                        actual=inp.buf,
+                    )
 
             # Exit prediction mode once real input caught up with requests
             # without any misprediction; otherwise keep predicting forward.

@@ -243,6 +243,24 @@ def _csum_bytes(checksum: int) -> bytes:
 class _NativeSessionBase:
     """Shared plumbing: lifecycle, cell ring, request/event conversion."""
 
+    def telemetry(self) -> dict:
+        """One structured snapshot, parity with the Python sessions'
+        telemetry(). The native core keeps its own internal counters, so
+        the session section here is the ctypes-visible surface only; the
+        process-wide metrics/recorder/tracer sections are identical."""
+        from ..obs import GLOBAL_TELEMETRY
+
+        snap = GLOBAL_TELEMETRY.snapshot()
+        section = {"type": f"native_{type(self).__name__}"}
+        for attr in ("current_frame", "last_saved_frame", "confirmed_frame"):
+            try:
+                value = getattr(self, attr, None)
+                section[attr] = int(value() if callable(value) else value)
+            except Exception:
+                pass
+        snap["session"] = section
+        return snap
+
     def __init__(
         self,
         num_players: int,

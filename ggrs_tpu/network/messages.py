@@ -150,6 +150,14 @@ class DecodeError(ValueError):
 
 
 def decode_message(buf: bytes) -> Message:
+    msg = _decode_message_body(buf)
+    # stamp the wire bytes: received-byte accounting (NetworkStats
+    # kbps_recv) then costs a len(), not a re-encode, per packet
+    msg._wire = bytes(buf)
+    return msg
+
+
+def _decode_message_body(buf: bytes) -> Message:
     if len(buf) < _HEADER.size:
         raise DecodeError("short packet")
     magic, body_type = _HEADER.unpack_from(buf, 0)

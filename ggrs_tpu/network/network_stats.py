@@ -1,4 +1,12 @@
-"""Connection-quality observability (reference: src/network/network_stats.rs)."""
+"""Connection-quality observability (reference: src/network/network_stats.rs).
+
+Extended over the reference with the receive direction and link-quality
+estimates: `kbps_recv` (received payload + UDP header bytes over the stats
+window), `jitter_ms` (RFC 3550-style EWMA of RTT variation) and
+`packets_lost` (estimated from gaps in the peer's fixed-cadence
+quality-report stream — no wire-format change, so Python and native C++
+peers interoperate unchanged).
+"""
 
 from __future__ import annotations
 
@@ -12,3 +20,7 @@ class NetworkStats:
     kbps_sent: int = 0
     local_frames_behind: int = 0
     remote_frames_behind: int = 0
+    # receive direction + link-quality estimates (beyond the reference)
+    kbps_recv: int = 0
+    jitter_ms: int = 0
+    packets_lost: int = 0

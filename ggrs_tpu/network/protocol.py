@@ -18,8 +18,9 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
-from ..errors import NotSynchronized
+from ..errors import NotSynchronized, StatsWindowTooYoung
 from ..frame_info import PlayerInput
+from ..obs import GLOBAL_TELEMETRY
 from ..sync_layer import ConnectionStatus
 from ..time_sync import TimeSync
 from ..types import NULL_FRAME, Frame, PlayerHandle
@@ -159,7 +160,7 @@ class PeerEndpoint:
             NULL_FRAME: bytes(input_size * len(self.handles))
         }
 
-        self.time_sync = TimeSync()
+        self.time_sync = TimeSync(peer_label=str(peer_addr))
         self.local_frame_advantage = 0
         self.remote_frame_advantage = 0
 
@@ -170,6 +171,51 @@ class PeerEndpoint:
         self.last_send_time = now
         self.last_recv_time = now
         self.last_sync_request_time = now
+
+        # receive direction + link-quality estimators (NetworkStats
+        # kbps_recv / jitter_ms / packets_lost). Plain fields are always
+        # maintained — integer adds, cheap enough to never gate; the
+        # registry mirrors below only move behind GLOBAL_TELEMETRY.enabled.
+        self.packets_recv = 0
+        self.bytes_recv = 0
+        # RFC 3550-style interarrival jitter over RTT samples:
+        # J += (|D| - J) / 16 per quality reply
+        self.jitter_ms = 0.0
+        self._last_rtt_sample: Optional[int] = None
+        # loss estimate from sequence gaps in the peer's quality-report
+        # stream: reports carry the sender's strictly-increasing clock and
+        # fire on a fixed 200ms cadence, so a gap of k intervals means
+        # k - 1 reports never arrived. No wire change — native C++ peers
+        # speak the identical format.
+        self.packets_lost = 0
+        self._last_quality_ping: Optional[int] = None
+
+        # pre-bound telemetry children (valid across Telemetry.reset());
+        # creation is a few dict entries, so it is not gated on enabled
+        _label = str(peer_addr)
+        _reg = GLOBAL_TELEMETRY.registry
+        self._m_packets_sent = _reg.counter(
+            "ggrs_peer_packets_sent_total", "packets queued to this peer", ("peer",)
+        ).labels(_label)
+        self._m_bytes_sent = _reg.counter(
+            "ggrs_peer_bytes_sent_total", "wire payload bytes queued to this peer", ("peer",)
+        ).labels(_label)
+        self._m_packets_recv = _reg.counter(
+            "ggrs_peer_packets_recv_total", "packets accepted from this peer", ("peer",)
+        ).labels(_label)
+        self._m_bytes_recv = _reg.counter(
+            "ggrs_peer_bytes_recv_total", "wire payload bytes accepted from this peer", ("peer",)
+        ).labels(_label)
+        self._m_rtt = _reg.gauge(
+            "ggrs_peer_rtt_ms", "last round-trip time to this peer", ("peer",)
+        ).labels(_label)
+        self._m_jitter = _reg.gauge(
+            "ggrs_peer_jitter_ms", "EWMA RTT jitter to this peer (RFC 3550 style)", ("peer",)
+        ).labels(_label)
+        self._m_lost = _reg.counter(
+            "ggrs_peer_packets_lost_total",
+            "packets estimated lost from quality-report sequence gaps", ("peer",)
+        ).labels(_label)
 
         self.checksum_history: Dict[Frame, int] = {}
         self.last_added_checksum_frame: Frame = NULL_FRAME
@@ -355,7 +401,11 @@ class PeerEndpoint:
         self.last_send_time = self.clock.now_ms()
         from .messages import encode_message
 
-        self.bytes_sent += len(encode_message(msg))
+        wire_len = len(encode_message(msg))
+        self.bytes_sent += wire_len
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_packets_sent.inc()
+            self._m_bytes_sent.inc(wire_len)
         self.send_queue.append(msg)
 
     # ------------------------------------------------------------------
@@ -369,6 +419,16 @@ class PeerEndpoint:
         if self.remote_magic != 0 and msg.magic != self.remote_magic:
             return
         self.last_recv_time = self.clock.now_ms()
+        # wire-decoded messages carry their bytes (decode_message stamps
+        # _wire); hand-built ones (tests) pay one cached encode
+        from .messages import encode_message
+
+        wire_len = len(msg._wire) if msg._wire is not None else len(encode_message(msg))
+        self.packets_recv += 1
+        self.bytes_recv += wire_len
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_packets_recv.inc()
+            self._m_bytes_recv.inc(wire_len)
         if self.disconnect_notify_sent and self.state == ProtocolState.RUNNING:
             self.disconnect_notify_sent = False
             self.event_queue.append(EvNetworkResumed())
@@ -494,6 +554,24 @@ class PeerEndpoint:
 
     def _on_quality_report(self, body: QualityReport) -> None:
         self.remote_frame_advantage = body.frame_advantage
+        # packet-loss estimate from sequence gaps: the peer's reports fire
+        # every QUALITY_REPORT_INTERVAL_MS carrying its strictly-increasing
+        # clock, so a ping-gap of k intervals means k - 1 reports (and
+        # statistically the same fraction of all its traffic) were dropped.
+        # ping is network-controlled: ignore non-monotonic values outright.
+        if self._last_quality_ping is not None and body.ping > self._last_quality_ping:
+            gap = body.ping - self._last_quality_ping
+            # floor, not round: reports fire on the sender's poll at >=200ms,
+            # so a slow-polling peer (e.g. 300ms cadence) stretches gaps to
+            # 1.5 intervals with zero real loss — flooring forgives that
+            # quantization while a genuinely dropped report (>=2 intervals)
+            # still counts
+            missed = gap // QUALITY_REPORT_INTERVAL_MS - 1
+            if missed > 0:
+                self.packets_lost += missed
+                if GLOBAL_TELEMETRY.enabled:
+                    self._m_lost.inc(missed)
+        self._last_quality_ping = max(self._last_quality_ping or 0, body.ping)
         self._queue_message(QualityReply(pong=body.ping))
 
     def _on_quality_reply(self, body: QualityReply) -> None:
@@ -502,6 +580,17 @@ class PeerEndpoint:
         # crafted packet) must not produce a negative RTT or crash the
         # session (parity with the C++ endpoint, endpoint.cpp)
         self.round_trip_time = now - body.pong if now >= body.pong else 0
+        # RFC 3550-style jitter over consecutive RTT samples; the first
+        # sample only seeds the baseline (comparing against the initial 0
+        # would inject a phantom |RTT|/16 spike on every fresh connection)
+        if self._last_rtt_sample is not None:
+            self.jitter_ms += (
+                abs(self.round_trip_time - self._last_rtt_sample) - self.jitter_ms
+            ) / 16.0
+        self._last_rtt_sample = self.round_trip_time
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_rtt.set(self.round_trip_time)
+            self._m_jitter.set(self.jitter_ms)
 
     def _on_checksum_report(self, body: ChecksumReport) -> None:
         if self.last_added_checksum_frame < body.frame:
@@ -531,14 +620,24 @@ class PeerEndpoint:
             raise NotSynchronized()
         seconds = (self.clock.now_ms() - self.stats_start_time) // 1000
         if seconds == 0:
+            # distinguishable from the unsynchronized case — but only once
+            # the endpoint actually IS synchronized: mid-handshake, "not
+            # synchronized" stays the truthful (plain) error even though
+            # the window is also young
+            if self.state == ProtocolState.RUNNING:
+                raise StatsWindowTooYoung()
             raise NotSynchronized()
-        total_bytes = self.bytes_sent + self.packets_sent * UDP_HEADER_SIZE
+        total_sent = self.bytes_sent + self.packets_sent * UDP_HEADER_SIZE
+        total_recv = self.bytes_recv + self.packets_recv * UDP_HEADER_SIZE
         return NetworkStats(
             send_queue_len=len(self.pending_output),
             ping_ms=self.round_trip_time,
-            kbps_sent=(total_bytes // int(seconds)) // 1024,
+            kbps_sent=(total_sent // int(seconds)) // 1024,
             local_frames_behind=self.local_frame_advantage,
             remote_frames_behind=self.remote_frame_advantage,
+            kbps_recv=(total_recv // int(seconds)) // 1024,
+            jitter_ms=int(round(self.jitter_ms)),
+            packets_lost=self.packets_lost,
         )
 
     def _last_recv_frame(self) -> Frame:

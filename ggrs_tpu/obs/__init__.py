@@ -1,0 +1,42 @@
+"""Session telemetry: metrics registry, flight recorder, desync forensics.
+
+Usage:
+
+    from ggrs_tpu.obs import enable_global_telemetry
+    enable_global_telemetry(dump_dir="/tmp/ggrs")   # before/after start, any time
+    ...
+    snap = session.telemetry()       # one structured snapshot (dict)
+    text = GLOBAL_TELEMETRY.prometheus()  # Prometheus text format
+
+Everything is near-zero-cost while disabled (the default): instrumentation
+sites check `GLOBAL_TELEMETRY.enabled` and skip. Importing this package
+does not import jax.
+"""
+
+from .metrics import (
+    FRAME_ADVANTAGE_BUCKETS,
+    LOG2_BUCKETS,
+    LOG2_BUCKETS_MS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from .recorder import FlightEvent, FlightRecorder, jsonable
+from .telemetry import GLOBAL_TELEMETRY, Telemetry, enable_global_telemetry
+
+__all__ = [
+    "FRAME_ADVANTAGE_BUCKETS",
+    "LOG2_BUCKETS",
+    "LOG2_BUCKETS_MS",
+    "Counter",
+    "FlightEvent",
+    "FlightRecorder",
+    "Gauge",
+    "GLOBAL_TELEMETRY",
+    "Histogram",
+    "MetricsRegistry",
+    "Telemetry",
+    "enable_global_telemetry",
+    "jsonable",
+]

@@ -1,0 +1,337 @@
+"""Metrics primitives: Counter / Gauge / Histogram behind one registry.
+
+The reference ships byte counters only (SURVEY.md §5); this is the
+instrument layer everything else plugs into. Design constraints, in order:
+
+1. Near-zero cost when telemetry is disabled — instruments are only
+   *updated* behind `GLOBAL_TELEMETRY.enabled` checks at the call sites
+   (the Tracer.span idiom), so creating them eagerly is free.
+2. Bound children stay valid across `reset()` — endpoints and backends
+   pre-bind labeled children once in their constructors, so a reset must
+   zero the underlying cells in place, never replace them.
+3. Histograms use FIXED log-scale buckets (powers of two) so two
+   snapshots are always mergeable/comparable and the export never
+   depends on observed data.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# default fixed log-scale buckets (upper bounds, `le` semantics);
+# +Inf is implicit as the overflow bucket
+LOG2_BUCKETS: Tuple[float, ...] = tuple(float(2**k) for k in range(0, 11))
+# millisecond durations need sub-ms resolution (fence stalls, RTTs)
+LOG2_BUCKETS_MS: Tuple[float, ...] = tuple(2.0**k for k in range(-3, 11))
+# frame advantage is signed: symmetric log-scale around zero
+FRAME_ADVANTAGE_BUCKETS: Tuple[float, ...] = (
+    -64.0, -32.0, -16.0, -8.0, -4.0, -2.0, -1.0, 0.0,
+    1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0,
+)
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _fmt_value(v: float) -> str:
+    # integers render without a trailing .0 — easier on the eyes and on
+    # naive parsers; everything else keeps full float repr
+    if isinstance(v, float) and v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class BoundCounter:
+    """A counter child bound to one label-value tuple."""
+
+    __slots__ = ("_cell",)
+
+    def __init__(self, cell: List[float]):
+        self._cell = cell
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._cell[0] += amount
+
+    @property
+    def value(self) -> float:
+        return self._cell[0]
+
+
+class BoundGauge:
+    __slots__ = ("_cell",)
+
+    def __init__(self, cell: List[float]):
+        self._cell = cell
+
+    def set(self, value: float) -> None:
+        self._cell[0] = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._cell[0] += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._cell[0] -= amount
+
+    @property
+    def value(self) -> float:
+        return self._cell[0]
+
+
+class _HistCell:
+    """Per-child histogram state: non-cumulative bucket counts + sum."""
+
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)  # +1: the +Inf overflow bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def zero(self) -> None:
+        for i in range(len(self.counts)):
+            self.counts[i] = 0
+        self.sum = 0.0
+        self.count = 0
+
+
+class BoundHistogram:
+    __slots__ = ("_cell", "_buckets")
+
+    def __init__(self, cell: _HistCell, buckets: Tuple[float, ...]):
+        self._cell = cell
+        self._buckets = buckets
+
+    def observe(self, value: float) -> None:
+        c = self._cell
+        c.counts[bisect_left(self._buckets, value)] += 1
+        c.sum += value
+        c.count += 1
+
+    @property
+    def count(self) -> int:
+        return self._cell.count
+
+    @property
+    def sum(self) -> float:
+        return self._cell.sum
+
+
+class _Instrument:
+    """Shared child-management for the three instrument kinds."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Tuple[str, ...]):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._bound: Dict[Tuple[str, ...], object] = {}
+
+    def _new_cell(self):
+        raise NotImplementedError
+
+    def _bind(self, cell):
+        raise NotImplementedError
+
+    def labels(self, *labelvalues) -> object:
+        key = tuple(str(v) for v in labelvalues)
+        if len(key) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labelnames)} label values "
+                f"({self.labelnames}), got {len(key)}"
+            )
+        bound = self._bound.get(key)
+        if bound is None:
+            cell = self._children.get(key)
+            if cell is None:
+                cell = self._new_cell()
+                self._children[key] = cell
+            bound = self._bind(cell)
+            self._bound[key] = bound
+        return bound
+
+    # unlabeled convenience: metric.inc()/set()/observe() act on the () child
+    def _default(self):
+        if self.labelnames:
+            raise ValueError(f"{self.name} is labeled {self.labelnames}; use .labels()")
+        return self.labels()
+
+    def reset(self) -> None:
+        """Zero every child IN PLACE — bound children stay valid."""
+        for cell in self._children.values():
+            if isinstance(cell, _HistCell):
+                cell.zero()
+            else:
+                cell[0] = 0.0
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def _new_cell(self):
+        return [0.0]
+
+    def _bind(self, cell):
+        return BoundCounter(cell)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def snapshot(self) -> dict:
+        return {
+            "type": self.kind,
+            "help": self.help,
+            "values": {
+                ",".join(k) if k else "": cell[0]
+                for k, cell in self._children.items()
+            },
+        }
+
+    def prometheus_lines(self) -> List[str]:
+        lines = _header(self)
+        for key, cell in sorted(self._children.items()):
+            lines.append(f"{self.name}{_labelset(self.labelnames, key)} {_fmt_value(cell[0])}")
+        return lines
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def _bind(self, cell):
+        return BoundGauge(cell)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        labelnames: Tuple[str, ...],
+        buckets: Optional[Iterable[float]] = None,
+    ):
+        super().__init__(name, help, labelnames)
+        b = tuple(float(x) for x in (buckets if buckets is not None else LOG2_BUCKETS))
+        assert b == tuple(sorted(b)) and len(b) > 0, "buckets must be sorted"
+        self.buckets = b
+
+    def _new_cell(self):
+        return _HistCell(len(self.buckets))
+
+    def _bind(self, cell):
+        return BoundHistogram(cell, self.buckets)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    def snapshot(self) -> dict:
+        values = {}
+        for key, cell in self._children.items():
+            values[",".join(key) if key else ""] = {
+                "count": cell.count,
+                "sum": cell.sum,
+                "buckets": {
+                    **{
+                        _fmt_value(le): cell.counts[i]
+                        for i, le in enumerate(self.buckets)
+                    },
+                    "+Inf": cell.counts[-1],
+                },
+            }
+        return {"type": self.kind, "help": self.help, "values": values}
+
+    def prometheus_lines(self) -> List[str]:
+        lines = _header(self)
+        names = self.labelnames + ("le",)
+        for key, cell in sorted(self._children.items()):
+            cum = 0
+            for i, le in enumerate(self.buckets):
+                cum += cell.counts[i]
+                lines.append(
+                    f"{self.name}_bucket{_labelset(names, key + (_fmt_value(le),))} {cum}"
+                )
+            lines.append(
+                f"{self.name}_bucket{_labelset(names, key + ('+Inf',))} {cell.count}"
+            )
+            base = _labelset(self.labelnames, key)
+            lines.append(f"{self.name}_sum{base} {_fmt_value(cell.sum)}")
+            lines.append(f"{self.name}_count{base} {cell.count}")
+        return lines
+
+
+def _header(m: _Instrument) -> List[str]:
+    lines = []
+    if m.help:
+        lines.append(f"# HELP {m.name} {m.help}")
+    lines.append(f"# TYPE {m.name} {m.kind}")
+    return lines
+
+
+def _labelset(names: Tuple[str, ...], values: Tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    pairs = ",".join(
+        f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)
+    )
+    return "{" + pairs + "}"
+
+
+class MetricsRegistry:
+    """Get-or-create instrument registry. One per Telemetry object; the
+    process-wide one lives on GLOBAL_TELEMETRY."""
+
+    def __init__(self):
+        self._metrics: Dict[str, _Instrument] = {}
+
+    def _get(self, cls, name, help, labelnames, **kw) -> _Instrument:
+        m = self._metrics.get(name)
+        if m is None:
+            m = cls(name, help, tuple(labelnames), **kw)
+            self._metrics[name] = m
+        elif type(m) is not cls:
+            raise ValueError(
+                f"metric {name!r} already registered as {m.kind}, not {cls.kind}"
+            )
+        return m
+
+    def counter(self, name: str, help: str = "", labelnames=()) -> Counter:
+        return self._get(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames=()) -> Gauge:
+        return self._get(Gauge, name, help, labelnames)
+
+    def histogram(
+        self, name: str, help: str = "", labelnames=(), buckets=None
+    ) -> Histogram:
+        return self._get(Histogram, name, help, labelnames, buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        return self._metrics.get(name)
+
+    def snapshot(self) -> dict:
+        return {name: m.snapshot() for name, m in sorted(self._metrics.items())}
+
+    def prometheus_lines(self) -> List[str]:
+        lines: List[str] = []
+        for _, m in sorted(self._metrics.items()):
+            lines.extend(m.prometheus_lines())
+        return lines
+
+    def reset(self) -> None:
+        """Zero every instrument in place (bound children stay valid)."""
+        for m in self._metrics.values():
+            m.reset()

@@ -1,0 +1,85 @@
+"""Flight recorder: a bounded ring of structured, timestamped session events.
+
+Think aircraft black box, not log file: the ring holds the last N events
+(rollbacks with depth, mispredictions, disconnects, fence stalls,
+plan-cache misses, desyncs) and is dumped wholesale into the desync
+forensics bundle — the question it answers is "what was the session doing
+just before things went wrong", after the fact, without a debugger
+attached. Events are plain dicts + a wall-clock timestamp so the ring is
+JSON-serializable as-is.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+DEFAULT_CAPACITY = 256
+
+
+def jsonable(value: Any) -> Any:
+    """Best-effort conversion to a JSON-serializable value; opaque objects
+    (peer addresses are `Any` by contract) degrade to repr()."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, bytes):
+        return value.hex()
+    if isinstance(value, (list, tuple)):
+        return [jsonable(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): jsonable(v) for k, v in value.items()}
+    return repr(value)
+
+
+@dataclass(frozen=True)
+class FlightEvent:
+    seq: int  # monotonically increasing, gaps reveal ring overwrites
+    ts_ms: float  # wall clock (time.time() * 1000): correlatable across peers
+    kind: str  # e.g. "rollback_begin", "misprediction", "desync_detected"
+    frame: int  # session frame the event refers to, -1 when frameless
+    data: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "seq": self.seq,
+            "ts_ms": self.ts_ms,
+            "kind": self.kind,
+            "frame": self.frame,
+            **{k: jsonable(v) for k, v in self.data.items()},
+        }
+
+
+class FlightRecorder:
+    """Bounded event ring; recording is O(1) and never allocates beyond the
+    ring itself (deque(maxlen) drops the oldest event on overflow)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        assert capacity > 0
+        self.capacity = capacity
+        self._events: deque = deque(maxlen=capacity)
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    @property
+    def total_recorded(self) -> int:
+        return self._seq
+
+    def record(self, kind: str, frame: int = -1, **data: Any) -> None:
+        self._seq += 1
+        self._events.append(
+            FlightEvent(self._seq, time.time() * 1000.0, kind, frame, data)
+        )
+
+    def tail(self, n: Optional[int] = None) -> List[FlightEvent]:
+        events = list(self._events)
+        return events if n is None else events[-n:]
+
+    def to_json(self, n: Optional[int] = None) -> List[dict]:
+        return [e.to_dict() for e in self.tail(n)]
+
+    def clear(self) -> None:
+        self._events.clear()

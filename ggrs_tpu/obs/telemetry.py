@@ -1,0 +1,172 @@
+"""The telemetry facade: one registry + one flight recorder + exporters.
+
+`GLOBAL_TELEMETRY` is the process-wide instance, disabled by default just
+like `GLOBAL_TRACER` — every instrumentation site in the stack guards with
+`if GLOBAL_TELEMETRY.enabled:` so a disabled session pays one attribute
+read and a branch, nothing else. Enabling mid-session is legal: instruments
+are pre-bound eagerly, so counters simply start moving.
+
+`snapshot()` folds the GLOBAL_TRACER span stats into the same structure so
+there is ONE report (metrics + flight-recorder tail + tracer spans), not a
+telemetry report and a separate tracing report. The Prometheus exporter
+renders tracer spans as synthetic `ggrs_tracer_span_*` metrics for the
+same reason.
+
+On `DesyncDetected` the P2P session calls `write_desync_forensics()`: the
+divergent frame, both checksums, the last-N flight-recorder events and the
+still-pending predicted inputs land in one JSON dump file, so a desync is
+diagnosable after the process is gone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from .metrics import MetricsRegistry, _escape_label
+from .recorder import DEFAULT_CAPACITY, FlightRecorder, jsonable
+
+
+class Telemetry:
+    # hard cap on forensics dumps per Telemetry instance: a desync storm
+    # (every comparison interval re-detects) must not flood the disk
+    MAX_FORENSICS_DUMPS = 32
+
+    def __init__(
+        self,
+        enabled: bool = False,
+        recorder_capacity: int = DEFAULT_CAPACITY,
+        dump_dir: Optional[str] = None,
+    ):
+        self.enabled = enabled
+        self.registry = MetricsRegistry()
+        self.recorder = FlightRecorder(recorder_capacity)
+        # None -> resolved at dump time from $GGRS_OBS_DUMP_DIR, else cwd
+        self.dump_dir = dump_dir
+        self._dumps_written = 0
+
+    # ------------------------------------------------------------------
+    # recording
+    # ------------------------------------------------------------------
+
+    def record(self, kind: str, frame: int = -1, **data: Any) -> None:
+        """Flight-recorder entry point; no-op when disabled."""
+        if self.enabled:
+            self.recorder.record(kind, frame=frame, **data)
+
+    # ------------------------------------------------------------------
+    # exporters
+    # ------------------------------------------------------------------
+
+    def snapshot(self, tracer=None, recorder_tail: Optional[int] = None) -> dict:
+        """One structured, JSON-serializable report: metrics + flight
+        recorder + tracer spans (GLOBAL_TRACER by default)."""
+        if tracer is None:
+            from ..utils.tracing import GLOBAL_TRACER as tracer
+        return {
+            "enabled": self.enabled,
+            "taken_at_ms": time.time() * 1000.0,
+            "metrics": self.registry.snapshot(),
+            "events": self.recorder.to_json(recorder_tail),
+            "tracer": {
+                name: {
+                    "count": s.count,
+                    "mean_ms": s.mean_ms,
+                    "max_ms": s.max_ms,
+                    "total_ms": s.total_ms,
+                }
+                for name, s in sorted(tracer.stats.items())
+            },
+        }
+
+    def to_json(self, tracer=None, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(tracer), indent=indent)
+
+    def prometheus(self, tracer=None) -> str:
+        """Prometheus text exposition format (0.0.4), tracer spans folded
+        in as ggrs_tracer_span_{count,total_ms,max_ms} series."""
+        if tracer is None:
+            from ..utils.tracing import GLOBAL_TRACER as tracer
+        lines: List[str] = self.registry.prometheus_lines()
+        if tracer.stats:
+            spans = sorted(tracer.stats.items())
+            for suffix, kind, value_of in (
+                ("count", "counter", lambda s: s.count),
+                ("total_ms", "counter", lambda s: s.total_ms),
+                ("max_ms", "gauge", lambda s: s.max_ms),
+            ):
+                name = f"ggrs_tracer_span_{suffix}"
+                lines.append(f"# TYPE {name} {kind}")
+                for span, s in spans:
+                    lines.append(
+                        f'{name}{{span="{_escape_label(span)}"}} {value_of(s)}'
+                    )
+        return "\n".join(lines) + "\n"
+
+    # ------------------------------------------------------------------
+    # desync forensics
+    # ------------------------------------------------------------------
+
+    def desync_forensics(
+        self,
+        *,
+        frame: int,
+        local_checksum: int,
+        remote_checksum: int,
+        addr: Any,
+        pending_predicted_inputs: Optional[List[dict]] = None,
+        session: Optional[dict] = None,
+        last_events: int = 64,
+    ) -> dict:
+        """Build (don't write) the forensics bundle for one desync."""
+        return {
+            "kind": "desync_forensics",
+            "written_at_ms": time.time() * 1000.0,
+            "frame": frame,
+            "local_checksum": local_checksum,
+            "remote_checksum": remote_checksum,
+            "peer": jsonable(addr),
+            "pending_predicted_inputs": pending_predicted_inputs or [],
+            "events": self.recorder.to_json(last_events),
+            "session": session or {},
+        }
+
+    def write_desync_forensics(self, **kwargs) -> Optional[str]:
+        """Write the bundle to `<dump_dir>/ggrs_desync_f<frame>_<ts>.json`
+        and return the path (None when the per-process dump cap is hit)."""
+        if self._dumps_written >= self.MAX_FORENSICS_DUMPS:
+            return None
+        bundle = self.desync_forensics(**kwargs)
+        dump_dir = self.dump_dir or os.environ.get("GGRS_OBS_DUMP_DIR") or "."
+        os.makedirs(dump_dir, exist_ok=True)
+        path = os.path.join(
+            dump_dir,
+            f"ggrs_desync_f{bundle['frame']}_{int(bundle['written_at_ms'])}"
+            f"_{self._dumps_written}.json",
+        )
+        with open(path, "w") as f:
+            json.dump(bundle, f, indent=1)
+        self._dumps_written += 1
+        return path
+
+    # ------------------------------------------------------------------
+
+    def reset(self) -> None:
+        """Zero metrics IN PLACE (pre-bound children stay valid), clear the
+        event ring, re-arm the forensics dump cap."""
+        self.registry.reset()
+        self.recorder.clear()
+        self._dumps_written = 0
+
+
+# process-wide default, disabled unless opted in (mirrors GLOBAL_TRACER)
+GLOBAL_TELEMETRY = Telemetry(enabled=False)
+
+
+def enable_global_telemetry(dump_dir: Optional[str] = None) -> Telemetry:
+    GLOBAL_TELEMETRY.enabled = True
+    if dump_dir is not None:
+        GLOBAL_TELEMETRY.dump_dir = dump_dir
+    return GLOBAL_TELEMETRY

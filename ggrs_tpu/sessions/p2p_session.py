@@ -28,6 +28,7 @@ from ..network.protocol import (
     EvSynchronizing,
     PeerEndpoint,
 )
+from ..obs import GLOBAL_TELEMETRY
 from ..sync_layer import ConnectionStatus, PendingChecksumReport, SyncLayer
 from ..utils.tracing import GLOBAL_TRACER
 from ..types import (
@@ -138,6 +139,10 @@ class P2PSession:
         self.local_checksum_history: Dict[Frame, int] = {}
         self._pending_checksum_report = PendingChecksumReport()
         self._wire_dispatch = None  # decided on first poll (socket+endpoints)
+        # desyncs already dumped to a forensics bundle: comparison intervals
+        # re-detect the same divergence every pass, one dump per (peer,
+        # frame) is the useful quantity
+        self._desyncs_dumped: set = set()
 
     # ------------------------------------------------------------------
     # public API
@@ -335,6 +340,48 @@ class P2PSession:
         )
         return reg[ptype.addr].network_stats()
 
+    def telemetry(self) -> dict:
+        """One structured snapshot: process-wide metrics + flight-recorder
+        tail + tracer spans (GLOBAL_TELEMETRY.snapshot()) plus this
+        session's own section (state, frames, per-peer NetworkStats)."""
+        snap = GLOBAL_TELEMETRY.snapshot()
+        snap["session"] = self._telemetry_session_section()
+        return snap
+
+    def _telemetry_session_section(self) -> dict:
+        from dataclasses import asdict
+
+        network: Dict[str, Any] = {}
+        for handle, ptype in sorted(self.player_reg.handles.items()):
+            if ptype.kind == PlayerTypeKind.LOCAL:
+                continue
+            try:
+                network[str(handle)] = asdict(self.network_stats(handle))
+            except NotSynchronized as exc:
+                network[str(handle)] = {"unavailable": type(exc).__name__}
+        # per-player prediction accuracy from THIS session's own queues
+        # (the global labeled counters blend every session in the process;
+        # queues are per-session, so this stays honest with several
+        # sessions alive). Native queues expose no tallies and are skipped.
+        accuracy: Dict[str, float] = {}
+        for player, q in enumerate(self.sync_layer.input_queues):
+            served = getattr(q, "predictions_served", 0)
+            if served > 0:
+                wrong = getattr(q, "mispredictions", 0)
+                accuracy[str(player)] = 1.0 - min(wrong / served, 1.0)
+        return {
+            "type": "p2p",
+            "state": self.state.value,
+            "current_frame": self.sync_layer.current_frame,
+            "last_confirmed_frame": self.sync_layer.last_confirmed_frame,
+            "frames_ahead": self.frames_ahead,
+            "local_players": self.player_reg.local_player_handles(),
+            "remote_players": self.player_reg.remote_player_handles(),
+            "spectators": self.player_reg.spectator_handles(),
+            "prediction_accuracy": accuracy,
+            "network": network,
+        }
+
     def confirmed_frame(self) -> Frame:
         """min(last_frame) over connected peers (src/sessions/p2p_session.rs:487-498)."""
         confirmed = 2**31 - 1
@@ -410,6 +457,14 @@ class P2PSession:
         )
         assert frame_to_load <= first_incorrect
         count = current_frame - frame_to_load
+        tel = GLOBAL_TELEMETRY
+        if tel.enabled:
+            tel.record(
+                "rollback_begin",
+                frame=frame_to_load,
+                depth=count,
+                first_incorrect=first_incorrect,
+            )
 
         requests.append(self.sync_layer.load_frame(frame_to_load))
         assert self.sync_layer.current_frame == frame_to_load
@@ -426,6 +481,8 @@ class P2PSession:
             self.sync_layer.advance_frame()
             requests.append(AdvanceFrame(inputs=inputs))
         assert self.sync_layer.current_frame == current_frame
+        if tel.enabled:
+            tel.record("rollback_end", frame=current_frame, resimulated=count)
 
     def _check_last_saved_state(
         self, last_saved: Frame, confirmed_frame: Frame, requests: List[Request]
@@ -542,6 +599,10 @@ class P2PSession:
                 self.sync_layer.add_remote_input(player, inp)
 
     def _push_event(self, event: Event) -> None:
+        tel = GLOBAL_TELEMETRY
+        if tel.enabled:
+            d = event.to_dict()
+            tel.record(d.pop("kind"), frame=d.pop("frame", -1), **d)
         self.event_queue.append(event)
         while len(self.event_queue) > MAX_EVENT_QUEUE_SIZE:
             self.event_queue.popleft()
@@ -597,3 +658,28 @@ class P2PSession:
                             addr=endpoint.peer_addr,
                         )
                     )
+                    self._dump_desync_forensics(
+                        remote_frame, local, remote_checksum, endpoint.peer_addr
+                    )
+
+    def _dump_desync_forensics(
+        self, frame: Frame, local: int, remote: int, addr: Any
+    ) -> None:
+        """One forensics bundle per (peer, frame) divergence: the frame,
+        both checksums, the flight-recorder tail (rollbacks,
+        mispredictions, disconnects leading up to it) and the predictions
+        still standing — enough to diagnose a desync after the process is
+        gone. Telemetry must be enabled: without the recorder running
+        there is no history worth dumping."""
+        tel = GLOBAL_TELEMETRY
+        if not tel.enabled or (addr, frame) in self._desyncs_dumped:
+            return
+        self._desyncs_dumped.add((addr, frame))
+        tel.write_desync_forensics(
+            frame=frame,
+            local_checksum=local,
+            remote_checksum=remote,
+            addr=addr,
+            pending_predicted_inputs=self.sync_layer.pending_predicted_inputs(),
+            session=self._telemetry_session_section(),
+        )

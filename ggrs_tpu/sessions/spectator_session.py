@@ -15,6 +15,7 @@ from typing import Any, Deque, List
 from ..errors import NotSynchronized, PredictionThreshold, SpectatorTooFarBehind
 from ..frame_info import PlayerInput
 from ..network.network_stats import NetworkStats
+from ..obs import GLOBAL_TELEMETRY
 from ..network.protocol import (
     EvDisconnected,
     EvInput,
@@ -80,6 +81,25 @@ class SpectatorSession:
 
     def network_stats(self) -> NetworkStats:
         return self.host.network_stats()
+
+    def telemetry(self) -> dict:
+        """One structured snapshot (see P2PSession.telemetry)."""
+        from dataclasses import asdict
+
+        snap = GLOBAL_TELEMETRY.snapshot()
+        try:
+            network = asdict(self.network_stats())
+        except NotSynchronized as exc:
+            network = {"unavailable": type(exc).__name__}
+        snap["session"] = {
+            "type": "spectator",
+            "state": self.state.value,
+            "current_frame": self.current_frame,
+            "last_recv_frame": self.last_recv_frame,
+            "frames_behind_host": max(self.last_recv_frame - self.current_frame, 0),
+            "network": {"host": network},
+        }
+        return snap
 
     def events(self) -> List[Event]:
         out = list(self.event_queue)
@@ -170,6 +190,10 @@ class SpectatorSession:
         self._trim_events()
 
     def _push_event(self, event: Event) -> None:
+        tel = GLOBAL_TELEMETRY
+        if tel.enabled:
+            d = event.to_dict()
+            tel.record(d.pop("kind"), frame=d.pop("frame", -1), **d)
         self.event_queue.append(event)
         self._trim_events()
 

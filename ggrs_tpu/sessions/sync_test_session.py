@@ -14,6 +14,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from ..errors import InvalidRequest, MismatchedChecksum
 from ..frame_info import PlayerInput
+from ..obs import GLOBAL_TELEMETRY
 from ..sync_layer import ConnectionStatus, SyncLayer
 from ..types import AdvanceFrame, Frame, PlayerHandle, Request
 
@@ -226,6 +227,9 @@ class SyncTestSession:
         """(src/sessions/sync_test_session.rs:178-203)"""
         start_frame = self.sync_layer.current_frame
         count = start_frame - frame_to
+        tel = GLOBAL_TELEMETRY
+        if tel.enabled:
+            tel.record("rollback_begin", frame=frame_to, depth=count, forced=True)
 
         requests.append(self.sync_layer.load_frame(frame_to))
         self.sync_layer.reset_prediction()
@@ -238,3 +242,18 @@ class SyncTestSession:
             self.sync_layer.advance_frame()
             requests.append(AdvanceFrame(inputs=inputs))
         assert self.sync_layer.current_frame == start_frame
+        if tel.enabled:
+            tel.record("rollback_end", frame=start_frame, resimulated=count, forced=True)
+
+    def telemetry(self) -> dict:
+        """One structured snapshot (see P2PSession.telemetry)."""
+        snap = GLOBAL_TELEMETRY.snapshot()
+        snap["session"] = {
+            "type": "sync_test",
+            "current_frame": self.sync_layer.current_frame,
+            "check_distance": self.check_distance,
+            "host_verification": self.host_verification,
+            "pending_checksum_checks": len(self._pending_checks),
+            "checksum_history_frames": len(self.checksum_history),
+        }
+        return snap

@@ -15,6 +15,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 from .errors import PredictionThreshold
 from .frame_info import GameState, PlayerInput
 from .input_queue import InputQueue
+from .obs import GLOBAL_TELEMETRY, LOG2_BUCKETS
 from .types import (
     NULL_FRAME,
     Frame,
@@ -218,12 +219,35 @@ class SyncLayer:
             self.input_queues = [NativeInputQueue(input_size) for _ in range(num_players)]
         else:
             self.input_queues = [InputQueue(input_size) for _ in range(num_players)]
+        # stamp the owning player onto each queue so its prediction
+        # counters carry a player label (native queues ignore it)
+        for i, q in enumerate(self.input_queues):
+            q.obs_player = i
+        # pre-bound telemetry instruments (updated only when enabled)
+        _reg = GLOBAL_TELEMETRY.registry
+        self._m_saves = _reg.counter(
+            "ggrs_state_saves_total", "SaveGameState requests emitted"
+        )
+        self._m_loads = _reg.counter(
+            "ggrs_state_loads_total", "LoadGameState requests emitted (rollbacks)"
+        )
+        self._m_depth = _reg.histogram(
+            "ggrs_rollback_depth_frames",
+            "frames resimulated per rollback",
+            buckets=LOG2_BUCKETS,
+        )
+        self._m_lag = _reg.gauge(
+            "ggrs_confirmed_frame_lag",
+            "current frame minus last confirmed frame",
+        )
 
     def advance_frame(self) -> None:
         self.current_frame += 1
 
     def save_current_state(self) -> Request:
         self._last_saved_frame = self.current_frame
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_saves.inc()
         cell = self.saved_states.get_cell(self.current_frame)
         return SaveGameState(cell=cell, frame=self.current_frame)
 
@@ -244,6 +268,9 @@ class SyncLayer:
         ), "tried to load a frame outside the rollback window"
         cell = self.saved_states.get_cell(frame_to_load)
         assert cell.frame == frame_to_load
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_loads.inc()
+            self._m_depth.observe(self.current_frame - frame_to_load)
         self.current_frame = frame_to_load
         return LoadGameState(cell=cell, frame=frame_to_load)
 
@@ -301,6 +328,8 @@ class SyncLayer:
             "would discard inputs still needed for rollback"
         )
         self.last_confirmed_frame = frame
+        if GLOBAL_TELEMETRY.enabled and frame != NULL_FRAME:
+            self._m_lag.set(self.current_frame - frame)
         if self.last_confirmed_frame > 0:
             for q in self.input_queues:
                 q.discard_confirmed_frames(frame - 1)
@@ -318,6 +347,19 @@ class SyncLayer:
     def saved_state_by_frame(self, frame: Frame) -> Optional[GameStateCell]:
         cell = self.saved_states.get_cell(frame)
         return cell if cell.frame == frame else None
+
+    def pending_predicted_inputs(self) -> List[dict]:
+        """Per-player predictions still standing in (JSON-able form, for
+        the desync forensics bundle): which players are being speculated
+        on, at what frame, with what repeated input."""
+        out: List[dict] = []
+        for player, q in enumerate(self.input_queues):
+            pred = getattr(q, "prediction", None)  # native queues: None
+            if pred is not None and pred.frame != NULL_FRAME:
+                out.append(
+                    {"player": player, "frame": pred.frame, "input": pred.buf.hex()}
+                )
+        return out
 
     @property
     def last_saved_frame(self) -> Frame:

@@ -3,6 +3,8 @@
 
 from __future__ import annotations
 
+from .obs import FRAME_ADVANTAGE_BUCKETS, GLOBAL_TELEMETRY
+
 FRAME_WINDOW_SIZE = 30
 
 
@@ -10,13 +12,24 @@ class TimeSync:
     """Sliding windows of local/remote frame advantage; the average drives
     WaitRecommendation events (src/time_sync.rs:3-39)."""
 
-    def __init__(self) -> None:
+    def __init__(self, peer_label: str = "?") -> None:
         self.local = [0] * FRAME_WINDOW_SIZE
         self.remote = [0] * FRAME_WINDOW_SIZE
+        # telemetry: the raw advantage distribution per peer — the average
+        # below feeds throttling, the histogram shows how skewed the raw
+        # samples are (a wide distribution means flappy pacing)
+        self._m_advantage = GLOBAL_TELEMETRY.registry.histogram(
+            "ggrs_frame_advantage",
+            "per-sample local frame advantage vs this peer",
+            ("peer",),
+            buckets=FRAME_ADVANTAGE_BUCKETS,
+        ).labels(peer_label)
 
     def advance_frame(self, frame: int, local_adv: int, remote_adv: int) -> None:
         self.local[frame % FRAME_WINDOW_SIZE] = local_adv
         self.remote[frame % FRAME_WINDOW_SIZE] = remote_adv
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_advantage.observe(local_adv)
 
     def average_frame_advantage(self) -> int:
         local_avg = sum(self.local) / FRAME_WINDOW_SIZE

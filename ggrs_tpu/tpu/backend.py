@@ -17,12 +17,14 @@ lazy checksums that only force a device->host transfer when read.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from ..obs import GLOBAL_TELEMETRY, LOG2_BUCKETS, LOG2_BUCKETS_MS
 from ..ops.fixed_point import combine_checksum
 from ..types import (
     AdvanceFrame,
@@ -460,6 +462,29 @@ class TpuRollbackBackend:
         # test hook): (has_load, advance_count, last_active, trailing?) ->
         # dispatch count. Bounded: the grammar admits O(window^2) shapes.
         self.dispatch_signatures: dict = {}
+        # pre-bound telemetry instruments (updated behind enabled checks)
+        _reg = GLOBAL_TELEMETRY.registry
+        self._m_fence_stall = _reg.histogram(
+            "ggrs_async_fence_stall_ms",
+            "time the host blocked on the oldest in-flight dispatch",
+            buckets=LOG2_BUCKETS_MS,
+        )
+        self._m_inflight = _reg.gauge(
+            "ggrs_async_inflight", "dispatches currently inside the async fence"
+        )
+        self._m_batch = _reg.histogram(
+            "ggrs_fused_batch_ticks",
+            "session ticks fused into one multi-tick device dispatch",
+            buckets=LOG2_BUCKETS,
+        )
+        self._m_plan_hits = _reg.counter(
+            "ggrs_dispatch_plan_hits_total",
+            "request segments whose canonical signature was already cached",
+        )
+        self._m_plan_misses = _reg.counter(
+            "ggrs_dispatch_plan_misses_total",
+            "request segments that introduced a new canonical signature",
+        )
         self.beam_gated = 0  # ticks where the FULL-width launch was withheld
         # width-1 history-only launches (member 0: pinned history +
         # repeat-last). Under a beam-sharded mesh the minimal legal width
@@ -757,7 +782,17 @@ class TpuRollbackBackend:
             last_active,
             trailing_save is not None,
         )
+        hit = sig in self.dispatch_signatures
         self.dispatch_signatures[sig] = self.dispatch_signatures.get(sig, 0) + 1
+        tel = GLOBAL_TELEMETRY
+        if tel.enabled:
+            if hit:
+                self._m_plan_hits.inc()
+            else:
+                self._m_plan_misses.inc()
+                tel.record(
+                    "plan_cache_miss", frame=start_frame, signature=str(sig)
+                )
         return (
             load, start_frame, count, inputs, statuses, save_slots, saves,
             last_active,
@@ -774,10 +809,24 @@ class TpuRollbackBackend:
             return
         self._inflight.append(handle)
         GLOBAL_TRACER.mark("tpu/async_dispatch", absolute=True)
+        tel = GLOBAL_TELEMETRY
+        if tel.enabled:
+            self._m_inflight.set(len(self._inflight))
         while len(self._inflight) > self.async_inflight:
             oldest = self._inflight.popleft()
             with GLOBAL_TRACER.span("tpu/async_fence", absolute=True):
+                t0 = time.perf_counter() if tel.enabled else 0.0
                 jax.block_until_ready(oldest)
+                if tel.enabled:
+                    stall_ms = (time.perf_counter() - t0) * 1000.0
+                    self._m_fence_stall.observe(stall_ms)
+                    self._m_inflight.set(len(self._inflight))
+                    tel.record(
+                        "fence_stall",
+                        frame=self.current_frame,
+                        stall_ms=round(stall_ms, 4),
+                        inflight=len(self._inflight),
+                    )
 
     def _run_segment(self, requests: List[Request]) -> None:
         with GLOBAL_TRACER.span("tpu/host_parse", absolute=True):
@@ -1042,6 +1091,8 @@ class TpuRollbackBackend:
         n_staged = self._multi_count
         if not rows and not n_staged:
             return
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_batch.observe(n_staged or len(rows))
         self._tick_rows = []
         self._tick_future = None
         core = self.core

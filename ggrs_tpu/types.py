@@ -9,8 +9,9 @@ src/lib.rs:250-255): the only game data that ever crosses the wire.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
-from typing import Any, Optional, Sequence, Tuple
+import re
+from dataclasses import dataclass, field, fields
+from typing import Any, Optional, Sequence, Tuple, Union
 
 # -1 represents no frame / invalid frame (src/lib.rs:46).
 NULL_FRAME: int = -1
@@ -88,48 +89,76 @@ class DesyncDetection:
 # ---------------------------------------------------------------------------
 
 
+class SessionEvent:
+    """Mixin for the session event dataclasses: a stable snake_case `kind`
+    and a JSON-serializable dict form, consumed by the flight recorder
+    (ggrs_tpu.obs) and anyone logging events structurally."""
+
+    @classmethod
+    def kind(cls) -> str:
+        return re.sub(r"(?<!^)(?=[A-Z])", "_", cls.__name__).lower()
+
+    def to_dict(self) -> dict:
+        from .obs.recorder import jsonable
+
+        out: dict = {"kind": type(self).kind()}
+        for f in fields(self):
+            out[f.name] = jsonable(getattr(self, f.name))
+        return out
+
+
 @dataclass(frozen=True)
-class Synchronizing:
+class Synchronizing(SessionEvent):
     addr: Any
     total: int
     count: int
 
 
 @dataclass(frozen=True)
-class Synchronized:
+class Synchronized(SessionEvent):
     addr: Any
 
 
 @dataclass(frozen=True)
-class Disconnected:
+class Disconnected(SessionEvent):
     addr: Any
 
 
 @dataclass(frozen=True)
-class NetworkInterrupted:
+class NetworkInterrupted(SessionEvent):
     addr: Any
     disconnect_timeout_ms: int
 
 
 @dataclass(frozen=True)
-class NetworkResumed:
+class NetworkResumed(SessionEvent):
     addr: Any
 
 
 @dataclass(frozen=True)
-class WaitRecommendation:
+class WaitRecommendation(SessionEvent):
     skip_frames: int
 
 
 @dataclass(frozen=True)
-class DesyncDetected:
+class DesyncDetected(SessionEvent):
     frame: Frame
     local_checksum: int
     remote_checksum: int
     addr: Any
 
 
-Event = Any  # union of the event dataclasses above
+# A real union (not Any): events are type-checkable, and every member
+# carries SessionEvent.to_dict() for the flight recorder.
+Event = Union[
+    Synchronizing,
+    Synchronized,
+    Disconnected,
+    NetworkInterrupted,
+    NetworkResumed,
+    WaitRecommendation,
+    DesyncDetected,
+]
 
 
 # ---------------------------------------------------------------------------

@@ -112,12 +112,27 @@ class Tracer:
             s.total_ns += dt
             s.max_ns = max(s.max_ns, dt)
 
-    def report(self) -> str:
-        lines = [f"{'span':40s} {'count':>8s} {'mean ms':>10s} {'max ms':>10s} {'total ms':>10s}"]
-        for name in sorted(self.stats):
+    def report(self, sort_by: str = "name") -> str:
+        """`sort_by="total"` surfaces hot spans first (descending total
+        time); `"name"` keeps the stable alphabetical listing. The name
+        column sizes itself to the longest span path, so deeply nested
+        spans no longer break column alignment."""
+        if sort_by == "name":
+            names = sorted(self.stats)
+        elif sort_by == "total":
+            names = sorted(
+                self.stats, key=lambda n: (-self.stats[n].total_ns, n)
+            )
+        else:
+            raise ValueError(f"sort_by must be 'name' or 'total', got {sort_by!r}")
+        width = max([len("span")] + [len(n) for n in names])
+        lines = [
+            f"{'span':{width}s} {'count':>8s} {'mean ms':>10s} {'max ms':>10s} {'total ms':>10s}"
+        ]
+        for name in names:
             s = self.stats[name]
             lines.append(
-                f"{name:40s} {s.count:8d} {s.mean_ms:10.4f} {s.max_ms:10.4f} {s.total_ms:10.2f}"
+                f"{name:{width}s} {s.count:8d} {s.mean_ms:10.4f} {s.max_ms:10.4f} {s.total_ms:10.2f}"
             )
         return "\n".join(lines)
 

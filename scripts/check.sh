@@ -9,12 +9,15 @@
 #   4. README perf table      (gen_perf_table --check: table == bench JSON)
 #   5. multi-chip dryrun      (the driver's compile/execute gate, 8 devices)
 #
-# Any failure fails the script. Usage: scripts/check.sh [--fast|--tier1]
+# Any failure fails the script. Usage: scripts/check.sh [--fast|--tier1|--obs-smoke]
 #   --fast skips the UBSAN rebuild+retest and the dryrun (inner-loop use).
 #   --tier1 runs EXACTLY the driver's tier-1 gate from ROADMAP.md (same
 #   pytest flags, same 870s budget, same DOTS_PASSED count) and nothing
 #   else — so builders see the number the driver will see, locally,
 #   before pushing.
+#   --obs-smoke runs a short P2P session with telemetry enabled and
+#   validates the Prometheus/JSON exports parse and that a forced desync
+#   produces a forensics bundle (scripts/obs_smoke.py, host-only, fast).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -32,6 +35,12 @@ if [ "${1:-}" = "--tier1" ]; then
   set -e
   echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
   exit $rc
+fi
+
+if [ "${1:-}" = "--obs-smoke" ]; then
+  echo "== obs smoke (telemetry exports + desync forensics) =="
+  JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+  exit $?
 fi
 
 FAST=0

@@ -219,3 +219,137 @@ def test_oversized_pending_window_sends_prefix_instead_of_crashing():
             break
     frames = sorted({e.input.frame for e in got})
     assert frames == list(range(100))  # everything eventually arrives
+
+
+# ---------------------------------------------------------------------------
+# network_stats: kbps math, window age, recv/loss/jitter estimators
+# ---------------------------------------------------------------------------
+
+
+def test_network_stats_window_too_young_is_distinguishable():
+    """Before the first full second of the stats window the endpoint raises
+    StatsWindowTooYoung — a NotSynchronized subclass, so catch-all callers
+    keep working, but the two conditions are tellable apart."""
+    import pytest
+
+    from ggrs_tpu.errors import NotSynchronized, StatsWindowTooYoung
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    (ep_a, sock_a), (ep_b, sock_b) = make_pair(clock, net)
+    status = [ConnectionStatus(), ConnectionStatus()]
+    # not even synchronizing yet: the plain NotSynchronized, not the subclass
+    with pytest.raises(NotSynchronized) as exc:
+        ep_a.network_stats()
+    assert not isinstance(exc.value, StatsWindowTooYoung)
+    ep_a.synchronize()
+    ep_b.synchronize()
+    # mid-handshake the truthful error stays the plain NotSynchronized,
+    # even though the stats window is also young
+    clock.advance(500)
+    with pytest.raises(NotSynchronized) as exc:
+        ep_a.network_stats()
+    assert not isinstance(exc.value, StatsWindowTooYoung)
+    # finish the handshake fast (well under the 1s window age)
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=2 * NUM_SYNC_PACKETS, advance_ms=10)
+    assert ep_a.is_running()
+    assert clock.now_ms() - ep_a.stats_start_time < 1000
+    with pytest.raises(StatsWindowTooYoung):
+        ep_a.network_stats()
+    clock.advance(1000)
+    stats = ep_a.network_stats()  # window aged past 1s: rates reportable
+    assert stats.kbps_sent >= 0
+
+
+def test_network_stats_kbps_math_sent_and_recv():
+    from ggrs_tpu.network.protocol import UDP_HEADER_SIZE
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    ((ep_a, sock_a), (ep_b, sock_b)), status = _sync(clock, net)
+
+    for frame in range(50):
+        ep_a.send_input({1: PlayerInput(frame, bytes([frame % 11]))}, status)
+        pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=1, advance_ms=50)
+
+    window_s = (clock.now_ms() - ep_a.stats_start_time) // 1000
+    assert window_s >= 1
+    stats = ep_a.network_stats()
+    expected_sent = (
+        (ep_a.bytes_sent + ep_a.packets_sent * UDP_HEADER_SIZE) // window_s
+    ) // 1024
+    expected_recv = (
+        (ep_a.bytes_recv + ep_a.packets_recv * UDP_HEADER_SIZE) // window_s
+    ) // 1024
+    assert stats.kbps_sent == expected_sent
+    assert stats.kbps_recv == expected_recv
+    # traffic flowed both ways during the pumps
+    assert ep_a.bytes_recv > 0 and ep_a.packets_recv > 0
+
+
+def test_recv_counters_track_delivered_wire_bytes():
+    from ggrs_tpu.network.messages import encode_message
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    ((ep_a, sock_a), (ep_b, sock_b)), status = _sync(clock, net)
+
+    base_packets, base_bytes = ep_b.packets_recv, ep_b.bytes_recv
+    ep_a.send_input({1: PlayerInput(0, b"\x09")}, status)
+    ep_a.send_all_messages(sock_a)
+    delivered = sock_b.receive_all_messages()
+    assert delivered
+    wire_total = sum(len(encode_message(m)) for _, m in delivered)
+    for _, msg in delivered:
+        ep_b.handle_message(msg)
+    assert ep_b.packets_recv - base_packets == len(delivered)
+    assert ep_b.bytes_recv - base_bytes == wire_total
+
+
+def test_packet_loss_estimated_from_quality_report_gaps():
+    """Quality reports fire on a fixed 200ms cadence carrying the sender's
+    clock; dropping every other one must show up as packets_lost on the
+    receiver without any wire-format change."""
+    from ggrs_tpu.network.messages import QualityReport
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    ((ep_a, sock_a), (ep_b, sock_b)), status = _sync(clock, net)
+    assert ep_a.packets_lost == 0
+
+    dropped = kept = 0
+    for i in range(20):
+        clock.advance(250)  # past the 200ms quality-report timer
+        ep_b.poll(status)
+        ep_b.send_all_messages(sock_b)
+        for _, msg in sock_a.receive_all_messages():
+            if isinstance(msg.body, QualityReport):
+                if i % 2 == 0:
+                    dropped += 1
+                    continue  # simulate datagram loss
+                kept += 1
+            ep_a.handle_message(msg)
+        ep_a.poll(status)
+        ep_a.send_all_messages(sock_a)
+        # let b consume replies so its timers stay honest
+        for _, msg in sock_b.receive_all_messages():
+            ep_b.handle_message(msg)
+    assert dropped > 0 and kept > 0
+    # each kept report following a dropped one shows a 2-interval gap
+    assert ep_a.packets_lost >= kept - 1
+    assert ep_a.network_stats().packets_lost == ep_a.packets_lost
+
+
+def test_jitter_tracks_rtt_variation():
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=10)
+    ((ep_a, sock_a), (ep_b, sock_b)), status = _sync(clock, net)
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=20, advance_ms=50)
+    settled = ep_a.jitter_ms
+    # now swing the latency hard: jitter must rise above the settled level
+    net.latency_ms = 150
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=10, advance_ms=60)
+    net.latency_ms = 10
+    pump([(ep_a, sock_a), (ep_b, sock_b)], status, clock, steps=10, advance_ms=60)
+    assert ep_a.jitter_ms > settled
+    assert ep_a.network_stats().jitter_ms == int(round(ep_a.jitter_ms))

@@ -34,3 +34,42 @@ def test_disabled_tracer_records_nothing():
     with t.span("x"):
         pass
     assert not t.stats
+
+
+def test_report_sizes_name_column_to_longest_path():
+    t = Tracer(enabled=True)
+    long_name = "session/" + "x" * 60
+    with t.span(long_name):
+        pass
+    with t.span("tick"):
+        pass
+    lines = t.report().splitlines()
+    # the name column sizes to the longest path, so every row's numeric
+    # fields start at the same offset — long paths no longer shift them
+    name_width = len(long_name)
+    count_end = name_width + 1 + 8  # "{name:{w}} {count:>8d}"
+    for line in lines:
+        assert len(line) > count_end
+        field = line[name_width + 1 : count_end].strip()
+        assert field in ("count",) or field.isdigit(), (
+            f"count column misaligned in {line!r}"
+        )
+    row = next(l for l in lines if long_name in l)
+    assert row.split()[0] == long_name
+
+
+def test_report_sort_by_total_surfaces_hot_spans_first():
+    import time
+
+    t = Tracer(enabled=True)
+    with t.span("cold"):
+        pass
+    with t.span("hot"):
+        time.sleep(0.002)
+    rows = t.report(sort_by="total").splitlines()[1:]
+    assert rows[0].split()[0] == "hot"
+    assert rows[1].split()[0] == "cold"
+    import pytest
+
+    with pytest.raises(ValueError):
+        t.report(sort_by="mean")
