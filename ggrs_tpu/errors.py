@@ -49,3 +49,13 @@ class StatsWindowTooYoung(NotSynchronized):
 class SpectatorTooFarBehind(GGRSError):
     """The spectator fell further behind the host than its input buffer can
     cover; catching up is impossible (src/error.rs:29)."""
+
+
+class HostFull(GGRSError):
+    """SessionHost admission control rejected an attach: the host is at its
+    `max_sessions` budget or draining. Typed (not a bare InvalidRequest) so
+    a fleet router can catch it and place the session on another host."""
+
+    def __init__(self, info: str):
+        super().__init__(info)
+        self.info = info

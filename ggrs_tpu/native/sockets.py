@@ -12,7 +12,10 @@ from typing import Any, List, Tuple
 from ..network.messages import Message, decode_all, encode_message
 from . import load
 
-RECV_BUFFER_SIZE = 4096
+# kept equal to network.sockets.RECV_BUFFER_SIZE: a Python peer may send
+# any datagram up to that bound, and a smaller native buffer would
+# reintroduce the silent-truncation hazard on cross-stack links
+from ..network.sockets import RECV_BUFFER_SIZE
 
 _configured = False
 

@@ -17,7 +17,36 @@ from typing import Any, Dict, List, Protocol, Tuple
 from ..utils.clock import Clock
 from .messages import Message, decode_all, encode_message
 
-RECV_BUFFER_SIZE = 4096
+# Sized to cover the largest datagram UDP can carry (65507 payload bytes):
+# the old 4096 silently truncated any fused-input datagram that outgrew it —
+# recvfrom() drops the excess without an error, and the codec then either
+# rejects the tail-less message or, worse, decodes a shorter valid prefix.
+# Senders enforce the same bound eagerly (check_datagram_size) so an
+# overgrown message fails loudly at the encode site, not as a mystery
+# truncation on the receiving peer.
+RECV_BUFFER_SIZE = 65536
+# the bound senders enforce: the receive buffer, capped at the largest
+# payload UDP itself can carry — a datagram in (65507, 65536] would clear
+# the buffer but die in sendto() with EMSGSIZE on the real transport, so
+# the virtual network must reject it too
+MAX_DATAGRAM_SIZE = min(RECV_BUFFER_SIZE, 65507)
+
+
+def check_datagram_size(wire: bytes) -> bytes:
+    """Encode-side twin of the receive buffer: every transport send path
+    funnels through here so a message that could not survive recvfrom()
+    (or UDP itself) raises at the sender, where the stack trace names the
+    oversized message, instead of silently truncating at the receiver.
+    A real exception (not an assert) so the guard survives `python -O`."""
+    if len(wire) > MAX_DATAGRAM_SIZE:
+        from ..errors import InvalidRequest
+
+        raise InvalidRequest(
+            f"datagram of {len(wire)} bytes exceeds MAX_DATAGRAM_SIZE "
+            f"({MAX_DATAGRAM_SIZE}): it would be truncated or rejected by "
+            "the real transport — split the message or grow the buffer"
+        )
+    return wire
 
 
 class NonBlockingSocket(Protocol):
@@ -43,11 +72,11 @@ class UdpNonBlockingSocket:
         return self.sock.getsockname()[1]
 
     def send_to(self, msg: Message, addr: Any) -> None:
-        self.sock.sendto(encode_message(msg), addr)
+        self.sock.sendto(check_datagram_size(encode_message(msg)), addr)
 
     def send_wire(self, wire: bytes, addr: Any) -> None:
         """Pre-encoded fast path used by native endpoints."""
-        self.sock.sendto(wire, addr)
+        self.sock.sendto(check_datagram_size(wire), addr)
 
     def receive_all_wire(self) -> List[Tuple[Any, bytes]]:
         """Raw datagrams (pre-codec): used by native endpoints and the
@@ -132,11 +161,15 @@ class InMemorySocket:
 
     def send_to(self, msg: Message, addr: Any) -> None:
         # serialize through the real wire codec so fault tests cover it
-        self.net._deliver(self.addr, addr, encode_message(msg))
+        self.net._deliver(
+            self.addr, addr, check_datagram_size(encode_message(msg))
+        )
 
     def send_wire(self, wire: bytes, addr: Any) -> None:
-        """Pre-encoded fast path used by native endpoints."""
-        self.net._deliver(self.addr, addr, wire)
+        """Pre-encoded fast path used by native endpoints; enforces the
+        same datagram bound as the real UDP socket so the virtual network
+        never delivers a message the real transport would truncate."""
+        self.net._deliver(self.addr, addr, check_datagram_size(wire))
 
     def receive_all_wire(self) -> List[Tuple[Any, bytes]]:
         return self.net._drain_wire(self.addr)

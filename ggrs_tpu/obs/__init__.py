@@ -17,6 +17,7 @@ from .metrics import (
     FRAME_ADVANTAGE_BUCKETS,
     LOG2_BUCKETS,
     LOG2_BUCKETS_MS,
+    SESSION_COUNT_BUCKETS,
     Counter,
     Gauge,
     Histogram,
@@ -36,6 +37,7 @@ __all__ = [
     "GLOBAL_TELEMETRY",
     "Histogram",
     "MetricsRegistry",
+    "SESSION_COUNT_BUCKETS",
     "Telemetry",
     "enable_global_telemetry",
     "jsonable",

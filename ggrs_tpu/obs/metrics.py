@@ -29,6 +29,11 @@ FRAME_ADVANTAGE_BUCKETS: Tuple[float, ...] = (
     -64.0, -32.0, -16.0, -8.0, -4.0, -2.0, -1.0, 0.0,
     1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0,
 )
+# session-count distributions (megabatch sizes, admission-queue depths):
+# log2 up to the largest host fleet a single device core plausibly serves
+SESSION_COUNT_BUCKETS: Tuple[float, ...] = tuple(
+    float(2**k) for k in range(0, 13)
+)
 
 
 def _escape_label(value: str) -> str:

@@ -1,0 +1,21 @@
+"""Multi-session serving: one device core, N sessions, cross-session
+continuous batching.
+
+    from ggrs_tpu.serve import SessionHost
+
+    host = SessionHost(game, num_players=4, max_sessions=64, clock=clock)
+    key = host.attach(session)          # HostFull past max_sessions
+    host.submit_input(key, handle, buf)
+    events = host.tick()                # pump + schedule + one megabatch
+    snap = host.telemetry()
+    host.drain(checkpoint_path="host.npz")
+
+Importing this package does not import jax; the device core materializes
+on the first SessionHost construction. The load-generator harness lives
+in ggrs_tpu.serve.loadgen (imported lazily for the same reason).
+"""
+
+from ..errors import HostFull
+from .host import SessionHost
+
+__all__ = ["HostFull", "SessionHost"]

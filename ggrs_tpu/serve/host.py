@@ -1,0 +1,683 @@
+"""SessionHost: N concurrent sessions multiplexed onto ONE shared device
+core via cross-session continuous batching.
+
+The single-session stack leaves the device idle whenever its one session
+waits on remote input; a serving process cannot afford that. The host owns
+many sessions (P2P and spectator), pumps all of their sockets every host
+tick, and coalesces every session whose `advance_frame` produced work into
+one fused cross-session MEGABATCH dispatch on a
+`ggrs_tpu.tpu.backend.MultiSessionDeviceCore` — each session world is one
+slot of a stacked device pytree, each session tick one packed control row,
+and the whole fleet's tick is one gather → vmapped-tick → scatter program
+behind the PR 1 async fence. Rows are data, so a freshly attached session,
+a mid-rollback session and a quiet session all ride the same cached
+program; megabatch row counts pad to a small set of bucket sizes so the
+jit cache stays bounded no matter how the fleet churns.
+
+Lifecycle: admission control (`max_sessions`, typed HostFull rejection),
+idle-session eviction and disconnect GC driven by the injectable Clock,
+and graceful drain (stop admitting, flush the fence, checkpoint the
+stacked worlds via utils/checkpoint). Backpressure: when the device
+window is full (`max_inflight_rows`), ready sessions queue in arrival
+order and the host reports queue depth.
+
+Telemetry rides the PR 2 obs registry: sessions active/evicted/rejected,
+megabatch-size histogram, cross-session occupancy, admission-queue wait
+histogram — one `host.telemetry()` snapshot folds them in with every
+hosted session's own section.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..errors import GGRSError, HostFull, InvalidRequest, PredictionThreshold
+from ..obs import GLOBAL_TELEMETRY, SESSION_COUNT_BUCKETS
+from ..types import (
+    Event,
+    InputStatus,
+    LoadGameState,
+    PlayerHandle,
+    Request,
+    SessionState,
+)
+from ..utils.clock import Clock
+from ..utils.tracing import GLOBAL_TRACER
+
+DEFAULT_IDLE_TIMEOUT_MS = 30_000
+
+
+class _StagedRow:
+    """One parsed request segment awaiting its megabatch: the packed
+    control row plus the SaveGameState requests whose cells get their
+    lazy checksums bound when the dispatch happens."""
+
+    __slots__ = ("row", "saves", "start_frame", "count")
+
+    def __init__(self, row, saves, start_frame, count):
+        self.row = row
+        self.saves = saves
+        self.start_frame = start_frame
+        self.count = count
+
+
+class _Lane:
+    """Host-side per-session state: device slot, staged rows, scheduling
+    and liveness bookkeeping."""
+
+    __slots__ = (
+        "key", "session", "slot", "kind", "num_players", "local_handles",
+        "max_prediction", "rows", "current_frame", "last_activity_ms",
+        "pending_inputs", "queued_since_tick", "ticks_advanced",
+        "throttled_ticks", "last_error", "failed",
+    )
+
+    def __init__(self, key, session, slot, kind, num_players,
+                 local_handles, max_prediction, now_ms):
+        self.key = key
+        self.session = session
+        self.slot = slot
+        self.kind = kind  # "p2p" | "spectator"
+        self.num_players = num_players
+        self.local_handles = frozenset(local_handles)
+        self.max_prediction = max_prediction
+        self.rows: deque = deque()
+        self.current_frame = 0
+        self.last_activity_ms = now_ms
+        self.pending_inputs: set = set()
+        self.queued_since_tick: Optional[int] = None
+        self.ticks_advanced = 0
+        self.throttled_ticks = 0
+        self.last_error: Optional[str] = None
+        self.failed = False  # quarantined: stops advancing, app detaches
+
+
+class SessionHost:
+    """Own N sessions, one shared device core; see the module docstring.
+
+    Usage:
+        host = SessionHost(game, max_prediction=8, num_players=4,
+                           max_sessions=64, clock=clock)
+        key = host.attach(session)            # raises HostFull past budget
+        host.submit_input(key, handle, buf)   # per local player per tick
+        events = host.tick()                  # pump + schedule + megabatch
+        ...
+        host.drain(checkpoint_path="host.npz")
+
+    Every session the host admits must share the host's game config (the
+    stacked worlds are one pytree): same model, same input_size, and a
+    player count <= the host's `num_players` layout — absent players pad
+    as DISCONNECTED, which both peers of a match do identically, so
+    desync detection still agrees across hosts."""
+
+    def __init__(self, game, *, max_prediction: int = 8,
+                 num_players: int = 2, max_sessions: int = 16,
+                 max_inflight_rows: Optional[int] = None,
+                 clock: Optional[Clock] = None,
+                 idle_timeout_ms: int = DEFAULT_IDLE_TIMEOUT_MS,
+                 async_inflight: int = 2, warmup: bool = False):
+        """`max_inflight_rows`: the device-window budget — session tick
+        rows admitted past the fence before ready sessions start queuing
+        (default: 2 full megabatches' worth). `idle_timeout_ms`: sessions
+        with no submitted input / advanced frame for this long are
+        evicted (0 disables). `warmup=True` compiles every megabatch
+        bucket before the first attach."""
+        from ..tpu.backend import MultiSessionDeviceCore
+
+        self.device = MultiSessionDeviceCore(
+            game, max_prediction, num_players, max_sessions,
+            async_inflight=async_inflight,
+        )
+        self.game = game
+        self.max_sessions = max_sessions
+        self.num_players = num_players
+        self.max_prediction = max_prediction
+        self.max_inflight_rows = (
+            max_inflight_rows
+            if max_inflight_rows is not None
+            else 2 * max_sessions
+        )
+        assert self.max_inflight_rows >= 1
+        self.clock = clock or Clock()
+        self.idle_timeout_ms = idle_timeout_ms
+        self._lanes: Dict[Any, _Lane] = {}
+        self._free_slots = list(range(max_sessions - 1, -1, -1))
+        # keys with staged rows, ARRIVAL order (the backpressure queue)
+        self._ready: deque = deque()
+        self._draining = False
+        self._drained = False
+        self._tick_index = 0
+        self._next_key = 0
+        # lifetime stats (host section of telemetry snapshots)
+        self.sessions_admitted = 0
+        self.sessions_rejected = 0
+        self.sessions_evicted = 0
+        self.sessions_gced = 0
+        self.desyncs_observed = 0
+        _reg = GLOBAL_TELEMETRY.registry
+        self._m_active = _reg.gauge(
+            "ggrs_host_sessions_active", "sessions currently attached"
+        )
+        self._m_evicted = _reg.counter(
+            "ggrs_host_sessions_evicted_total",
+            "sessions evicted for idleness or disconnect GC",
+        )
+        self._m_rejected = _reg.counter(
+            "ggrs_host_sessions_rejected_total",
+            "attach attempts rejected by admission control (HostFull)",
+        )
+        self._m_queue_depth = _reg.gauge(
+            "ggrs_host_queue_depth",
+            "ready sessions waiting on the device-window budget",
+        )
+        self._m_queue_wait = _reg.histogram(
+            "ggrs_host_queue_wait_ticks",
+            "host ticks a session's staged rows waited before dispatch",
+            buckets=SESSION_COUNT_BUCKETS,
+        )
+        if warmup:
+            self.device.warmup()
+
+    # ------------------------------------------------------------------
+    # admission / lifecycle
+    # ------------------------------------------------------------------
+
+    def attach(self, session, *, key: Any = None) -> Any:
+        """Admit a session; returns its host key. Raises HostFull when the
+        host is at max_sessions or draining, InvalidRequest when the
+        session is incompatible with the host layout or already hosted."""
+        if self._draining:
+            self._reject()
+            raise HostFull("host is draining: not admitting sessions")
+        if not self._free_slots:
+            self._reject()
+            raise HostFull(
+                f"host is at max_sessions={self.max_sessions}"
+            )
+        if key is None:
+            key = self._next_key
+            self._next_key += 1
+        if key in self._lanes:
+            raise InvalidRequest(f"host key {key!r} already in use")
+
+        # admission validates EVERYTHING the staging path will assume, so
+        # an incompatible session is rejected here with a clear error
+        # instead of crashing tick() for the whole fleet later
+        from ..sessions.p2p_session import P2PSession
+        from ..sessions.spectator_session import SpectatorSession
+
+        if isinstance(session, P2PSession):
+            kind = "p2p"
+        elif isinstance(session, SpectatorSession):
+            kind = "spectator"
+        else:
+            raise InvalidRequest(
+                "only Python P2PSession/SpectatorSession can be hosted "
+                f"(got {type(session).__name__}; native sessions drive "
+                "their own core)"
+            )
+        n_players = session.num_players
+        if n_players > self.num_players:
+            raise InvalidRequest(
+                f"session has {n_players} players; host layout is "
+                f"{self.num_players}"
+            )
+        if session.input_size != self.game.input_size:
+            raise InvalidRequest(
+                f"session input_size {session.input_size} != game "
+                f"input_size {self.game.input_size}"
+            )
+        if kind == "p2p":
+            if session.max_prediction > self.max_prediction:
+                raise InvalidRequest(
+                    f"session max_prediction {session.max_prediction} "
+                    f"exceeds the host window ({self.max_prediction})"
+                )
+            if session.sync_layer.current_frame != 0:
+                raise InvalidRequest(
+                    "host requires a fresh session (frame 0); this one is "
+                    f"at frame {session.sync_layer.current_frame}"
+                )
+            local_handles = session.local_player_handles()
+            max_prediction = session.max_prediction
+        else:
+            if session.current_frame >= 0:
+                raise InvalidRequest(
+                    "host requires a fresh spectator session; this one "
+                    f"already advanced to frame {session.current_frame}"
+                )
+            local_handles = []
+            max_prediction = self.max_prediction
+
+        # the hook raises on double-attach BEFORE we commit a slot
+        session.on_host_attach(self, key)
+        slot = self._free_slots.pop()
+        self.device.reset_slot(slot)
+        self._lanes[key] = _Lane(
+            key, session, slot, kind, n_players, local_handles,
+            max_prediction, self.clock.now_ms(),
+        )
+        self.sessions_admitted += 1
+        tel = GLOBAL_TELEMETRY
+        if tel.enabled:
+            self._m_active.set(len(self._lanes))
+            tel.record("host_session_attached", key=str(key), slot=slot)
+        return key
+
+    def _reject(self) -> None:
+        self.sessions_rejected += 1
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_rejected.inc()
+
+    def detach(self, key: Any) -> None:
+        """Remove a session and recycle its device slot. Staged rows that
+        never dispatched are dropped with it (the slot is reset, so no
+        other session can observe the partial state)."""
+        lane = self._lanes.pop(key, None)
+        if lane is None:
+            raise InvalidRequest(f"unknown host key {key!r}")
+        if lane.queued_since_tick is not None or lane.rows:
+            try:
+                self._ready.remove(key)
+            except ValueError:
+                pass
+        lane.session.on_host_detach()
+        self._free_slots.append(lane.slot)
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_active.set(len(self._lanes))
+            GLOBAL_TELEMETRY.record(
+                "host_session_detached", key=str(key), slot=lane.slot
+            )
+
+    def session(self, key: Any):
+        return self._lanes[key].session
+
+    def keys(self) -> List[Any]:
+        return list(self._lanes)
+
+    @property
+    def active_sessions(self) -> int:
+        return len(self._lanes)
+
+    @property
+    def queue_depth(self) -> int:
+        """Ready sessions still waiting on the device-window budget."""
+        return len(self._ready)
+
+    # ------------------------------------------------------------------
+    # per-tick driving
+    # ------------------------------------------------------------------
+
+    def submit_input(self, key: Any, handle: PlayerHandle, buf: bytes) -> None:
+        """Queue one local player's input for the session's next advance;
+        the session advances on the next host tick once every local
+        handle has input."""
+        lane = self._lanes[key]
+        lane.session.add_local_input(handle, buf)
+        lane.pending_inputs.add(handle)
+        lane.last_activity_ms = self.clock.now_ms()
+
+    def tick(self) -> Dict[Any, List[Event]]:
+        """One host cycle: pump every session's sockets, advance each
+        ready session, coalesce their tick rows into megabatches under
+        the device-window budget, then run eviction/GC. Returns the
+        events each session surfaced this tick, keyed by host key."""
+        with GLOBAL_TRACER.span("host/tick", absolute=True):
+            return self._tick_impl()
+
+    def _tick_impl(self) -> Dict[Any, List[Event]]:
+        self._tick_index += 1
+        events: Dict[Any, List[Event]] = {}
+
+        # 1. pump: every session's sockets drain every host tick, even for
+        # sessions that won't advance — protocol liveness (sync handshake,
+        # quality reports, disconnect timers) must not depend on input
+        with GLOBAL_TRACER.span("host/pump", absolute=True):
+            for lane in list(self._lanes.values()):
+                try:
+                    lane.session.poll_remote_clients()
+                except GGRSError as exc:  # keep serving the rest
+                    lane.last_error = type(exc).__name__
+                evs = lane.session.events()
+                if evs:
+                    events[lane.key] = evs
+                    lane.last_activity_ms = max(
+                        lane.last_activity_ms, self.clock.now_ms()
+                    )
+                    for ev in evs:
+                        if type(ev).__name__ == "DesyncDetected":
+                            self.desyncs_observed += 1
+
+        # 2. advance ready sessions and stage their rows
+        with GLOBAL_TRACER.span("host/advance", absolute=True):
+            for lane in list(self._lanes.values()):
+                if not self._lane_ready(lane):
+                    continue
+                try:
+                    requests = lane.session.advance_frame()
+                except PredictionThreshold:
+                    # spectator whose host input hasn't arrived: benign
+                    lane.throttled_ticks += 1
+                    continue
+                except GGRSError as exc:
+                    lane.last_error = type(exc).__name__
+                    if GLOBAL_TELEMETRY.enabled:
+                        GLOBAL_TELEMETRY.record(
+                            "host_session_error",
+                            key=str(lane.key),
+                            error=type(exc).__name__,
+                        )
+                    continue
+                lane.pending_inputs.clear()
+                lane.ticks_advanced += 1
+                lane.last_activity_ms = self.clock.now_ms()
+                try:
+                    self._stage(lane, requests)
+                except Exception as exc:
+                    # fleet isolation: a session whose request stream the
+                    # parser rejects is QUARANTINED (its device slot may
+                    # have missed a tick, so it must not keep advancing),
+                    # never a crash of the whole host tick. Rows staged
+                    # before the failing segment are dropped too — they
+                    # will never be followed by their successors, and
+                    # lingering rows would pin the lane past eviction/GC
+                    # (leaking its slot until a manual detach)
+                    lane.rows.clear()
+                    lane.failed = True
+                    lane.last_error = type(exc).__name__
+                    if GLOBAL_TELEMETRY.enabled:
+                        GLOBAL_TELEMETRY.record(
+                            "host_session_error",
+                            key=str(lane.key),
+                            error=type(exc).__name__,
+                            stage="parse",
+                        )
+                    continue
+                if lane.rows and lane.queued_since_tick is None:
+                    lane.queued_since_tick = self._tick_index
+                    self._ready.append(lane.key)
+
+        # 3. dispatch megabatches under the device-window budget
+        self._pump_device()
+
+        # 4. lifecycle: disconnect GC, then idle eviction
+        self._run_gc(events)
+        return events
+
+    def _lane_ready(self, lane: _Lane) -> bool:
+        if lane.failed:  # quarantined by a staging error
+            return False
+        if lane.rows:  # staged rows must dispatch before the next advance
+            return False
+        s = lane.session
+        if s.current_state() != SessionState.RUNNING:
+            return False
+        if lane.kind == "spectator":
+            return True
+        if not lane.local_handles <= lane.pending_inputs:
+            return False
+        # mirror sync_layer.add_local_input's prediction-threshold gate so
+        # a throttled session never advances into the partially-mutated
+        # PredictionThreshold raise mid-advance
+        sl = s.sync_layer
+        if (
+            sl.current_frame >= lane.max_prediction
+            and sl.current_frame - sl.last_confirmed_frame
+            >= lane.max_prediction
+        ):
+            lane.throttled_ticks += 1
+            return False
+        return True
+
+    # ------------------------------------------------------------------
+    # request staging (parse -> packed rows)
+    # ------------------------------------------------------------------
+
+    def _stage(self, lane: _Lane, requests: List[Request]) -> None:
+        segment: List[Request] = []
+        for req in requests:
+            if isinstance(req, LoadGameState) and segment:
+                self._stage_segment(lane, segment)
+                segment = []
+            segment.append(req)
+        if segment:
+            self._stage_segment(lane, segment)
+
+    def _stage_segment(self, lane: _Lane, requests: List[Request]) -> None:
+        from ..tpu.backend import parse_request_segment
+
+        core = self.device.core
+        W, P, I = core.window, self.num_players, self.game.input_size
+        inputs = np.zeros((W, P, I), dtype=np.uint8)
+        statuses = np.zeros((W, P), dtype=np.int32)
+        save_slots = np.full((W,), core.scratch_slot, dtype=np.int32)
+        if lane.num_players < P:
+            # pad players beyond the session's count as DISCONNECTED: the
+            # game model substitutes its deterministic dummy input, and
+            # every peer of the match pads identically
+            statuses[:, lane.num_players:] = int(InputStatus.DISCONNECTED)
+        load, start_frame, count, saves, last_active, trailing = (
+            parse_request_segment(
+                requests,
+                window=W,
+                ring_len=core.ring_len,
+                max_prediction=core.max_prediction,
+                current_frame=lane.current_frame,
+                inputs=inputs,
+                statuses=statuses,
+                save_slots=save_slots,
+            )
+        )
+        # per-row canonical signature into the SHARED plan cache: the
+        # fleet's repeated shapes coalesce across sessions
+        self.device.plan_cache.note(
+            (load is not None, count, last_active, trailing is not None),
+            frame=start_frame,
+        )
+        row = core.pack_tick_row(
+            do_load=load is not None,
+            load_slot=(load.frame % core.ring_len) if load is not None else 0,
+            inputs=inputs,
+            statuses=statuses,
+            save_slots=save_slots,
+            advance_count=count,
+            start_frame=start_frame,
+        )
+        lane.rows.append(_StagedRow(row, saves, start_frame, count))
+        lane.current_frame = start_frame + count
+
+    # ------------------------------------------------------------------
+    # megabatch scheduling
+    # ------------------------------------------------------------------
+
+    def _pump_device(self) -> None:
+        """Coalesce the ready queue's head rows into megabatches, oldest
+        arrivals first, until the device window is full or the queue is
+        empty. One row per session per megabatch preserves each session's
+        in-order request stream; a session with a second staged row
+        (sparse-saving keepalive) keeps its queue position."""
+        from ..tpu.backend import SnapshotRef, _LazyChecksum
+
+        core = self.device.core
+        while self._ready:
+            budget = self.max_inflight_rows - self.device.poll_retired()
+            if budget <= 0:
+                break
+            take = min(budget, len(self._ready), self.device.capacity)
+            picked: List[Tuple[_Lane, _StagedRow]] = []
+            for key in list(self._ready)[:take]:
+                lane = self._lanes[key]
+                picked.append((lane, lane.rows[0]))
+            entries = [
+                (lane.slot, staged.row) for lane, staged in picked
+            ]
+            batch, _bucket = self.device.dispatch(entries)
+            for k, (lane, staged) in enumerate(picked):
+                lane.rows.popleft()
+                base = k * core.window
+                for slot_i, save in staged.saves:
+                    save.cell.save_lazy(
+                        save.frame,
+                        SnapshotRef(save.frame, save.frame % core.ring_len),
+                        _LazyChecksum(batch, base + slot_i),
+                    )
+                if not lane.rows:
+                    self._ready.remove(lane.key)
+                    if GLOBAL_TELEMETRY.enabled:
+                        self._m_queue_wait.observe(
+                            self._tick_index - lane.queued_since_tick
+                        )
+                    lane.queued_since_tick = None
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_queue_depth.set(len(self._ready))
+
+    # ------------------------------------------------------------------
+    # eviction / GC / drain
+    # ------------------------------------------------------------------
+
+    def _run_gc(self, events: Dict[Any, List[Event]]) -> None:
+        now = self.clock.now_ms()
+        for lane in list(self._lanes.values()):
+            if lane.rows:
+                continue  # drain its staged work first
+            if self._all_remotes_gone(lane):
+                self._evict(lane, "disconnect_gc")
+                self.sessions_gced += 1
+                continue
+            if (
+                self.idle_timeout_ms > 0
+                and now - lane.last_activity_ms >= self.idle_timeout_ms
+            ):
+                self._evict(lane, "idle_timeout")
+
+    def _all_remotes_gone(self, lane: _Lane) -> bool:
+        """Disconnect GC predicate: a P2P session whose every remote peer
+        (players and spectators) has disconnected serves nobody; a
+        spectator whose host endpoint died can never advance again."""
+        from ..network.protocol import ProtocolState
+
+        s = lane.session
+        if lane.kind == "spectator":
+            return s.host.state in (
+                ProtocolState.DISCONNECTED, ProtocolState.SHUTDOWN
+            )
+        remotes = s.remote_player_handles()
+        if not remotes:
+            return False  # solo/local-only session: nothing to GC on
+        if any(
+            not s.local_connect_status[h].disconnected for h in remotes
+        ):
+            return False
+        # spectator endpoints still alive keep the session useful
+        return not any(
+            ep.is_running() for ep in s.player_reg.spectators.values()
+        )
+
+    def _evict(self, lane: _Lane, reason: str) -> None:
+        self.sessions_evicted += 1
+        tel = GLOBAL_TELEMETRY
+        if tel.enabled:
+            self._m_evicted.inc()
+            tel.record(
+                "host_session_evicted", key=str(lane.key), reason=reason
+            )
+        self.detach(lane.key)
+
+    def drain(self, checkpoint_path: Optional[str] = None) -> dict:
+        """Graceful shutdown: stop admitting (attach raises HostFull),
+        flush every staged row and the async fence, optionally checkpoint
+        the stacked device worlds, and return a final summary. Sessions
+        stay attached (detach them, or let the process exit)."""
+        self._draining = True
+        guard = 0
+        while self._ready:
+            # retire the whole fence first so the budget can never pin the
+            # queue: each pass then dispatches at least one megabatch
+            self.device.block_until_ready()
+            self._pump_device()
+            guard += 1
+            assert guard < 10_000, "drain failed to flush the ready queue"
+        self.device.block_until_ready()
+        if checkpoint_path is not None:
+            self.device.save(checkpoint_path)
+        self._drained = True
+        summary = self._host_section()
+        summary["checkpoint"] = checkpoint_path
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "host_drained", sessions=len(self._lanes),
+                checkpoint=str(checkpoint_path),
+            )
+        return summary
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+
+    def _host_section(self) -> dict:
+        dev = self.device
+        sessions = {}
+        for key, lane in self._lanes.items():
+            entry = {
+                "kind": lane.kind,
+                "slot": lane.slot,
+                "state": lane.session.current_state().value,
+                "current_frame": lane.current_frame,
+                "staged_rows": len(lane.rows),
+                "ticks_advanced": lane.ticks_advanced,
+                "throttled_ticks": lane.throttled_ticks,
+            }
+            if lane.last_error:
+                entry["last_error"] = lane.last_error
+            if lane.failed:
+                entry["failed"] = True
+            sessions[str(key)] = entry
+        return {
+            "active": len(self._lanes),
+            "max_sessions": self.max_sessions,
+            "draining": self._draining,
+            "admitted": self.sessions_admitted,
+            "rejected": self.sessions_rejected,
+            "evicted": self.sessions_evicted,
+            "disconnect_gced": self.sessions_gced,
+            "desyncs_observed": self.desyncs_observed,
+            "queue_depth": len(self._ready),
+            "inflight_rows": dev.inflight_rows,
+            "max_inflight_rows": self.max_inflight_rows,
+            "megabatches": dev.megabatches,
+            "rows_dispatched": dev.rows_dispatched,
+            "mean_megabatch_rows": (
+                round(dev.rows_dispatched / dev.megabatches, 3)
+                if dev.megabatches
+                else None
+            ),
+            "plan_signatures": len(dev.plan_cache.signatures),
+            "buckets": list(dev.buckets),
+            "sessions": sessions,
+        }
+
+    def telemetry(self) -> dict:
+        """One structured snapshot: the process-wide obs snapshot
+        (metrics incl. the host instruments, flight-recorder tail, tracer
+        spans) plus a `host` section aggregating scheduler/lifecycle
+        state and every hosted session's own session section."""
+        snap = GLOBAL_TELEMETRY.snapshot()
+        host = self._host_section()
+        for key, lane in self._lanes.items():
+            section_fn = getattr(
+                lane.session, "_telemetry_session_section", None
+            )
+            if callable(section_fn):
+                try:
+                    host["sessions"][str(key)]["session"] = section_fn()
+                except GGRSError:  # e.g. stats window too young
+                    pass
+        snap["host"] = host
+        return snap

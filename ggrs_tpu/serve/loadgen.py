@@ -1,0 +1,241 @@
+"""Load generator for SessionHost: hundreds of scripted 2-4-player
+matches over a lossy virtual network, driven in virtual time.
+
+Every peer of every match attaches to ONE SessionHost, so the fleet's
+simulation all runs on the shared device core — the megabatch-size
+histogram then directly reads how well cross-session coalescing engages.
+The network between peers is the seeded `InMemoryNetwork` fault model
+(latency/jitter/loss), the clock a `FakeClock` the harness advances one
+frame interval per host tick: the whole soak is deterministic per seed
+and runs as fast as the host can pump, which is what bench and CI
+smoke need.
+
+Inputs are scripted per (match, peer, tick) from the seed, with desync
+detection on — a zero-desync soak certifies that N concurrent sessions
+multiplexed through one stacked device pytree stay bit-exact replicas
+of each other.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional
+
+from ..network.sockets import InMemoryNetwork
+from ..sessions.builder import SessionBuilder
+from ..types import DesyncDetection, PlayerType, SessionState
+from ..utils.clock import FakeClock
+from .host import SessionHost
+
+FRAME_MS = 16
+
+
+def build_matches(
+    host: SessionHost,
+    net: InMemoryNetwork,
+    clock,
+    *,
+    sessions: int,
+    players_cycle=(2, 3, 4),
+    max_prediction: int = 8,
+    input_delay: int = 1,
+    desync_interval: int = 10,
+    seed: int = 0,
+) -> List[List[Any]]:
+    """Create full P2P constellations (every peer a hosted session) until
+    at least `sessions` peers are attached; returns the host keys grouped
+    by match. Match m's peer k lives at virtual address (m, k)."""
+    matches: List[List[Any]] = []
+    total = 0
+    m = 0
+    while total < sessions:
+        n = players_cycle[m % len(players_cycle)]
+        n = min(n, host.num_players, max(2, sessions - total))
+        keys = []
+        for k in range(n):
+            b = (
+                SessionBuilder(input_size=host.game.input_size)
+                .with_num_players(n)
+                .with_max_prediction_window(max_prediction)
+                .with_input_delay(input_delay)
+                .with_desync_detection_mode(
+                    DesyncDetection.on(interval=desync_interval)
+                )
+                .with_clock(clock)
+                .with_rng(random.Random((seed * 7919 + m * 131 + k) & 0xFFFF))
+            )
+            for h in range(n):
+                if h == k:
+                    b = b.add_player(PlayerType.local(), h)
+                else:
+                    b = b.add_player(PlayerType.remote((m, h)), h)
+            sess = b.start_p2p_session(net.socket((m, k)))
+            keys.append(host.attach(sess))
+        matches.append(keys)
+        total += n
+        m += 1
+    return matches
+
+
+def sync_fleet(host, matches, clock, *, max_ticks: int = 800) -> None:
+    """Pump the host until every hosted session reaches RUNNING."""
+    for _ in range(max_ticks):
+        host.tick()
+        clock.advance(FRAME_MS)
+        if all(
+            host.session(k).current_state() == SessionState.RUNNING
+            for keys in matches
+            for k in keys
+        ):
+            return
+    raise AssertionError(
+        f"fleet of {sum(len(m) for m in matches)} sessions failed to "
+        f"synchronize within {max_ticks} ticks"
+    )
+
+
+def make_scripts(matches, ticks: int, seed: int) -> Dict[Any, List[int]]:
+    """Deterministic per-(match, peer, tick) input scripts."""
+    rng = random.Random(seed ^ 0x5EED)
+    return {
+        (m, k): [rng.randrange(0, 16) for _ in range(ticks)]
+        for m, keys in enumerate(matches)
+        for k in range(len(keys))
+    }
+
+
+def drive_scripted(host, matches, clock, scripts, ticks: int) -> List[Any]:
+    """Submit every peer's scripted input and tick the host `ticks`
+    times; returns the (key, event) DesyncDetected pairs observed. The
+    shared drive loop of run_loadgen and bench.bench_serve_host."""
+    desyncs: List[Any] = []
+    for t in range(ticks):
+        for m, keys in enumerate(matches):
+            for k, key in enumerate(keys):
+                host.submit_input(key, k, bytes([scripts[(m, k)][t]]))
+        events = host.tick()
+        for key, evs in events.items():
+            desyncs += [
+                (key, e) for e in evs
+                if type(e).__name__ == "DesyncDetected"
+            ]
+        clock.advance(FRAME_MS)
+    return desyncs
+
+
+def run_loadgen(
+    *,
+    sessions: int = 64,
+    ticks: int = 120,
+    game=None,
+    entities: int = 16,
+    max_players: int = 4,
+    max_prediction: int = 8,
+    latency_ms: int = 20,
+    jitter_ms: int = 10,
+    loss: float = 0.05,
+    seed: int = 0,
+    host: Optional[SessionHost] = None,
+    max_inflight_rows: Optional[int] = None,
+    idle_timeout_ms: int = 0,
+    warmup: bool = True,
+    sync_ticks: int = 400,
+) -> Dict[str, Any]:
+    """Spin up >= `sessions` scripted peers in 2-4-player matches on one
+    SessionHost over a seeded lossy InMemoryNetwork and drive them
+    `ticks` host ticks in virtual time. Returns a JSON-able report:
+    desyncs, per-session progress, megabatch shape, queue behavior.
+
+    `host=None` builds one sized to the fleet (ExGame by default);
+    passing a host lets bench arms reuse a warmed core across runs."""
+    clock = FakeClock()
+    net = InMemoryNetwork(
+        clock,
+        latency_ms=latency_ms,
+        jitter_ms=jitter_ms,
+        loss=loss,
+        seed=seed,
+    )
+    if host is None:
+        if game is None:
+            from ..models.ex_game import ExGame
+
+            game = ExGame(num_players=max_players, num_entities=entities)
+        host = SessionHost(
+            game,
+            max_prediction=max_prediction,
+            num_players=max_players,
+            max_sessions=sessions + max_players,  # room for the last match
+            max_inflight_rows=max_inflight_rows,
+            clock=clock,
+            idle_timeout_ms=idle_timeout_ms,
+            warmup=warmup,
+        )
+    matches = build_matches(
+        host,
+        net,
+        clock,
+        sessions=sessions,
+        max_prediction=max_prediction,
+        seed=seed,
+    )
+    n_sessions = sum(len(keys) for keys in matches)
+
+    # --- synchronization phase: pump until every session is RUNNING
+    sync_fleet(host, matches, clock, max_ticks=sync_ticks)
+
+    # --- scripted drive: every peer submits its scripted input each tick;
+    # the host advances whoever is ready and megabatches the rest
+    scripts = make_scripts(matches, ticks, seed)
+    desyncs = drive_scripted(host, matches, clock, scripts, ticks)
+
+    # --- cooldown: let in-flight inputs and checksum reports land so the
+    # final comparison intervals actually run
+    for _ in range(3 * max_prediction):
+        events = host.tick()
+        for key, evs in events.items():
+            desyncs += [
+                (key, e) for e in evs
+                if type(e).__name__ == "DesyncDetected"
+            ]
+        clock.advance(FRAME_MS)
+
+    dev = host.device
+    frames = [host._lanes[k].current_frame for keys in matches for k in keys]
+    checksums_published = sum(
+        len(getattr(host.session(k), "local_checksum_history", ()))
+        for keys in matches
+        for k in keys
+    )
+    report = {
+        "sessions": n_sessions,
+        "matches": len(matches),
+        "ticks": ticks,
+        "seed": seed,
+        "loss": loss,
+        "latency_ms": latency_ms,
+        "jitter_ms": jitter_ms,
+        "desyncs": len(desyncs),
+        "checksums_published": checksums_published,
+        "min_frame": min(frames),
+        "max_frame": max(frames),
+        "megabatches": dev.megabatches,
+        "rows_dispatched": dev.rows_dispatched,
+        "mean_megabatch_rows": (
+            round(dev.rows_dispatched / dev.megabatches, 3)
+            if dev.megabatches
+            else 0.0
+        ),
+        "max_bucket": max(
+            (
+                sig[1]
+                for sig in dev.plan_cache.signatures
+                if isinstance(sig, tuple) and sig and sig[0] == "megabatch"
+            ),
+            default=0,
+        ),
+        "plan_signatures": len(dev.plan_cache.signatures),
+        "host": host._host_section(),
+    }
+    report["_host"] = host  # live handle for callers; strip before JSON
+    return report

@@ -143,6 +143,39 @@ class P2PSession:
         # re-detect the same divergence every pass, one dump per (peer,
         # frame) is the useful quantity
         self._desyncs_dumped: set = set()
+        # serve-host attachment (ggrs_tpu.serve.SessionHost): the host
+        # drives poll/advance and fulfills requests on its shared device
+        # core, so a session must belong to at most one host at a time
+        self._host = None
+        self._host_key = None
+
+    # ------------------------------------------------------------------
+    # serve-host lifecycle hooks (ggrs_tpu/serve/host.py)
+    # ------------------------------------------------------------------
+
+    def on_host_attach(self, host: Any, key: Any) -> None:
+        """Called by SessionHost.attach: from here the HOST owns this
+        session's pump/advance loop and request fulfillment. Attaching an
+        already-hosted session is an error — two hosts would both fulfill
+        its requests against different device slots."""
+        if self._host is not None:
+            raise InvalidRequest(
+                f"session already attached to a host (key={self._host_key!r})"
+            )
+        self._host = host
+        self._host_key = key
+
+    def on_host_detach(self) -> None:
+        """Called by SessionHost.detach/evict: the session is standalone
+        again (its device slot is recycled; any un-dispatched rows were
+        dropped with it)."""
+        self._host = None
+        self._host_key = None
+
+    @property
+    def host_key(self) -> Any:
+        """The key this session is hosted under, or None when standalone."""
+        return self._host_key
 
     # ------------------------------------------------------------------
     # public API
@@ -174,7 +207,12 @@ class P2PSession:
             return self._advance_frame_impl()
 
     def _advance_frame_impl(self) -> List[Request]:
-        self.poll_remote_clients()
+        # hosted sessions skip the internal pump: SessionHost drains every
+        # session's sockets once per host tick immediately before
+        # advancing the ready ones — repeating it here would double the
+        # fleet's per-tick socket/protocol work for nothing
+        if self._host is None:
+            self.poll_remote_clients()
         if self.state != SessionState.RUNNING:
             raise NotSynchronized()
 

@@ -58,6 +58,7 @@ class SpectatorSession:
     ):
         self.state = SessionState.SYNCHRONIZING
         self.num_players = num_players
+        self.input_size = input_size
         self.inputs: List[List[PlayerInput]] = [
             [PlayerInput.blank(NULL_FRAME, input_size) for _ in range(num_players)]
             for _ in range(SPECTATOR_BUFFER_SIZE)
@@ -70,6 +71,28 @@ class SpectatorSession:
         self.last_recv_frame: Frame = NULL_FRAME
         self.max_frames_behind = max_frames_behind
         self.catchup_speed = catchup_speed
+        # serve-host attachment (same contract as P2PSession's hooks)
+        self._host = None
+        self._host_key = None
+
+    def on_host_attach(self, host: Any, key: Any) -> None:
+        """SessionHost.attach hook; see P2PSession.on_host_attach."""
+        if self._host is not None:
+            from ..errors import InvalidRequest
+
+            raise InvalidRequest(
+                f"session already attached to a host (key={self._host_key!r})"
+            )
+        self._host = host
+        self._host_key = key
+
+    def on_host_detach(self) -> None:
+        self._host = None
+        self._host_key = None
+
+    @property
+    def host_key(self) -> Any:
+        return self._host_key
 
     def current_state(self) -> SessionState:
         return self.state
@@ -108,7 +131,10 @@ class SpectatorSession:
 
     def advance_frame(self) -> List[Request]:
         """(src/sessions/p2p_spectator_session.rs:109-138)"""
-        self.poll_remote_clients()
+        # hosted sessions skip the internal pump (see P2PSession's twin):
+        # the SessionHost already drained this tick
+        if self._host is None:
+            self.poll_remote_clients()
         if self.state != SessionState.RUNNING:
             raise NotSynchronized()
 

@@ -19,12 +19,17 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
-from ..obs import GLOBAL_TELEMETRY, LOG2_BUCKETS, LOG2_BUCKETS_MS
+from ..obs import (
+    GLOBAL_TELEMETRY,
+    LOG2_BUCKETS,
+    LOG2_BUCKETS_MS,
+    SESSION_COUNT_BUCKETS,
+)
 from ..ops.fixed_point import combine_checksum
 from ..types import (
     AdvanceFrame,
@@ -211,6 +216,125 @@ class _FutureChecksumBatch:
         return self.batch is not None and self.batch.ready
 
 
+class DispatchPlanCache:
+    """Canonical dispatch-signature tally: (has_load, advance_count,
+    last_active, trailing_save?) -> dispatch count, fronting one jit
+    cache. A TpuRollbackBackend owns one by default; a SessionHost's
+    MultiSessionDeviceCore shares ONE across every hosted session —
+    which is the point of canonicalization: every session's rollback
+    blocks of a given shape coalesce onto the same cached program, so
+    the Nth session admitted compiles nothing. Bounded in practice: the
+    request grammar admits O(window^2) shapes."""
+
+    def __init__(self):
+        self.signatures: dict = {}
+        _reg = GLOBAL_TELEMETRY.registry
+        self._m_hits = _reg.counter(
+            "ggrs_dispatch_plan_hits_total",
+            "request segments whose canonical signature was already cached",
+        )
+        self._m_misses = _reg.counter(
+            "ggrs_dispatch_plan_misses_total",
+            "request segments that introduced a new canonical signature",
+        )
+
+    def note(self, sig, frame: Frame = -1, *, metrics: bool = True) -> bool:
+        """Tally one dispatch of canonical signature `sig`; returns
+        whether the signature was already known (a plan-cache hit).
+        `metrics=False` keeps the tally out of the hit/miss counters —
+        for signature populations that aren't request segments (e.g.
+        megabatch bucket programs), which would otherwise pollute the
+        segment-canonicalization hit rate operators read."""
+        hit = sig in self.signatures
+        self.signatures[sig] = self.signatures.get(sig, 0) + 1
+        tel = GLOBAL_TELEMETRY
+        if metrics and tel.enabled:
+            if hit:
+                self._m_hits.inc()
+            else:
+                self._m_misses.inc()
+                tel.record("plan_cache_miss", frame=frame, signature=str(sig))
+        return hit
+
+    def clear(self) -> None:
+        self.signatures.clear()
+
+
+def parse_request_segment(
+    requests: List[Request],
+    *,
+    window: int,
+    ring_len: int,
+    max_prediction: int,
+    current_frame: Frame,
+    inputs: np.ndarray,
+    statuses: np.ndarray,
+    save_slots: np.ndarray,
+):
+    """One pass over a session's request segment — the grammar
+    [Load?] (Save? Advance)* Save? — into caller-owned packed staging
+    (inputs u8[W,P,I], statuses i32[W,P], save_slots i32[W], all
+    pre-filled with their neutral values; P may exceed the session's
+    player count, in which case the caller pre-fills the pad columns).
+
+    Returns (load, start_frame, count, saves, last_active,
+    trailing_save): `saves` is [(window_slot, SaveGameState)] for lazy-
+    checksum cell binding, `last_active` the row's 1-based last active
+    slot for branchless-variant routing. THE one implementation of the
+    grammar, shared by TpuRollbackBackend (pooled staging, per-backend
+    jit cache) and the serve host's session lanes (fresh staging, one
+    shared megabatch program)."""
+    load: Optional[LoadGameState] = None
+    slots: List[Tuple[Optional[SaveGameState], AdvanceFrame]] = []
+    pending_save: Optional[SaveGameState] = None
+
+    for req in requests:
+        if isinstance(req, LoadGameState):
+            assert load is None and not slots and pending_save is None, (
+                "unsupported request pattern: Load must lead a segment"
+            )
+            load = req
+        elif isinstance(req, SaveGameState):
+            if pending_save is not None:
+                # first-frame double save (p2p_session.rs:270-272 + :295)
+                assert pending_save.frame == req.frame
+            pending_save = req
+        elif isinstance(req, AdvanceFrame):
+            slots.append((pending_save, req))
+            pending_save = None
+        else:
+            raise TypeError(f"unknown request {req!r}")
+    trailing_save = pending_save
+
+    count = len(slots)
+    assert count <= max_prediction + 1, "tick exceeds the fused window"
+    assert trailing_save is None or count < window
+
+    start_frame = load.frame if load is not None else current_frame
+    saves: List[Tuple[int, SaveGameState]] = []
+
+    for i, (save, adv) in enumerate(slots):
+        if save is not None:
+            assert save.frame == start_frame + i, (
+                f"save of frame {save.frame} out of order "
+                f"(expected {start_frame + i})"
+            )
+            save_slots[i] = save.frame % ring_len
+            saves.append((i, save))
+        for p, (buf, status) in enumerate(adv.inputs):
+            inputs[i, p] = np.frombuffer(buf, dtype=np.uint8)
+            statuses[i, p] = int(status)
+    if trailing_save is not None:
+        assert trailing_save.frame == start_frame + count
+        save_slots[count] = trailing_save.frame % ring_len
+        saves.append((count, trailing_save))
+
+    last_active = max(count, 1)
+    if saves:
+        last_active = max(last_active, saves[-1][0] + 1)
+    return load, start_frame, count, saves, last_active, trailing_save
+
+
 class TpuRollbackBackend:
     """Request-fulfilling rollback backend over a device game.
 
@@ -268,7 +392,8 @@ class TpuRollbackBackend:
                  speculation_gate: str = "always",
                  defer_speculation: bool = False, lazy_ticks: int = 0,
                  spec_backend: str = "auto", tick_backend: str = "auto",
-                 async_dispatch: bool = False, async_inflight: int = 2):
+                 async_dispatch: bool = False, async_inflight: int = 2,
+                 plan_cache: Optional["DispatchPlanCache"] = None):
         """`mesh`: optional jax Mesh with an `entity` axis — the world and
         its snapshot ring shard across it (see ResimCore); the session-facing
         contract (requests in, SnapshotRefs + lazy checksums out) is
@@ -460,8 +585,9 @@ class TpuRollbackBackend:
         self._pad_row: Optional[np.ndarray] = None
         # canonicalized dispatch signatures observed (async bookkeeping /
         # test hook): (has_load, advance_count, last_active, trailing?) ->
-        # dispatch count. Bounded: the grammar admits O(window^2) shapes.
-        self.dispatch_signatures: dict = {}
+        # dispatch count, via a DispatchPlanCache (optionally shared —
+        # backends fronting one jit cache should share one tally)
+        self.plan_cache = plan_cache or DispatchPlanCache()
         # pre-bound telemetry instruments (updated behind enabled checks)
         _reg = GLOBAL_TELEMETRY.registry
         self._m_fence_stall = _reg.histogram(
@@ -476,14 +602,6 @@ class TpuRollbackBackend:
             "ggrs_fused_batch_ticks",
             "session ticks fused into one multi-tick device dispatch",
             buckets=LOG2_BUCKETS,
-        )
-        self._m_plan_hits = _reg.counter(
-            "ggrs_dispatch_plan_hits_total",
-            "request segments whose canonical signature was already cached",
-        )
-        self._m_plan_misses = _reg.counter(
-            "ggrs_dispatch_plan_misses_total",
-            "request segments that introduced a new canonical signature",
         )
         self.beam_gated = 0  # ticks where the FULL-width launch was withheld
         # width-1 history-only launches (member 0: pinned history +
@@ -717,82 +835,42 @@ class TpuRollbackBackend:
         save_slots.fill(core.scratch_slot)
         return inputs, statuses, save_slots
 
+    @property
+    def dispatch_signatures(self) -> dict:
+        """Signature -> dispatch count view of the plan cache (test hook /
+        bookkeeping; the historical attribute name)."""
+        return self.plan_cache.signatures
+
     def _parse_segment(self, requests: List[Request]):
-        """One pass over a request segment into packed-dispatch staging.
-        Returns (load, start_frame, count, inputs, statuses, save_slots,
-        saves, last_active): `last_active` is the row's 1-based last
-        active slot, handed to the core so branchless-variant routing
-        skips its save-slot rescan; the (shape-level) signature is tallied
-        in dispatch_signatures — repeated rollback blocks of one shape
-        reuse one cached jitted program."""
-        load: Optional[LoadGameState] = None
-        slots: List[Tuple[Optional[SaveGameState], AdvanceFrame]] = []
-        pending_save: Optional[SaveGameState] = None
-
-        for req in requests:
-            if isinstance(req, LoadGameState):
-                assert load is None and not slots and pending_save is None, (
-                    "unsupported request pattern: Load must lead a segment"
-                )
-                load = req
-            elif isinstance(req, SaveGameState):
-                if pending_save is not None:
-                    # first-frame double save (p2p_session.rs:270-272 + :295)
-                    assert pending_save.frame == req.frame
-                pending_save = req
-            elif isinstance(req, AdvanceFrame):
-                slots.append((pending_save, req))
-                pending_save = None
-            else:
-                raise TypeError(f"unknown request {req!r}")
-        trailing_save = pending_save
-
+        """One pass over a request segment into packed-dispatch staging
+        (the shared parse_request_segment grammar walk over this backend's
+        pooled staging). Returns (load, start_frame, count, inputs,
+        statuses, save_slots, saves, last_active): `last_active` is the
+        row's 1-based last active slot, handed to the core so
+        branchless-variant routing skips its save-slot rescan; the
+        (shape-level) signature is tallied in the plan cache — repeated
+        rollback blocks of one shape reuse one cached jitted program."""
         core = self.core
-        W = core.window
-        count = len(slots)
-        assert count <= core.max_prediction + 1, "tick exceeds the fused window"
-        assert trailing_save is None or count < W
-
         inputs, statuses, save_slots = self._next_stage()
-
-        start_frame = load.frame if load is not None else self.current_frame
-        saves: List[Tuple[int, SaveGameState]] = []
-
-        for i, (save, adv) in enumerate(slots):
-            if save is not None:
-                assert save.frame == start_frame + i, (
-                    f"save of frame {save.frame} out of order (expected {start_frame + i})"
-                )
-                save_slots[i] = save.frame % core.ring_len
-                saves.append((i, save))
-            for p, (buf, status) in enumerate(adv.inputs):
-                inputs[i, p] = np.frombuffer(buf, dtype=np.uint8)
-                statuses[i, p] = int(status)
-        if trailing_save is not None:
-            assert trailing_save.frame == start_frame + count
-            save_slots[count] = trailing_save.frame % core.ring_len
-            saves.append((count, trailing_save))
-
-        last_active = max(count, 1)
-        if saves:
-            last_active = max(last_active, saves[-1][0] + 1)
+        load, start_frame, count, saves, last_active, trailing_save = (
+            parse_request_segment(
+                requests,
+                window=core.window,
+                ring_len=core.ring_len,
+                max_prediction=core.max_prediction,
+                current_frame=self.current_frame,
+                inputs=inputs,
+                statuses=statuses,
+                save_slots=save_slots,
+            )
+        )
         sig = (
             load is not None,
             count,
             last_active,
             trailing_save is not None,
         )
-        hit = sig in self.dispatch_signatures
-        self.dispatch_signatures[sig] = self.dispatch_signatures.get(sig, 0) + 1
-        tel = GLOBAL_TELEMETRY
-        if tel.enabled:
-            if hit:
-                self._m_plan_hits.inc()
-            else:
-                self._m_plan_misses.inc()
-                tel.record(
-                    "plan_cache_miss", frame=start_frame, signature=str(sig)
-                )
+        self.plan_cache.note(sig, frame=start_frame)
         return (
             load, start_frame, count, inputs, statuses, save_slots, saves,
             last_active,
@@ -1569,3 +1647,255 @@ class TpuRollbackBackend:
             )
         backend.current_frame = meta["current_frame"]
         return backend
+
+
+class MultiSessionDeviceCore:
+    """N independent session worlds stacked on a leading `session` axis of
+    one device-resident pytree, ticked by ONE fused cross-session
+    megabatch dispatch — the batch-across-sessions entry point behind
+    ggrs_tpu.serve.SessionHost.
+
+    Every hosted session keeps the exact request/cell contract of
+    TpuRollbackBackend (ordered Save/Load/Advance lists in, SnapshotRefs
+    and lazy checksums out), but instead of one device dispatch per
+    session per tick, the host collects each ready session's packed
+    control row and executes them all as one program: gather the active
+    slots' (ring, state) from the stacked pytrees, vmap the
+    single-session packed tick over them, scatter the results back.
+    Rows are DATA (the packed control-word layout), so sessions at
+    different frames, mid-rollback or freshly attached all ride the same
+    jitted program — only the row count shapes the jit key, and it pads
+    to a small set of bucket sizes so the cache stays bounded at
+    O(len(buckets)) programs regardless of fleet churn.
+
+    Capacity is fixed at construction; slot `capacity` is a dummy world
+    that padding rows no-op tick against (pad rows skip every save and
+    advance, so the dummy never changes and duplicate pad scatters write
+    identical values)."""
+
+    def __init__(self, game, max_prediction: int, num_players: int,
+                 capacity: int, *, async_inflight: int = 2,
+                 plan_cache: Optional[DispatchPlanCache] = None,
+                 buckets: Optional[Sequence[int]] = None):
+        """`num_players` is the HOST-WIDE player layout (the widest
+        session the host admits): every hosted session's rows are packed
+        at this width, with absent players padded as DISCONNECTED so the
+        game model substitutes its deterministic dummy input — both peers
+        of a match pad identically, so checksums still agree.
+
+        `buckets`: megabatch row-count pad targets (default: powers of
+        two up to capacity, plus capacity itself)."""
+        import jax.numpy as jnp
+        from collections import deque as _deque
+
+        assert capacity >= 1
+        # the template core supplies the packed-row layout and the
+        # single-session tick program the megabatch vmaps; its own
+        # (single) ring/state are only the stack's init template
+        self.core = ResimCore(game, max_prediction, num_players)
+        self.capacity = capacity
+        self.num_players = num_players
+        self.input_size = game.input_size
+        self.async_inflight = async_inflight
+        self.plan_cache = plan_cache or DispatchPlanCache()
+        self.ledger = ChecksumLedger()
+        if buckets is None:
+            buckets, b = {capacity}, 1
+            while b < capacity:
+                buckets.add(b)
+                b *= 2
+        self.buckets = tuple(sorted(set(buckets)))
+        assert self.buckets[-1] >= capacity, (
+            "largest bucket must cover a full-capacity megabatch"
+        )
+        S = capacity + 1  # + the dummy pad slot
+        self.states = jax.tree.map(
+            lambda x: jnp.stack([x] * S), self.core.state
+        )
+        self.rings = jax.tree.map(
+            lambda x: jnp.zeros((S,) + x.shape, x.dtype), self.core.ring
+        )
+        self._dispatch_fn = jax.jit(
+            self._dispatch_impl, donate_argnums=(0, 1)
+        )
+        self._pad_row = self.core.pad_tick_row()
+        # async fence over megabatches: (result handle, live row count);
+        # inflight_rows is the host's backpressure signal
+        self._inflight: "_deque" = _deque()
+        self.inflight_rows = 0
+        self.megabatches = 0
+        self.rows_dispatched = 0
+        _reg = GLOBAL_TELEMETRY.registry
+        self._m_batch_rows = _reg.histogram(
+            "ggrs_host_megabatch_rows",
+            "session tick rows fused into one cross-session dispatch",
+            buckets=SESSION_COUNT_BUCKETS,
+        )
+        self._m_occupancy = _reg.gauge(
+            "ggrs_host_megabatch_occupancy",
+            "live rows / padded bucket size of the last megabatch",
+        )
+
+    # ------------------------------------------------------------------
+
+    def _dispatch_impl(self, rings, states, idx, rows):
+        """Gather [B] session worlds, vmap the packed tick, scatter back.
+        Duplicate pad indices (all pointing at the dummy slot) compute
+        identical results, so the scatter stays deterministic."""
+        g_ring = jax.tree.map(lambda a: a[idx], rings)
+        g_state = jax.tree.map(lambda a: a[idx], states)
+
+        def one(ring, state, row):
+            ring, state, _, his, los = self.core._tick_packed_impl(
+                ring, state, row, {}
+            )
+            return ring, state, his, los
+
+        new_ring, new_state, his, los = jax.vmap(one)(g_ring, g_state, rows)
+        rings = jax.tree.map(lambda a, b: a.at[idx].set(b), rings, new_ring)
+        states = jax.tree.map(
+            lambda a, b: a.at[idx].set(b), states, new_state
+        )
+        return rings, states, his, los
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest configured pad target covering n rows."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise AssertionError(f"{n} rows exceed the largest bucket")
+
+    def dispatch(self, entries) -> Tuple[_ChecksumBatch, int]:
+        """Run one cross-session megabatch. `entries` is a list of
+        (slot, packed_row) with AT MOST ONE row per slot — a session's
+        second staged row (sparse-saving keepalive) rides the next
+        megabatch, preserving its in-session order. Returns
+        (checksum_batch, bucket): entry k's window-slot i checksum lives
+        at flat index k * window + i of the batch. Non-blocking beyond
+        the async-inflight fence."""
+        n = len(entries)
+        assert 0 < n <= self.capacity
+        assert len({slot for slot, _ in entries}) == n, (
+            "one row per session slot per megabatch"
+        )
+        bucket = self.bucket_for(n)
+        idx = np.full((bucket,), self.capacity, dtype=np.int32)
+        rows = np.tile(self._pad_row, (bucket, 1))
+        for k, (slot, row) in enumerate(entries):
+            assert 0 <= slot < self.capacity
+            idx[k] = slot
+            rows[k] = row
+        # each bucket is one cached jitted program: tally it beside the
+        # per-row signatures, but OUT of the segment hit/miss counters
+        # (a different cache population with its own hit dynamics)
+        self.plan_cache.note(("megabatch", bucket), metrics=False)
+        self.rings, self.states, his, los = self._dispatch_fn(
+            self.rings, self.states, idx, rows
+        )
+        self.megabatches += 1
+        self.rows_dispatched += n
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_batch_rows.observe(n)
+            self._m_occupancy.set(n / bucket)
+        self._note_inflight(his, n)
+        return _ChecksumBatch(his, los, self.ledger), bucket
+
+    def _note_inflight(self, handle, n_rows: int) -> None:
+        """Same fence discipline as TpuRollbackBackend._note_inflight:
+        admit the dispatch, then block on the OLDEST once more than
+        async_inflight megabatches are outstanding."""
+        self._inflight.append((handle, n_rows))
+        self.inflight_rows += n_rows
+        while len(self._inflight) > self.async_inflight:
+            oldest, rows = self._inflight.popleft()
+            jax.block_until_ready(oldest)
+            self.inflight_rows -= rows
+
+    def poll_retired(self) -> int:
+        """Drop already-retired megabatches from the fence without
+        blocking; returns the rows still in flight (the host's
+        backpressure budget reads this)."""
+        while self._inflight and _array_is_ready(self._inflight[0][0]):
+            _, rows = self._inflight.popleft()
+            self.inflight_rows -= rows
+        return self.inflight_rows
+
+    # ------------------------------------------------------------------
+    # slot lifecycle
+    # ------------------------------------------------------------------
+
+    def reset_slot(self, slot: int) -> None:
+        """Return one session slot to its initial world (attach/evict
+        slot reuse): state back to init_state(), ring zeroed. Eager
+        per-leaf updates — a lifecycle event, not a hot path."""
+        import jax.numpy as jnp
+
+        assert 0 <= slot < self.capacity
+        init = self.core.game.init_state()
+        self.states = jax.tree.map(
+            lambda a, x: a.at[slot].set(x), self.states, init
+        )
+        self.rings = jax.tree.map(
+            lambda a: a.at[slot].set(jnp.zeros(a.shape[1:], a.dtype)),
+            self.rings,
+        )
+
+    def state_numpy(self, slot: int):
+        """Host copy of one session slot's live world (parity checks)."""
+        self.block_until_ready()
+        return jax.tree.map(
+            lambda a: np.asarray(jax.device_get(a[slot])), self.states
+        )
+
+    def warmup(self) -> None:
+        """Compile the megabatch program at every bucket size before
+        serving: first compilation takes seconds — enough to stall every
+        hosted session at once mid-tick. All-pad dispatches are true
+        no-ops on the stacked worlds."""
+        for b in self.buckets:
+            idx = np.full((b,), self.capacity, dtype=np.int32)
+            rows = np.tile(self._pad_row, (b, 1))
+            self.rings, self.states, _, _ = self._dispatch_fn(
+                self.rings, self.states, idx, rows
+            )
+        self.block_until_ready()
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self.states)
+        self._inflight.clear()
+        self.inflight_rows = 0
+
+    # ------------------------------------------------------------------
+    # durable checkpoint (graceful drain rides this)
+    # ------------------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        from ..utils.checkpoint import save_device_checkpoint
+
+        self.block_until_ready()
+        save_device_checkpoint(
+            path,
+            {"rings": self.rings, "states": self.states},
+            {
+                "kind": "MultiSessionDeviceCore",
+                "capacity": self.capacity,
+                "max_prediction": self.core.max_prediction,
+                "num_players": self.num_players,
+            },
+        )
+
+    @classmethod
+    def restore(cls, path: str, game) -> "MultiSessionDeviceCore":
+        from ..utils.checkpoint import load_device_checkpoint
+
+        tree, meta = load_device_checkpoint(path)
+        assert meta["kind"] == "MultiSessionDeviceCore"
+        core = cls(
+            game,
+            max_prediction=meta["max_prediction"],
+            num_players=meta["num_players"],
+            capacity=meta["capacity"],
+        )
+        core.rings = jax.device_put(tree["rings"])
+        core.states = jax.device_put(tree["states"])
+        return core

@@ -18,6 +18,10 @@
 #   --obs-smoke runs a short P2P session with telemetry enabled and
 #   validates the Prometheus/JSON exports parse and that a forced desync
 #   produces a forensics bundle (scripts/obs_smoke.py, host-only, fast).
+#   --serve-smoke runs a short SessionHost loadgen scenario end-to-end
+#   (cross-session megabatching, zero desyncs) and validates the host
+#   telemetry snapshot exports via both the Prometheus and JSON
+#   exporters (scripts/serve_smoke.py, CPU jax, <1 min).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -40,6 +44,12 @@ fi
 if [ "${1:-}" = "--obs-smoke" ]; then
   echo "== obs smoke (telemetry exports + desync forensics) =="
   JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+  exit $?
+fi
+
+if [ "${1:-}" = "--serve-smoke" ]; then
+  echo "== serve smoke (SessionHost loadgen + host telemetry exporters) =="
+  JAX_PLATFORMS=cpu python scripts/serve_smoke.py
   exit $?
 fi
 
