@@ -14,6 +14,7 @@ does not import jax.
 """
 
 from .metrics import (
+    DISPATCH_DEPTH_BUCKETS,
     FRAME_ADVANTAGE_BUCKETS,
     LOG2_BUCKETS,
     LOG2_BUCKETS_MS,
@@ -27,6 +28,7 @@ from .recorder import FlightEvent, FlightRecorder, jsonable
 from .telemetry import GLOBAL_TELEMETRY, Telemetry, enable_global_telemetry
 
 __all__ = [
+    "DISPATCH_DEPTH_BUCKETS",
     "FRAME_ADVANTAGE_BUCKETS",
     "LOG2_BUCKETS",
     "LOG2_BUCKETS_MS",

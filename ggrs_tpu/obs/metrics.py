@@ -34,6 +34,13 @@ FRAME_ADVANTAGE_BUCKETS: Tuple[float, ...] = (
 SESSION_COUNT_BUCKETS: Tuple[float, ...] = tuple(
     float(2**k) for k in range(0, 13)
 )
+# routed dispatch depth (window slots actually executed per dispatch):
+# finer than log2 in the interactive range so adjacent depth variants
+# (3 vs 6 slots) land in distinct buckets; le=1 isolates the megabatch
+# zero-rollback fast path, which the dispatch smoke gate asserts on
+DISPATCH_DEPTH_BUCKETS: Tuple[float, ...] = (
+    1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0,
+)
 
 
 def _escape_label(value: str) -> str:

@@ -12,7 +12,13 @@ and the whole fleet's tick is one gather → vmapped-tick → scatter program
 behind the PR 1 async fence. Rows are data, so a freshly attached session,
 a mid-rollback session and a quiet session all ride the same cached
 program; megabatch row counts pad to a small set of bucket sizes so the
-jit cache stays bounded no matter how the fleet churns.
+jit cache stays bounded no matter how the fleet churns. The scheduler
+additionally groups ready rows by ROLLBACK DEPTH (depth-adaptive
+dispatch): zero-rollback ticks — the dominant traffic — ride a dedicated
+fast program that skips the ring gather/scatter and the resim scan
+outright, and rollback rows ride windowed programs sized to their depth
+bucket, so one deep rollback never drags the whole fleet's rows to the
+full window (docs/DESIGN.md "Depth-adaptive dispatch").
 
 Lifecycle: admission control (`max_sessions`, typed HostFull rejection),
 idle-session eviction and disconnect GC driven by the injectable Clock,
@@ -53,30 +59,43 @@ DEFAULT_IDLE_TIMEOUT_MS = 30_000
 class _StagedRow:
     """One parsed request segment awaiting its megabatch: the packed
     control row plus the SaveGameState requests whose cells get their
-    lazy checksums bound when the dispatch happens."""
+    lazy checksums bound when the dispatch happens. `last_active` (the
+    row's 1-based last active slot) and `fast` (zero-rollback fast-path
+    eligibility) are the scheduler's depth-routing keys, computed once
+    at parse time so grouping never rescans rows."""
 
-    __slots__ = ("row", "saves", "start_frame", "count")
+    __slots__ = ("row", "saves", "start_frame", "count", "last_active",
+                 "fast")
 
-    def __init__(self, row, saves, start_frame, count):
+    def __init__(self, row, saves, start_frame, count, last_active, fast):
         self.row = row
         self.saves = saves
         self.start_frame = start_frame
         self.count = count
+        self.last_active = last_active
+        self.fast = fast
 
 
 class _Lane:
     """Host-side per-session state: device slot, staged rows, scheduling
     and liveness bookkeeping."""
 
+    # a lane stages at most two rows per advance (misprediction rollback
+    # + sparse-saving keepalive segments) and cannot advance again until
+    # they dispatch, and a dispatched row is host-copied into the pooled
+    # bucket staging before dispatch() returns — so a 4-deep rotating
+    # row pool can never hand out a buffer still staged or in flight
+    ROW_POOL = 4
+
     __slots__ = (
         "key", "session", "slot", "kind", "num_players", "local_handles",
         "max_prediction", "rows", "current_frame", "last_activity_ms",
         "pending_inputs", "queued_since_tick", "ticks_advanced",
-        "throttled_ticks", "last_error", "failed",
+        "throttled_ticks", "last_error", "failed", "row_pool", "row_flip",
     )
 
     def __init__(self, key, session, slot, kind, num_players,
-                 local_handles, max_prediction, now_ms):
+                 local_handles, max_prediction, now_ms, packed_len):
         self.key = key
         self.session = session
         self.slot = slot
@@ -93,6 +112,17 @@ class _Lane:
         self.throttled_ticks = 0
         self.last_error: Optional[str] = None
         self.failed = False  # quarantined: stops advancing, app detaches
+        # pooled packed-row buffers (pack_tick_row_into targets): staging
+        # a segment allocates nothing on the steady-state path
+        self.row_pool = [
+            np.empty((packed_len,), dtype=np.int32)
+            for _ in range(self.ROW_POOL)
+        ]
+        self.row_flip = 0
+
+    def next_row_buf(self) -> np.ndarray:
+        self.row_flip = (self.row_flip + 1) % len(self.row_pool)
+        return self.row_pool[self.row_flip]
 
 
 class SessionHost:
@@ -118,19 +148,26 @@ class SessionHost:
                  max_inflight_rows: Optional[int] = None,
                  clock: Optional[Clock] = None,
                  idle_timeout_ms: int = DEFAULT_IDLE_TIMEOUT_MS,
-                 async_inflight: int = 2, warmup: bool = False):
+                 async_inflight: int = 2, warmup: bool = False,
+                 depth_routing: bool = True):
         """`max_inflight_rows`: the device-window budget — session tick
         rows admitted past the fence before ready sessions start queuing
         (default: 2 full megabatches' worth). `idle_timeout_ms`: sessions
         with no submitted input / advanced frame for this long are
         evicted (0 disables). `warmup=True` compiles every megabatch
-        bucket before the first attach."""
+        bucket (the full row x depth grid under depth routing) before
+        the first attach. `depth_routing=True` groups ready sessions by
+        rollback depth and dispatches one megabatch per occupied depth
+        bucket — zero-rollback ticks ride a dedicated fast program —
+        instead of dragging every row to the full window; False pins the
+        single full-window megabatch (the parity suite's reference)."""
         from ..tpu.backend import MultiSessionDeviceCore
 
         self.device = MultiSessionDeviceCore(
             game, max_prediction, num_players, max_sessions,
-            async_inflight=async_inflight,
+            async_inflight=async_inflight, depth_routing=depth_routing,
         )
+        self.depth_routing = depth_routing
         self.game = game
         self.max_sessions = max_sessions
         self.num_players = num_players
@@ -259,6 +296,7 @@ class SessionHost:
         self._lanes[key] = _Lane(
             key, session, slot, kind, n_players, local_handles,
             max_prediction, self.clock.now_ms(),
+            self.device.core._packed_len,
         )
         self.sessions_admitted += 1
         tel = GLOBAL_TELEMETRY
@@ -446,14 +484,31 @@ class SessionHost:
         if segment:
             self._stage_segment(lane, segment)
 
+    def _parse_staging(self):
+        """The host-wide pooled parse triple (inputs, statuses,
+        save_slots), refilled with neutral values per segment: the walk's
+        output is consumed synchronously by pack_tick_row_into, so one
+        triple serves the whole fleet with zero steady-state allocation."""
+        core = self.device.core
+        if not hasattr(self, "_parse_bufs"):
+            W, P, I = core.window, self.num_players, self.game.input_size
+            self._parse_bufs = (
+                np.zeros((W, P, I), dtype=np.uint8),
+                np.zeros((W, P), dtype=np.int32),
+                np.full((W,), core.scratch_slot, dtype=np.int32),
+            )
+        inputs, statuses, save_slots = self._parse_bufs
+        inputs.fill(0)
+        statuses.fill(0)
+        save_slots.fill(core.scratch_slot)
+        return inputs, statuses, save_slots
+
     def _stage_segment(self, lane: _Lane, requests: List[Request]) -> None:
         from ..tpu.backend import parse_request_segment
 
         core = self.device.core
-        W, P, I = core.window, self.num_players, self.game.input_size
-        inputs = np.zeros((W, P, I), dtype=np.uint8)
-        statuses = np.zeros((W, P), dtype=np.int32)
-        save_slots = np.full((W,), core.scratch_slot, dtype=np.int32)
+        W, P = core.window, self.num_players
+        inputs, statuses, save_slots = self._parse_staging()
         if lane.num_players < P:
             # pad players beyond the session's count as DISCONNECTED: the
             # game model substitutes its deterministic dummy input, and
@@ -477,7 +532,11 @@ class SessionHost:
             (load is not None, count, last_active, trailing is not None),
             frame=start_frame,
         )
-        row = core.pack_tick_row(
+        # pack straight into the lane's pooled row buffer (no per-tick
+        # allocation); the scheduler's depth grouping reads the routing
+        # keys off the staged row instead of rescanning it
+        row = core.pack_tick_row_into(
+            lane.next_row_buf(),
             do_load=load is not None,
             load_slot=(load.frame % core.ring_len) if load is not None else 0,
             inputs=inputs,
@@ -486,7 +545,12 @@ class SessionHost:
             advance_count=count,
             start_frame=start_frame,
         )
-        lane.rows.append(_StagedRow(row, saves, start_frame, count))
+        lane.rows.append(
+            _StagedRow(
+                row, saves, start_frame, count, last_active,
+                self.device.fast_eligible(row, last_active),
+            )
+        )
         lane.current_frame = start_frame + count
 
     # ------------------------------------------------------------------
@@ -498,7 +562,16 @@ class SessionHost:
         arrivals first, until the device window is full or the queue is
         empty. One row per session per megabatch preserves each session's
         in-order request stream; a session with a second staged row
-        (sparse-saving keepalive) keeps its queue position."""
+        (sparse-saving keepalive) keeps its queue position.
+
+        Depth routing: each pass's picked rows split into the
+        zero-rollback FAST group (no load, one advance — the dominant
+        shape in real traffic) plus one group per occupied depth bucket,
+        and every group dispatches as its own megabatch program sized to
+        its depth — one deep-rollback session no longer drags the other
+        63 sessions' rows to the full window. Groups are disjoint lanes,
+        so the one-row-per-session-per-megabatch invariant holds within
+        each pass."""
         from ..tpu.backend import SnapshotRef, _LazyChecksum
 
         core = self.device.core
@@ -511,26 +584,50 @@ class SessionHost:
             for key in list(self._ready)[:take]:
                 lane = self._lanes[key]
                 picked.append((lane, lane.rows[0]))
-            entries = [
-                (lane.slot, staged.row) for lane, staged in picked
-            ]
-            batch, _bucket = self.device.dispatch(entries)
-            for k, (lane, staged) in enumerate(picked):
-                lane.rows.popleft()
-                base = k * core.window
-                for slot_i, save in staged.saves:
-                    save.cell.save_lazy(
-                        save.frame,
-                        SnapshotRef(save.frame, save.frame % core.ring_len),
-                        _LazyChecksum(batch, base + slot_i),
+            if self.depth_routing:
+                groups: Dict[Any, List[Tuple[_Lane, _StagedRow]]] = {}
+                for lane, staged in picked:
+                    gkey = (
+                        "fast"
+                        if staged.fast
+                        else self.device.depth_bucket_for(staged.last_active)
                     )
-                if not lane.rows:
-                    self._ready.remove(lane.key)
-                    if GLOBAL_TELEMETRY.enabled:
-                        self._m_queue_wait.observe(
-                            self._tick_index - lane.queued_since_tick
+                    groups.setdefault(gkey, []).append((lane, staged))
+            else:
+                groups = {None: picked}
+            for gkey, group in groups.items():
+                entries = [
+                    (lane.slot, staged.row) for lane, staged in group
+                ]
+                if gkey == "fast":
+                    batch, _bucket = self.device.dispatch(entries, fast=True)
+                elif gkey is None:
+                    batch, _bucket = self.device.dispatch(entries)
+                else:
+                    batch, _bucket = self.device.dispatch(
+                        entries,
+                        last_active=max(
+                            staged.last_active for _, staged in group
+                        ),
+                    )
+                for k, (lane, staged) in enumerate(group):
+                    lane.rows.popleft()
+                    base = k * core.window
+                    for slot_i, save in staged.saves:
+                        save.cell.save_lazy(
+                            save.frame,
+                            SnapshotRef(
+                                save.frame, save.frame % core.ring_len
+                            ),
+                            _LazyChecksum(batch, base + slot_i),
                         )
-                    lane.queued_since_tick = None
+                    if not lane.rows:
+                        self._ready.remove(lane.key)
+                        if GLOBAL_TELEMETRY.enabled:
+                            self._m_queue_wait.observe(
+                                self._tick_index - lane.queued_since_tick
+                            )
+                        lane.queued_since_tick = None
         if GLOBAL_TELEMETRY.enabled:
             self._m_queue_depth.set(len(self._ready))
 

@@ -227,11 +227,7 @@ def run_loadgen(
             else 0.0
         ),
         "max_bucket": max(
-            (
-                sig[1]
-                for sig in dev.plan_cache.signatures
-                if isinstance(sig, tuple) and sig and sig[0] == "megabatch"
-            ),
+            (bucket for bucket, _, _ in dev.megabatch_programs()),
             default=0,
         ),
         "plan_signatures": len(dev.plan_cache.signatures),

@@ -393,7 +393,8 @@ class TpuRollbackBackend:
                  defer_speculation: bool = False, lazy_ticks: int = 0,
                  spec_backend: str = "auto", tick_backend: str = "auto",
                  async_dispatch: bool = False, async_inflight: int = 2,
-                 plan_cache: Optional["DispatchPlanCache"] = None):
+                 plan_cache: Optional["DispatchPlanCache"] = None,
+                 depth_routing: bool = True):
         """`mesh`: optional jax Mesh with an `entity` axis — the world and
         its snapshot ring shard across it (see ResimCore); the session-facing
         contract (requests in, SnapshotRefs + lazy checksums out) is
@@ -477,7 +478,14 @@ class TpuRollbackBackend:
         cost by the buffer depth. The live state lags the session by up to
         lazy_ticks frames between flushes: loops that render every frame
         call state_numpy() (or flush()) per frame and get per-tick
-        dispatch behavior back automatically."""
+        dispatch behavior back automatically.
+
+        `depth_routing`: route the lazy multi-tick flush to the depth
+        variant covering the buffer's deepest row (max last-active slot
+        across the staged ticks) instead of always scanning full-window
+        rows — bit-identical, proportionally less device work per
+        zero-rollback tick. False pins the full-window scan (the parity
+        suite's reference arm)."""
         self.core = ResimCore(
             game, max_prediction, num_players, mesh=mesh,
             device_verify=device_verify, spec_backend=spec_backend,
@@ -565,7 +573,12 @@ class TpuRollbackBackend:
         if async_dispatch and lazy_ticks == 0:
             lazy_ticks = self.ASYNC_DEFAULT_LAZY_TICKS
         self.lazy_ticks = lazy_ticks
+        self.depth_routing = depth_routing
         self._tick_rows: List[np.ndarray] = []  # packed rows awaiting dispatch
+        # max 1-based last active slot across the buffered rows: the lazy
+        # flush routes the multi-tick scan to the depth variant covering
+        # it (pad rows are inert at any variant, so only real rows count)
+        self._buffered_last_active = 0
         self._tick_future: Optional[_FutureChecksumBatch] = None
         # async pipeline state: the in-flight dispatch fence (device result
         # handles, oldest first) and the rotating host staging pools —
@@ -982,6 +995,9 @@ class TpuRollbackBackend:
             if self._tick_future is None:
                 self._tick_future = _FutureChecksumBatch(self.flush)
             batch = self._tick_future
+            self._buffered_last_active = max(
+                self._buffered_last_active, last_active
+            )
             if self.async_dispatch:
                 # pack straight into the pooled multi-tick buffer: no
                 # per-tick row allocation, no flush-time copy
@@ -1173,6 +1189,14 @@ class TpuRollbackBackend:
             self._m_batch.observe(n_staged or len(rows))
         self._tick_rows = []
         self._tick_future = None
+        # depth routing: scan only the variant covering the buffer's
+        # deepest row (None = the full-window reference program)
+        max_active = (
+            self._buffered_last_active
+            if self.depth_routing and self._buffered_last_active
+            else None
+        )
+        self._buffered_last_active = 0
         core = self.core
         if n_staged:  # async: rows were packed straight into the pool
             buf = self._multi_active
@@ -1180,14 +1204,14 @@ class TpuRollbackBackend:
             self._multi_count = 0
             if n_staged == 1:
                 with GLOBAL_TRACER.span("tpu/fused_tick", absolute=True):
-                    his, los = core.tick_row(buf[0])
+                    his, los = core.tick_row(buf[0], max_active)
             else:
                 buf[n_staged:] = self._pad_row
                 with GLOBAL_TRACER.span("tpu/fused_multi_tick", absolute=True):
-                    his, los = core.tick_multi(buf)
+                    his, los = core.tick_multi(buf, last_active=max_active)
         elif len(rows) == 1:
             with GLOBAL_TRACER.span("tpu/fused_tick", absolute=True):
-                his, los = core.tick_row(rows[0])
+                his, los = core.tick_row(rows[0], max_active)
         else:
             # eager mode has no fence bounding when a dispatch's read of
             # host memory retires (jax may alias aligned buffers), so the
@@ -1196,7 +1220,7 @@ class TpuRollbackBackend:
             for j, r in enumerate(rows):
                 buf[j] = r
             with GLOBAL_TRACER.span("tpu/fused_multi_tick", absolute=True):
-                his, los = core.tick_multi(buf)
+                his, los = core.tick_multi(buf, last_active=max_active)
         self._note_inflight(his)
         future.batch = _ChecksumBatch(his, los, self.ledger)
 
@@ -1438,12 +1462,36 @@ class TpuRollbackBackend:
             # mid-session compile stall warmup exists to prevent
             for v in core.branchless_variants():
                 core.tick(True, 0, inputs, statuses, scratch, v)
+            if core._t1_windowed:
+                # trivial rows dispatch the WINDOWED cond program here
+                # (the tick above compiled it at the smallest variant),
+                # which leaves the full cond program cold — keep it
+                # compiled too: it is still the route for full-depth
+                # variants and the bit-parity reference, and a cold
+                # program is a landmine
+                row0 = core.pack_tick_row(
+                    False, 0, inputs, statuses, scratch, 0
+                )
+                core.ring, core.state, core.verify, _, _ = core._tick_fn(
+                    core.ring, core.state, row0, core.verify
+                )
         if self.lazy_ticks:
             # compile the fused multi-tick program at the buffer depth
-            # (all-padding rows: a true no-op on the game state)
-            core.tick_multi(
-                np.tile(core.pad_tick_row(), (self.lazy_ticks, 1))
-            )
+            # (all-padding rows: a true no-op on the game state). With
+            # depth routing the live flush dispatches one scan body per
+            # depth variant — compile EVERY variant, or the first flush
+            # of a new max depth pays the mid-session compile stall
+            # warmup exists to prevent. The pallas tick kernel route
+            # (rows > 1) is depth-flat: one compile covers it.
+            pad = np.tile(core.pad_tick_row(), (self.lazy_ticks, 1))
+            if (
+                self.depth_routing
+                and self.lazy_ticks > 1
+                and core._tick_pallas_fn is None
+            ):
+                for v in core.branchless_variants():
+                    core.tick_multi(pad, last_active=v)
+            core.tick_multi(pad)
         if self.beam_width:
             from .beam import branching_beam
 
@@ -1592,6 +1640,7 @@ class TpuRollbackBackend:
                 "lazy_ticks": self.lazy_ticks,
                 "async_dispatch": self.async_dispatch,
                 "async_inflight": self.async_inflight,
+                "depth_routing": self.depth_routing,
                 "speculation_gate": self.speculation_gate,
                 "defer_speculation": self.defer_speculation,
                 "spec_backend": self.core.spec_backend,
@@ -1627,6 +1676,7 @@ class TpuRollbackBackend:
             lazy_ticks=meta.get("lazy_ticks", 0),
             async_dispatch=meta.get("async_dispatch", False),
             async_inflight=meta.get("async_inflight", 2),
+            depth_routing=meta.get("depth_routing", True),
             speculation_gate=meta.get("speculation_gate", "always"),
             defer_speculation=meta.get("defer_speculation", False),
             spec_backend=_backend_knob("spec_backend"),
@@ -1676,7 +1726,8 @@ class MultiSessionDeviceCore:
     def __init__(self, game, max_prediction: int, num_players: int,
                  capacity: int, *, async_inflight: int = 2,
                  plan_cache: Optional[DispatchPlanCache] = None,
-                 buckets: Optional[Sequence[int]] = None):
+                 buckets: Optional[Sequence[int]] = None,
+                 depth_routing: bool = True):
         """`num_players` is the HOST-WIDE player layout (the widest
         session the host admits): every hosted session's rows are packed
         at this width, with absent players padded as DISCONNECTED so the
@@ -1684,7 +1735,21 @@ class MultiSessionDeviceCore:
         of a match pad identically, so checksums still agree.
 
         `buckets`: megabatch row-count pad targets (default: powers of
-        two up to capacity, plus capacity itself)."""
+        two up to capacity, plus capacity itself).
+
+        `depth_routing`: dispatch one vmapped program per (row-count
+        bucket x depth bucket) instead of always vmapping the full-window
+        tick — under vmap the per-slot lax.cond lowers to selects, so a
+        zero-rollback row in a full-window program executes the same
+        device work as an 8-frame rollback. Depth buckets are powers of
+        two up to the window (the jit cache stays
+        O(log capacity x log window) programs), plus a dedicated
+        ZERO-ROLLBACK FAST PATH for rows with no pending misprediction
+        (no LoadGameState, i.e. first_incorrect_frame == NULL_FRAME at
+        the session): no ring gather/scatter at all — one step, two
+        checksums, per-slot ring writes — since those rows dominate real
+        traffic. False pins the single full-window program (the parity
+        suite's reference arm)."""
         import jax.numpy as jnp
         from collections import deque as _deque
 
@@ -1697,6 +1762,7 @@ class MultiSessionDeviceCore:
         self.num_players = num_players
         self.input_size = game.input_size
         self.async_inflight = async_inflight
+        self.depth_routing = depth_routing
         self.plan_cache = plan_cache or DispatchPlanCache()
         self.ledger = ChecksumLedger()
         if buckets is None:
@@ -1708,6 +1774,15 @@ class MultiSessionDeviceCore:
         assert self.buckets[-1] >= capacity, (
             "largest bucket must cover a full-capacity megabatch"
         )
+        # depth-bucket pad targets for the windowed megabatch program:
+        # powers of two up to the window, window included — O(log W)
+        # programs per row bucket
+        W = self.core.window
+        depths, d = {W}, 2
+        while d < W:
+            depths.add(d)
+            d *= 2
+        self.depth_buckets = tuple(sorted(depths))
         S = capacity + 1  # + the dummy pad slot
         self.states = jax.tree.map(
             lambda x: jnp.stack([x] * S), self.core.state
@@ -1716,9 +1791,19 @@ class MultiSessionDeviceCore:
             lambda x: jnp.zeros((S,) + x.shape, x.dtype), self.core.ring
         )
         self._dispatch_fn = jax.jit(
-            self._dispatch_impl, donate_argnums=(0, 1)
+            self._dispatch_impl, static_argnums=(4,), donate_argnums=(0, 1)
+        )
+        self._dispatch_fast_fn = jax.jit(
+            self._dispatch_fast_impl, donate_argnums=(0, 1)
         )
         self._pad_row = self.core.pad_tick_row()
+        # per-row-bucket pooled (idx, rows) staging, async_inflight + 1
+        # deep — the dispatch compaction packs straight into these
+        # instead of allocating + re-tiling pad rows every megabatch
+        # (rows escape into jax, which may alias aligned host memory;
+        # reuse is safe because the fence proves the dispatch that read
+        # a buffer retired before the pool rotates back to it)
+        self._stage_pools: dict = {}
         # async fence over megabatches: (result handle, live row count);
         # inflight_rows is the host's backpressure signal
         self._inflight: "_deque" = _deque()
@@ -1738,16 +1823,18 @@ class MultiSessionDeviceCore:
 
     # ------------------------------------------------------------------
 
-    def _dispatch_impl(self, rings, states, idx, rows):
-        """Gather [B] session worlds, vmap the packed tick, scatter back.
-        Duplicate pad indices (all pointing at the dummy slot) compute
-        identical results, so the scatter stays deterministic."""
+    def _dispatch_impl(self, rings, states, idx, rows, nslots):
+        """Gather [B] session worlds, vmap the packed tick windowed at
+        the STATIC depth bucket `nslots` (= the window for the unrouted
+        full program), scatter back. Duplicate pad indices (all pointing
+        at the dummy slot) compute identical results, so the scatter
+        stays deterministic."""
         g_ring = jax.tree.map(lambda a: a[idx], rings)
         g_state = jax.tree.map(lambda a: a[idx], states)
 
         def one(ring, state, row):
-            ring, state, _, his, los = self.core._tick_packed_impl(
-                ring, state, row, {}
+            ring, state, _, his, los = self.core._tick_windowed_impl(
+                ring, state, row, {}, nslots
             )
             return ring, state, his, los
 
@@ -1758,6 +1845,80 @@ class MultiSessionDeviceCore:
         )
         return rings, states, his, los
 
+    def _dispatch_fast_impl(self, rings, states, idx, rows):
+        """The zero-rollback megabatch program: every row is guaranteed
+        (dispatch asserts it) to carry no load, at most one advance and
+        no active slot past 1 — the shape of a tick with no pending
+        misprediction. So: NO per-row ring gather/scatter (the full
+        program moves ring_len+1 world copies per row either way), no
+        resim scan — one vmapped step, two checksums (slot 0 pre-step,
+        slot 1 post-step for the trailing-save shape) and two masked
+        single-slot ring writes addressed directly into the stacked
+        rings. Masked (scratch) saves write the slot's OLD value back to
+        ring slot 0 — the branchless trick — so even the ring's bytes
+        stay bit-identical to the cond program; pad rows (advance 0) are
+        inert. Checksums land at window slots 0/1 of a zero [B, W] batch,
+        keeping the flat k*W + i indexing."""
+        import jax.numpy as jnp
+
+        core = self.core
+        W, P, I = core.window, self.num_players, self.input_size
+        B = rows.shape[0]
+
+        def where_rows(pred, a, b):
+            return jax.tree.map(
+                lambda x, y: jnp.where(
+                    pred.reshape((-1,) + (1,) * (x.ndim - 1)), x, y
+                ),
+                a,
+                b,
+            )
+
+        g_state = jax.tree.map(lambda a: a[idx], states)
+        advance = rows[:, 2]
+        s0 = rows[:, core._off_save]
+        s1 = rows[:, core._off_save + 1]
+        statuses0 = rows[:, core._off_status : core._off_status + P]
+        inputs0 = (
+            rows[:, core._off_input : core._off_input + P * I]
+            .astype(jnp.uint8)
+            .reshape(B, P, I)
+        )
+        zero = jnp.uint32(0)
+        # slot 0: masked save of the pre-step state
+        hi0, lo0 = jax.vmap(core.game.checksum)(g_state)
+        do0 = s0 < core.ring_len
+        w0 = jnp.where(do0, s0, 0)
+        old0 = jax.tree.map(lambda r: r[idx, w0], rings)
+        rings = jax.tree.map(
+            lambda r, v: r.at[idx, w0].set(v),
+            rings,
+            where_rows(do0, g_state, old0),
+        )
+        # the one advance (masked only so pad rows stay inert)
+        nxt = jax.vmap(core.game.step)(g_state, inputs0, statuses0)
+        new_state = where_rows(advance > 0, nxt, g_state)
+        # slot 1: masked trailing save of the post-step state
+        hi1, lo1 = jax.vmap(core.game.checksum)(new_state)
+        do1 = s1 < core.ring_len
+        w1 = jnp.where(do1, s1, 0)
+        old1 = jax.tree.map(lambda r: r[idx, w1], rings)
+        rings = jax.tree.map(
+            lambda r, v: r.at[idx, w1].set(v),
+            rings,
+            where_rows(do1, new_state, old1),
+        )
+        states = jax.tree.map(
+            lambda a, b: a.at[idx].set(b), states, new_state
+        )
+        his = jnp.zeros((B, W), dtype=hi0.dtype)
+        los = jnp.zeros((B, W), dtype=lo0.dtype)
+        his = his.at[:, 0].set(jnp.where(do0, hi0, zero))
+        his = his.at[:, 1].set(jnp.where(do1, hi1, zero))
+        los = los.at[:, 0].set(jnp.where(do0, lo0, zero))
+        los = los.at[:, 1].set(jnp.where(do1, lo1, zero))
+        return rings, states, his, los
+
     def bucket_for(self, n: int) -> int:
         """Smallest configured pad target covering n rows."""
         for b in self.buckets:
@@ -1765,38 +1926,144 @@ class MultiSessionDeviceCore:
                 return b
         raise AssertionError(f"{n} rows exceed the largest bucket")
 
-    def dispatch(self, entries) -> Tuple[_ChecksumBatch, int]:
+    def depth_bucket_for(self, last_active: int) -> int:
+        """Smallest depth-bucket pad target covering a 1-based last
+        active slot."""
+        for d in self.depth_buckets:
+            if d >= last_active:
+                return d
+        raise AssertionError(
+            f"{last_active} slots exceed the window ({self.core.window})"
+        )
+
+    def dispatch_bucket_budget(self) -> int:
+        """The jit-cache bound depth routing guarantees: one program per
+        (row bucket x depth bucket) plus the fast path per row bucket —
+        O(log capacity x log window). The soak tests pin the live
+        signature population inside this."""
+        return len(self.buckets) * (len(self.depth_buckets) + 1)
+
+    def megabatch_programs(self) -> List[Tuple[int, Optional[int], int]]:
+        """The plan cache's megabatch-program population as structured
+        (row_bucket, depth, dispatch_count) records — depth 0 is the
+        zero-rollback fast path, an int the windowed depth bucket, None
+        the unrouted full-window program. THE accessor for benches,
+        gates and tests: the raw signature tuple layout stays private to
+        this module (it already changed shape once)."""
+        out = []
+        for sig, c in self.plan_cache.signatures.items():
+            if isinstance(sig, tuple) and sig and sig[0] == "megabatch":
+                out.append((sig[1], sig[2] if len(sig) > 2 else None, c))
+        return out
+
+    def fast_eligible(
+        self, row: np.ndarray, last_active: Optional[int] = None
+    ) -> bool:
+        """May this packed row ride the zero-rollback fast program? No
+        load, exactly one advance, no active slot past 1 (a save of the
+        current frame and/or a trailing save of the advanced frame).
+        `last_active` (the row's 1-based last active slot) skips the
+        save-slot rescan when the caller's parse already knows it."""
+        if int(row[0]) != 0 or int(row[2]) != 1:
+            return False
+        if last_active is None:
+            core = self.core
+            tail = row[core._off_save + 2 : core._off_status]
+            return bool((np.asarray(tail) >= core.ring_len).all())
+        return last_active <= 2
+
+    def _acquire_stage(self, bucket: int):
+        """Rotate the pooled (idx, rows) staging pair for one row-count
+        bucket, restoring pad defaults only over the entries the LAST
+        use of this buffer actually wrote (re-tiling the whole pad rows
+        every megabatch is exactly the host copy depth bucketing set out
+        to remove)."""
+        pool = self._stage_pools.get(bucket)
+        if pool is None:
+            pool = {
+                "flip": 0,
+                "bufs": [
+                    [
+                        np.full((bucket,), self.capacity, dtype=np.int32),
+                        np.tile(self._pad_row, (bucket, 1)),
+                        0,  # rows written by this buffer's last use
+                    ]
+                    for _ in range(self.async_inflight + 1)
+                ],
+            }
+            self._stage_pools[bucket] = pool
+        pool["flip"] = (pool["flip"] + 1) % len(pool["bufs"])
+        return pool["bufs"][pool["flip"]]
+
+    def dispatch(
+        self, entries, *, last_active: Optional[int] = None,
+        fast: bool = False,
+    ) -> Tuple[_ChecksumBatch, int]:
         """Run one cross-session megabatch. `entries` is a list of
         (slot, packed_row) with AT MOST ONE row per slot — a session's
         second staged row (sparse-saving keepalive) rides the next
         megabatch, preserving its in-session order. Returns
         (checksum_batch, bucket): entry k's window-slot i checksum lives
         at flat index k * window + i of the batch. Non-blocking beyond
-        the async-inflight fence."""
+        the async-inflight fence.
+
+        Depth routing (the host's scheduler groups rows accordingly):
+        `fast=True` runs the zero-rollback program — every row must be
+        fast_eligible; `last_active` (the MAX 1-based last active slot
+        across the rows) runs the windowed program at the depth bucket
+        covering it; neither runs the legacy full-window program."""
         n = len(entries)
         assert 0 < n <= self.capacity
         assert len({slot for slot, _ in entries}) == n, (
             "one row per session slot per megabatch"
         )
         bucket = self.bucket_for(n)
-        idx = np.full((bucket,), self.capacity, dtype=np.int32)
-        rows = np.tile(self._pad_row, (bucket, 1))
+        staged = self._acquire_stage(bucket)
+        idx, rows, used = staged
         for k, (slot, row) in enumerate(entries):
             assert 0 <= slot < self.capacity
             idx[k] = slot
             rows[k] = row
-        # each bucket is one cached jitted program: tally it beside the
-        # per-row signatures, but OUT of the segment hit/miss counters
-        # (a different cache population with its own hit dynamics)
-        self.plan_cache.note(("megabatch", bucket), metrics=False)
-        self.rings, self.states, his, los = self._dispatch_fn(
-            self.rings, self.states, idx, rows
+        for k in range(n, used):  # re-pad only what the last use dirtied
+            idx[k] = self.capacity
+            rows[k] = self._pad_row
+        staged[2] = n
+        if fast:
+            assert all(
+                self.fast_eligible(rows[k]) for k in range(n)
+            ), (
+                "fast dispatch carries a row with a load, a multi-advance "
+                "or a save past window slot 1"
+            )
+            sig_depth, nslots, fn_args = 0, 1, ()
+            fn = self._dispatch_fast_fn
+        elif last_active is not None:
+            nslots = self.depth_bucket_for(last_active)
+            sig_depth, fn_args = nslots, (nslots,)
+            fn = self._dispatch_fn
+        else:
+            nslots = self.core.window
+            sig_depth, fn_args = None, (nslots,)
+            fn = self._dispatch_fn
+        # each (row bucket, depth bucket) is one cached jitted program:
+        # tally it beside the per-row signatures, but OUT of the segment
+        # hit/miss counters (a different cache population with its own
+        # hit dynamics). sig_depth 0 = the fast path, None = unrouted
+        # full window.
+        self.plan_cache.note(("megabatch", bucket, sig_depth), metrics=False)
+        self.rings, self.states, his, los = fn(
+            self.rings, self.states, idx, rows, *fn_args
         )
         self.megabatches += 1
         self.rows_dispatched += n
         if GLOBAL_TELEMETRY.enabled:
             self._m_batch_rows.observe(n)
             self._m_occupancy.set(n / bucket)
+            if fast or last_active is not None:
+                # fast dispatches observe depth 1 (the le=1 bucket is
+                # exactly the fast-path counter the smoke gate asserts)
+                self.core._m_depth.observe(nslots)
+                self.core._m_waste.inc((self.core.window - nslots) * n)
         self._note_inflight(his, n)
         return _ChecksumBatch(his, los, self.ledger), bucket
 
@@ -1848,16 +2115,30 @@ class MultiSessionDeviceCore:
         )
 
     def warmup(self) -> None:
-        """Compile the megabatch program at every bucket size before
-        serving: first compilation takes seconds — enough to stall every
-        hosted session at once mid-tick. All-pad dispatches are true
-        no-ops on the stacked worlds."""
+        """Compile the megabatch program grid — every (row-count bucket x
+        depth bucket) plus the zero-rollback fast path per row bucket —
+        before serving: first compilation takes seconds, enough to stall
+        every hosted session at once mid-tick, and depth routing must
+        never trade the padding win for mid-serve compile stalls. All-pad
+        dispatches are true no-ops on the stacked worlds (pad rows
+        advance nothing and save nowhere, on the fast program included).
+        With depth_routing=False only the full-window program per row
+        bucket compiles, as before."""
         for b in self.buckets:
             idx = np.full((b,), self.capacity, dtype=np.int32)
             rows = np.tile(self._pad_row, (b, 1))
-            self.rings, self.states, _, _ = self._dispatch_fn(
-                self.rings, self.states, idx, rows
-            )
+            if self.depth_routing:
+                self.rings, self.states, _, _ = self._dispatch_fast_fn(
+                    self.rings, self.states, idx, rows
+                )
+                for d in self.depth_buckets:
+                    self.rings, self.states, _, _ = self._dispatch_fn(
+                        self.rings, self.states, idx, rows, d
+                    )
+            else:
+                self.rings, self.states, _, _ = self._dispatch_fn(
+                    self.rings, self.states, idx, rows, self.core.window
+                )
         self.block_until_ready()
 
     def block_until_ready(self) -> None:

@@ -28,9 +28,35 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import DISPATCH_DEPTH_BUCKETS, GLOBAL_TELEMETRY
+
 
 def _tree_where(pred, a, b):
     return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def depth_dispatch_instruments():
+    """The two depth-adaptive-dispatch instruments, get-or-created on the
+    global registry: a histogram of the routed depth bucket (window slots
+    actually executed) per dispatch, and a counter of full-window slots
+    minus the slots actually dispatched — the device work depth routing
+    avoided. Shared by every routed path (T=1 branchless variants, the
+    lazy multi-tick scan, the cross-session megabatch): one pair of
+    series makes the win — and a silent routing regression (waste
+    flatlining at 0, depth pinned at the window) — visible in any
+    telemetry snapshot."""
+    reg = GLOBAL_TELEMETRY.registry
+    depth = reg.histogram(
+        "ggrs_dispatch_depth",
+        "window slots actually executed by a depth-routed device dispatch",
+        buckets=DISPATCH_DEPTH_BUCKETS,
+    )
+    waste = reg.counter(
+        "ggrs_padded_slot_waste",
+        "full-window slots minus active slots actually dispatched "
+        "(device work avoided by depth-adaptive dispatch)",
+    )
+    return depth, waste
 
 
 class ResimCore:
@@ -45,6 +71,12 @@ class ResimCore:
     # unrolled program (see the _tick_fn comment in __init__): ~0.5ms of
     # worst-case masked work buys ~2ms of control-flow dispatch overhead
     BRANCHLESS_MAX_ENTITIES = 1 << 18
+    # trivial T=1 rows (no load, one advance) route through the WINDOWED
+    # cond program from this world size up: below it the full cond
+    # program's skipped slots cost too little device time to buy the
+    # extra per-core compile (every interactive session would pay a
+    # compile for a program that saves microseconds on toy worlds)
+    T1_WINDOWED_MIN_ENTITIES = 1 << 11
     # worlds at or past this size route lone ticks through the pallas
     # tick kernel (as a 1-row multi dispatch) when the core has one: the
     # XLA T=1 programs run the step as unfused elementwise passes whose
@@ -149,6 +181,18 @@ class ResimCore:
         self._tick_fn = jax.jit(
             self._tick_packed_impl, donate_argnums=(0, 1, 3)
         )
+        # the windowed cond program: the same per-slot cond tick truncated
+        # to a STATIC nslots. Trivial T=1 rows (no load, one advance — the
+        # speculative ticks between rollbacks, the dominant interactive
+        # traffic) keep cond's taken-branch economics but stop paying the
+        # full window's scanned slots of control flow: their last active
+        # slot is <= 2, so they dispatch the smallest variant instead of
+        # W slots of cond skipping. Bit-identical to the full cond
+        # program (truncated slots are provably inert).
+        self._tick_windowed_fn = jax.jit(
+            self._tick_windowed_impl, static_argnums=(4,),
+            donate_argnums=(0, 1, 3),
+        )
         # nslots is a STATIC jit key: one executable per coalesced
         # depth variant (branchless_variants), all compiled by warmup
         self._tick_branchless_fn = (
@@ -161,8 +205,21 @@ class ResimCore:
             and n_entities <= self.BRANCHLESS_MAX_ENTITIES
             else None
         )
+        # nslots is a STATIC jit key here too: the lazy multi-tick scan
+        # compiles one body per coalesced depth variant (the same
+        # branchless_variants family as T=1), and the backend routes a
+        # buffered batch by the MAX last-active slot across its rows —
+        # a buffer of zero-rollback ticks scans 3 slots per row instead
+        # of the full window (depth-adaptive dispatch)
         self._tick_multi_fn = jax.jit(
-            self._tick_multi_impl, donate_argnums=(0, 1, 3)
+            self._tick_multi_impl, static_argnums=(4,),
+            donate_argnums=(0, 1, 3),
+        )
+        # trivial-row windowed-cond routing gate (see the constants above)
+        self._t1_windowed = (
+            self._tick_branchless_fn is not None
+            and n_entities is not None
+            and n_entities >= self.T1_WINDOWED_MIN_ENTITIES
         )
         self._speculate_fn = jax.jit(self._speculate_impl)
 
@@ -310,6 +367,9 @@ class ResimCore:
         self._aoff_status = self._aoff_save + self.window
         self._aoff_input = self._aoff_status + self.window * p
         self._apacked_len = self._aoff_input + self.window * p * i
+        # depth-adaptive dispatch instruments (updated behind enabled
+        # checks at the routing sites, the Tracer.span idiom)
+        self._m_depth, self._m_waste = depth_dispatch_instruments()
 
     # ------------------------------------------------------------------
 
@@ -333,6 +393,43 @@ class ResimCore:
         return self._tick_impl(
             ring, state, do_load, load_slot, inputs, statuses, save_slots,
             advance_count, start_frame, verify,
+        )
+
+    def _tick_windowed_impl(self, ring, state, packed, verify, nslots):
+        """The packed cond tick truncated to its first `nslots` window
+        slots (a STATIC value): the scan body, inputs and save slots past
+        `nslots` are never traced, so the compiled program's device work
+        is proportional to the depth bucket, not the full window.
+        Checksums zero-pad back to [W] so batch indexing (flat j*W + i)
+        never changes. Bit-identical to _tick_packed_impl whenever every
+        dispatched row's last active slot (advance count and highest real
+        save) fits in `nslots` — the routers guarantee it, and slots past
+        the last active one are provably inert in the full program
+        (cond-skipped saves, cond-skipped steps, (0, 0) checksums)."""
+        W, P, I = self.window, self.num_players, self.game.input_size
+        do_load = packed[0] != 0
+        load_slot = packed[1]
+        advance_count = packed[2]
+        start_frame = packed[3]
+        save_slots = packed[self._off_save : self._off_save + nslots]
+        statuses = packed[self._off_status : self._off_status + nslots * P]
+        statuses = statuses.reshape(nslots, P)
+        inputs = (
+            packed[self._off_input : self._off_input + nslots * P * I]
+            .astype(jnp.uint8)
+            .reshape(nslots, P, I)
+        )
+        ring, state, verify, his, los = self._tick_impl(
+            ring, state, do_load, load_slot, inputs, statuses, save_slots,
+            advance_count, start_frame, verify, nslots=nslots,
+        )
+        pad = jnp.zeros((W - nslots,), dtype=his.dtype)
+        return (
+            ring,
+            state,
+            verify,
+            jnp.concatenate([his, pad]),
+            jnp.concatenate([los, pad]),
         )
 
     def _tick_branchless_impl(self, ring, state, packed, verify, nslots):
@@ -417,7 +514,7 @@ class ResimCore:
             )
         return self._bl_variants
 
-    def _tick_multi_impl(self, ring, state, packed, verify):
+    def _tick_multi_impl(self, ring, state, packed, verify, nslots):
         """T buffered ticks as ONE device program: a lax.scan of the packed
         tick over rows of packed[T, L]. On the tunnel each dispatch costs
         ~1ms of host time regardless of content, so batching T interactive
@@ -425,12 +522,17 @@ class ResimCore:
         by T (ggrs_tpu/tpu/backend.py lazy_ticks). Padding rows
         (advance_count=0, scratch-only saves) are true no-ops — the
         per-slot conds skip all work — so one buffer length compiles
-        once."""
+        once per depth variant. `nslots` (STATIC) truncates every row's
+        scan body to the depth bucket covering the buffer's deepest row:
+        a buffer of zero-rollback ticks no longer pays the full window's
+        scanned slots per row (cond skips the work inside a slot, but
+        each traced slot still costs control flow and — under vmap's
+        cond->select lowering in the megabatch — real compute)."""
 
         def body(carry, row):
             ring, state, verify = carry
-            ring, state, verify, his, los = self._tick_packed_impl(
-                ring, state, row, verify
+            ring, state, verify, his, los = self._tick_windowed_impl(
+                ring, state, row, verify, nslots
             )
             return (ring, state, verify), (his, los)
 
@@ -452,6 +554,12 @@ class ResimCore:
             valid = np.nonzero(save_slots < self.ring_len)[0]
             if valid.size:
                 last_active = max(last_active, int(valid[-1]) + 1)
+        return self.variant_for(last_active)
+
+    def variant_for(self, last_active: int) -> int:
+        """Smallest coalesced depth variant covering a 1-based last
+        active slot — THE rounding rule every depth-routed path shares
+        (T=1 branchless, the lazy multi-tick scan)."""
         for v in self.branchless_variants():
             if v >= last_active:
                 return v
@@ -490,19 +598,43 @@ class ResimCore:
         if self._tick_branchless_fn is not None and (
             row[0] != 0 or row[2] > 1
         ):
+            nslots = self._branchless_nslots(row, last_active)
+            if GLOBAL_TELEMETRY.enabled:
+                self._m_depth.observe(nslots)
+                self._m_waste.inc(self.window - nslots)
             self.ring, self.state, self.verify, his, los = (
                 self._tick_branchless_fn(
-                    self.ring, self.state, row, self.verify,
-                    self._branchless_nslots(row, last_active),
+                    self.ring, self.state, row, self.verify, nslots,
                 )
             )
             return his, los
+        # trivial rows (mid-size worlds): the windowed cond program at
+        # the smallest covering variant — same cond skipping, a fraction
+        # of the scanned slots. Worlds below T1_WINDOWED_MIN_ENTITIES
+        # keep the full cond program (the saved slots are not worth a
+        # per-core compile there), and worlds past the branchless cap
+        # keep it untouched too (their routing economics were measured
+        # there; a rollback row's variant can reach W anyway).
+        if self._t1_windowed:
+            nslots = self._branchless_nslots(row, last_active)
+            if nslots < self.window:
+                if GLOBAL_TELEMETRY.enabled:
+                    self._m_depth.observe(nslots)
+                    self._m_waste.inc(self.window - nslots)
+                self.ring, self.state, self.verify, his, los = (
+                    self._tick_windowed_fn(
+                        self.ring, self.state, row, self.verify, nslots,
+                    )
+                )
+                return his, los
         self.ring, self.state, self.verify, his, los = self._tick_fn(
             self.ring, self.state, row, self.verify
         )
         return his, los
 
-    def tick_multi(self, rows: np.ndarray) -> Tuple[Any, Any]:
+    def tick_multi(
+        self, rows: np.ndarray, last_active: Optional[int] = None
+    ) -> Tuple[Any, Any]:
         """Run T packed ticks (layout: see tick()) in one dispatch; returns
         (checksum_hi[T, W], checksum_lo[T, W]) as device arrays. Multi-row
         dispatches route to the pallas tick kernel when the core has one:
@@ -512,14 +644,31 @@ class ResimCore:
         whose lax.cond slot skipping beats the kernel's masked full
         window for a lone tick — but routes to the kernel from
         PALLAS_T1_MIN_ENTITIES up, where every XLA T=1 program's unfused
-        passes cost more than the kernel's size-flat streaming."""
-        fn = self._tick_multi_fn
+        passes cost more than the kernel's size-flat streaming.
+
+        `last_active` (optional): the MAX 1-based last active slot across
+        the buffered rows, precomputed by the backend's parse. The XLA
+        scan then runs the depth variant covering it instead of the full
+        window — bit-identical (slots past every row's last active one
+        are inert) at a fraction of the scanned device work. None keeps
+        the full-window program (the depth-routing-off reference). The
+        pallas kernel path ignores it: the kernel's VMEM streaming is
+        already window-flat."""
         if self._tick_pallas_fn is not None and (
             rows.shape[0] > 1 or self._pallas_t1()
         ):
-            fn = self._tick_pallas_fn
-        self.ring, self.state, self.verify, his, los = fn(
-            self.ring, self.state, rows, self.verify
+            self.ring, self.state, self.verify, his, los = (
+                self._tick_pallas_fn(self.ring, self.state, rows, self.verify)
+            )
+            return his, los
+        nslots = (
+            self.window if last_active is None else self.variant_for(last_active)
+        )
+        if GLOBAL_TELEMETRY.enabled and last_active is not None:
+            self._m_depth.observe(nslots)
+            self._m_waste.inc((self.window - nslots) * int(rows.shape[0]))
+        self.ring, self.state, self.verify, his, los = self._tick_multi_fn(
+            self.ring, self.state, rows, self.verify, nslots
         )
         return his, los
 
@@ -557,18 +706,21 @@ class ResimCore:
         load_slot,  # i32[]
         inputs,  # u8[W, P, input_size]
         statuses,  # i32[W, P]
-        save_slots,  # i32[W]; scratch_slot means "no save"
+        save_slots,  # i32[S]; scratch_slot means "no save"
         advance_count,  # i32[]
         start_frame,  # i32[]; frame of the first window slot
         verify,  # device-verify carry ({} when disabled)
+        nslots=None,  # static slot count (None = the full window)
     ):
+        if nslots is None:
+            nslots = self.window
         loaded = jax.tree.map(
             lambda r: jax.lax.dynamic_index_in_dim(r, load_slot, 0, keepdims=False),
             ring,
         )
         state = _tree_where(do_load, loaded, state)
 
-        iota = jnp.arange(self.window, dtype=jnp.int32)
+        iota = jnp.arange(nslots, dtype=jnp.int32)
 
         def body(carry, xs):
             ring, state, verify = carry

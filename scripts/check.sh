@@ -22,6 +22,11 @@
 #   (cross-session megabatching, zero desyncs) and validates the host
 #   telemetry snapshot exports via both the Prometheus and JSON
 #   exporters (scripts/serve_smoke.py, CPU jax, <1 min).
+#   --dispatch-smoke runs one mixed-depth hosted scenario and asserts —
+#   via the ggrs_dispatch_depth histogram — that the zero-rollback fast
+#   path was actually taken and the megabatch jit cache stayed on the
+#   (row x depth) bucket grid, catching silent depth-routing regressions
+#   (scripts/dispatch_smoke.py, CPU jax, <1 min).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -50,6 +55,12 @@ fi
 if [ "${1:-}" = "--serve-smoke" ]; then
   echo "== serve smoke (SessionHost loadgen + host telemetry exporters) =="
   JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+  exit $?
+fi
+
+if [ "${1:-}" = "--dispatch-smoke" ]; then
+  echo "== dispatch smoke (depth routing + zero-rollback fast path) =="
+  JAX_PLATFORMS=cpu python scripts/dispatch_smoke.py
   exit $?
 fi
 

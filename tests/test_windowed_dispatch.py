@@ -1,0 +1,336 @@
+"""Depth-adaptive dispatch: bitwise parity of the depth-bucketed programs
+against the full-window references, across every rollback depth and all
+three dispatch paths (T=1 content routing, the lazy multi-tick scan, the
+cross-session megabatch with its zero-rollback fast path), plus the jit
+cache's O(log N x log W) bucket-budget bound under a lossy hosted soak.
+
+The contract under test: routing a row (or a whole buffered batch / a
+megabatch group) to the smallest depth bucket covering its last active
+slot must change NOTHING observable — checksums, ring bytes, live state —
+only the device work dispatched."""
+
+import jax
+import numpy as np
+import pytest
+
+from ggrs_tpu import SessionBuilder
+from ggrs_tpu.models.ex_game import ExGame
+from ggrs_tpu.tpu import TpuRollbackBackend
+from ggrs_tpu.tpu.backend import MultiSessionDeviceCore
+from ggrs_tpu.tpu.resim import ResimCore
+
+ENTITIES = 16
+PLAYERS = 2
+
+
+def make_core(max_prediction=8):
+    return ResimCore(
+        ExGame(num_players=PLAYERS, num_entities=ENTITIES),
+        max_prediction=max_prediction,
+        num_players=PLAYERS,
+    )
+
+
+def depth_row(core, rng, depth, frame):
+    """One packed tick row of rollback depth `depth` (0 = a plain
+    zero-rollback tick: no load, one advance, dense saves), with real
+    inputs in every active slot and a save per advanced frame."""
+    W = core.window
+    inputs = rng.integers(0, 16, size=(W, PLAYERS, 1), dtype=np.uint8)
+    statuses = np.zeros((W, PLAYERS), dtype=np.int32)
+    save_slots = np.full((W,), core.scratch_slot, dtype=np.int32)
+    count = max(depth, 1)
+    for i in range(count):
+        save_slots[i] = (frame + i) % core.ring_len
+    return core.pack_tick_row(
+        do_load=depth > 0,
+        load_slot=frame % core.ring_len,
+        inputs=inputs,
+        statuses=statuses,
+        save_slots=save_slots,
+        advance_count=count,
+        start_frame=frame,
+    )
+
+
+def fetch(core):
+    return (
+        jax.device_get(core.ring),
+        jax.device_get(core.state),
+    )
+
+
+def assert_cores_equal(a, b, msg=""):
+    (ring_a, state_a), (ring_b, state_b) = fetch(a), fetch(b)
+    for k in state_a:
+        np.testing.assert_array_equal(
+            np.asarray(ring_a[k]), np.asarray(ring_b[k]),
+            err_msg=f"{msg} ring[{k}]",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state_a[k]), np.asarray(state_b[k]),
+            err_msg=f"{msg} state[{k}]",
+        )
+
+
+def test_t1_depth_routing_bitwise_across_depths():
+    """T=1: the content router (branchless depth variants for rollback /
+    multi-advance rows, cond for trivial rows) vs the full-window cond
+    program, one tick per rollback depth 0..max_prediction — checksums,
+    ring bytes and live state identical after every tick."""
+    routed, full = make_core(), make_core()
+    assert routed._tick_branchless_fn is not None
+    # force the trivial-row windowed-cond route (entity-gated off on toy
+    # worlds purely for compile economics) so its parity is pinned too
+    routed._t1_windowed = True
+    rng_a, rng_b = np.random.default_rng(7), np.random.default_rng(7)
+    frame = 0
+    for depth in range(routed.max_prediction + 1):
+        row_a = depth_row(routed, rng_a, depth, frame)
+        row_b = depth_row(full, rng_b, depth, frame)
+        his_a, los_a = routed.tick_row(row_a)
+        # the full-window reference: the cond program, no routing
+        full.ring, full.state, full.verify, his_b, los_b = full._tick_fn(
+            full.ring, full.state, row_b, full.verify
+        )
+        np.testing.assert_array_equal(np.asarray(his_a), np.asarray(his_b))
+        np.testing.assert_array_equal(np.asarray(los_a), np.asarray(los_b))
+        assert_cores_equal(routed, full, f"depth={depth}")
+        frame += max(depth, 1)
+
+
+def test_multi_tick_depth_routing_bitwise_mixed_buffers():
+    """The lazy multi-tick scan at the depth variant covering the
+    buffer's deepest row vs the full-window scan, over buffers mixing
+    every rollback depth 0..max_prediction — checksums [T, W], ring and
+    state identical."""
+    routed, full = make_core(), make_core()
+    rng_a, rng_b = np.random.default_rng(11), np.random.default_rng(11)
+    # three buffers with different max depths so several variants route
+    for depths in ([0, 0, 1, 0], [2, 0, 3, 1], list(range(9))):
+        frame = 0
+        rows_a, rows_b, last_active = [], [], 0
+        for d in depths:
+            rows_a.append(depth_row(routed, rng_a, d, frame))
+            rows_b.append(depth_row(full, rng_b, d, frame))
+            frame += max(d, 1)
+            last_active = max(last_active, max(d, 1))
+        his_a, los_a = routed.tick_multi(
+            np.stack(rows_a), last_active=last_active
+        )
+        his_b, los_b = full.tick_multi(np.stack(rows_b))  # full window
+        np.testing.assert_array_equal(np.asarray(his_a), np.asarray(his_b))
+        np.testing.assert_array_equal(np.asarray(los_a), np.asarray(los_b))
+        assert_cores_equal(routed, full, f"depths={depths}")
+
+
+@pytest.mark.parametrize("lazy_ticks", [16])
+def test_lazy16_backend_parity_routing_on_vs_off(lazy_ticks):
+    """End to end through TpuRollbackBackend(lazy_ticks=16): the same
+    forced-rollback SyncTest request stream with depth routing on vs
+    off — final state and every saved checksum bit-identical."""
+
+    def backend(depth_routing):
+        return TpuRollbackBackend(
+            ExGame(num_players=PLAYERS, num_entities=ENTITIES),
+            max_prediction=6,
+            num_players=PLAYERS,
+            lazy_ticks=lazy_ticks,
+            depth_routing=depth_routing,
+        )
+
+    def synctest():
+        return (
+            SessionBuilder(input_size=1)
+            .with_num_players(PLAYERS)
+            .with_max_prediction_window(6)
+            .with_check_distance(4)
+            .start_synctest_session()
+        )
+
+    routed, full = backend(True), backend(False)
+    sess_r, sess_f = synctest(), synctest()
+    cells_r, cells_f = [], []
+    for t in range(25):
+        for h in range(PLAYERS):
+            buf = bytes([(t * (3 + h) + h) % 16])
+            sess_r.add_local_input(h, buf)
+            sess_f.add_local_input(h, buf)
+        rr, rf = sess_r.advance_frame(), sess_f.advance_frame()
+        routed.handle_requests(rr)
+        full.handle_requests(rf)
+        cells_r += [r.cell for r in rr if hasattr(r, "cell")]
+        cells_f += [r.cell for r in rf if hasattr(r, "cell")]
+    sr, sf = routed.state_numpy(), full.state_numpy()
+    for k in sr:
+        np.testing.assert_array_equal(
+            np.asarray(sr[k]), np.asarray(sf[k]), err_msg=f"state[{k}]"
+        )
+    assert len(cells_r) == len(cells_f) > 0
+    for cr, cf in zip(cells_r, cells_f):
+        assert cr.frame == cf.frame
+        assert cr.checksum == cf.checksum, f"checksum at frame {cr.frame}"
+
+
+def test_megabatch_mixed_depths_bitwise_vs_full_window():
+    """A hosted-style 8-session megabatch with mixed rollback depths
+    (0..8): depth-grouped dispatch (zero-rollback fast program + one
+    windowed program per occupied depth bucket) vs ONE full-window
+    megabatch — per-slot checksums, stacked rings and stacked states all
+    bit-identical."""
+    N = 8
+
+    def device(depth_routing):
+        return MultiSessionDeviceCore(
+            ExGame(num_players=PLAYERS, num_entities=ENTITIES),
+            max_prediction=8,
+            num_players=PLAYERS,
+            capacity=N,
+            depth_routing=depth_routing,
+        )
+
+    dev_a, dev_b = device(True), device(False)
+    core_a, core_b = dev_a.core, dev_b.core
+    depths = [0, 3, 0, 8, 1, 0, 5, 0]  # zero-rollback rows dominate
+    rng_a, rng_b = np.random.default_rng(23), np.random.default_rng(23)
+    frame = 4
+    rows_a = [depth_row(core_a, rng_a, d, frame) for d in depths]
+    rows_b = [depth_row(core_b, rng_b, d, frame) for d in depths]
+
+    # routed: group like the host scheduler (fast + per depth bucket)
+    groups = {}
+    for slot, (row, d) in enumerate(zip(rows_a, depths)):
+        la = max(d, 1)
+        gkey = (
+            "fast"
+            if dev_a.fast_eligible(row, la)
+            else dev_a.depth_bucket_for(la)
+        )
+        groups.setdefault(gkey, []).append((slot, row, la))
+    assert "fast" in groups and len(groups) >= 3  # genuinely mixed
+    got = {}
+    for gkey, group in groups.items():
+        entries = [(slot, row) for slot, row, _ in group]
+        if gkey == "fast":
+            batch, _ = dev_a.dispatch(entries, fast=True)
+        else:
+            batch, _ = dev_a.dispatch(
+                entries, last_active=max(la for _, _, la in group)
+            )
+        for k, (slot, _, _) in enumerate(group):
+            got[slot] = (batch, k)
+
+    # reference: one full-window megabatch
+    batch_b, _ = dev_b.dispatch(list(enumerate(rows_b)))
+
+    W = core_a.window
+    for slot in range(N):
+        batch, k = got[slot]
+        for i in range(W):
+            assert batch.resolve(k * W + i) == batch_b.resolve(
+                slot * W + i
+            ), f"checksum slot={slot} window={i}"
+    dev_a.block_until_ready()
+    dev_b.block_until_ready()
+    for leaf_a, leaf_b in zip(
+        jax.tree.leaves(dev_a.rings), jax.tree.leaves(dev_b.rings)
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(leaf_a)),
+            np.asarray(jax.device_get(leaf_b)),
+        )
+    for leaf_a, leaf_b in zip(
+        jax.tree.leaves(dev_a.states), jax.tree.leaves(dev_b.states)
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(leaf_a)),
+            np.asarray(jax.device_get(leaf_b)),
+        )
+
+
+@pytest.mark.slow  # the 64-session serve soak carries the same bound in
+# tier-1; this denser mixed-depth variant rides the full gate only
+def test_lossy_soak_jit_cache_within_bucket_budget():
+    """A lossy hosted soak must keep the megabatch program population
+    inside the O(log N x log W) grid depth routing guarantees — fleet
+    churn, mixed depths and backpressure must never mint programs beyond
+    (row buckets) x (depth buckets + fast)."""
+    from ggrs_tpu.serve.loadgen import run_loadgen
+
+    rep = run_loadgen(
+        sessions=12, ticks=30, entities=ENTITIES, seed=3, loss=0.05,
+        latency_ms=20, jitter_ms=10,
+    )
+    host = rep.pop("_host")
+    assert rep["desyncs"] == 0
+    mega = host.device.megabatch_programs()
+    assert len(mega) > 0
+    assert len(mega) <= host.device.dispatch_bucket_budget(), (
+        f"megabatch programs escaped the bucket grid: {sorted(mega)}"
+    )
+    # every minted program names a grid point: a configured row bucket
+    # x (a configured depth bucket | 0 = the fast path)
+    for bucket, d, _count in mega:
+        assert bucket in host.device.buckets
+        assert d == 0 or d in host.device.depth_buckets
+    host.drain()
+
+
+def test_depth_telemetry_instruments_record_fast_path():
+    """The obs wiring: a hosted zero-rollback fleet must land megabatch
+    dispatches in the depth histogram's le=1 bucket (the fast-path
+    marker the dispatch smoke gate asserts) and grow the padded-slot
+    waste counter; both must ride the exporters."""
+    from ggrs_tpu import PlayerType
+    from ggrs_tpu.network.sockets import InMemoryNetwork
+    from ggrs_tpu.obs import GLOBAL_TELEMETRY
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    host = SessionHost(
+        ExGame(num_players=PLAYERS, num_entities=ENTITIES),
+        max_prediction=8,
+        num_players=PLAYERS,
+        max_sessions=4,
+        clock=clock,
+    )
+
+    def solo(addr):
+        b = SessionBuilder(input_size=1).with_num_players(PLAYERS)
+        for h in range(PLAYERS):
+            b = b.add_player(PlayerType.local(), h)
+        return b.start_p2p_session(net.socket(addr))
+
+    keys = [host.attach(solo(f"s{i}")) for i in range(3)]
+    GLOBAL_TELEMETRY.enabled = True
+    try:
+        depth0 = GLOBAL_TELEMETRY.registry.get("ggrs_dispatch_depth")
+        waste0 = GLOBAL_TELEMETRY.registry.get(
+            "ggrs_padded_slot_waste"
+        ).value
+        fast0 = depth0.snapshot()["values"].get("", {"buckets": {}})[
+            "buckets"
+        ].get("1", 0)
+        for t in range(6):
+            for key in keys:
+                for h in range(PLAYERS):
+                    host.submit_input(key, h, bytes([(t + h) % 16]))
+            host.tick()
+            clock.advance(16)
+        snap = GLOBAL_TELEMETRY.registry.get(
+            "ggrs_dispatch_depth"
+        ).snapshot()["values"][""]
+        assert snap["buckets"]["1"] > fast0, (
+            "zero-rollback hosted traffic never took the fast path"
+        )
+        waste = GLOBAL_TELEMETRY.registry.get("ggrs_padded_slot_waste")
+        assert waste.value > waste0
+        # both exporters carry the new series
+        text = GLOBAL_TELEMETRY.prometheus()
+        assert "ggrs_dispatch_depth_bucket" in text
+        assert "ggrs_padded_slot_waste" in text
+        assert "ggrs_dispatch_depth" in GLOBAL_TELEMETRY.snapshot()["metrics"]
+    finally:
+        GLOBAL_TELEMETRY.enabled = False
