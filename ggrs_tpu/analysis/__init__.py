@@ -1,0 +1,44 @@
+"""Static-analysis suite + runtime retrace sanitizer.
+
+Four source-level passes guard the invariants the rollback core's
+guarantees rest on (run as `python -m ggrs_tpu.analysis`, gated by
+`scripts/check.sh --lint` against `analysis/baseline.toml`):
+
+  determinism        DET001-004  simulation/device modules stay bitwise
+                                 replayable across peers
+  trace_discipline   TRC001-004  functions under jax traces stay pure,
+                                 sync-free and retrace-stable
+  fence              FEN001      device-core shared state only mutates
+                                 through the async-fence entry points
+  wire_contract      WIRE001-004 Python and C++ stacks cannot silently
+                                 drift on formats, layouts or bounds
+
+The runtime companion (`GGRS_SANITIZE=1`, analysis/sanitize.py) wraps
+jax.jit to attribute every program compile to its call site and assert
+the megabatch jit cache against the dispatch-bucket budget mid-serve.
+
+This package imports no jax (the sanitizer imports it lazily at
+install), so the lint gate runs anywhere the repo checks out.
+"""
+
+from .baseline import (
+    BaselineEntry,
+    apply_baseline,
+    format_baseline,
+    parse_baseline,
+)
+from .engine import PASS_NAMES, Repo, run_passes
+from .findings import RULES, Finding, sort_findings
+
+__all__ = [
+    "BaselineEntry",
+    "Finding",
+    "PASS_NAMES",
+    "RULES",
+    "Repo",
+    "apply_baseline",
+    "format_baseline",
+    "parse_baseline",
+    "run_passes",
+    "sort_findings",
+]

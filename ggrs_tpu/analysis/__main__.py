@@ -1,0 +1,125 @@
+"""CLI: `python -m ggrs_tpu.analysis` — run the passes, apply the
+baseline, print what's new, exit nonzero on any unbaselined finding.
+
+    python -m ggrs_tpu.analysis                 # the gate
+    python -m ggrs_tpu.analysis --list-rules    # rule table
+    python -m ggrs_tpu.analysis --no-baseline   # raw findings
+    python -m ggrs_tpu.analysis --passes determinism,fence
+    python -m ggrs_tpu.analysis --write-baseline  # re-audit: rewrite the
+        allowlist from current findings (justifications start as TODO and
+        MUST be filled in before committing)
+
+Exit codes: 0 clean (stale baseline entries only warn), 1 unbaselined
+findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .baseline import BaselineEntry, format_baseline, parse_baseline
+from .engine import PASS_NAMES, Repo, run_passes
+from .findings import RULES
+from . import apply_baseline
+
+BASELINE_RELPATH = "ggrs_tpu/analysis/baseline.toml"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m ggrs_tpu.analysis")
+    ap.add_argument(
+        "--passes",
+        help=f"comma-separated subset of {','.join(PASS_NAMES)}",
+    )
+    ap.add_argument("--baseline", help="baseline file "
+                    f"(default: <repo>/{BASELINE_RELPATH})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, audited or not")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--root", help="repo root (default: auto-detect)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    repo = Repo(root=os.path.abspath(args.root)) if args.root else Repo.from_here()
+    passes = None
+    if args.passes:
+        passes = [p.strip() for p in args.passes.split(",") if p.strip()]
+        unknown = set(passes) - set(PASS_NAMES)
+        if unknown:
+            print(f"unknown passes: {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    findings = run_passes(repo, passes)
+
+    baseline_path = args.baseline or os.path.join(
+        repo.root or ".", BASELINE_RELPATH
+    )
+    entries = []
+    if not args.no_baseline and os.path.isfile(baseline_path):
+        with open(baseline_path, "r", encoding="utf-8") as f:
+            entries = parse_baseline(f.read(), origin=baseline_path)
+
+    if args.write_baseline:
+        new_entries = [
+            BaselineEntry(
+                rule=f.rule, path=f.path, symbol=f.symbol,
+                justification="TODO: audit and justify (or fix)",
+            )
+            for f in findings
+        ]
+        # collapse duplicates into counts
+        merged = {}
+        for e in new_entries:
+            if e.key in merged:
+                merged[e.key].count += 1
+            else:
+                merged[e.key] = e
+        # keep existing justifications where the key survives
+        old = {e.key: e for e in entries}
+        for key, e in merged.items():
+            if key in old:
+                e.justification = old[key].justification
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write(format_baseline(
+                sorted(merged.values(), key=lambda e: e.key),
+                header=(
+                    "ggrs_tpu static-analysis baseline — the audited "
+                    "allowlist.\nEvery entry is a finding that was reviewed "
+                    "and intentionally kept; the\njustification says why. "
+                    "New findings are NOT suppressed: the gate\nratchets — "
+                    "fix the code or audit it into this file.\nRegenerate "
+                    "skeleton: python -m ggrs_tpu.analysis --write-baseline"
+                ),
+            ))
+        print(f"wrote {len(merged)} entries to {baseline_path}")
+        return 0
+
+    fresh, suppressed, stale = apply_baseline(findings, entries)
+
+    for f in fresh:
+        print(f.render())
+    for e in stale:
+        print(
+            f"note: stale baseline entry {e.rule} {e.path} [{e.symbol}] "
+            "matches nothing — prune it (the ratchet tightened)",
+            file=sys.stderr,
+        )
+    print(
+        f"ggrs_tpu.analysis: {len(fresh)} finding(s), "
+        f"{len(suppressed)} baselined, {len(stale)} stale baseline "
+        f"entr{'y' if len(stale) == 1 else 'ies'}",
+        file=sys.stderr,
+    )
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

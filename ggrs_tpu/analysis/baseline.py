@@ -1,0 +1,183 @@
+"""Baseline load/match/write: the audited-allowlist ratchet.
+
+`analysis/baseline.toml` holds every finding that was audited and
+intentionally kept, each with a one-line justification. The gate then
+ratchets: a finding matching a baseline entry (same rule, path, enclosing
+symbol, up to `count` occurrences) is suppressed; anything new fails.
+Stale entries (nothing matches them anymore) are reported as prunable but
+don't fail the gate — deleting them is the ratchet tightening.
+
+Python 3.10 has no tomllib, so this module reads a strict subset of TOML:
+comments, `[[finding]]` array-of-table headers, and `key = "string"` /
+`key = integer` pairs. `format_baseline` emits exactly that subset, so
+round-trips are stable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from .findings import Finding
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    symbol: str
+    justification: str
+    count: int = 1
+    # filled during matching
+    matched: int = field(default=0, compare=False)
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def _closing_quote(value: str) -> int:
+    """Index of the closing quote of a `"..."` literal starting at 0: a
+    quote is escaped only when preceded by an ODD run of backslashes
+    (`"x\\\\"` ends at the final quote; `"x\\""` does not)."""
+    end = value.find('"', 1)
+    while end != -1:
+        backslashes = 0
+        i = end - 1
+        while i > 0 and value[i] == "\\":
+            backslashes += 1
+            i -= 1
+        if backslashes % 2 == 0:
+            return end
+        end = value.find('"', end + 1)
+    return -1
+
+
+def _unescape(s: str) -> str:
+    """Left-to-right `\\\\` / `\\"` unescape (two blind str.replace
+    passes corrupt adjacent escape sequences)."""
+    out: List[str] = []
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s) and s[i + 1] in ('\\', '"'):
+            out.append(s[i + 1])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def parse_baseline(text: str, origin: str = "baseline.toml") -> List[BaselineEntry]:
+    entries: List[BaselineEntry] = []
+    current: Dict[str, object] = {}
+    in_entry = False
+
+    def flush(lineno: int) -> None:
+        nonlocal current
+        if not in_entry:
+            return
+        missing = {"rule", "path", "symbol", "justification"} - set(current)
+        if missing:
+            raise BaselineError(
+                f"{origin}:{lineno}: entry missing {sorted(missing)}"
+            )
+        entries.append(
+            BaselineEntry(
+                rule=str(current["rule"]),
+                path=str(current["path"]),
+                symbol=str(current["symbol"]),
+                justification=str(current["justification"]),
+                count=int(current.get("count", 1)),
+            )
+        )
+        current = {}
+
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[finding]]":
+            flush(lineno)
+            in_entry = True
+            continue
+        if line.startswith("["):
+            raise BaselineError(
+                f"{origin}:{lineno}: only [[finding]] tables are supported"
+            )
+        if "=" not in line:
+            raise BaselineError(f"{origin}:{lineno}: expected key = value")
+        if not in_entry:
+            raise BaselineError(
+                f"{origin}:{lineno}: key outside a [[finding]] table"
+            )
+        key, _, value = line.partition("=")
+        key = key.strip()
+        value = value.strip()
+        # strip a trailing comment only outside quotes
+        if value.startswith('"'):
+            end = _closing_quote(value)
+            if end == -1:
+                raise BaselineError(f"{origin}:{lineno}: unterminated string")
+            current[key] = _unescape(value[1:end])
+        else:
+            value = value.split("#", 1)[0].strip()
+            try:
+                current[key] = int(value)
+            except ValueError as exc:
+                raise BaselineError(
+                    f"{origin}:{lineno}: unsupported value {value!r}"
+                ) from exc
+    flush(len(text.splitlines()) + 1)
+    return entries
+
+
+def _quote(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def format_baseline(entries: List[BaselineEntry], header: str = "") -> str:
+    lines: List[str] = []
+    if header:
+        for h in header.splitlines():
+            lines.append(f"# {h}".rstrip())
+        lines.append("")
+    for e in sorted(entries, key=lambda e: e.key):
+        lines.append("[[finding]]")
+        lines.append(f"rule = {_quote(e.rule)}")
+        lines.append(f"path = {_quote(e.path)}")
+        lines.append(f"symbol = {_quote(e.symbol)}")
+        if e.count != 1:
+            lines.append(f"count = {e.count}")
+        lines.append(f"justification = {_quote(e.justification)}")
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n" if lines else ""
+
+
+def apply_baseline(
+    findings: List[Finding], entries: List[BaselineEntry]
+) -> Tuple[List[Finding], List[Finding], List[BaselineEntry]]:
+    """Split findings into (new, suppressed); also return the stale
+    baseline entries that matched nothing (prunable). Duplicate baseline
+    keys are legal (two [[finding]] entries for one symbol): their
+    budgets stack in file order instead of shadowing each other."""
+    budget: Dict[Tuple[str, str, str], List[BaselineEntry]] = {}
+    for e in entries:
+        e.matched = 0
+        budget.setdefault(e.key, []).append(e)
+    fresh: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        for e in budget.get(f.key, ()):
+            if e.matched < e.count:
+                e.matched += 1
+                suppressed.append(f)
+                break
+        else:
+            fresh.append(f)
+    stale = [e for e in entries if e.matched == 0]
+    return fresh, suppressed, stale

@@ -1,0 +1,159 @@
+"""Pass 1 — determinism lint (DET001..DET004).
+
+Scope: the modules whose code runs (or feeds data) inside the simulated
+world — `tpu/`, `models/`, `ops/`, `sync_layer.py`, `input_queue.py`.
+Everything there must be bitwise-replayable across peers: the rollback
+core's desync detection compares full-state checksums, so ANY
+nondeterminism (wall clock, unseeded RNG, CPython object identity,
+unordered-set iteration feeding device buffers) eventually surfaces as a
+MismatchedChecksum forensics bundle 64 sessions deep. Catch it at the
+source line instead.
+
+Host-side pacing (time.monotonic / time.perf_counter) is deliberately NOT
+flagged: the adaptive speculation gate times idle budgets with it, and the
+bit-parity contract (tests/test_async_dispatch.py) proves pacing cannot
+change results — only wall-clock *values* entering simulation state can.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .engine import Repo, call_name, finding
+from .findings import Finding
+
+# module scope: repo-relative prefixes of simulation/device code
+SCOPE_PREFIXES = (
+    "ggrs_tpu/tpu/",
+    "ggrs_tpu/models/",
+    "ggrs_tpu/ops/",
+    "ggrs_tpu/sync_layer.py",
+    "ggrs_tpu/input_queue.py",
+)
+
+# DET001: wall-clock reads (values differ across peers by construction)
+WALL_CLOCK_CALLS = {
+    "time.time", "time.time_ns", "time.localtime", "time.gmtime",
+    "time.ctime", "time.strftime",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+# DET002: module-level RNG draws (process-global state, unseeded by default)
+_RANDOM_FNS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "sample", "shuffle", "getrandbits", "randbytes", "gauss", "normalvariate",
+    "betavariate", "expovariate", "triangular", "vonmisesvariate",
+}
+_NP_RANDOM_FNS = {
+    "rand", "randn", "randint", "random", "random_sample", "choice",
+    "shuffle", "permutation", "uniform", "normal", "standard_normal",
+    "bytes", "beta", "binomial", "poisson", "exponential",
+}
+
+
+def in_scope(path: str) -> bool:
+    return any(
+        path == p or path.startswith(p) for p in SCOPE_PREFIXES
+    )
+
+
+def _check_call(path: str, node: ast.Call, out: List[Finding]) -> None:
+    name = call_name(node)
+    if name is None:
+        return
+    if name in WALL_CLOCK_CALLS:
+        out.append(finding(
+            "DET001", path, node,
+            f"{name}() reads the wall clock; peers disagree on the value "
+            "— derive times from the session clock / frame counter",
+        ))
+        return
+    parts = name.split(".")
+    # module-level `random.X(...)` (a `rng.X(...)` on a seeded
+    # random.Random instance resolves to a different base name)
+    if len(parts) == 2 and parts[0] == "random" and parts[1] in _RANDOM_FNS:
+        out.append(finding(
+            "DET002", path, node,
+            f"{name}() draws from the process-global unseeded RNG; "
+            "inject a seeded random.Random instead",
+        ))
+        return
+    # `np.random.X(...)` / `numpy.random.X(...)` global draws
+    if (
+        len(parts) == 3
+        and parts[0] in ("np", "numpy")
+        and parts[1] == "random"
+        and parts[2] in _NP_RANDOM_FNS
+    ):
+        out.append(finding(
+            "DET002", path, node,
+            f"{name}() draws from numpy's global RNG; use a seeded "
+            "np.random.Generator (default_rng(seed))",
+        ))
+        return
+    if name in ("np.random.default_rng", "numpy.random.default_rng") and not (
+        node.args or node.keywords
+    ):
+        out.append(finding(
+            "DET002", path, node,
+            "default_rng() without a seed draws OS entropy; pass a seed",
+        ))
+        return
+    if name in ("id", "hash"):
+        out.append(finding(
+            "DET003", path, node,
+            f"{name}() is CPython-run dependent (object addresses / "
+            "PYTHONHASHSEED); use an explicit stable key",
+        ))
+
+
+def _iter_expr_of(node: ast.AST):
+    """The iterable expressions a node loops over, if any."""
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        yield node.iter
+    elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                           ast.GeneratorExp)):
+        for gen in node.generators:
+            yield gen.iter
+    elif isinstance(node, ast.Call) and call_name(node) in (
+        "list", "tuple", "enumerate", "zip", "iter"
+    ):
+        # order-preserving conversions of a set are still order-dependent
+        for arg in node.args:
+            yield arg
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Set):
+        return True
+    if isinstance(node, ast.SetComp):
+        return True
+    if isinstance(node, ast.Call) and call_name(node) in ("set", "frozenset"):
+        return True
+    return False
+
+
+def _check_iteration(path: str, node: ast.AST, out: List[Finding]) -> None:
+    for it in _iter_expr_of(node):
+        if _is_set_expr(it):
+            out.append(finding(
+                "DET004", path, it,
+                "iterating a set: element order varies across processes "
+                "(PYTHONHASHSEED); wrap in sorted(...)",
+            ))
+
+
+def run(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    for path in repo.python_files():
+        if not in_scope(path):
+            continue
+        tree = repo.tree(path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                _check_call(path, node, out)
+            _check_iteration(path, node, out)
+    return out

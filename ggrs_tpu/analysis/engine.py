@@ -1,0 +1,186 @@
+"""Analysis engine: repo abstraction, AST utilities and the pass runner.
+
+Pure stdlib on purpose — `python -m ggrs_tpu.analysis` must run anywhere
+the repo checks out (no jax, no device), and fast enough to gate every
+push. Each pass module exposes `run(repo) -> List[Finding]`; tests feed a
+`Repo` built from in-memory fixture sources through the same entry point
+the CLI uses, so fixture behavior IS gate behavior.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .findings import Finding, sort_findings
+
+
+class Repo:
+    """File access seam for the passes: real tree or in-memory fixtures."""
+
+    def __init__(self, root: Optional[str] = None,
+                 files: Optional[Dict[str, str]] = None):
+        """`root`: repo root on disk. `files`: {relpath: source} overlay —
+        when given without a root, the repo is fully in-memory."""
+        self.root = root
+        self._overlay = dict(files or {})
+        self._tree_cache: Dict[str, ast.Module] = {}
+
+    @classmethod
+    def from_here(cls) -> "Repo":
+        """Locate the repo root from this package's location on disk
+        (ggrs_tpu/analysis/engine.py -> two parents up)."""
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        return cls(root=os.path.dirname(pkg))
+
+    def exists(self, relpath: str) -> bool:
+        if relpath in self._overlay:
+            return True
+        return self.root is not None and os.path.isfile(
+            os.path.join(self.root, relpath)
+        )
+
+    def read(self, relpath: str) -> str:
+        if relpath in self._overlay:
+            return self._overlay[relpath]
+        assert self.root is not None, f"no such fixture file: {relpath}"
+        with open(os.path.join(self.root, relpath), "r", encoding="utf-8") as f:
+            return f.read()
+
+    def python_files(self) -> List[str]:
+        """Repo-relative paths of every package source file the AST passes
+        scan (the `ggrs_tpu/` tree; tests/scripts/examples are not shipped
+        simulation code and have their own hygiene)."""
+        paths = set(p for p in self._overlay if p.endswith(".py"))
+        if self.root is not None:
+            pkg_root = os.path.join(self.root, "ggrs_tpu")
+            for dirpath, dirnames, filenames in os.walk(pkg_root):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for name in filenames:
+                    if name.endswith(".py"):
+                        full = os.path.join(dirpath, name)
+                        paths.add(
+                            os.path.relpath(full, self.root).replace(os.sep, "/")
+                        )
+        return sorted(paths)
+
+    def tree(self, relpath: str) -> ast.Module:
+        t = self._tree_cache.get(relpath)
+        if t is None:
+            t = ast.parse(self.read(relpath), filename=relpath)
+            attach_parents(t)
+            self._tree_cache[relpath] = t
+        return t
+
+
+# ---------------------------------------------------------------------------
+# AST utilities shared by the passes
+# ---------------------------------------------------------------------------
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def attach_parents(tree: ast.Module) -> None:
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            child._ggrs_parent = parent  # type: ignore[attr-defined]
+
+
+def parent_of(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_ggrs_parent", None)
+
+
+def qualname_of(node: ast.AST) -> str:
+    """Dotted qualname of the innermost enclosing function/class scope,
+    `<module>` at module level. `<lambda>` segments keep lambdas
+    addressable in the baseline."""
+    parts: List[str] = []
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            parts.append(cur.name)
+        elif isinstance(cur, ast.Lambda):
+            parts.append("<lambda>")
+        cur = parent_of(cur)
+    return ".".join(reversed(parts)) if parts else "<module>"
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    cur = parent_of(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return cur
+        cur = parent_of(cur)
+    return None
+
+
+def enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    cur = parent_of(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = parent_of(cur)
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for Name/Attribute chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def in_loop(node: ast.AST, *, within: Optional[ast.AST] = None) -> bool:
+    """Is `node` lexically inside a for/while body (without crossing a
+    function boundary, unless that function is `within` itself)?"""
+    cur = parent_of(node)
+    while cur is not None and cur is not within:
+        if isinstance(cur, (ast.For, ast.While)):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+        cur = parent_of(cur)
+    return False
+
+
+def finding(rule: str, path: str, node: ast.AST, message: str) -> Finding:
+    return Finding(
+        rule=rule,
+        path=path,
+        line=getattr(node, "lineno", 0),
+        symbol=qualname_of(node),
+        message=message,
+    )
+
+
+# ---------------------------------------------------------------------------
+# pass runner
+# ---------------------------------------------------------------------------
+
+PASS_NAMES = ("determinism", "trace_discipline", "fence", "wire_contract")
+
+
+def run_passes(
+    repo: Repo, passes: Optional[Iterable[str]] = None
+) -> List[Finding]:
+    from . import determinism, fence, trace_discipline, wire_contract
+
+    table = {
+        "determinism": determinism.run,
+        "trace_discipline": trace_discipline.run,
+        "fence": fence.run,
+        "wire_contract": wire_contract.run,
+    }
+    selected = list(passes) if passes is not None else list(PASS_NAMES)
+    findings: List[Finding] = []
+    for name in selected:
+        findings.extend(table[name](repo))
+    return sort_findings(findings)

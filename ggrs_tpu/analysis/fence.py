@@ -1,0 +1,164 @@
+"""Pass 3 — fence-discipline lint (FEN001).
+
+The async dispatch pipeline's correctness argument (PR 1/3/4) is a
+*protocol*: pooled staging buffers may be reused only because the fence
+proves the dispatch that read them retired; the inflight deque and its
+row count ARE the fence; the shared plan cache is the jit-cache bound.
+Mutating any of that state from a method outside the fence/dispatch
+entry points silently breaks the proof — the buffer gets reused while a
+dispatch may still read it, or the backpressure signal drifts from the
+real in-flight window.
+
+This pass encodes the protocol as a policy table: per protected module,
+the attribute names that make up device-core shared state and the
+methods allowed to write them. A write is an attribute assignment
+(`x._inflight = ...`, `x.rings = ...`), an augmented assignment, a
+subscript store through the attribute, or a mutating container-method
+call (`x._inflight.append(...)`). Reads are always fine; so are calls to
+the entry points themselves (that's the routed path).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .engine import Repo, enclosing_class, finding, parent_of, qualname_of
+from .findings import Finding
+
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "clear", "update", "setdefault", "add", "discard", "fill", "sort",
+    "reverse",
+}
+
+# device-core shared state: the async-fence carry, the pooled staging
+# buffers, and the dispatch-plan cache
+CORE_STATE: FrozenSet[str] = frozenset({
+    "_inflight", "inflight_rows",
+    "_stage_pool", "_stage_pools", "_stage_flip",
+    "_multi_bufs", "_multi_flip", "_multi_active", "_multi_count",
+    "_pad_row", "_tick_rows", "_tick_future", "_buffered_last_active",
+    "plan_cache", "dispatch_signatures",
+    "rings", "states",
+})
+
+
+@dataclass(frozen=True)
+class FencePolicy:
+    protected: FrozenSet[str]
+    # (class qualname or "*", method name) pairs allowed to write
+    allowed: FrozenSet[Tuple[str, str]]
+
+
+# the fence/dispatch entry points per protected module. serve/host.py
+# deliberately has NO allowances: the host must drive the device core
+# through its methods (dispatch/poll_retired/reset_slot/...), never by
+# reaching into `self.device.<state>`.
+POLICIES: Dict[str, FencePolicy] = {
+    "ggrs_tpu/tpu/backend.py": FencePolicy(
+        protected=CORE_STATE,
+        allowed=frozenset({
+            ("TpuRollbackBackend", "__init__"),
+            ("TpuRollbackBackend", "_note_inflight"),
+            ("TpuRollbackBackend", "_next_stage"),
+            ("TpuRollbackBackend", "_acquire_multi_buf"),
+            ("TpuRollbackBackend", "_run_segment"),
+            ("TpuRollbackBackend", "flush"),
+            ("TpuRollbackBackend", "reset"),
+            ("TpuRollbackBackend", "block_until_ready"),
+            ("MultiSessionDeviceCore", "__init__"),
+            ("MultiSessionDeviceCore", "_note_inflight"),
+            ("MultiSessionDeviceCore", "poll_retired"),
+            ("MultiSessionDeviceCore", "_acquire_stage"),
+            ("MultiSessionDeviceCore", "dispatch"),
+            ("MultiSessionDeviceCore", "reset_slot"),
+            ("MultiSessionDeviceCore", "warmup"),
+            ("MultiSessionDeviceCore", "_warmup_impl"),
+            ("MultiSessionDeviceCore", "block_until_ready"),
+            ("MultiSessionDeviceCore", "restore"),
+            # the plan cache's own accounting lives in its own class
+            ("DispatchPlanCache", "__init__"),
+            ("DispatchPlanCache", "note"),
+            ("DispatchPlanCache", "clear"),
+        }),
+    ),
+    "ggrs_tpu/serve/host.py": FencePolicy(
+        protected=CORE_STATE,
+        allowed=frozenset(),
+    ),
+}
+
+
+def _is_allowed(node: ast.AST, policy: FencePolicy) -> bool:
+    """Walk enclosing (class, method) scopes against the allowlist."""
+    qual = qualname_of(node)
+    parts = qual.split(".")
+    for i in range(len(parts) - 1):
+        if (parts[i], parts[i + 1]) in policy.allowed:
+            return True
+    # module-level code (e.g. constants) never mutates live state
+    return qual == "<module>"
+
+
+def _attrs_of_write(node: ast.AST) -> List[ast.Attribute]:
+    """Every Attribute being written by this node, tuple-unpacking
+    included — `self.rings, self.states, his, los = fn(...)` is the
+    codebase's canonical write form for the stacked worlds, so a pass
+    that only saw bare Attribute targets would miss exactly the writes
+    it exists to police."""
+    if not isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        return []
+    targets = (
+        node.targets if isinstance(node, ast.Assign) else [node.target]
+    )
+    out: List[ast.Attribute] = []
+    stack = list(targets)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            stack.append(t.value)
+        elif isinstance(t, ast.Attribute):
+            # x.attr = ... | x.attr += ...
+            out.append(t)
+        elif isinstance(t, ast.Subscript) and isinstance(t.value, ast.Attribute):
+            # x.attr[k] = ...
+            out.append(t.value)
+    return out
+
+
+def _attr_of_mutating_call(node: ast.Call) -> Optional[ast.Attribute]:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+        if isinstance(f.value, ast.Attribute):
+            return f.value
+    return None
+
+
+def run(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    for path, policy in sorted(POLICIES.items()):
+        if not repo.exists(path):
+            continue
+        tree = repo.tree(path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                mut = _attr_of_mutating_call(node)
+                attrs = [mut] if mut is not None else []
+            else:
+                attrs = _attrs_of_write(node)
+            for attr in attrs:
+                if attr.attr not in policy.protected:
+                    continue
+                if _is_allowed(node, policy):
+                    continue
+                out.append(finding(
+                    "FEN001", path, node,
+                    f"write to device-core state '.{attr.attr}' outside "
+                    "the fence/dispatch entry points — route it through "
+                    "the owning method (see analysis/fence.py POLICIES)",
+                ))
+    return out

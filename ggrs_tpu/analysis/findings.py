@@ -1,0 +1,72 @@
+"""Finding model + rule registry for the static-analysis suite.
+
+Every pass emits `Finding` records carrying a stable rule id, the
+repo-relative path, a 1-based line and the enclosing symbol (dotted
+qualname, `<module>` at module scope). The (rule, path, symbol) triple is
+the baseline key — line numbers churn with unrelated edits, symbols
+don't — so `analysis/baseline.toml` entries survive refactors that move
+code within a function.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+# rule id -> one-line description (the docs table is generated from the
+# same strings; tests assert every emitted finding uses a registered id)
+RULES: Dict[str, str] = {
+    # determinism (simulation/device modules must be replay-deterministic)
+    "DET001": "wall-clock read in a simulation/device module "
+              "(time.time/datetime.now break cross-peer checksum parity)",
+    "DET002": "unseeded RNG in a simulation/device module "
+              "(module-level random/np.random draws differ across peers)",
+    "DET003": "id()/hash() in a simulation/device module "
+              "(CPython address / PYTHONHASHSEED dependent values)",
+    "DET004": "iteration over an unordered set in a simulation/device "
+              "module (order differs across processes; sort first)",
+    # trace discipline (functions reachable from jit/vmap/scan bodies)
+    "TRC001": "host synchronization inside a traced function "
+              "(.item()/np.asarray/float() force a device->host transfer "
+              "per trace, or fail outright on tracers)",
+    "TRC002": "Python-level branch on a traced argument "
+              "(concretizes the tracer; use lax.cond/jnp.where)",
+    "TRC003": "mutation of closed-over state inside a traced function "
+              "(runs at trace time only; silently stale on cached calls)",
+    "TRC004": "jit cache created per call "
+              "(jax.jit inside a loop / immediately-invoked jit retraces "
+              "every time and unboundedly grows compile caches)",
+    # fence discipline (device-core shared state behind the async fence)
+    "FEN001": "device-core shared state mutated outside the "
+              "fence/dispatch entry points (staging pools, plan cache and "
+              "the inflight carry are only coherent under the fence)",
+    # wire contract (Python <-> native format/constant drift)
+    "WIRE001": "message type code drift between network/messages.py and "
+               "native/endpoint.cpp",
+    "WIRE002": "ctypes struct layout drift against native/ggrs_native.h",
+    "WIRE003": "datagram size bound drift between the Python and native "
+               "transports",
+    "WIRE004": "shared protocol constant drift between the Python and "
+               "native stacks",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int  # 1-based; 0 when the finding is file-level
+    symbol: str  # enclosing dotted qualname, or "<module>"
+    message: str
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        """The baseline-matching key (line numbers intentionally absent)."""
+        return (self.rule, self.path, self.symbol)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.symbol}] {self.message}"
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))

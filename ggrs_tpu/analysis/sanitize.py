@@ -1,0 +1,315 @@
+"""Runtime retrace sanitizer: `GGRS_SANITIZE=1` turns "unexpected
+recompile" from a perf mystery into a pointed report.
+
+The static pass (TRC004) catches per-call jit caches it can see; this is
+the dynamic complement. When installed, `jax.jit` is wrapped so every
+returned compiled function is a thin proxy that, after each call, checks
+the underlying compile-cache size: growth means a trace just happened,
+and the sanitizer records WHO (the jitted function), WHERE (the
+non-jax stack frames of the call site) and WHEN (the running compile
+index). After `freeze()` — called at the end of warmup, when every
+program the steady state dispatches is supposed to exist — any further
+compile is an *unexpected recompile*: it lands in the flight recorder,
+increments `ggrs_recompiles_total` (both exporters, `host.telemetry()`
+snapshots), and is listed with full provenance in `report()`.
+
+`check_dispatch_budget` is the mid-serve assertion the megabatch layer
+calls (MultiSessionDeviceCore.dispatch): the (row bucket x depth bucket)
+grid bounds the jit cache at `dispatch_bucket_budget()` programs, and
+with the sanitizer active a dispatch that grows past the bound raises
+RetraceBudgetExceeded naming every compile that got it there — instead
+of silently compiling mid-serve until the fleet stalls.
+
+Overhead when not installed: zero (nothing is patched). Installed, each
+jitted call pays one `_cache_size()` read. Install/uninstall are
+idempotent and restore the original `jax.jit`, so tests can sandwich a
+scenario without leaking the patch.
+"""
+
+from __future__ import annotations
+
+import os
+import traceback
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..errors import RetraceBudgetExceeded
+
+
+@dataclass
+class CompileEvent:
+    index: int  # running compile count across all sanitized functions
+    fn_name: str
+    fn_compiles: int  # this function's cache size after the compile
+    after_freeze: bool
+    stack: List[str] = field(default_factory=list)  # "file:line in func"
+
+    def provenance(self) -> str:
+        return self.stack[-1] if self.stack else "<unknown>"
+
+    def render(self) -> str:
+        tag = "RECOMPILE" if self.after_freeze else "compile"
+        lines = [
+            f"[{self.index}] {tag} of {self.fn_name} "
+            f"(cache size now {self.fn_compiles})"
+        ]
+        lines.extend(f"    at {frame}" for frame in self.stack[-6:])
+        return "\n".join(lines)
+
+
+def _call_stack() -> List[str]:
+    frames = []
+    for f in traceback.extract_stack():
+        fn = f.filename
+        if "/jax/" in fn or "jax_graft" in fn or fn.endswith("sanitize.py"):
+            continue
+        frames.append(f"{fn}:{f.lineno} in {f.name}")
+    return frames
+
+
+class _SanitizedJit:
+    """Proxy over one jitted function: forwards everything, watches the
+    compile-cache size after each call."""
+
+    def __init__(self, inner: Any, sanitizer: "RetraceSanitizer", name: str):
+        self._ggrs_inner = inner
+        self._ggrs_sanitizer = sanitizer
+        self._ggrs_name = name
+        self._ggrs_seen = 0
+
+    def __call__(self, *args, **kwargs):
+        out = self._ggrs_inner(*args, **kwargs)
+        self._ggrs_note()
+        return out
+
+    def _ggrs_note(self) -> None:
+        size_fn = getattr(self._ggrs_inner, "_cache_size", None)
+        if size_fn is None:
+            return
+        n = size_fn()
+        while self._ggrs_seen < n:
+            self._ggrs_seen += 1
+            self._ggrs_sanitizer._on_compile(self._ggrs_name, self._ggrs_seen)
+
+    def _cache_size(self) -> int:
+        size_fn = getattr(self._ggrs_inner, "_cache_size", None)
+        return size_fn() if size_fn else 0
+
+    def __getattr__(self, name):
+        return getattr(self._ggrs_inner, name)
+
+
+class RetraceSanitizer:
+    def __init__(self):
+        self.events: List[CompileEvent] = []
+        self.frozen_at: Optional[int] = None
+        self.freeze_label: Optional[str] = None
+        self._installed = False
+        self._orig_jit = None
+        self._m_compiles = None
+        self._m_recompiles = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def install(self) -> "RetraceSanitizer":
+        if self._installed:
+            return self
+        import jax
+
+        from ..obs import GLOBAL_TELEMETRY
+
+        reg = GLOBAL_TELEMETRY.registry
+        self._m_compiles = reg.counter(
+            "ggrs_program_compiles_total",
+            "program compiles observed by the retrace sanitizer",
+        )
+        self._m_recompiles = reg.counter(
+            "ggrs_recompiles_total",
+            "compiles after the sanitizer froze (post-warmup steady state "
+            "should never compile)",
+        )
+        self._orig_jit = jax.jit
+        sanitizer = self
+
+        def sanitized_jit(fun=None, **kwargs):
+            if fun is None:
+                # keyword-only partial form: jax.jit(static_argnums=...)(f)
+                def bind(f):
+                    return sanitized_jit(f, **kwargs)
+
+                return bind
+            inner = sanitizer._orig_jit(fun, **kwargs)
+            name = getattr(fun, "__qualname__", None) or getattr(
+                fun, "__name__", repr(fun)
+            )
+            return _SanitizedJit(inner, sanitizer, name)
+
+        jax.jit = sanitized_jit
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        import jax
+
+        jax.jit = self._orig_jit
+        self._orig_jit = None
+        self._installed = False
+
+    @property
+    def installed(self) -> bool:
+        return self._installed
+
+    # ------------------------------------------------------------------
+    # recording
+    # ------------------------------------------------------------------
+
+    def _on_compile(self, fn_name: str, fn_compiles: int) -> None:
+        from ..obs import GLOBAL_TELEMETRY
+
+        after_freeze = self.frozen_at is not None
+        ev = CompileEvent(
+            index=len(self.events) + 1,
+            fn_name=fn_name,
+            fn_compiles=fn_compiles,
+            after_freeze=after_freeze,
+            stack=_call_stack(),
+        )
+        self.events.append(ev)
+        tel = GLOBAL_TELEMETRY
+        if tel.enabled:
+            self._m_compiles.inc()
+            tel.record(
+                "program_compile", fn=fn_name, compiles=fn_compiles,
+                provenance=ev.provenance(),
+            )
+            if after_freeze:
+                self._m_recompiles.inc()
+                tel.record(
+                    "unexpected_recompile", fn=fn_name,
+                    compiles=fn_compiles, provenance=ev.provenance(),
+                    frozen_label=self.freeze_label,
+                )
+
+    def freeze(self, label: str = "steady-state") -> None:
+        """Declare warmup complete: every compile from here on is an
+        unexpected recompile."""
+        self.frozen_at = len(self.events)
+        self.freeze_label = label
+
+    def thaw(self) -> None:
+        self.frozen_at = None
+        self.freeze_label = None
+
+    # ------------------------------------------------------------------
+    # queries / assertions
+    # ------------------------------------------------------------------
+
+    @property
+    def compiles(self) -> List[CompileEvent]:
+        return list(self.events)
+
+    @property
+    def recompiles(self) -> List[CompileEvent]:
+        return [e for e in self.events if e.after_freeze]
+
+    def check_dispatch_budget(
+        self, fns: Dict[str, Any], budget: int, context: str = "dispatch"
+    ) -> None:
+        """Assert the summed compile-cache sizes of `fns` stay within
+        `budget` programs; raise RetraceBudgetExceeded with per-compile
+        provenance otherwise."""
+        sizes = {
+            name: getattr(fn, "_cache_size", lambda: 0)()
+            for name, fn in fns.items()
+        }
+        total = sum(sizes.values())
+        if total <= budget:
+            return
+        relevant = [
+            e for e in self.events
+            if any(e.fn_name.endswith(name) for name in sizes)
+        ] or self.events
+        trail = "\n".join(e.render() for e in relevant[-24:])
+        raise RetraceBudgetExceeded(
+            f"{context}: {total} compiled programs across {sizes} exceed "
+            f"the dispatch-bucket budget ({budget}); the jit cache is no "
+            f"longer bounded by the (row x depth) grid.\nCompile trail:\n"
+            f"{trail}"
+        )
+
+    def report(self) -> str:
+        lines = [
+            f"retrace sanitizer: {len(self.events)} compiles observed"
+            + (
+                f", {len(self.recompiles)} after freeze "
+                f"('{self.freeze_label}')"
+                if self.frozen_at is not None
+                else " (never frozen)"
+            )
+        ]
+        for e in self.events:
+            lines.append(e.render())
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        self.events.clear()
+        self.frozen_at = None
+        self.freeze_label = None
+
+
+_SANITIZER: Optional[RetraceSanitizer] = None
+
+
+def install_sanitizer() -> RetraceSanitizer:
+    global _SANITIZER
+    if _SANITIZER is None:
+        _SANITIZER = RetraceSanitizer()
+    _SANITIZER.install()
+    return _SANITIZER
+
+
+def uninstall_sanitizer() -> None:
+    if _SANITIZER is not None:
+        _SANITIZER.uninstall()
+
+
+def active_sanitizer() -> Optional[RetraceSanitizer]:
+    """The installed sanitizer, or None (the common, zero-cost case)."""
+    s = _SANITIZER
+    return s if s is not None and s.installed else None
+
+
+@contextmanager
+def warmup_scope(label: str):
+    """THE warmup protocol, in one place: lift any standing freeze for
+    the duration of a backend's warmup (a later backend compiling its
+    grid is legitimate, not a mid-serve recompile), then re-freeze under
+    `label` on exit EVEN IF THE WARMUP RAISES — a process that keeps
+    serving other warm cores must keep recompile detection armed, not
+    silently disarm it exactly when something went wrong. A no-op
+    (including the re-freeze) when no sanitizer is installed."""
+    san = active_sanitizer()
+    if san is not None:
+        san.thaw()
+    try:
+        yield
+    finally:
+        # looked up again: the sanitizer may have been installed or
+        # uninstalled while the warmup ran
+        san = active_sanitizer()
+        if san is not None:
+            san.freeze(label)
+
+
+def maybe_install_from_env() -> Optional[RetraceSanitizer]:
+    """`GGRS_SANITIZE=1` opts the process in; called from
+    ggrs_tpu.tpu.__init__ so every device-backend entry point is wrapped
+    before any program is built."""
+    if os.environ.get("GGRS_SANITIZE") == "1":
+        return install_sanitizer()
+    return None

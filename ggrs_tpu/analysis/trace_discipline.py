@@ -1,0 +1,550 @@
+"""Pass 2 — trace-discipline lint (TRC001..TRC004).
+
+Finds the functions that execute under a jax trace — arguments of
+`jax.jit` / `jax.vmap` / `jax.pmap` / `lax.scan` / `lax.cond` /
+`lax.while_loop` / `lax.fori_loop` / `pl.pallas_call` / `shard_map`
+call sites and decorators, resolved within the module (local defs,
+lambdas, `self._x_impl` methods), plus everything those functions define
+or call that resolves in the same module/class — and lints their bodies:
+
+  TRC001  host syncs (`.item()`, `np.asarray`, `float(x)` on array-ish
+          values): a transfer per trace at best, a ConcretizationError at
+          worst. Shape reads (`int(x.shape[0])`, `len(x)`) are static
+          under trace and stay allowed.
+  TRC002  Python `if`/`while`/ternary/assert comparing a traced argument:
+          concretizes the tracer. Bare truthiness (`if verify:`) is NOT
+          flagged — branching on pytree *structure* (an empty dict) is
+          legal, idiomatic, and trace-stable.
+  TRC003  mutating closed-over state (self attributes, nonlocal/global
+          rebinding, `.append`/`[k] = v` on free variables): runs once at
+          trace time, then silently never again on cached executions.
+  TRC004  per-call jit caches anywhere in the package: `jax.jit(...)`
+          inside a loop, or an immediately-invoked `jax.jit(f)(args)`
+          outside module scope — each call makes a fresh cache, so every
+          call retraces (the unbounded-retrace failure mode the
+          dispatch-bucket budget bounds at the megabatch layer).
+
+The resolver is intentionally module-local: cross-module trace targets
+(e.g. `jax.jit(core.tick_multi)` where `core` came from another file)
+are out of reach for a single-file AST pass; the runtime retrace
+sanitizer (analysis/sanitize.py) covers the dynamic remainder.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .engine import (
+    Repo,
+    call_name,
+    dotted_name,
+    enclosing_class,
+    enclosing_function,
+    finding,
+    in_loop,
+    parent_of,
+)
+from .findings import Finding
+
+# trace-entry callables -> indices of their function-valued arguments
+# (None = every positional argument may be a branch function, lax.cond
+# style: cond(pred, true_fn, false_fn))
+_FN_ARG0 = (0,)
+TRACE_ENTRIES: Dict[str, Tuple[Optional[Tuple[int, ...]], bool] ] = {
+    # name suffix -> (fn arg positions, has_static_kwargs)
+    "jax.jit": (_FN_ARG0, True),
+    "jit": (_FN_ARG0, True),
+    "jax.pmap": (_FN_ARG0, True),
+    "jax.vmap": (_FN_ARG0, False),
+    "vmap": (_FN_ARG0, False),
+    "jax.lax.scan": (_FN_ARG0, False),
+    "lax.scan": (_FN_ARG0, False),
+    "jax.lax.cond": (None, False),
+    "lax.cond": (None, False),
+    "jax.lax.while_loop": ((0, 1), False),
+    "lax.while_loop": ((0, 1), False),
+    "jax.lax.fori_loop": ((2,), False),
+    "lax.fori_loop": ((2,), False),
+    "jax.checkpoint": (_FN_ARG0, False),
+    "jax.remat": (_FN_ARG0, False),
+    "pl.pallas_call": (_FN_ARG0, False),
+    "pallas_call": (_FN_ARG0, False),
+    "shard_map": (_FN_ARG0, False),
+    "jax.shard_map": (_FN_ARG0, False),
+}
+
+# TRC001 host-sync call names (module-qualified where applicable)
+_SYNC_CALLS = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "np.frombuffer", "numpy.frombuffer", "np.copy", "numpy.copy",
+    "jax.device_get", "jax.block_until_ready",
+}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+
+# TRC003 mutating method names on containers
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popleft", "appendleft",
+    "clear", "update", "setdefault", "add", "discard", "write", "sort",
+    "reverse", "fill",
+}
+
+
+class _TracedFn:
+    __slots__ = ("node", "path", "static_params", "via", "pallas")
+
+    def __init__(self, node: ast.AST, path: str, via: str,
+                 pallas: bool = False):
+        self.node = node
+        self.path = path
+        self.via = via  # how it became traced (for messages)
+        # pallas kernels mutate Ref arguments by subscript store BY
+        # DESIGN (those are device writes, not trace-time Python
+        # mutation): TRC003's container checks stand down for them
+        self.pallas = pallas
+        self.static_params: Set[str] = set()
+
+
+def _params_of(fn: ast.AST, *, skip_self: bool) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+    if skip_self and names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return names
+
+
+def _static_params_from_call(
+    call: ast.Call, fn: ast.AST, *, bound_method: bool
+) -> Set[str]:
+    """Resolve static_argnums/static_argnames at a jit site into param
+    names of the target function (argnums index the call-time signature,
+    which excludes `self` for a bound `self._x` target)."""
+    names = _params_of(fn, skip_self=bound_method)
+    static: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for e in (
+                kw.value.elts if isinstance(kw.value, ast.Tuple) else [kw.value]
+            ):
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    if 0 <= e.value < len(names):
+                        static.add(names[e.value])
+        elif kw.arg == "static_argnames":
+            vals = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    static.add(e.value)
+    return static
+
+
+def _match_trace_entry(name: Optional[str]) -> Optional[Tuple[Optional[Tuple[int, ...]], bool]]:
+    if name is None:
+        return None
+    if name in TRACE_ENTRIES:
+        return TRACE_ENTRIES[name]
+    # tolerate private import aliases (`_shard_map`, `_pl.pallas_call`)
+    tail = name.split(".")[-1]
+    if tail in ("pallas_call", "shard_map"):
+        return (_FN_ARG0, False)
+    return None
+
+
+def _index_functions(tree: ast.Module):
+    """Maps for module-local resolution: (scope, name) -> def node for
+    plain functions, (class, name) -> def node for methods."""
+    by_scope: Dict[Tuple[int, str], ast.AST] = {}
+    methods: Dict[Tuple[int, str], ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls = enclosing_class(node)
+            if cls is not None and parent_of(node) is cls:
+                methods[(id(cls), node.name)] = node
+            owner = enclosing_function(node)
+            by_scope[(id(owner) if owner else 0, node.name)] = node
+    return by_scope, methods
+
+
+def _resolve_fn_ref(
+    ref: ast.AST, site: ast.AST, by_scope, methods
+) -> Optional[Tuple[ast.AST, bool]]:
+    """Resolve a function-valued expression at a trace-entry site to a
+    local def. Returns (fn node, is_bound_method)."""
+    if isinstance(ref, ast.Lambda):
+        return ref, False
+    if isinstance(ref, ast.Name):
+        scope: Optional[ast.AST] = enclosing_function(site)
+        while True:
+            fn = by_scope.get((id(scope) if scope else 0, ref.id))
+            if fn is not None:
+                return fn, False
+            if scope is None:
+                return None
+            scope = enclosing_function(scope)
+    if isinstance(ref, ast.Attribute) and isinstance(ref.value, ast.Name):
+        if ref.value.id in ("self", "cls"):
+            cls = enclosing_class(site)
+            if cls is not None:
+                fn = methods.get((id(cls), ref.attr))
+                if fn is not None:
+                    return fn, True
+    return None
+
+
+def find_traced_functions(tree: ast.Module, path: str) -> Dict[int, _TracedFn]:
+    by_scope, methods = _index_functions(tree)
+    traced: Dict[int, _TracedFn] = {}
+
+    def mark(fn: ast.AST, via: str, static: Set[str]) -> None:
+        entry = traced.get(id(fn))
+        if entry is None:
+            entry = _TracedFn(
+                fn, path, via, pallas="pallas_call" in via
+            )
+            traced[id(fn)] = entry
+        entry.static_params |= static
+
+    # 1. explicit trace-entry call sites
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            spec = _match_trace_entry(call_name(node))
+            if spec is None:
+                continue
+            positions, has_static = spec
+            refs = (
+                list(enumerate(node.args))
+                if positions is None
+                else [(i, node.args[i]) for i in positions if i < len(node.args)]
+            )
+            for _, ref in refs:
+                hit = _resolve_fn_ref(ref, node, by_scope, methods)
+                if hit is None:
+                    continue
+                fn, bound = hit
+                static = (
+                    _static_params_from_call(node, fn, bound_method=bound)
+                    if has_static
+                    else set()
+                )
+                mark(fn, call_name(node) or "trace", static)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dec_call = dec if isinstance(dec, ast.Call) else None
+                name = call_name(dec_call) if dec_call else (
+                    ast.unparse(dec) if not isinstance(dec, ast.Call) else None
+                )
+                # @jax.jit / @jit / @partial(jax.jit, static_argnums=...)
+                if name in ("functools.partial", "partial") and dec_call:
+                    if dec_call.args:
+                        inner_name = dotted_name(dec_call.args[0])
+                        if inner_name and _match_trace_entry(inner_name):
+                            static = _static_params_from_call(
+                                dec_call, node, bound_method=False
+                            )
+                            mark(node, inner_name, static)
+                elif name and _match_trace_entry(name):
+                    static = (
+                        _static_params_from_call(dec_call, node,
+                                                 bound_method=False)
+                        if dec_call
+                        else set()
+                    )
+                    mark(node, name, static)
+
+    # 2. propagate: nested defs inside traced fns + locally-resolvable
+    # callees of traced fns (fixpoint within the module)
+    changed = True
+    while changed:
+        changed = False
+        for entry in list(traced.values()):
+            for node in ast.walk(entry.node):
+                target: Optional[Tuple[ast.AST, bool]] = None
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if id(node) not in traced:
+                        owner = enclosing_function(node)
+                        if owner is entry.node or (
+                            owner is not None and id(owner) in traced
+                        ):
+                            target = (node, False)
+                elif isinstance(node, ast.Call):
+                    target = _resolve_fn_ref(node.func, node, by_scope, methods)
+                if target is not None and id(target[0]) not in traced:
+                    traced[id(target[0])] = _TracedFn(
+                        target[0], path, f"called from {entry.via}",
+                        pallas=entry.pallas,
+                    )
+                    changed = True
+
+    # a function lexically nested inside a pallas kernel IS kernel code,
+    # even when it was discovered through its own lax.scan/cond site
+    # (the scan body mutating Ref cells is still a device write)
+    for entry in traced.values():
+        owner = enclosing_function(entry.node)
+        while owner is not None and not entry.pallas:
+            parent_entry = traced.get(id(owner))
+            if parent_entry is not None and parent_entry.pallas:
+                entry.pallas = True
+            owner = enclosing_function(owner)
+    return traced
+
+
+def _walk_within(fn: ast.AST):
+    """Walk a function body without descending into nested function
+    definitions (they are linted as their own traced entries)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_shape_read(node: ast.AST) -> bool:
+    """`x.shape[0]`, `x.ndim`, `x.size`, `len(x)`, literals and pure
+    arithmetic over them are static under trace."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in (
+        "shape", "ndim", "size", "dtype", "itemsize",
+    ):
+        return True
+    if isinstance(node, ast.Subscript):
+        return _is_shape_read(node.value)
+    if isinstance(node, ast.Call) and call_name(node) == "len":
+        return True
+    if isinstance(node, ast.BinOp):
+        return _is_shape_read(node.left) and _is_shape_read(node.right)
+    if isinstance(node, ast.Attribute):
+        return _is_shape_read(node.value)
+    return False
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    names: Set[str] = set(_params_of(fn, skip_self=False))
+    args = fn.args
+    names.update(a.arg for a in args.kwonlyargs)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in _walk_within(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            names.difference_update(node.names)
+        elif isinstance(node, ast.comprehension):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def _lint_traced_fn(entry: _TracedFn, out: List[Finding]) -> None:
+    fn, path = entry.node, entry.path
+    params = set(_params_of(fn, skip_self=True)) - entry.static_params
+    local = _local_names(fn)
+
+    for node in _walk_within(fn):
+        # TRC001 — host syncs
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+            ):
+                out.append(finding(
+                    "TRC001", path, node,
+                    ".item() inside a traced function forces a device->host "
+                    "sync (or fails on a tracer); keep values on device",
+                ))
+            elif name in _SYNC_CALLS:
+                out.append(finding(
+                    "TRC001", path, node,
+                    f"{name}() inside a traced function materializes a host "
+                    "array per trace; use jnp ops on the tracer instead",
+                ))
+            elif (
+                name in _CAST_BUILTINS
+                and node.args
+                and not _is_shape_read(node.args[0])
+                # casting a closed-over global (an enum member, a module
+                # constant) is concrete at trace time; tracers only flow
+                # in through the function's own params/locals
+                and any(
+                    isinstance(n, ast.Name) and n.id in local
+                    for n in ast.walk(node.args[0])
+                )
+            ):
+                out.append(finding(
+                    "TRC001", path, node,
+                    f"{name}() on a potentially traced value concretizes the "
+                    "tracer (shape/len reads are fine; data reads are not)",
+                ))
+        # TRC002 — Python branching on traced args
+        test = None
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            test = node.test
+        elif isinstance(node, ast.Assert):
+            test = node.test
+        if test is not None:
+            hit = _traced_compare(test, params)
+            if hit is not None:
+                out.append(finding(
+                    "TRC002", path, node,
+                    f"Python branch compares traced argument '{hit}'; "
+                    "this concretizes the tracer — use lax.cond/jnp.where "
+                    "(or mark the argument static)",
+                ))
+        # TRC003 — closed-over mutation
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            out.append(finding(
+                "TRC003", path, node,
+                f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                "rebinding inside a traced function happens at trace time "
+                "only; cached executions never rerun it",
+            ))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in _flatten_targets(targets):
+                base = t.value if isinstance(t, (ast.Attribute, ast.Subscript)) else None
+                if isinstance(t, ast.Attribute) and isinstance(base, ast.Name) and base.id == "self":
+                    out.append(finding(
+                        "TRC003", path, t,
+                        f"assignment to self.{t.attr} inside a traced "
+                        "function mutates closed-over state at trace time "
+                        "only; return the value instead",
+                    ))
+                elif isinstance(t, ast.Subscript) and not entry.pallas:
+                    # Ref stores are device writes, hence the pallas gate
+                    if isinstance(base, ast.Name) and base.id not in local:
+                        out.append(finding(
+                            "TRC003", path, t,
+                            f"subscript store into closed-over '{base.id}' "
+                            "inside a traced function runs at trace time "
+                            "only",
+                        ))
+                    elif _self_attr_root(base) is not None:
+                        out.append(finding(
+                            "TRC003", path, t,
+                            f"subscript store into self."
+                            f"{_self_attr_root(base)} inside a traced "
+                            "function mutates closed-over state at trace "
+                            "time only; use .at[...].set and return it",
+                        ))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _MUTATORS
+                and isinstance(f.value, ast.Name)
+                and f.value.id not in local
+                and f.value.id != "self"
+                and not entry.pallas  # Ref mutation is the kernel idiom
+            ):
+                out.append(finding(
+                    "TRC003", path, node,
+                    f"{f.value.id}.{f.attr}() mutates closed-over state "
+                    "inside a traced function (trace-time only)",
+                ))
+
+
+def _flatten_targets(targets: List[ast.AST]) -> List[ast.AST]:
+    """Expand tuple/list/starred assignment targets so
+    `self.a, x = ...` is seen as a write to self.a."""
+    out: List[ast.AST] = []
+    stack = list(targets)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            stack.append(t.value)
+        else:
+            out.append(t)
+    return out
+
+
+def _self_attr_root(node: Optional[ast.AST]) -> Optional[str]:
+    """`self.buf` / `self.a.b` -> the first attribute name, else None."""
+    attr = None
+    while isinstance(node, ast.Attribute):
+        attr = node.attr
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self":
+        return attr
+    return None
+
+
+def _traced_compare(test: ast.AST, params: Set[str]) -> Optional[str]:
+    """A param name used inside a comparison/arithmetic test (bare
+    truthiness and shape reads excluded)."""
+    for node in ast.walk(test):
+        if isinstance(node, (ast.Compare, ast.BinOp, ast.UnaryOp)):
+            operands: List[ast.AST] = []
+            if isinstance(node, ast.Compare):
+                # `x is None` / `x is not None` sentinel checks are
+                # structural: a tracer is never None, so the branch is
+                # decided by the (static) Python default, not the value
+                if all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+                ):
+                    continue
+                operands = [node.left, *node.comparators]
+            elif isinstance(node, ast.BinOp):
+                operands = [node.left, node.right]
+            else:
+                operands = [node.operand]
+            for op in operands:
+                if isinstance(op, ast.Name) and op.id in params:
+                    return op.id
+    return None
+
+
+def _lint_trc004(tree: ast.Module, path: str, out: List[Finding]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name not in ("jax.jit", "jit", "jax.pmap"):
+            continue
+        owner = enclosing_function(node)
+        if in_loop(node, within=owner):
+            out.append(finding(
+                "TRC004", path, node,
+                f"{name}(...) inside a loop builds a fresh compile cache "
+                "per iteration — hoist it (or memoize keyed by the static "
+                "configuration)",
+            ))
+            continue
+        p = parent_of(node)
+        if (
+            isinstance(p, ast.Call)
+            and p.func is node
+            and owner is not None
+        ):
+            out.append(finding(
+                "TRC004", path, node,
+                f"immediately-invoked {name}(f)(...) discards its compile "
+                "cache after the call — every call retraces; bind the "
+                "jitted function once",
+            ))
+
+
+def run(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    for path in repo.python_files():
+        tree = repo.tree(path)
+        traced = find_traced_functions(tree, path)
+        for entry in traced.values():
+            _lint_traced_fn(entry, out)
+        _lint_trc004(tree, path, out)
+    return out

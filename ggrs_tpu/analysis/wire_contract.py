@@ -1,0 +1,559 @@
+"""Pass 4 — wire-contract check (WIRE001..WIRE004).
+
+The Python and C++ stacks speak one flat little-endian wire format and
+share one ABI, but nothing ties the two sources together: a message code
+renumbered in `network/messages.py`, a field added to a ctypes struct
+without touching `native/ggrs_native.h`, or a buffer grown on one side
+only, all compile clean and then corrupt bytes (or truncate datagrams)
+at the first cross-stack packet. This pass extracts both sides —
+struct formats and constants from the Python ASTs, `constexpr`/`#define`
+constants and struct layouts from the C++ sources by regex — and
+cross-checks them:
+
+  WIRE001  MSG_* message type codes: messages.py <-> native/endpoint.cpp
+  WIRE002  ctypes struct layouts (field order, C type, array lengths):
+           native/endpoint.py + native/session.py <-> native/ggrs_native.h
+  WIRE003  datagram bounds: RECV_BUFFER_SIZE is single-sourced, the
+           native bindings' wire/send buffer caps alias it, the codec's
+           input-payload cap + worst-case overhead exactly fills
+           MAX_DATAGRAM_SIZE, and MAX_DATAGRAM_SIZE <= 65507 (UDP's own
+           payload ceiling)
+  WIRE004  shared protocol constants (MAX_PAYLOAD, checksum history,
+           queue lengths, handle/input caps, NULL_FRAME): Python <-> C++
+
+`extract(repo)` returns everything the checks saw — the wire-contract
+test suite (tests/test_wire_contract.py) asserts the *runtime* encoders
+against the same extraction, closing the loop from source text to bytes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import struct as _struct
+from typing import Dict, List, Optional, Tuple
+
+from .engine import Repo
+from .findings import Finding
+
+MESSAGES_PY = "ggrs_tpu/network/messages.py"
+SOCKETS_PY = "ggrs_tpu/network/sockets.py"
+NATIVE_SOCKETS_PY = "ggrs_tpu/native/sockets.py"
+NATIVE_ENDPOINT_PY = "ggrs_tpu/native/endpoint.py"
+NATIVE_SESSION_PY = "ggrs_tpu/native/session.py"
+PROTOCOL_PY = "ggrs_tpu/network/protocol.py"
+BUILDER_PY = "ggrs_tpu/sessions/builder.py"
+INPUT_QUEUE_PY = "ggrs_tpu/input_queue.py"
+TYPES_PY = "ggrs_tpu/types.py"
+ENDPOINT_CPP = "native/endpoint.cpp"
+SESSION_CPP = "native/session.cpp"
+INPUT_QUEUE_CPP = "native/input_queue.cpp"
+NATIVE_H = "native/ggrs_native.h"
+
+# UDP's own payload ceiling (65535 - 8 UDP header - 20 IP header): the
+# one number neither stack may exceed
+UDP_MAX_PAYLOAD = 65507
+
+_CTYPE_TO_C = {
+    "c_int32": "int32_t",
+    "c_uint8": "uint8_t",
+    "c_uint16": "uint16_t",
+    "c_uint32": "uint32_t",
+    "c_int64": "int64_t",
+    "c_uint64": "uint64_t",
+    "c_long": "long",
+    "c_int": "int",
+}
+
+# ctypes struct -> native header struct
+_STRUCT_MAP = {
+    (NATIVE_ENDPOINT_PY, "_Config"): "ggrs_ep_config",
+    (NATIVE_ENDPOINT_PY, "_Event"): "ggrs_ep_event",
+    (NATIVE_ENDPOINT_PY, "_Stats"): "ggrs_ep_stats",
+    (NATIVE_SESSION_PY, "_SessConfig"): "ggrs_sess_config",
+    (NATIVE_SESSION_PY, "_SessReq"): "ggrs_sess_req",
+    (NATIVE_SESSION_PY, "_SessEvent"): "ggrs_sess_event",
+    (NATIVE_SESSION_PY, "_Stats"): "ggrs_ep_stats",
+}
+
+# (python file, python constant) <-> (c++ file, c++ constant) parity table
+_CONST_PARITY = [
+    (PROTOCOL_PY, "MAX_PAYLOAD", ENDPOINT_CPP, "MAX_PAYLOAD"),
+    (PROTOCOL_PY, "MAX_CHECKSUM_HISTORY_SIZE", ENDPOINT_CPP,
+     "MAX_CHECKSUM_HISTORY_SIZE"),
+    (PROTOCOL_PY, "MAX_CHECKSUM_HISTORY_SIZE", SESSION_CPP,
+     "MAX_CHECKSUM_HISTORY"),
+    (BUILDER_PY, "MAX_EVENT_QUEUE_SIZE", SESSION_CPP, "MAX_EVENT_QUEUE"),
+    (BUILDER_PY, "SPECTATOR_BUFFER_SIZE", SESSION_CPP, "SPECTATOR_BUFFER"),
+    (INPUT_QUEUE_PY, "INPUT_QUEUE_LENGTH", INPUT_QUEUE_CPP, "QUEUE_LEN"),
+    (TYPES_PY, "NULL_FRAME", INPUT_QUEUE_CPP, "NULL_FRAME"),
+    (NATIVE_ENDPOINT_PY, "_MAX_HANDLES", ENDPOINT_CPP, "MAX_HANDLES"),
+    (NATIVE_ENDPOINT_PY, "_MAX_INPUT", ENDPOINT_CPP, "MAX_INPUT_SIZE"),
+    (NATIVE_SESSION_PY, "_MAX_PLAYERS", SESSION_CPP, "MAX_PLAYERS"),
+    (NATIVE_SESSION_PY, "_MAX_TOTAL_HANDLES", SESSION_CPP,
+     "MAX_TOTAL_HANDLES"),
+    (NATIVE_SESSION_PY, "_MAX_INPUT", SESSION_CPP, "MAX_INPUT_SIZE"),
+]
+
+
+def _file_finding(rule: str, path: str, line: int, message: str) -> Finding:
+    return Finding(rule=rule, path=path, line=line, symbol="<module>",
+                   message=message)
+
+
+# ---------------------------------------------------------------------------
+# extraction: Python side
+# ---------------------------------------------------------------------------
+
+def _safe_int(expr: str) -> Optional[int]:
+    if re.fullmatch(r"[\d\s+*()x-]+", expr) and not expr.strip().startswith("-"):
+        try:
+            return int(eval(expr, {"__builtins__": {}}))  # noqa: S307
+        except Exception:
+            return None
+    try:
+        return int(expr, 0)
+    except ValueError:
+        return None
+
+
+def _py_constants(
+    repo: Repo, path: str,
+    attr_values: Optional[Dict[Tuple[str, str], int]] = None,
+) -> Dict[str, Tuple[int, int]]:
+    """Module-level `NAME = <int literal / simple arithmetic>` constants
+    -> {name: (value, lineno)}. Folds Name references to already-seen
+    constants so `MAX = min(RECV, 65507)` style definitions resolve;
+    `attr_values` supplies known attribute reads like ("_HEADER", "size")
+    so size arithmetic over struct formats resolves too."""
+    out: Dict[str, Tuple[int, int]] = {}
+    if not repo.exists(path):
+        return out
+    tree = repo.tree(path)
+
+    # fold `from ..network.sockets import RECV_BUFFER_SIZE`-style imports
+    # of the canonical transport bounds, so aliases of the shared
+    # constant resolve to its value (that aliasing IS the contract)
+    if path != SOCKETS_PY:
+        for node in tree.body:
+            if (
+                isinstance(node, ast.ImportFrom)
+                and node.module
+                and node.module.endswith("sockets")
+            ):
+                canonical = _py_constants(repo, SOCKETS_PY)
+                for alias in node.names:
+                    if alias.name in canonical:
+                        out[alias.asname or alias.name] = (
+                            canonical[alias.name][0], node.lineno
+                        )
+
+    def resolve(node: ast.AST) -> Optional[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return node.value
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            v = resolve(node.operand)
+            return -v if v is not None else None
+        if isinstance(node, ast.Name) and node.id in out:
+            return out[node.id][0]
+        if (
+            attr_values
+            and isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and (node.value.id, node.attr) in attr_values
+        ):
+            return attr_values[(node.value.id, node.attr)]
+        if isinstance(node, ast.BinOp):
+            left, right = resolve(node.left), resolve(node.right)
+            if left is None or right is None:
+                return None
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv) and right:
+                return left // right
+            return None
+        if isinstance(node, ast.Call):
+            name = node.func.id if isinstance(node.func, ast.Name) else None
+            if name in ("min", "max"):
+                vals = [resolve(a) for a in node.args]
+                if all(v is not None for v in vals) and vals:
+                    return (min if name == "min" else max)(vals)  # type: ignore[arg-type]
+        return None
+
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for t in targets:
+            if isinstance(t, ast.Name):
+                v = resolve(value)
+                if v is not None:
+                    out[t.id] = (v, node.lineno)
+    return out
+
+
+def _messages_constants(repo: Repo) -> Dict[str, Tuple[int, int]]:
+    """messages.py constants with `<fmt>.size` arithmetic resolved."""
+    attr_values = {
+        (name, "size"): _struct.calcsize(fmt)
+        for name, (fmt, _) in _py_struct_formats(repo).items()
+    }
+    return _py_constants(repo, MESSAGES_PY, attr_values)
+
+
+def _py_struct_formats(repo: Repo) -> Dict[str, Tuple[str, int]]:
+    """`NAME = struct.Struct("<fmt>")` assignments in messages.py."""
+    out: Dict[str, Tuple[str, int]] = {}
+    if not repo.exists(MESSAGES_PY):
+        return out
+    for node in repo.tree(MESSAGES_PY).body:
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        fn = call.func
+        if (
+            isinstance(fn, ast.Attribute) and fn.attr == "Struct"
+            and call.args and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = (call.args[0].value, node.lineno)
+    return out
+
+
+def _py_ctypes_structs(repo: Repo, path: str, consts: Dict[str, Tuple[int, int]]):
+    """{class name: (lineno, [(field, ctype, array_len or None)])}"""
+    out: Dict[str, Tuple[int, List[Tuple[str, str, Optional[int]]]]] = {}
+    if not repo.exists(path):
+        return out
+
+    def resolve_len(node: ast.AST) -> Optional[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return node.value
+        if isinstance(node, ast.Name) and node.id in consts:
+            return consts[node.id][0]
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            left, right = resolve_len(node.left), resolve_len(node.right)
+            if left is not None and right is not None:
+                return left * right
+        return None
+
+    for node in ast.walk(repo.tree(path)):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if not (
+                isinstance(stmt, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "_fields_"
+                    for t in stmt.targets
+                )
+                and isinstance(stmt.value, ast.List)
+            ):
+                continue
+            fields: List[Tuple[str, str, Optional[int]]] = []
+            for elt in stmt.value.elts:
+                if not (isinstance(elt, ast.Tuple) and len(elt.elts) == 2):
+                    continue
+                fname = (
+                    elt.elts[0].value
+                    if isinstance(elt.elts[0], ast.Constant)
+                    else "?"
+                )
+                ctype_node = elt.elts[1]
+                arr_len: Optional[int] = None
+                if isinstance(ctype_node, ast.BinOp) and isinstance(
+                    ctype_node.op, ast.Mult
+                ):
+                    arr_len = resolve_len(ctype_node.right)
+                    ctype_node = ctype_node.left
+                ctype = (
+                    ctype_node.attr
+                    if isinstance(ctype_node, ast.Attribute)
+                    else (
+                        ctype_node.id
+                        if isinstance(ctype_node, ast.Name)
+                        else "?"
+                    )
+                )
+                fields.append((str(fname), ctype, arr_len))
+            out[node.name] = (node.lineno, fields)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# extraction: C++ side
+# ---------------------------------------------------------------------------
+
+_CPP_CONST_RE = re.compile(
+    r"^\s*(?:constexpr\s+)?(?:static\s+)?"
+    r"(?:uint8_t|uint16_t|uint32_t|uint64_t|int32_t|int64_t|int|long|size_t)"
+    r"\s+([A-Za-z_][A-Za-z0-9_]*)\s*=\s*([0-9xX+*()\s-]+?)\s*;",
+    re.MULTILINE,
+)
+_CPP_DEFINE_RE = re.compile(
+    r"^\s*#define\s+([A-Za-z_][A-Za-z0-9_]*)\s+\(?(-?\d+)\)?\s*$",
+    re.MULTILINE,
+)
+
+
+def _cpp_constants(repo: Repo, path: str) -> Dict[str, Tuple[int, int]]:
+    out: Dict[str, Tuple[int, int]] = {}
+    if not repo.exists(path):
+        return out
+    text = repo.read(path)
+    for m in _CPP_CONST_RE.finditer(text):
+        v = _safe_int(m.group(2))
+        if v is not None:
+            out[m.group(1)] = (v, text[: m.start()].count("\n") + 1)
+    for m in _CPP_DEFINE_RE.finditer(text):
+        out[m.group(1)] = (
+            int(m.group(2)), text[: m.start()].count("\n") + 1
+        )
+    return out
+
+
+_H_STRUCT_RE = re.compile(
+    r"struct\s+([A-Za-z_][A-Za-z0-9_]*)\s*\{(.*?)\};", re.DOTALL
+)
+_H_FIELD_RE = re.compile(
+    r"^\s*(uint8_t|uint16_t|uint32_t|uint64_t|int8_t|int16_t|int32_t|"
+    r"int64_t|int|long)\s+([A-Za-z_][A-Za-z0-9_]*)\s*"
+    r"(?:\[([0-9*\s]+)\])?\s*;",
+    re.MULTILINE,
+)
+
+
+def _h_structs(repo: Repo):
+    """{struct name: (lineno, [(field, c type, array_len or None)])}"""
+    out: Dict[str, Tuple[int, List[Tuple[str, str, Optional[int]]]]] = {}
+    if not repo.exists(NATIVE_H):
+        return out
+    text = repo.read(NATIVE_H)
+    for m in _H_STRUCT_RE.finditer(text):
+        name, body = m.group(1), m.group(2)
+        line = text[: m.start()].count("\n") + 1
+        fields: List[Tuple[str, str, Optional[int]]] = []
+        for fm in _H_FIELD_RE.finditer(body):
+            arr = _safe_int(fm.group(3)) if fm.group(3) else None
+            fields.append((fm.group(2), fm.group(1), arr))
+        out[name] = (line, fields)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the checks
+# ---------------------------------------------------------------------------
+
+def extract(repo: Optional[Repo] = None) -> dict:
+    """Everything the pass compares, for tests and tooling."""
+    repo = repo or Repo.from_here()
+    formats = _py_struct_formats(repo)
+    msg_consts = _messages_constants(repo)
+    sock_consts = _py_constants(repo, SOCKETS_PY)
+    ep_py_consts = _py_constants(repo, NATIVE_ENDPOINT_PY)
+    sess_py_consts = _py_constants(repo, NATIVE_SESSION_PY)
+    return {
+        "struct_formats": {k: v[0] for k, v in formats.items()},
+        "struct_sizes": {
+            k: _struct.calcsize(v[0]) for k, v in formats.items()
+        },
+        "py_msg_codes": {
+            k: v[0] for k, v in msg_consts.items() if k.startswith("MSG_")
+        },
+        "cpp_msg_codes": {
+            k: v[0]
+            for k, v in _cpp_constants(repo, ENDPOINT_CPP).items()
+            if k.startswith("MSG_")
+        },
+        "recv_buffer_size": sock_consts.get("RECV_BUFFER_SIZE", (None, 0))[0],
+        "max_datagram_size": sock_consts.get("MAX_DATAGRAM_SIZE", (None, 0))[0],
+        "max_input_payload": msg_consts.get("MAX_INPUT_PAYLOAD", (None, 0))[0],
+        "input_overhead": msg_consts.get("INPUT_MSG_OVERHEAD", (None, 0))[0],
+        "native_send_buf_cap": ep_py_consts.get("_SEND_BUF_CAP", (None, 0))[0],
+        "native_wire_buf_cap": sess_py_consts.get("_WIRE_BUF_CAP", (None, 0))[0],
+        "h_structs": {
+            k: [(f, t, n) for f, t, n in v[1]]
+            for k, v in _h_structs(repo).items()
+        },
+        "udp_max_payload": UDP_MAX_PAYLOAD,
+    }
+
+
+def _check_msg_codes(repo: Repo, out: List[Finding]) -> None:
+    py = {
+        k: v for k, v in _messages_constants(repo).items()
+        if k.startswith("MSG_")
+    }
+    cpp = {
+        k: v for k, v in _cpp_constants(repo, ENDPOINT_CPP).items()
+        if k.startswith("MSG_")
+    }
+    if not py or not cpp:
+        return
+    for name, (val, line) in sorted(py.items()):
+        if name not in cpp:
+            out.append(_file_finding(
+                "WIRE001", MESSAGES_PY, line,
+                f"{name}={val} has no native counterpart in {ENDPOINT_CPP}",
+            ))
+        elif cpp[name][0] != val:
+            out.append(_file_finding(
+                "WIRE001", MESSAGES_PY, line,
+                f"{name}={val} but {ENDPOINT_CPP}:{cpp[name][1]} says "
+                f"{cpp[name][0]} — the stacks would misparse each other's "
+                "packets",
+            ))
+    for name, (val, line) in sorted(cpp.items()):
+        if name not in py:
+            out.append(_file_finding(
+                "WIRE001", ENDPOINT_CPP, line,
+                f"{name}={val} has no Python counterpart in {MESSAGES_PY}",
+            ))
+
+
+def _check_ctypes_structs(repo: Repo, out: List[Finding]) -> None:
+    h = _h_structs(repo)
+    if not h:
+        return
+    for (path, cls), h_name in sorted(_STRUCT_MAP.items()):
+        consts = _py_constants(repo, path)
+        structs = _py_ctypes_structs(repo, path, consts)
+        if cls not in structs:
+            continue
+        line, py_fields = structs[cls]
+        if h_name not in h:
+            out.append(_file_finding(
+                "WIRE002", path, line,
+                f"{cls} maps to struct {h_name}, absent from {NATIVE_H}",
+            ))
+            continue
+        h_line, h_fields = h[h_name]
+        if [f for f, _, _ in py_fields] != [f for f, _, _ in h_fields]:
+            out.append(_file_finding(
+                "WIRE002", path, line,
+                f"{cls} field names/order {[f for f, _, _ in py_fields]} != "
+                f"{h_name} ({NATIVE_H}:{h_line}) "
+                f"{[f for f, _, _ in h_fields]}",
+            ))
+            continue
+        for (fname, ctype, alen), (_, htype, hlen) in zip(py_fields, h_fields):
+            want = _CTYPE_TO_C.get(ctype)
+            if want != htype:
+                out.append(_file_finding(
+                    "WIRE002", path, line,
+                    f"{cls}.{fname} is ctypes.{ctype} but {h_name}.{fname} "
+                    f"is {htype} — ABI size/sign drift",
+                ))
+            if alen != hlen:
+                out.append(_file_finding(
+                    "WIRE002", path, line,
+                    f"{cls}.{fname} array length {alen} != {h_name}.{fname} "
+                    f"[{hlen}]",
+                ))
+
+
+def _check_datagram_bounds(repo: Repo, out: List[Finding]) -> None:
+    sock = _py_constants(repo, SOCKETS_PY)
+    recv = sock.get("RECV_BUFFER_SIZE")
+    max_dg = sock.get("MAX_DATAGRAM_SIZE")
+    if recv is None or max_dg is None:
+        return
+    if max_dg[0] > UDP_MAX_PAYLOAD:
+        out.append(_file_finding(
+            "WIRE003", SOCKETS_PY, max_dg[1],
+            f"MAX_DATAGRAM_SIZE={max_dg[0]} exceeds UDP's payload ceiling "
+            f"({UDP_MAX_PAYLOAD}); sendto() would fail with EMSGSIZE",
+        ))
+    if max_dg[0] > recv[0]:
+        out.append(_file_finding(
+            "WIRE003", SOCKETS_PY, max_dg[1],
+            f"MAX_DATAGRAM_SIZE={max_dg[0]} exceeds RECV_BUFFER_SIZE="
+            f"{recv[0]}: an accepted datagram would truncate at recvfrom()",
+        ))
+    # native bindings must alias, not redefine, the shared receive bound
+    for path, const in (
+        (NATIVE_ENDPOINT_PY, "_SEND_BUF_CAP"),
+        (NATIVE_SESSION_PY, "_WIRE_BUF_CAP"),
+    ):
+        consts = _py_constants(repo, path)
+        cap = consts.get(const)
+        if cap is not None and cap[0] < recv[0]:
+            out.append(_file_finding(
+                "WIRE003", path, cap[1],
+                f"{const}={cap[0]} is below RECV_BUFFER_SIZE={recv[0]}: a "
+                "legal datagram queued by the native core would truncate "
+                "at the drain buffer — alias the shared constant",
+            ))
+    if repo.exists(NATIVE_SOCKETS_PY):
+        ns = _py_constants(repo, NATIVE_SOCKETS_PY)
+        if "RECV_BUFFER_SIZE" in ns and ns["RECV_BUFFER_SIZE"][0] != recv[0]:
+            out.append(_file_finding(
+                "WIRE003", NATIVE_SOCKETS_PY, ns["RECV_BUFFER_SIZE"][1],
+                f"RECV_BUFFER_SIZE redefined as {ns['RECV_BUFFER_SIZE'][0]} "
+                f"(canonical: {recv[0]} in {SOCKETS_PY}) — import it instead",
+            ))
+    # the codec's input-payload cap must exactly fill the datagram bound:
+    # smaller wastes wire budget silently, larger encodes messages every
+    # send path then rejects
+    if not repo.exists(MESSAGES_PY):
+        return
+    msg = _messages_constants(repo)
+    formats = _py_struct_formats(repo)
+    cap = msg.get("MAX_INPUT_PAYLOAD")
+    needed = {"_HEADER", "_INPUT_HEAD", "_STATUS"}
+    if cap is None:
+        # the named cap is itself part of the contract
+        out.append(_file_finding(
+            "WIRE003", MESSAGES_PY, 1,
+            "messages.py does not define MAX_INPUT_PAYLOAD: the InputMsg "
+            "payload bound must be named and derived from the datagram "
+            "bound, not an inline magic number",
+        ))
+    elif needed <= set(formats):
+        handles = _cpp_constants(repo, ENDPOINT_CPP).get("MAX_HANDLES", (16, 0))[0]
+        overhead = (
+            _struct.calcsize(formats["_HEADER"][0])
+            + _struct.calcsize(formats["_INPUT_HEAD"][0])
+            + handles * _struct.calcsize(formats["_STATUS"][0])
+            + 2  # the u16 payload length prefix
+        )
+        if cap[0] + overhead != max_dg[0]:
+            out.append(_file_finding(
+                "WIRE003", MESSAGES_PY, cap[1],
+                f"MAX_INPUT_PAYLOAD={cap[0]} + worst-case InputMsg "
+                f"overhead ({overhead}) != MAX_DATAGRAM_SIZE={max_dg[0]} — "
+                "the codec and the transport disagree on the largest legal "
+                "input batch",
+            ))
+
+
+def _check_const_parity(repo: Repo, out: List[Finding]) -> None:
+    for py_path, py_name, cpp_path, cpp_name in _CONST_PARITY:
+        py = _py_constants(repo, py_path).get(py_name)
+        cpp = _cpp_constants(repo, cpp_path).get(cpp_name)
+        if py is None or cpp is None:
+            continue
+        if py[0] != cpp[0]:
+            out.append(_file_finding(
+                "WIRE004", py_path, py[1],
+                f"{py_name}={py[0]} but {cpp_path}:{cpp[1]} pins "
+                f"{cpp_name}={cpp[0]} — cross-stack behavior diverges",
+            ))
+
+
+def run(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    _check_msg_codes(repo, out)
+    _check_ctypes_structs(repo, out)
+    _check_datagram_bounds(repo, out)
+    _check_const_parity(repo, out)
+    return out

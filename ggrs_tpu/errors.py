@@ -59,3 +59,12 @@ class HostFull(GGRSError):
     def __init__(self, info: str):
         super().__init__(info)
         self.info = info
+
+
+class RetraceBudgetExceeded(GGRSError):
+    """The retrace sanitizer observed more compiled programs than the
+    dispatch-bucket budget allows: a jit cache meant to be bounded by the
+    (row bucket x depth bucket) grid is growing mid-serve, which means a
+    dispatch signature escaped canonicalization (every compile carries
+    stack provenance in the message). Raised only with GGRS_SANITIZE=1 /
+    an installed sanitizer — production paths never pay the check."""

@@ -21,6 +21,7 @@ from ..errors import InvalidRequest, NotSynchronized
 from ..frame_info import PlayerInput
 from ..network.messages import Message, encode_message
 from ..network.network_stats import NetworkStats
+from ..network.sockets import RECV_BUFFER_SIZE
 from ..network.protocol import (
     EvDisconnected,
     EvInput,
@@ -37,7 +38,11 @@ from . import load
 
 _MAX_HANDLES = 16
 _MAX_INPUT = 64
-_SEND_BUF_CAP = 4096
+# drain-buffer cap for ggrs_ep_next_send: aliases the transport's shared
+# receive bound so a datagram the native core may legally queue can never
+# truncate at the binding (the wire-contract lint pins the relation; the
+# old standalone 4096 predated RECV_BUFFER_SIZE's growth to 64 KiB)
+_SEND_BUF_CAP = RECV_BUFFER_SIZE
 
 
 class _Config(ctypes.Structure):

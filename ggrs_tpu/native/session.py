@@ -38,6 +38,7 @@ from ..errors import (
 )
 from ..network.messages import decode_message, encode_message
 from ..network.network_stats import NetworkStats
+from ..network.sockets import RECV_BUFFER_SIZE
 from ..sessions.sync_test_session import DeferredChecks
 from ..sync_layer import GameStateCell, PendingChecksumReport, SavedStates
 from ..types import (
@@ -68,7 +69,10 @@ from . import load
 _MAX_PLAYERS = 16
 _MAX_TOTAL_HANDLES = 32
 _MAX_INPUT = 64
-_WIRE_BUF_CAP = 4096
+# drain-buffer cap for ggrs_sess_drain_wire: aliases the transport's
+# shared receive bound (see native/endpoint.py _SEND_BUF_CAP — same
+# truncation hazard, same wire-contract lint pin)
+_WIRE_BUF_CAP = RECV_BUFFER_SIZE
 _U128_MASK = (1 << 128) - 1
 _INT32_MIN = -(1 << 31)
 

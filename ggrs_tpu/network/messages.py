@@ -37,6 +37,20 @@ _STATUS = struct.Struct("<Bi")
 _QUALITY_REPORT = struct.Struct("<bQ")
 _CHECKSUM_REPORT = struct.Struct("<i16s")
 
+# The largest compressed-input payload an InputMsg may carry, derived so
+# the WORST-CASE encoded message (16 connect statuses — the native stack's
+# MAX_HANDLES) exactly fits the transport's MAX_DATAGRAM_SIZE (65507, UDP's
+# own payload ceiling; network/sockets.py). The old inline cap (0xFFFF)
+# admitted payloads the codec would happily encode and every send path
+# would then reject — the bound must live where the bytes are built.
+# Cross-checked by the wire-contract lint (analysis/wire_contract.py
+# WIRE003) and tests/test_wire_contract.py.
+_UDP_MAX_PAYLOAD = 65507
+INPUT_MSG_OVERHEAD = (
+    _HEADER.size + _INPUT_HEAD.size + 16 * _STATUS.size + 2
+)  # 2 = the u16 payload length prefix
+MAX_INPUT_PAYLOAD = _UDP_MAX_PAYLOAD - INPUT_MSG_OVERHEAD
+
 
 @dataclass(frozen=True)
 class SyncRequest:
@@ -125,7 +139,25 @@ def _encode_message_uncached(msg: Message) -> bytes:
         )
         for st in body.peer_connect_status:
             out += _STATUS.pack(1 if st.disconnected else 0, st.last_frame)
-        assert len(body.bytes_) <= 0xFFFF
+        # MAX_INPUT_PAYLOAD assumes the 16-status worst case (the native
+        # stack's MAX_HANDLES); a wider pure-Python session tightens the
+        # cap by its extra statuses so the ACTUAL encoded datagram can
+        # never exceed what the transport carries
+        payload_cap = MAX_INPUT_PAYLOAD - max(
+            0, len(body.peer_connect_status) - 16
+        ) * _STATUS.size
+        if len(body.bytes_) > payload_cap:
+            # a real exception (not an assert) so the guard survives
+            # `python -O`, mirroring sockets.check_datagram_size
+            from ..errors import InvalidRequest
+
+            raise InvalidRequest(
+                f"InputMsg payload of {len(body.bytes_)} bytes exceeds "
+                f"the {payload_cap}-byte cap "
+                f"({len(body.peer_connect_status)} connect statuses): the "
+                "encoded datagram could not survive the transport — "
+                "shrink the un-acked window or the input size"
+            )
         out += struct.pack("<H", len(body.bytes_)) + body.bytes_
         return bytes(out)
     if isinstance(body, InputAck):

@@ -3,6 +3,13 @@
 Importing this subpackage imports jax.
 """
 
+# GGRS_SANITIZE=1 wraps jax.jit BEFORE any backend constructs a program,
+# so every compile in the process carries stack provenance
+# (analysis/sanitize.py); a no-op otherwise
+from ..analysis.sanitize import maybe_install_from_env as _maybe_sanitize
+
+_maybe_sanitize()
+
 from .backend import SnapshotRef, TpuRollbackBackend
 from .resim import ResimCore
 from .sync_test import TpuSyncTestSession

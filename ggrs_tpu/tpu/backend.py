@@ -24,6 +24,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..analysis.sanitize import active_sanitizer, warmup_scope
 from ..obs import (
     GLOBAL_TELEMETRY,
     LOG2_BUCKETS,
@@ -1442,6 +1443,10 @@ class TpuRollbackBackend:
         speculation, adoption) before entering a real-time loop: first
         compilation takes seconds — enough to trip peers' disconnect
         timeouts mid-session. Game state is left untouched."""
+        with warmup_scope("TpuRollbackBackend.warmup"):
+            self._warmup_impl()
+
+    def _warmup_impl(self) -> None:
         import jax.numpy as jnp
 
         core = self.core
@@ -2054,6 +2059,20 @@ class MultiSessionDeviceCore:
         self.rings, self.states, his, los = fn(
             self.rings, self.states, idx, rows, *fn_args
         )
+        san = active_sanitizer()
+        if san is not None:
+            # GGRS_SANITIZE: the megabatch jit cache must stay on the
+            # (row bucket x depth bucket) grid — a dispatch that just
+            # compiled past the budget names its call site and raises
+            # instead of silently growing the cache mid-serve
+            san.check_dispatch_budget(
+                {
+                    "_dispatch_impl": self._dispatch_fn,
+                    "_dispatch_fast_impl": self._dispatch_fast_fn,
+                },
+                self.dispatch_bucket_budget(),
+                context="MultiSessionDeviceCore.dispatch",
+            )
         self.megabatches += 1
         self.rows_dispatched += n
         if GLOBAL_TELEMETRY.enabled:
@@ -2124,6 +2143,10 @@ class MultiSessionDeviceCore:
         advance nothing and save nowhere, on the fast program included).
         With depth_routing=False only the full-window program per row
         bucket compiles, as before."""
+        with warmup_scope("MultiSessionDeviceCore.warmup"):
+            self._warmup_impl()
+
+    def _warmup_impl(self) -> None:
         for b in self.buckets:
             idx = np.full((b,), self.capacity, dtype=np.int32)
             rows = np.tile(self._pad_row, (b, 1))

@@ -3,6 +3,8 @@
 # push with `cargo test` + a wasm compile check, .github/workflows/rust.yml;
 # this is the equivalent for a dual Python/C++ + device-kernel stack):
 #
+#   0. static analysis        (python -m ggrs_tpu.analysis vs baseline.toml
+#                              + the GGRS_SANITIZE retrace smoke)
 #   1. native build           (g++ -> ggrs_tpu/native/libggrs_native.so)
 #   2. full pytest suite      (8-device virtual CPU mesh; ~15 min)
 #   3. UBSAN pass             (sanitized rebuild + the native/wire tests)
@@ -27,8 +29,25 @@
 #   path was actually taken and the megabatch jit cache stayed on the
 #   (row x depth) bucket grid, catching silent depth-routing regressions
 #   (scripts/dispatch_smoke.py, CPU jax, <1 min).
+#   --lint runs the determinism/trace/fence/wire static-analysis gate
+#   (python -m ggrs_tpu.analysis, pure AST, no jax, seconds) against
+#   analysis/baseline.toml, then the retrace-sanitizer smoke
+#   (GGRS_SANITIZE=1 scripts/lint_smoke.py). Also step 0 of the default
+#   flow: the cheapest gate runs first.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+run_lint() {
+  echo "== static analysis gate (determinism/trace/fence/wire) =="
+  python -m ggrs_tpu.analysis
+  echo "== retrace sanitizer smoke (GGRS_SANITIZE=1) =="
+  GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/lint_smoke.py
+}
+
+if [ "${1:-}" = "--lint" ]; then
+  run_lint
+  exit $?
+fi
 
 if [ "${1:-}" = "--tier1" ]; then
   echo "== tier-1 gate (ROADMAP.md verbatim) =="
@@ -66,6 +85,9 @@ fi
 
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
+
+echo "== [0/5] static analysis + sanitizer smoke =="
+run_lint
 
 echo "== [1/5] native build =="
 make -C native

@@ -1,0 +1,91 @@
+"""Retrace-sanitizer smoke: prove GGRS_SANITIZE=1 catches a deliberately
+retrace-unstable toy program, with stack provenance pointing at the line
+that caused it (scripts/check.sh --lint runs this after the static gate).
+
+Two scenarios:
+  1. a shape-churning jitted step (the classic unstable program: every
+     call a new shape, every call a retrace) — the sanitizer must record
+     one recompile per churned call AND name THIS file in the provenance;
+  2. a stable hosted-style dispatch loop after warmup/freeze — the
+     sanitizer must stay silent (zero recompiles), so the tool can't cry
+     wolf on healthy steady state.
+
+Exit 0 when both hold; nonzero with the report otherwise.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("GGRS_SANITIZE", "1")
+if os.environ.get("GGRS_SANITIZE") != "1":
+    print("lint_smoke: GGRS_SANITIZE must be 1 for this smoke", file=sys.stderr)
+    sys.exit(2)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ggrs_tpu.tpu  # noqa: F401  (installs the sanitizer via the env var)
+from ggrs_tpu.analysis.sanitize import active_sanitizer
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> int:
+    san = active_sanitizer()
+    assert san is not None, "GGRS_SANITIZE=1 did not install the sanitizer"
+    san.reset()
+
+    # --- scenario 1: the seeded retrace ------------------------------
+    @jax.jit
+    def unstable_step(x):
+        return x * 2 + 1
+
+    unstable_step(jnp.ones(4))  # warmup: the one legitimate compile
+    san.freeze("lint_smoke warmup")
+    churn = 5
+    for n in range(5, 5 + churn):
+        unstable_step(jnp.ones(n))  # new shape -> retrace, every call
+
+    recompiles = san.recompiles
+    print(san.report())
+    if len(recompiles) != churn:
+        print(
+            f"FAIL: expected {churn} recompiles from the shape churn, "
+            f"sanitizer saw {len(recompiles)}",
+            file=sys.stderr,
+        )
+        return 1
+    this_file = os.path.basename(__file__)
+    if not all(this_file in e.provenance() for e in recompiles):
+        print(
+            "FAIL: recompile provenance does not point at the offending "
+            f"call site in {this_file}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"OK: {churn} seeded retraces caught, provenance -> {this_file}"
+    )
+
+    # --- scenario 2: healthy steady state stays clean -----------------
+    san.reset()
+
+    @jax.jit
+    def stable_step(x):
+        return (x * 3).sum()
+
+    batch = jnp.arange(64, dtype=jnp.float32)
+    stable_step(batch)
+    san.freeze("lint_smoke stable warmup")
+    for _ in range(32):
+        stable_step(batch)
+    if san.recompiles:
+        print("FAIL: healthy loop reported recompiles:", file=sys.stderr)
+        print(san.report(), file=sys.stderr)
+        return 1
+    print("OK: stable loop recompile-clean under the sanitizer")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

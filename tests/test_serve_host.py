@@ -424,8 +424,21 @@ def test_loadgen_soak_64_sessions_lossy():
             latency_ms=20,
             jitter_ms=10,
         )
-    finally:
         GLOBAL_TELEMETRY.enabled = False
+        _soak_assertions(rep)
+    finally:
+        # test isolation even when an assertion above fails: the soak ran
+        # with telemetry ON, and nonzero counters/events left in the
+        # process-wide registry trip later tests asserting a quiet
+        # disabled-telemetry baseline (observed: test_telemetry.
+        # test_disabled_telemetry_records_nothing sharing the process)
+        GLOBAL_TELEMETRY.enabled = False
+        GLOBAL_TELEMETRY.reset()
+
+
+def _soak_assertions(rep):
+    from ggrs_tpu.obs import GLOBAL_TELEMETRY
+
     host = rep.pop("_host")
     assert rep["sessions"] >= 64
     assert rep["desyncs"] == 0, f"soak desynced: {rep}"

@@ -1,0 +1,171 @@
+"""Wire-contract satellite: the runtime encoders against the lint pass's
+source-level extraction (analysis/wire_contract.py), closing the loop
+from source text to actual bytes — if either side drifts, one of these
+fails before a cross-stack packet ever gets the chance to misparse."""
+
+import struct
+
+import pytest
+
+from ggrs_tpu.analysis.wire_contract import extract
+from ggrs_tpu.network import messages as M
+from ggrs_tpu.network.messages import (
+    INPUT_MSG_OVERHEAD,
+    MAX_INPUT_PAYLOAD,
+    ChecksumReport,
+    InputAck,
+    InputMsg,
+    KeepAlive,
+    Message,
+    QualityReply,
+    QualityReport,
+    SyncReply,
+    SyncRequest,
+    decode_message,
+    encode_message,
+)
+from ggrs_tpu.network.sockets import (
+    MAX_DATAGRAM_SIZE,
+    RECV_BUFFER_SIZE,
+    check_datagram_size,
+)
+from ggrs_tpu.sync_layer import ConnectionStatus
+
+
+@pytest.fixture(scope="module")
+def contract():
+    return extract()
+
+
+def test_extraction_sees_the_real_constants(contract):
+    assert contract["recv_buffer_size"] == RECV_BUFFER_SIZE
+    assert contract["max_datagram_size"] == MAX_DATAGRAM_SIZE
+    assert contract["max_input_payload"] == MAX_INPUT_PAYLOAD
+    assert contract["input_overhead"] == INPUT_MSG_OVERHEAD
+    assert contract["udp_max_payload"] == 65507
+
+
+def test_msg_codes_match_native(contract):
+    py, cpp = contract["py_msg_codes"], contract["cpp_msg_codes"]
+    assert py and cpp
+    assert py == cpp
+    # and the runtime module agrees with its own source text
+    for name, val in py.items():
+        assert getattr(M, name) == val
+
+
+def test_encoded_sizes_match_extracted_struct_formats(contract):
+    sizes = contract["struct_sizes"]
+    header = sizes["_HEADER"]
+    cases = [
+        (SyncRequest(7), header + sizes["_U32"]),
+        (SyncReply(9), header + sizes["_U32"]),
+        (InputAck(12), header + sizes["_I32"]),
+        (QualityReport(-3, 123456), header + sizes["_QUALITY_REPORT"]),
+        (QualityReply(123456), header + sizes["_U64"]),
+        (ChecksumReport(checksum=(1 << 127) | 5, frame=44),
+         header + sizes["_CHECKSUM_REPORT"]),
+        (KeepAlive(), header),
+    ]
+    for body, want in cases:
+        wire = encode_message(Message(0xAB, body))
+        assert len(wire) == want, type(body).__name__
+        # and the codec round-trips its own bytes
+        got = decode_message(wire)
+        assert got.body == body
+
+
+def test_input_msg_size_formula(contract):
+    sizes = contract["struct_sizes"]
+    statuses = [ConnectionStatus(False, 3), ConnectionStatus(True, -1)]
+    payload = b"\x01\x02\x03"
+    body = InputMsg(
+        peer_connect_status=statuses, start_frame=5, ack_frame=2,
+        bytes_=payload,
+    )
+    wire = encode_message(Message(1, body))
+    assert len(wire) == (
+        sizes["_HEADER"] + sizes["_INPUT_HEAD"]
+        + len(statuses) * sizes["_STATUS"] + 2 + len(payload)
+    )
+
+
+def test_worst_case_input_msg_exactly_fills_the_datagram_bound():
+    # 16 statuses (the native MAX_HANDLES) + the full payload cap must
+    # land EXACTLY on MAX_DATAGRAM_SIZE: heavier would die in sendto(),
+    # lighter would mean wasted wire budget hidden in the formula
+    statuses = [ConnectionStatus(False, i) for i in range(16)]
+    body = InputMsg(
+        peer_connect_status=statuses, start_frame=1, ack_frame=0,
+        bytes_=b"\xff" * MAX_INPUT_PAYLOAD,
+    )
+    wire = encode_message(Message(2, body))
+    assert len(wire) == MAX_DATAGRAM_SIZE
+    assert check_datagram_size(wire) is wire  # the transport accepts it
+
+
+def test_input_payload_past_the_cap_raises_at_encode():
+    from ggrs_tpu.errors import InvalidRequest
+
+    body = InputMsg(bytes_=b"\x00" * (MAX_INPUT_PAYLOAD + 1))
+    with pytest.raises(InvalidRequest, match="cap"):
+        encode_message(Message(2, body))
+
+
+def test_input_payload_cap_tightens_past_16_statuses():
+    # MAX_INPUT_PAYLOAD assumes the native 16-handle worst case; a wider
+    # pure-Python session must tighten the cap by its extra statuses so
+    # the encoded datagram never exceeds what the transport carries
+    from ggrs_tpu.errors import InvalidRequest
+
+    statuses = [ConnectionStatus(False, i) for i in range(17)]
+    over = InputMsg(
+        peer_connect_status=statuses, bytes_=b"\x00" * MAX_INPUT_PAYLOAD
+    )
+    with pytest.raises(InvalidRequest, match="17 connect statuses"):
+        encode_message(Message(2, over))
+    at_cap = InputMsg(
+        peer_connect_status=statuses,
+        bytes_=b"\x00" * (MAX_INPUT_PAYLOAD - 5),  # one extra _STATUS
+    )
+    wire = encode_message(Message(2, at_cap))
+    assert len(wire) == MAX_DATAGRAM_SIZE
+    assert check_datagram_size(wire) is wire
+
+
+def test_recv_buffer_bounds_agree_across_stacks(contract):
+    # one canonical receive bound, aliased everywhere
+    from ggrs_tpu.native import sockets as native_sockets
+
+    assert native_sockets.RECV_BUFFER_SIZE == RECV_BUFFER_SIZE
+    assert MAX_DATAGRAM_SIZE == min(RECV_BUFFER_SIZE, 65507)
+    assert contract["native_send_buf_cap"] == RECV_BUFFER_SIZE
+    assert contract["native_wire_buf_cap"] == RECV_BUFFER_SIZE
+    # the runtime modules agree with the source-level extraction
+    from ggrs_tpu.native.endpoint import _SEND_BUF_CAP
+    from ggrs_tpu.native.session import _WIRE_BUF_CAP
+
+    assert _SEND_BUF_CAP == RECV_BUFFER_SIZE
+    assert _WIRE_BUF_CAP == RECV_BUFFER_SIZE
+
+
+def test_check_datagram_size_rejects_past_bound():
+    from ggrs_tpu.errors import InvalidRequest
+
+    assert check_datagram_size(b"x" * MAX_DATAGRAM_SIZE)
+    with pytest.raises(InvalidRequest):
+        check_datagram_size(b"x" * (MAX_DATAGRAM_SIZE + 1))
+
+
+def test_header_struct_matches_native_abi(contract):
+    # ggrs_native.h structs the ctypes bindings mirror — spot-check the
+    # checksum width the wire format and the session ABI must share
+    h = contract["h_structs"]
+    sess_event = dict(
+        (f, (t, n)) for f, t, n in h["ggrs_sess_event"]
+    )
+    assert sess_event["local_checksum"] == ("uint8_t", 16)
+    assert sess_event["remote_checksum"] == ("uint8_t", 16)
+    # the Python codec's u128 checksum field is the same 16 bytes
+    assert struct.calcsize(contract["struct_formats"]["_CHECKSUM_REPORT"]) \
+        == struct.calcsize("<i") + 16
