@@ -76,7 +76,7 @@ def _game_family(model):
 def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
                 bench_batches=BENCH_BATCHES, backend="pallas",
                 model="ex_game", batch=BATCH, mesh=None, repeats=1,
-                mesh_devices=0):
+                mesh_devices=0, pinned_warmup=False, trim=0):
     """backend="pallas" runs the whole batch as one TPU kernel with carries
     resident in VMEM (~3x the XLA scan on the 4k world; bit-identical —
     tests/test_pallas_core.py, tests/test_pallas_arena.py); falls back to
@@ -126,6 +126,15 @@ def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
         sess, frame = build_and_warm(backend)
 
     ticks = bench_batches * batch
+    if pinned_warmup:
+        # pinned warmup: one full UNRECORDED measurement pass right
+        # before the samples — the first recorded sample then never
+        # inherits a cold tunnel window (the headline arm's rounds were
+        # spreading 25-37% partly on exactly that, BENCH_local_r05)
+        for _ in range(bench_batches):
+            sess.advance_frames(input_script(batch, frame, mod))
+            frame += batch
+        sess.check()
     rates = []
     for _ in range(repeats):
         t0 = time.perf_counter()
@@ -140,21 +149,36 @@ def bench_fused(entities=ENTITIES, check_distance=CHECK_DISTANCE,
         rates.append((ticks * check_distance) / (time.perf_counter() - t0))
     rates.sort()
     p50 = rates[len(rates) // 2]
+    # trimmed stats: drop the `trim` fastest and slowest samples before
+    # computing the committed median/spread, so one slow tunnel window
+    # (or one anomalously hot pass) cannot masquerade as a regression or
+    # an improvement; raw samples stay in the artifact for forensics
+    kept = rates[trim : len(rates) - trim] if len(rates) > 2 * trim else rates
+    p50_trimmed = kept[len(kept) // 2]
     stats = {
         "samples_frames_per_sec": [round(r, 1) for r in rates],
-        "spread_pct": round(100.0 * (rates[-1] - rates[0]) / p50, 1),
+        "spread_pct": round(
+            100.0 * (kept[-1] - kept[0]) / p50_trimmed, 1
+        ),
+        "spread_pct_raw": round(100.0 * (rates[-1] - rates[0]) / p50, 1),
+        "trimmed_samples": len(kept),
     }
-    return p50, check_distance / p50 * 1000.0, backend, sess, stats
+    return p50_trimmed, check_distance / p50_trimmed * 1000.0, backend, sess, stats
 
 
-def bench_fused_stats(repeats=5, **kw):
-    """Headline-config wrapper: p50-of-repeats plus the spread, JSON-ready
-    (VERDICT r3 item 6: variance on headline numbers). Five passes, not
-    three: the tunnel's per-dispatch latency drifts up to ~2x within a
-    process (r4's committed arena samples spread 100%), and a 5-sample p50
-    sits inside the stable cluster even when two passes land in a slow
-    window."""
-    rate, ms, backend, _sess, stats = bench_fused(repeats=repeats, **kw)
+def bench_fused_stats(repeats=9, trim=2, **kw):
+    """Headline-config wrapper: TRIMMED median over >= 9 samples after a
+    pinned warmup pass, JSON-ready. The headline arm is contention-noisy
+    (BENCH_local_r05: 25-37% spread across rounds, 82k-201k frames/sec)
+    and the tunnel's per-dispatch latency drifts up to ~2x within a
+    process; nine samples with the top/bottom two dropped put the
+    committed p50 inside the stable cluster and the reported spread_pct
+    (of the SURVIVING cluster) lets a reader tell a real regression from
+    window noise — spread_pct_raw keeps the untrimmed figure for
+    comparison against older artifacts."""
+    rate, ms, backend, _sess, stats = bench_fused(
+        repeats=repeats, trim=trim, pinned_warmup=True, **kw
+    )
     return {
         "frames_per_sec_p50": round(rate, 1),
         "ms_per_tick_p50": round(ms, 4),
@@ -1516,6 +1540,14 @@ def bench_p2p4_rollback(rounds=12, burst=12, lazy_ticks=0, mesh_devices=0,
     from ggrs_tpu.utils.tracing import GLOBAL_TRACER
 
     GLOBAL_TRACER.enabled = True
+    # the per-tick breakdown's host-tax split now reads the obs
+    # instruments the runtime itself maintains (ggrs_host_tax_ms,
+    # ggrs_drain_blocked_ticks_total) instead of ad-hoc timers — enable
+    # the registry for this phase so they populate (guard-checked
+    # instrumentation; the overhead is noise-level, PR 2's A/B)
+    from ggrs_tpu.obs import GLOBAL_TELEMETRY, enable_global_telemetry
+
+    enable_global_telemetry()
 
     # Each round, session 0's first tick ingests the peers' accumulated real
     # inputs and performs the full `burst`-frame rollback as one fused
@@ -1537,6 +1569,7 @@ def bench_p2p4_rollback(rounds=12, burst=12, lazy_ticks=0, mesh_devices=0,
             backend.flush()
             true_barrier(backend.core.state)
             GLOBAL_TRACER.reset()
+            GLOBAL_TELEMETRY.registry.reset()
             t_all = time.perf_counter()
         for k in range(burst):
             sessions[0].add_local_input(0, bytes([frame % 16]))
@@ -1635,11 +1668,45 @@ def bench_p2p4_rollback(rounds=12, burst=12, lazy_ticks=0, mesh_devices=0,
             / max(n_ticks, 1),
             3,
         ),
+        # the obs-sourced host-tax split (ggrs_host_tax_ms sums across
+        # the WHOLE mesh's sessions, amortized per session-0 tick) — the
+        # runtime's own instruments, not bench-local timers
+        "host_tax_ms": _host_tax_per_tick(n_ticks),
     }
+    # the drain-free-tick gate counter is only meaningful when the mesh
+    # actually runs desync detection (a mesh without it can never block
+    # on a checksum drain, and a vacuous 0 would read as evidence the
+    # optimization works); this arm runs detection off for comparability
+    # with the committed baselines, so the field is usually absent —
+    # scripts/check.sh --pump-smoke is the real gate
+    if any(
+        getattr(getattr(sess, "desync_detection", None), "enabled", False)
+        for sess in sessions
+    ):
+        breakdown["drain_blocked_ticks"] = int(
+            sum(getattr(sess, "drain_blocked_ticks", 0) for sess in sessions)
+        )
     GLOBAL_TRACER.enabled = False
     # device-inclusive rollback throughput: `burst` resim frames per round
     # (the speculative ticks' execution rides in the same wall clock)
     return (rounds * burst) / elapsed, median_s * 1000.0, breakdown
+
+
+def _host_tax_per_tick(n_ticks):
+    """ggrs_host_tax_ms per-phase sums (pump/parse/drain), amortized per
+    measured tick — {} when the instrument never observed (telemetry off
+    or no batched pump in the arm), so old readers stay compatible."""
+    from ggrs_tpu.obs import GLOBAL_TELEMETRY
+
+    tax = GLOBAL_TELEMETRY.registry.get("ggrs_host_tax_ms")
+    if tax is None:
+        return {}
+    out = {}
+    for key, cell in tax._children.items():
+        phase = key[0] if key else ""
+        if cell.count:
+            out[phase] = round(cell.sum / max(n_ticks, 1), 4)
+    return out
 
 
 # --telemetry (set in main): each phase subprocess enables the session
@@ -1692,7 +1759,19 @@ def bench_serve_host(sessions=64, ticks=120, entities=1024):
     n_sessions = sum(len(keys) for keys in matches)
     sync_fleet(host, matches, clock)
 
-    # the measured window: loadgen's shared scripted drive, barriered
+    # the measured window: loadgen's shared scripted drive, barriered.
+    # Reset the obs window here — sync/handshake ticks (cold pump passes,
+    # compile-stall-adjacent flushes) would otherwise inflate the
+    # host_tax_ms sums and could report a warmup-phase blocked flush as a
+    # steady-state drain-blocked tick
+    from ggrs_tpu.obs import GLOBAL_TELEMETRY as _TEL
+
+    _TEL.registry.reset()
+    for keys in matches:
+        for k in keys:
+            sess = host.session(k)
+            if hasattr(sess, "drain_blocked_ticks"):
+                sess.drain_blocked_ticks = 0
     scripts = make_scripts(matches, ticks, seed=7)
     host.device.block_until_ready()
     t0 = time.perf_counter()
@@ -1738,6 +1817,13 @@ def bench_serve_host(sessions=64, ticks=120, entities=1024):
             depth_mix.get("fast", 0) / dispatched, 3
         ) if dispatched else 0.0,
         "dispatch_bucket_budget": dev.dispatch_bucket_budget(),
+        # obs-sourced host tax + drain-free gate ({}/0 when the phase
+        # runs without --telemetry; populated sums per host tick when on)
+        "host_tax_ms": _host_tax_per_tick(ticks),
+        "drain_blocked_ticks": int(sum(
+            getattr(host.session(k), "drain_blocked_ticks", 0)
+            for keys in matches for k in keys
+        )),
     }
 
 

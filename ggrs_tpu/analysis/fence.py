@@ -88,6 +88,18 @@ POLICIES: Dict[str, FencePolicy] = {
         protected=CORE_STATE,
         allowed=frozenset(),
     ),
+    # the batched wire pump's pooled decode staging (network/pump.py):
+    # the offset/length scratch is reused across pump passes — only the
+    # staging's own grow path may rebind the arrays (the byte pool is
+    # each pass's immutable joined buffer, so it needs no policy)
+    "ggrs_tpu/network/pump.py": FencePolicy(
+        protected=frozenset({"offs", "lens", "staging"}),
+        allowed=frozenset({
+            ("PumpStaging", "__init__"),
+            ("PumpStaging", "ensure"),
+            ("WirePump", "__init__"),
+        }),
+    ),
 }
 
 

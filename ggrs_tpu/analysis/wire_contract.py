@@ -93,6 +93,14 @@ _CONST_PARITY = [
     (NATIVE_SESSION_PY, "_MAX_TOTAL_HANDLES", SESSION_CPP,
      "MAX_TOTAL_HANDLES"),
     (NATIVE_SESSION_PY, "_MAX_INPUT", SESSION_CPP, "MAX_INPUT_SIZE"),
+    # wire-layout sizes the batched pump (network/pump.py) gathers fields
+    # at — the Python codec derives them from its struct formats, the C++
+    # endpoint pins them as constexpr beside its Reader offsets
+    (MESSAGES_PY, "WIRE_HEADER_SIZE", ENDPOINT_CPP, "WIRE_HEADER_SIZE"),
+    (MESSAGES_PY, "WIRE_INPUT_HEAD_SIZE", ENDPOINT_CPP, "WIRE_INPUT_HEAD_SIZE"),
+    (MESSAGES_PY, "WIRE_STATUS_SIZE", ENDPOINT_CPP, "WIRE_STATUS_SIZE"),
+    (MESSAGES_PY, "WIRE_CHECKSUM_BODY_SIZE", ENDPOINT_CPP,
+     "WIRE_CHECKSUM_BODY_SIZE"),
 ]
 
 
@@ -538,7 +546,13 @@ def _check_datagram_bounds(repo: Repo, out: List[Finding]) -> None:
 
 def _check_const_parity(repo: Repo, out: List[Finding]) -> None:
     for py_path, py_name, cpp_path, cpp_name in _CONST_PARITY:
-        py = _py_constants(repo, py_path).get(py_name)
+        # messages.py constants are derived from struct formats
+        # (`_HEADER.size` arithmetic) — resolve through the format-aware
+        # extractor or every WIRE_*_SIZE pairing would silently skip
+        if py_path == MESSAGES_PY:
+            py = _messages_constants(repo).get(py_name)
+        else:
+            py = _py_constants(repo, py_path).get(py_name)
         cpp = _cpp_constants(repo, cpp_path).get(cpp_name)
         if py is None or cpp is None:
             continue

@@ -249,9 +249,14 @@ class NativePeerEndpoint:
         last = (ctypes.c_int32 * n)(*[s.last_frame for s in connect_status])
         return disc, last, n
 
-    def poll(self, connect_status: Sequence[ConnectionStatus]) -> List[Any]:
+    def poll(
+        self, connect_status: Sequence[ConnectionStatus],
+        now: Optional[int] = None,
+    ) -> List[Any]:
         disc, last, n = self._pack_status(connect_status)
-        self._lib.ggrs_ep_poll(self._ep, disc, last, n, self.clock.now_ms())
+        if now is None:
+            now = self.clock.now_ms()
+        self._lib.ggrs_ep_poll(self._ep, disc, last, n, now)
         return self._drain_events()
 
     def send_input(
@@ -304,6 +309,19 @@ class NativePeerEndpoint:
                 from ..network.messages import decode_message
 
                 socket.send_to(decode_message(wire), self.peer_addr)
+
+    def drain_sends(self, out: List[Tuple[bytes, Any]]) -> None:
+        """Batched twin of send_all_messages (PeerEndpoint.drain_sends):
+        pull every queued wire out of the C++ endpoint as (wire, addr)
+        pairs; the pump ships the batch via socket.send_wire_batch."""
+        addr = self.peer_addr
+        next_send = self._lib.ggrs_ep_next_send
+        while True:
+            n = next_send(self._ep, self._send_buf, _SEND_BUF_CAP)
+            assert n >= 0, "native send buffer too small"
+            if n == 0:
+                return
+            out.append((self._send_buf.raw[:n], addr))
 
     def _drain_events(self) -> List[Any]:
         events: List[Any] = []

@@ -412,6 +412,18 @@ class _NativeNetworkedSession(_NativeSessionBase):
                     for ep in eps:
                         lib.ggrs_sess_handle_wire(self._h, ep, wire, len(wire), now)
         lib.ggrs_sess_poll(self._h, now)
+        # drain-free tick (P2PSession._pump_checksums' native twin):
+        # resolve host-ready desync checksums on the pump, prefetch the
+        # oldest in-flight one, stay two advances behind the capture
+        # frontier so no mid-correction value can bind early (spectator
+        # sessions share this pump but have no checksum lane)
+        pcr = getattr(self, "_pending_checksum_report", None)
+        if pcr is not None and self.desync_detection.enabled and len(pcr):
+            self._pending_checksum_report.flush(
+                force=False,
+                emit=self._emit_checksum_report,
+                max_serial=self._advance_serial - 2,
+            )
         self._send_all()
 
     def _send_all(self) -> None:
@@ -540,6 +552,11 @@ class NativeP2PSession(_NativeNetworkedSession):
         )
         self.desync_detection = desync_detection
         self._pending_checksum_report = PendingChecksumReport()
+        # drain-free tick bookkeeping (P2PSession's twins): advance
+        # serial gates the pump-side flush; blocked ticks are the gate
+        # counter bench/smoke read
+        self._advance_serial = 0
+        self.drain_blocked_ticks = 0
 
         rng = rng or _random.Random()
         cfg = _SessConfig()
@@ -593,7 +610,12 @@ class NativeP2PSession(_NativeNetworkedSession):
             # once the caller fulfilled those requests, i.e. by now
             interval = self.desync_detection.interval
             force = self.current_frame % interval == interval - 1
-            self._pending_checksum_report.flush(force, self._emit_checksum_report)
+            blocked = self._pending_checksum_report.flush(
+                force, self._emit_checksum_report
+            )
+            if blocked:
+                self.drain_blocked_ticks += 1
+        self._advance_serial += 1
         requests = self._advance_native(self.clock.now_ms())
         if self.desync_detection.enabled:
             self._capture_checksum_request()
@@ -605,7 +627,8 @@ class NativeP2PSession(_NativeNetworkedSession):
         if frame == NULL_FRAME:
             return
         self._pending_checksum_report.capture(
-            frame, self.cells[frame % len(self.cells)]
+            frame, self.cells[frame % len(self.cells)],
+            serial=self._advance_serial,
         )
 
     def _emit_checksum_report(self, frame: Frame, checksum: int) -> None:

@@ -82,6 +82,14 @@ class NativeUdpNonBlockingSocket:
         host, port = addr
         self._lib.ggrs_udp_send(self._fd, wire, len(wire), _ip_to_int(host), port)
 
+    def send_wire_batch(self, batch) -> None:
+        """Batched drain: one bound-method loop over the C send."""
+        send = self._lib.ggrs_udp_send
+        fd = self._fd
+        for wire, addr in batch:
+            host, port = addr
+            send(fd, wire, len(wire), _ip_to_int(host), port)
+
     def send_to(self, msg: Message, addr: Any) -> None:
         self.send_wire(encode_message(msg), addr)
 

@@ -211,6 +211,12 @@ class AuthenticatedSocket:
             body = wire
         self.inner.send_wire(body + self._tag(self.key, self._domain + body), addr)
 
+    def send_wire_batch(self, batch) -> None:
+        """Batched drain: each datagram still gets its own MAC (and
+        replay counter) — authentication is per-datagram by design."""
+        for wire, addr in batch:
+            self.send_wire(wire, addr)
+
     def send_to(self, msg: Message, addr: Any) -> None:
         self.send_wire(encode_message(msg), addr)
 

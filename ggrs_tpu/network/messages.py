@@ -37,6 +37,16 @@ _STATUS = struct.Struct("<Bi")
 _QUALITY_REPORT = struct.Struct("<bQ")
 _CHECKSUM_REPORT = struct.Struct("<i16s")
 
+# Named wire-layout sizes, shared by this codec and the batched pump
+# (network/pump.py, which extracts fields straight out of pooled byte
+# staging at these offsets) and cross-checked against the C++ endpoint's
+# twins by the WIRE parity lint (analysis/wire_contract.py) — an offset
+# drift between the three decoders would silently desync the stacks.
+WIRE_HEADER_SIZE = _HEADER.size            # magic u16 + body_type u8
+WIRE_INPUT_HEAD_SIZE = _INPUT_HEAD.size    # start/ack i32 + flags u8 + n u8
+WIRE_STATUS_SIZE = _STATUS.size            # disconnected u8 + last_frame i32
+WIRE_CHECKSUM_BODY_SIZE = _CHECKSUM_REPORT.size  # frame i32 + checksum u128
+
 # The largest compressed-input payload an InputMsg may carry, derived so
 # the WORST-CASE encoded message (16 connect statuses — the native stack's
 # MAX_HANDLES) exactly fits the transport's MAX_DATAGRAM_SIZE (65507, UDP's

@@ -27,6 +27,14 @@ from ..types import NULL_FRAME, Frame, PlayerHandle
 from ..utils.clock import Clock
 from . import compression
 from .messages import (
+    MSG_CHECKSUM_REPORT,
+    MSG_INPUT,
+    MSG_INPUT_ACK,
+    MSG_KEEP_ALIVE,
+    MSG_QUALITY_REPLY,
+    MSG_QUALITY_REPORT,
+    MSG_SYNC_REPLY,
+    MSG_SYNC_REQUEST,
     ChecksumReport,
     InputAck,
     InputMsg,
@@ -36,6 +44,7 @@ from .messages import (
     QualityReport,
     SyncReply,
     SyncRequest,
+    encode_message,
 )
 from .network_stats import NetworkStats
 from .sockets import NonBlockingSocket
@@ -257,8 +266,14 @@ class PeerEndpoint:
     # timers (src/network/protocol.rs:351-404)
     # ------------------------------------------------------------------
 
-    def poll(self, connect_status: Sequence[ConnectionStatus]) -> List[Any]:
-        now = self.clock.now_ms()
+    def poll(
+        self, connect_status: Sequence[ConnectionStatus],
+        now: Optional[int] = None,
+    ) -> List[Any]:
+        """`now` lets a fleet-wide pump pass hoist the clock read out of
+        its per-endpoint loop (one read per pass, not per endpoint)."""
+        if now is None:
+            now = self.clock.now_ms()
         if self.state == ProtocolState.SYNCHRONIZING:
             # Deliberate divergence from the reference (protocol.rs:353):
             # retries key off the last sync REQUEST, not the last send of
@@ -308,6 +323,19 @@ class PeerEndpoint:
             return
         while self.send_queue:
             socket.send_to(self.send_queue.popleft(), self.peer_addr)
+
+    def drain_sends(self, out: List[Tuple[bytes, Any]]) -> None:
+        """Batched twin of send_all_messages: append every queued
+        message's wire bytes (already encoded once by _queue_message's
+        byte accounting) as (wire, peer_addr) pairs; the pump ships the
+        whole pass's batch through one socket.send_wire_batch call."""
+        if self.state == ProtocolState.SHUTDOWN:
+            self.send_queue.clear()
+            return
+        addr = self.peer_addr
+        q = self.send_queue
+        while q:
+            out.append((encode_message(q.popleft()), addr))
 
     def send_input(
         self,
@@ -399,8 +427,6 @@ class PeerEndpoint:
         msg = Message(magic=self.magic, body=body)
         self.packets_sent += 1
         self.last_send_time = self.clock.now_ms()
-        from .messages import encode_message
-
         wire_len = len(encode_message(msg))
         self.bytes_sent += wire_len
         if GLOBAL_TELEMETRY.enabled:
@@ -413,17 +439,68 @@ class PeerEndpoint:
     # ------------------------------------------------------------------
 
     def handle_message(self, msg: Message) -> None:
+        """Object-level receive (tests, hand-built messages, transports
+        without a wire lane): maps the Message onto the field-level
+        handle_decoded, THE one applier both paths share — the batched
+        pump (network/pump.py) calls handle_decoded with fields gathered
+        straight out of the pooled decode staging, so divergence between
+        the two receive paths is impossible by construction."""
+        # wire-decoded messages carry their bytes (decode_message stamps
+        # _wire); hand-built ones (tests) pay one cached encode
+        wire_len = len(msg._wire) if msg._wire is not None else len(encode_message(msg))
+        body = msg.body
+        if isinstance(body, InputMsg):
+            self.handle_decoded(
+                MSG_INPUT, msg.magic, wire_len,
+                body.start_frame, body.ack_frame,
+                1 if body.disconnect_requested else 0,
+                [(s.disconnected, s.last_frame)
+                 for s in body.peer_connect_status],
+                body.bytes_,
+            )
+        elif isinstance(body, InputAck):
+            self.handle_decoded(MSG_INPUT_ACK, msg.magic, wire_len, body.ack_frame)
+        elif isinstance(body, QualityReport):
+            self.handle_decoded(
+                MSG_QUALITY_REPORT, msg.magic, wire_len,
+                body.frame_advantage, body.ping,
+            )
+        elif isinstance(body, QualityReply):
+            self.handle_decoded(MSG_QUALITY_REPLY, msg.magic, wire_len, body.pong)
+        elif isinstance(body, SyncRequest):
+            self.handle_decoded(
+                MSG_SYNC_REQUEST, msg.magic, wire_len, body.random_request
+            )
+        elif isinstance(body, SyncReply):
+            self.handle_decoded(
+                MSG_SYNC_REPLY, msg.magic, wire_len, body.random_reply
+            )
+        elif isinstance(body, ChecksumReport):
+            self.handle_decoded(
+                MSG_CHECKSUM_REPORT, msg.magic, wire_len,
+                body.frame, body.checksum,
+            )
+        elif isinstance(body, KeepAlive):
+            self.handle_decoded(MSG_KEEP_ALIVE, msg.magic, wire_len)
+
+    def handle_decoded(
+        self, kind: int, magic: int, wire_len: int,
+        a: int = 0, b: int = 0, c: int = 0,
+        statuses: Sequence[Tuple[Any, int]] = (), payload: bytes = b"",
+    ) -> None:
+        """Field-level receive: one decoded datagram's worth of scalars,
+        positionally matched to network/pump.py's record layout (kind,
+        magic, a, b, c, statuses, payload). Branches ordered by live
+        traffic frequency. Scalar meanings: INPUT a=start_frame,
+        b=ack_frame, c=flags; INPUT_ACK a=ack_frame; QUALITY_REPORT
+        a=frame_advantage, b=ping; QUALITY_REPLY a=pong; SYNC_* a=nonce;
+        CHECKSUM_REPORT a=frame, b=checksum."""
         if self.state == ProtocolState.SHUTDOWN:
             return
         # packet auth: filter foreign magics once the peer is known
-        if self.remote_magic != 0 and msg.magic != self.remote_magic:
+        if self.remote_magic != 0 and magic != self.remote_magic:
             return
         self.last_recv_time = self.clock.now_ms()
-        # wire-decoded messages carry their bytes (decode_message stamps
-        # _wire); hand-built ones (tests) pay one cached encode
-        from .messages import encode_message
-
-        wire_len = len(msg._wire) if msg._wire is not None else len(encode_message(msg))
         self.packets_recv += 1
         self.bytes_recv += wire_len
         if GLOBAL_TELEMETRY.enabled:
@@ -433,32 +510,28 @@ class PeerEndpoint:
             self.disconnect_notify_sent = False
             self.event_queue.append(EvNetworkResumed())
 
-        body = msg.body
-        if isinstance(body, SyncRequest):
-            self._on_sync_request(body)
-        elif isinstance(body, SyncReply):
-            self._on_sync_reply(msg.magic, body)
-        elif isinstance(body, InputMsg):
-            self._on_input(body)
-        elif isinstance(body, InputAck):
-            self._pop_pending_output(body.ack_frame)
-        elif isinstance(body, QualityReport):
-            self._on_quality_report(body)
-        elif isinstance(body, QualityReply):
-            self._on_quality_reply(body)
-        elif isinstance(body, ChecksumReport):
-            self._on_checksum_report(body)
-        # KeepAlive: nothing beyond the recv-time update
+        if kind == MSG_INPUT:
+            self._on_input_fields(a, b, bool(c & 1), statuses, payload)
+        elif kind == MSG_INPUT_ACK:
+            self._pop_pending_output(a)
+        elif kind == MSG_QUALITY_REPORT:
+            self._on_quality_report_fields(a, b)
+        elif kind == MSG_QUALITY_REPLY:
+            self._on_quality_reply_pong(a)
+        elif kind == MSG_SYNC_REQUEST:
+            self._queue_message(SyncReply(random_reply=a))
+        elif kind == MSG_SYNC_REPLY:
+            self._on_sync_reply_nonce(magic, a)
+        elif kind == MSG_CHECKSUM_REPORT:
+            self._on_checksum_report_fields(a, b)
+        # MSG_KEEP_ALIVE: nothing beyond the recv-time update
 
-    def _on_sync_request(self, body: SyncRequest) -> None:
-        self._queue_message(SyncReply(random_reply=body.random_request))
-
-    def _on_sync_reply(self, magic: int, body: SyncReply) -> None:
+    def _on_sync_reply_nonce(self, magic: int, nonce: int) -> None:
         if self.state != ProtocolState.SYNCHRONIZING:
             return
-        if body.random_reply not in self.sync_random_requests:
+        if nonce not in self.sync_random_requests:
             return
-        self.sync_random_requests.discard(body.random_reply)
+        self.sync_random_requests.discard(nonce)
         self.sync_remaining_roundtrips -= 1
         if self.sync_remaining_roundtrips > 0:
             self.event_queue.append(
@@ -473,43 +546,51 @@ class PeerEndpoint:
             self.event_queue.append(EvSynchronized())
             self.remote_magic = magic  # peer is now authorized
 
-    def _on_input(self, body: InputMsg) -> None:
-        """(src/network/protocol.rs:616-689)"""
-        self._pop_pending_output(body.ack_frame)
+    def _on_input_fields(
+        self, start_frame: Frame, ack_frame: Frame,
+        disconnect_requested: bool,
+        statuses: Sequence[Tuple[Any, int]], payload: bytes,
+    ) -> None:
+        """(src/network/protocol.rs:616-689) — `statuses` items are
+        (disconnected, last_frame) pairs straight off the wire decode."""
+        self._pop_pending_output(ack_frame)
 
-        if body.disconnect_requested:
+        if disconnect_requested:
             if self.state != ProtocolState.DISCONNECTED and not self.disconnect_event_sent:
                 self.event_queue.append(EvDisconnected())
                 self.disconnect_event_sent = True
         else:
-            for i, st in enumerate(body.peer_connect_status):
-                if i >= len(self.peer_connect_status):
+            mine_all = self.peer_connect_status
+            n_mine = len(mine_all)
+            for i, (disc, last_frame) in enumerate(statuses):
+                if i >= n_mine:
                     break
-                mine = self.peer_connect_status[i]
-                mine.disconnected = st.disconnected or mine.disconnected
-                mine.last_frame = max(mine.last_frame, st.last_frame)
+                mine = mine_all[i]
+                mine.disconnected = bool(disc) or mine.disconnected
+                if last_frame > mine.last_frame:
+                    mine.last_frame = last_frame
 
         last_recv = self._last_recv_frame()
         # a start_frame beyond last_recv+1 means the peer encoded against an
         # input we never received — unrecoverable for this packet, but the
         # value is network-controlled, so drop it rather than abort (parity
         # with the C++ endpoint, endpoint.cpp on_input)
-        if last_recv != NULL_FRAME and body.start_frame > last_recv + 1:
+        if last_recv != NULL_FRAME and start_frame > last_recv + 1:
             return
         # before any input arrived, a legitimate first packet starts within
         # the sender's pending window (its first queued frame, bounded by
         # the 128-slot queue); a huge spoofed start_frame would otherwise
         # permanently poison recv_inputs and blackhole all real inputs
         if last_recv == NULL_FRAME and not (
-            0 <= body.start_frame <= PENDING_OUTPUT_SIZE
+            0 <= start_frame <= PENDING_OUTPUT_SIZE
         ):
             return
         # ...and frame arithmetic must stay inside int32 in either direction
         # (parity with the C++ endpoint, where overflow would be UB)
-        if not (0 <= body.start_frame <= (1 << 31) - 1 - 2 * PENDING_OUTPUT_SIZE):
+        if not (0 <= start_frame <= (1 << 31) - 1 - 2 * PENDING_OUTPUT_SIZE):
             return
 
-        decode_frame = NULL_FRAME if last_recv == NULL_FRAME else body.start_frame - 1
+        decode_frame = NULL_FRAME if last_recv == NULL_FRAME else start_frame - 1
         ref = self.recv_inputs.get(decode_frame)
         if ref is None:
             return
@@ -522,13 +603,13 @@ class PeerEndpoint:
         # (parity with the C++ endpoint, endpoint.cpp on_input)
         try:
             decoded = compression.decode(
-                ref, body.bytes_, max_output=len(ref) * (PENDING_OUTPUT_SIZE + 1)
+                ref, payload, max_output=len(ref) * (PENDING_OUTPUT_SIZE + 1)
             )
         except ValueError:
             return
         per_player = self.input_size
         for i, inp_bytes in enumerate(decoded):
-            inp_frame = body.start_frame + i
+            inp_frame = start_frame + i
             if inp_frame <= self._last_recv_frame():
                 continue  # already have it
             self.recv_inputs[inp_frame] = inp_bytes
@@ -552,15 +633,15 @@ class PeerEndpoint:
         while self.pending_output and self.pending_output[0][0] <= ack_frame:
             self.last_acked_input = self.pending_output.popleft()
 
-    def _on_quality_report(self, body: QualityReport) -> None:
-        self.remote_frame_advantage = body.frame_advantage
+    def _on_quality_report_fields(self, frame_advantage: int, ping: int) -> None:
+        self.remote_frame_advantage = frame_advantage
         # packet-loss estimate from sequence gaps: the peer's reports fire
         # every QUALITY_REPORT_INTERVAL_MS carrying its strictly-increasing
         # clock, so a ping-gap of k intervals means k - 1 reports (and
         # statistically the same fraction of all its traffic) were dropped.
         # ping is network-controlled: ignore non-monotonic values outright.
-        if self._last_quality_ping is not None and body.ping > self._last_quality_ping:
-            gap = body.ping - self._last_quality_ping
+        if self._last_quality_ping is not None and ping > self._last_quality_ping:
+            gap = ping - self._last_quality_ping
             # floor, not round: reports fire on the sender's poll at >=200ms,
             # so a slow-polling peer (e.g. 300ms cadence) stretches gaps to
             # 1.5 intervals with zero real loss — flooring forgives that
@@ -571,15 +652,15 @@ class PeerEndpoint:
                 self.packets_lost += missed
                 if GLOBAL_TELEMETRY.enabled:
                     self._m_lost.inc(missed)
-        self._last_quality_ping = max(self._last_quality_ping or 0, body.ping)
-        self._queue_message(QualityReply(pong=body.ping))
+        self._last_quality_ping = max(self._last_quality_ping or 0, ping)
+        self._queue_message(QualityReply(pong=ping))
 
-    def _on_quality_reply(self, body: QualityReply) -> None:
+    def _on_quality_reply_pong(self, pong: int) -> None:
         now = self.clock.now_ms()
         # network-controlled value: a pong from the future (clock skew or a
         # crafted packet) must not produce a negative RTT or crash the
         # session (parity with the C++ endpoint, endpoint.cpp)
-        self.round_trip_time = now - body.pong if now >= body.pong else 0
+        self.round_trip_time = now - pong if now >= pong else 0
         # RFC 3550-style jitter over consecutive RTT samples; the first
         # sample only seeds the baseline (comparing against the initial 0
         # would inject a phantom |RTT|/16 spike on every fresh connection)
@@ -592,15 +673,15 @@ class PeerEndpoint:
             self._m_rtt.set(self.round_trip_time)
             self._m_jitter.set(self.jitter_ms)
 
-    def _on_checksum_report(self, body: ChecksumReport) -> None:
-        if self.last_added_checksum_frame < body.frame:
+    def _on_checksum_report_fields(self, frame: Frame, checksum: int) -> None:
+        if self.last_added_checksum_frame < frame:
             if len(self.checksum_history) > MAX_CHECKSUM_HISTORY_SIZE:
                 keep_after = self.last_added_checksum_frame - MAX_CHECKSUM_HISTORY_SIZE
                 self.checksum_history = {
                     f: c for f, c in self.checksum_history.items() if f > keep_after
                 }
-            self.last_added_checksum_frame = body.frame
-            self.checksum_history[body.frame] = body.checksum
+            self.last_added_checksum_frame = frame
+            self.checksum_history[frame] = checksum
 
     # ------------------------------------------------------------------
     # frame advantage / stats

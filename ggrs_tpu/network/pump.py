@@ -1,0 +1,464 @@
+"""Batched wire pump: fleet-wide decode/apply/send in pooled passes.
+
+The per-message hot path the per-tick loops used to pay —
+`decode_message`'s dataclass construction, one struct unpack per field,
+one `handle_message` per datagram, one `sendto` per queued message — is
+replaced with one POOLED pass per pump cycle. Every datagram received
+this pass lands in one staging byte pool; headers and fixed-size bodies
+are extracted with vectorized numpy gathers, ONE pass per message type
+(the wire twin of tpu/backend.py's plan-cached one-pass request parser);
+the decoded fields are then applied to the owning endpoints in arrival
+order through `PeerEndpoint.handle_decoded`, so no Message/dataclass
+objects exist on the hot path at all. Sends mirror it: every endpoint's
+queued wire drains into one per-socket batch shipped via
+`send_wire_batch` (a sendmmsg-style drain: one Python call, N
+datagrams).
+
+Decode order is free (decoding is pure), apply order is not: records are
+applied in per-socket arrival order, so every endpoint state machine
+sees exactly the sequence the legacy per-message loop fed it. Bit parity
+with the legacy path is by construction — `handle_decoded` and
+`handle_message` share the same appliers — and pinned by
+tests/test_wire_pump.py's fuzz/parity suite.
+
+Fence note (analysis/fence.py FEN001): the pooled offset/length scratch
+in `PumpStaging` is shared mutable state reused across pump passes; only
+`batch_decode` (via `PumpStaging.ensure`) may grow or rebind it. The
+byte pool itself is each pass's joined datagram buffer (immutable
+bytes), so field gathers and payload slices can alias it safely.
+"""
+
+from __future__ import annotations
+
+import struct as _struct
+import time as _time
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..errors import GGRSError
+from ..obs import GLOBAL_TELEMETRY, LOG2_BUCKETS, LOG2_BUCKETS_MS
+from .messages import (
+    MSG_CHECKSUM_REPORT,
+    MSG_INPUT,
+    MSG_INPUT_ACK,
+    MSG_KEEP_ALIVE,
+    MSG_QUALITY_REPLY,
+    MSG_QUALITY_REPORT,
+    MSG_SYNC_REPLY,
+    MSG_SYNC_REQUEST,
+    WIRE_CHECKSUM_BODY_SIZE,
+    WIRE_HEADER_SIZE,
+    WIRE_INPUT_HEAD_SIZE,
+    WIRE_STATUS_SIZE,
+)
+
+# fixed body sizes (bytes past the 3-byte header) per message type; INPUT
+# is variable (head + n_status * status + u16-length-prefixed payload)
+_FIXED_BODY = {
+    MSG_SYNC_REQUEST: 4,
+    MSG_SYNC_REPLY: 4,
+    MSG_INPUT_ACK: 4,
+    MSG_QUALITY_REPORT: 9,
+    MSG_QUALITY_REPLY: 8,
+    MSG_CHECKSUM_REPORT: WIRE_CHECKSUM_BODY_SIZE,
+    MSG_KEEP_ALIVE: 0,
+}
+
+# packed little-endian connect-status entry: disconnected u8 + last_frame
+# i32 — itemsize must equal the wire layout or the vectorized status
+# decode below would stride off the format
+_STATUS_DTYPE = np.dtype([("disc", "u1"), ("last", "<i4")])
+assert _STATUS_DTYPE.itemsize == WIRE_STATUS_SIZE
+
+# scalar decode structs (the small-pass twin below)
+_HDR_AT = _struct.Struct("<HB").unpack_from
+_U32_AT = _struct.Struct("<I").unpack_from
+_I32_AT = _struct.Struct("<i").unpack_from
+_U64_AT = _struct.Struct("<Q").unpack_from
+_QREPORT_AT = _struct.Struct("<bQ").unpack_from
+_INPUT_HEAD_AT = _struct.Struct("<iiBB").unpack_from
+_STATUS_ITER = _struct.Struct("<Bi").iter_unpack
+
+# passes at or below this many datagrams decode scalar: numpy's fixed
+# per-op cost (~15 array ops minimum) dwarfs a handful of messages —
+# measured ~10x SLOWER than struct unpacks at 3 datagrams, ~2.4x FASTER
+# at 512. The crossover sits around a few dozen; idle test meshes and
+# single low-traffic sessions live far below it, hosted fleets far above.
+SMALL_BATCH = 24
+
+
+def decode_record(wire: bytes) -> Optional[tuple]:
+    """Scalar twin of batch_decode for small passes: same record layout
+    (kind, magic, a, b, c, statuses, payload), same drop semantics, no
+    numpy and no Message/dataclass objects — just struct unpacks."""
+    n = len(wire)
+    if n < WIRE_HEADER_SIZE:
+        return None
+    magic, kind = _HDR_AT(wire, 0)
+    body = _FIXED_BODY.get(kind)
+    if body is not None:
+        if n < WIRE_HEADER_SIZE + body:
+            return None
+        if kind == MSG_INPUT_ACK:
+            return (kind, magic, _I32_AT(wire, 3)[0], 0, 0, (), b"")
+        if kind == MSG_QUALITY_REPORT:
+            adv, ping = _QREPORT_AT(wire, 3)
+            return (kind, magic, adv, ping, 0, (), b"")
+        if kind == MSG_QUALITY_REPLY:
+            return (kind, magic, _U64_AT(wire, 3)[0], 0, 0, (), b"")
+        if kind in (MSG_SYNC_REQUEST, MSG_SYNC_REPLY):
+            return (kind, magic, _U32_AT(wire, 3)[0], 0, 0, (), b"")
+        if kind == MSG_CHECKSUM_REPORT:
+            return (
+                kind, magic, _I32_AT(wire, 3)[0],
+                int.from_bytes(wire[7:23], "little"), 0, (), b"",
+            )
+        return (kind, magic, 0, 0, 0, (), b"")  # MSG_KEEP_ALIVE
+    if kind == MSG_INPUT:
+        if n < WIRE_HEADER_SIZE + WIRE_INPUT_HEAD_SIZE:
+            return None
+        sf, af, fl, ns = _INPUT_HEAD_AT(wire, 3)
+        so = WIRE_HEADER_SIZE + WIRE_INPUT_HEAD_SIZE
+        po = so + ns * WIRE_STATUS_SIZE
+        if po + 2 > n:
+            return None  # truncated statuses / length prefix
+        blen = wire[po] | (wire[po + 1] << 8)
+        pe = po + 2 + blen
+        if pe > n:
+            return None  # truncated input payload
+        statuses = (
+            tuple(_STATUS_ITER(wire[so:po])) if ns else ()
+        )
+        return (MSG_INPUT, magic, sf, af, fl, statuses, wire[po + 2 : pe])
+    return None  # unknown body type
+
+
+class PumpStaging:
+    """Pooled decode staging: offset/length scratch grown geometrically
+    and reused for every pump pass (the byte pool itself is the pass's
+    joined datagram buffer — one C-speed join, viewed zero-copy)."""
+
+    __slots__ = ("offs", "lens")
+
+    def __init__(self, msgs: int = 256):
+        self.offs = np.empty(msgs + 1, dtype=np.int64)
+        self.lens = np.empty(msgs, dtype=np.int64)
+
+    def ensure(self, n_msgs: int) -> None:
+        if self.lens.shape[0] < n_msgs:
+            cap = self.lens.shape[0]
+            while cap < n_msgs:
+                cap *= 2
+            self.offs = np.empty(cap + 1, dtype=np.int64)
+            self.lens = np.empty(cap, dtype=np.int64)
+
+
+def _gather(pool: np.ndarray, starts: np.ndarray, size: int) -> np.ndarray:
+    """[N, size] uint8 matrix of `size` bytes at each start offset — a
+    fancy-index COPY (contiguous), safe to .view() typed fields out of."""
+    return pool[starts[:, None] + np.arange(size, dtype=np.int64)]
+
+
+def batch_decode(
+    datagrams: Sequence[Tuple[Any, Any, bytes]],
+    staging: Optional[PumpStaging] = None,
+) -> List[Optional[tuple]]:
+    """One-pass batched decode of a whole pump pass's datagrams.
+
+    `datagrams` is [(tag, addr, wire)] in arrival order (tag/addr are
+    opaque routing keys the caller uses at apply time). Returns a list
+    parallel to the input: entry i is None when datagram i is
+    undecodable (same drop semantics as messages.decode_all — short
+    packet, unknown body type, truncated body), else the record tuple
+
+        (kind, magic, a, b, c, statuses, payload)
+
+    whose positional fields match PeerEndpoint.handle_decoded: `a`/`b`/
+    `c` carry the type's scalar fields (e.g. INPUT: a=start_frame,
+    b=ack_frame, c=flags; CHECKSUM_REPORT: a=frame, b=checksum),
+    `statuses` is [(disconnected, last_frame)] and `payload` the
+    compressed input bytes for INPUT messages, else ()/b""."""
+    n = len(datagrams)
+    records: List[Optional[tuple]] = [None] * n
+    if n == 0:
+        return records
+    staging = staging if staging is not None else _SHARED_STAGING
+
+    # staging fill: ONE C-speed join into the pass's byte pool (a Python
+    # per-datagram copy loop costs more than the whole vectorized decode)
+    # + pooled offset/length scratch
+    wires = [w for _, _, w in datagrams]
+    joined = b"".join(wires)
+    pool = np.frombuffer(joined, dtype=np.uint8)
+    staging.ensure(n)
+    offs, lens = staging.offs, staging.lens
+    lens_n = lens[:n]
+    lens_n[:] = [len(w) for w in wires]
+    offs[0] = 0
+    np.cumsum(lens_n, out=offs[1 : n + 1])
+    offs_n = offs[:n]
+    valid = np.flatnonzero(lens_n >= WIRE_HEADER_SIZE)
+    if valid.shape[0] == 0:
+        return records
+    vo = offs_n[valid]
+    magic = pool[vo].astype(np.int64) | (pool[vo + 1].astype(np.int64) << 8)
+    btype = pool[vo + 2]
+
+    # -- fixed-size bodies: one vectorized extraction pass per type ----
+    for kind, body in _FIXED_BODY.items():
+        sel = btype == kind
+        if not sel.any():
+            continue
+        ok = sel & (lens_n[valid] >= WIRE_HEADER_SIZE + body)
+        idxs = valid[ok]
+        if idxs.shape[0] == 0:
+            continue
+        starts = offs_n[idxs] + WIRE_HEADER_SIZE
+        mags = magic[ok].tolist()
+        rows = idxs.tolist()
+        if kind in (MSG_SYNC_REQUEST, MSG_SYNC_REPLY):
+            vals = _gather(pool, starts, 4).view("<u4").ravel().tolist()
+            for i, m, v in zip(rows, mags, vals):
+                records[i] = (kind, m, v, 0, 0, (), b"")
+        elif kind == MSG_INPUT_ACK:
+            vals = _gather(pool, starts, 4).view("<i4").ravel().tolist()
+            for i, m, v in zip(rows, mags, vals):
+                records[i] = (kind, m, v, 0, 0, (), b"")
+        elif kind == MSG_QUALITY_REPORT:
+            advs = pool[starts].astype(np.int8).tolist()
+            pings = _gather(pool, starts + 1, 8).view("<u8").ravel().tolist()
+            for i, m, adv, ping in zip(rows, mags, advs, pings):
+                records[i] = (kind, m, adv, ping, 0, (), b"")
+        elif kind == MSG_QUALITY_REPLY:
+            vals = _gather(pool, starts, 8).view("<u8").ravel().tolist()
+            for i, m, v in zip(rows, mags, vals):
+                records[i] = (kind, m, v, 0, 0, (), b"")
+        elif kind == MSG_CHECKSUM_REPORT:
+            frames = _gather(pool, starts, 4).view("<i4").ravel().tolist()
+            for i, m, f, st in zip(rows, mags, frames, starts.tolist()):
+                records[i] = (
+                    kind, m, f,
+                    int.from_bytes(joined[st + 4 : st + 20], "little"),
+                    0, (), b"",
+                )
+        else:  # MSG_KEEP_ALIVE
+            for i, m in zip(rows, mags):
+                records[i] = (kind, m, 0, 0, 0, (), b"")
+
+    # -- INPUT: vectorized head, per-message statuses + payload --------
+    sel = (btype == MSG_INPUT) & (
+        lens_n[valid] >= WIRE_HEADER_SIZE + WIRE_INPUT_HEAD_SIZE
+    )
+    idxs = valid[sel]
+    if idxs.shape[0]:
+        starts = offs_n[idxs] + WIRE_HEADER_SIZE
+        head = _gather(pool, starts, WIRE_INPUT_HEAD_SIZE)
+        start_frames = head[:, 0:4].copy().view("<i4").ravel().tolist()
+        ack_frames = head[:, 4:8].copy().view("<i4").ravel().tolist()
+        flags = head[:, 8].tolist()
+        n_statuses = head[:, 9].tolist()
+        mags = magic[sel].tolist()
+        ends = (offs_n[idxs] + lens_n[idxs]).tolist()
+        sstarts = (starts + WIRE_INPUT_HEAD_SIZE).tolist()
+        for i, m, sf, af, fl, ns, so, end in zip(
+            idxs.tolist(), mags, start_frames, ack_frames, flags,
+            n_statuses, sstarts, ends,
+        ):
+            po = so + ns * WIRE_STATUS_SIZE
+            if po + 2 > end:
+                continue  # truncated statuses / length prefix
+            blen = joined[po] | (joined[po + 1] << 8)
+            pe = po + 2 + blen
+            if pe > end:
+                continue  # truncated input payload
+            statuses = (
+                pool[so:po].view(_STATUS_DTYPE).tolist() if ns else ()
+            )
+            records[i] = (
+                MSG_INPUT, m, sf, af, fl, statuses,
+                joined[po + 2 : pe],
+            )
+    return records
+
+
+_SHARED_STAGING = PumpStaging()
+
+
+def record_to_message(rec: tuple, wire: bytes):
+    """Rebuild the legacy Message object a record denotes — the parity
+    seam the fuzz suite compares against decode_all (never on the hot
+    path)."""
+    from ..sync_layer import ConnectionStatus
+    from .messages import (
+        ChecksumReport,
+        InputAck,
+        InputMsg,
+        KeepAlive,
+        Message,
+        QualityReply,
+        QualityReport,
+        SyncReply,
+        SyncRequest,
+    )
+
+    kind, magic, a, b, c, statuses, payload = rec
+    if kind == MSG_SYNC_REQUEST:
+        body = SyncRequest(a)
+    elif kind == MSG_SYNC_REPLY:
+        body = SyncReply(a)
+    elif kind == MSG_INPUT:
+        body = InputMsg(
+            peer_connect_status=[
+                ConnectionStatus(bool(d), f) for d, f in statuses
+            ],
+            disconnect_requested=bool(c & 1),
+            start_frame=a,
+            ack_frame=b,
+            bytes_=payload,
+        )
+    elif kind == MSG_INPUT_ACK:
+        body = InputAck(a)
+    elif kind == MSG_QUALITY_REPORT:
+        body = QualityReport(a, b)
+    elif kind == MSG_QUALITY_REPLY:
+        body = QualityReply(a)
+    elif kind == MSG_CHECKSUM_REPORT:
+        body = ChecksumReport(checksum=b, frame=a)
+    elif kind == MSG_KEEP_ALIVE:
+        body = KeepAlive()
+    else:
+        raise ValueError(f"unknown record kind {kind}")
+    return Message(magic, body, _wire=bytes(wire))
+
+
+def host_tax_histogram():
+    """Get-or-create THE ggrs_host_tax_ms instrument — one definition
+    shared by WirePump (phase=pump) and SessionHost (parse/drain), so
+    the help text and buckets cannot drift between registration sites."""
+    return GLOBAL_TELEMETRY.registry.histogram(
+        "ggrs_host_tax_ms",
+        "host-side tax per tick, split by phase "
+        "(pump = socket drain + batched decode/apply + protocol "
+        "timers + batched send; parse = request-grammar staging; "
+        "drain = checksum-ledger/fence drains)",
+        ("phase",),
+        buckets=LOG2_BUCKETS_MS,
+    )
+
+
+class WirePump:
+    """Reusable fleet pump: drain every session's socket, batch-decode
+    the union in one pooled pass, apply records in arrival order, then
+    run each session's timer/event phase and ship the queued sends as
+    per-socket batches. One instance serves a whole SessionHost (or a
+    single standalone session via the module-default pump).
+
+    A session participates through three small hooks (P2PSession and
+    SpectatorSession both provide them):
+      - `_pump_routes()` -> {addr: ((endpoint, handle_decoded|None,
+        handle_wire|None), ...)} — the per-address dispatch table;
+      - `_pump_post(wire_out)` — frame-advantage update, endpoint
+        timers, event handling, and send drain into `wire_out` (or the
+        legacy per-message send when `wire_out` is None);
+      - `socket` — must expose receive_all_wire/send_wire_batch for the
+        batched path; anything else falls back to the session's legacy
+        `_poll_legacy()` loop, unbatched but identical in behavior."""
+
+    __slots__ = ("staging", "_m_batch", "_m_tax")
+
+    def __init__(self):
+        self.staging = PumpStaging()
+        _reg = GLOBAL_TELEMETRY.registry
+        self._m_batch = _reg.histogram(
+            "ggrs_pump_batch_msgs",
+            "datagrams decoded per batched pump pass",
+            buckets=LOG2_BUCKETS,
+        )
+        self._m_tax = host_tax_histogram().labels("pump")
+
+    def pump(
+        self, sessions: Sequence[Any], isolate: bool = False
+    ) -> List[Tuple[Any, Exception]]:
+        """One batched pump pass over `sessions` (any mix of P2P and
+        spectator sessions). With `isolate=False` (standalone use) a
+        GGRSError from a session's protocol handlers propagates, exactly
+        like the legacy per-session poll; `isolate=True` (SessionHost
+        fleets) quarantines it to the raising session and returns the
+        (session, error) pairs so the rest of the fleet keeps pumping."""
+        tel = GLOBAL_TELEMETRY
+        t0 = _time.perf_counter() if tel.enabled else 0.0
+        errors: List[Tuple[Any, Exception]] = []
+
+        datagrams: List[Tuple[int, Any, bytes]] = []
+        batched: List[Any] = []
+        for s in sessions:
+            recv = getattr(s.socket, "receive_all_wire", None)
+            if recv is None or not s.batched_pump:
+                try:
+                    s._poll_legacy()
+                except GGRSError as exc:
+                    if not isolate:
+                        raise
+                    errors.append((s, exc))
+                continue
+            si = len(batched)
+            batched.append(s)
+            for addr, wire in recv():
+                datagrams.append((si, addr, wire))
+
+        failed: set = set()
+        if datagrams:
+            if len(datagrams) <= SMALL_BATCH:
+                records = [decode_record(w) for _, _, w in datagrams]
+            else:
+                records = batch_decode(datagrams, self.staging)
+            route_cache: List[Optional[dict]] = [None] * len(batched)
+            for (si, addr, wire), rec in zip(datagrams, records):
+                if rec is None or si in failed:
+                    continue
+                routes = route_cache[si]
+                if routes is None:
+                    routes = route_cache[si] = batched[si]._pump_routes()
+                try:
+                    for _ep, fast, raw in routes.get(addr, ()):
+                        if fast is not None:
+                            fast(
+                                rec[0], rec[1], len(wire),
+                                rec[2], rec[3], rec[4], rec[5], rec[6],
+                            )
+                        elif raw is not None:
+                            raw(wire)
+                except GGRSError as exc:
+                    if not isolate:
+                        raise
+                    failed.add(si)
+                    errors.append((batched[si], exc))
+
+        for si, s in enumerate(batched):
+            if si in failed:
+                continue
+            try:
+                sink = getattr(s.socket, "send_wire_batch", None)
+                if sink is None:
+                    s._pump_post(None)
+                else:
+                    out: List[Tuple[bytes, Any]] = []
+                    s._pump_post(out)
+                    if out:
+                        sink(out)
+            except GGRSError as exc:
+                if not isolate:
+                    raise
+                errors.append((s, exc))
+
+        if tel.enabled:
+            self._m_batch.observe(len(datagrams))
+            self._m_tax.observe((_time.perf_counter() - t0) * 1000.0)
+        return errors
+
+
+# module-default pump: standalone sessions (no SessionHost) share one —
+# the staging pool then serves every session in the process exactly as
+# the host's does for its fleet
+GLOBAL_PUMP = WirePump()

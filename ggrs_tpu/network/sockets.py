@@ -78,6 +78,15 @@ class UdpNonBlockingSocket:
         """Pre-encoded fast path used by native endpoints."""
         self.sock.sendto(check_datagram_size(wire), addr)
 
+    def send_wire_batch(self, batch: List[Tuple[bytes, Any]]) -> None:
+        """sendmmsg-style drain: ship one pump pass's (wire, addr) pairs
+        in a single call — CPython exposes no sendmmsg(2), so this is a
+        bound-method sendto loop, which still amortizes the per-message
+        Python dispatch the legacy send path paid."""
+        sendto = self.sock.sendto
+        for wire, addr in batch:
+            sendto(check_datagram_size(wire), addr)
+
     def receive_all_wire(self) -> List[Tuple[Any, bytes]]:
         """Raw datagrams (pre-codec): used by native endpoints and the
         authenticated-transport wrapper, which must see exact wire bytes."""
@@ -170,6 +179,14 @@ class InMemorySocket:
         same datagram bound as the real UDP socket so the virtual network
         never delivers a message the real transport would truncate."""
         self.net._deliver(self.addr, addr, check_datagram_size(wire))
+
+    def send_wire_batch(self, batch: List[Tuple[bytes, Any]]) -> None:
+        """Batched drain (UdpNonBlockingSocket.send_wire_batch's virtual
+        twin): same per-datagram bound and fault model, one call."""
+        deliver = self.net._deliver
+        src = self.addr
+        for wire, addr in batch:
+            deliver(src, addr, check_datagram_size(wire))
 
     def receive_all_wire(self) -> List[Tuple[Any, bytes]]:
         return self.net._drain_wire(self.addr)

@@ -175,6 +175,11 @@ class TcpDatagramSocket:
         conn.queue(_DATA, wire)
         conn.flush()
 
+    def send_wire_batch(self, batch) -> None:
+        """Batched drain: per-datagram framing on the stream, one call."""
+        for wire, addr in batch:
+            self.send_wire(wire, addr)
+
     def send_to(self, msg: Message, addr: Any) -> None:
         self.send_wire(encode_message(msg), addr)
 

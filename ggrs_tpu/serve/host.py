@@ -35,6 +35,7 @@ hosted session's own section.
 
 from __future__ import annotations
 
+import time as _time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -148,8 +149,8 @@ class SessionHost:
                  max_inflight_rows: Optional[int] = None,
                  clock: Optional[Clock] = None,
                  idle_timeout_ms: int = DEFAULT_IDLE_TIMEOUT_MS,
-                 async_inflight: int = 2, warmup: bool = False,
-                 depth_routing: bool = True):
+                 async_inflight: int = 4, warmup: bool = False,
+                 depth_routing: bool = True, batched_pump: bool = True):
         """`max_inflight_rows`: the device-window budget — session tick
         rows admitted past the fence before ready sessions start queuing
         (default: 2 full megabatches' worth). `idle_timeout_ms`: sessions
@@ -160,7 +161,17 @@ class SessionHost:
         rollback depth and dispatches one megabatch per occupied depth
         bucket — zero-rollback ticks ride a dedicated fast program —
         instead of dragging every row to the full window; False pins the
-        single full-window megabatch (the parity suite's reference)."""
+        single full-window megabatch (the parity suite's reference).
+        `batched_pump=True` drains the WHOLE fleet's sockets through one
+        pooled batched decode pass per host tick (network/pump.py) —
+        one pass per message type over the union of every session's
+        datagrams — instead of N per-message `poll_remote_clients`
+        loops; False pins the legacy per-session pump (the parity
+        suite's reference). `async_inflight` defaults to 4 megabatches
+        (was 2): a wider fence keeps the steady-state tick from ever
+        blocking on the oldest dispatch while the checksum ledger drains
+        off the pump pass."""
+        from ..network.pump import WirePump, host_tax_histogram
         from ..tpu.backend import MultiSessionDeviceCore
 
         self.device = MultiSessionDeviceCore(
@@ -215,6 +226,13 @@ class SessionHost:
             "host ticks a session's staged rows waited before dispatch",
             buckets=SESSION_COUNT_BUCKETS,
         )
+        # fleet-wide batched wire pump + host-tax attribution (the pump
+        # phase's own child is observed inside WirePump.pump; the shared
+        # instrument is defined once, in network/pump.py)
+        self.batched_pump = batched_pump
+        self._pump = WirePump()
+        self._m_tax_parse = host_tax_histogram().labels("parse")
+        self._m_tax_drain = host_tax_histogram().labels("drain")
         if warmup:
             self.device.warmup()
 
@@ -291,6 +309,11 @@ class SessionHost:
 
         # the hook raises on double-attach BEFORE we commit a slot
         session.on_host_attach(self, key)
+        if not self.batched_pump:
+            # the legacy-pump host is the parity reference: its sessions
+            # must pump per-message too, or the "pre-batched" arm would
+            # still ride the batched single-session pump underneath
+            session.batched_pump = False
         slot = self._free_slots.pop()
         self.device.reset_slot(slot)
         self._lanes[key] = _Lane(
@@ -369,16 +392,32 @@ class SessionHost:
     def _tick_impl(self) -> Dict[Any, List[Event]]:
         self._tick_index += 1
         events: Dict[Any, List[Event]] = {}
+        tel = GLOBAL_TELEMETRY
 
         # 1. pump: every session's sockets drain every host tick, even for
         # sessions that won't advance — protocol liveness (sync handshake,
-        # quality reports, disconnect timers) must not depend on input
+        # quality reports, disconnect timers) must not depend on input.
+        # Batched: ONE pooled decode pass over the union of the fleet's
+        # datagrams (network/pump.py), per-session errors quarantined;
+        # legacy: N per-session poll loops (the parity reference).
         with GLOBAL_TRACER.span("host/pump", absolute=True):
-            for lane in list(self._lanes.values()):
-                try:
-                    lane.session.poll_remote_clients()
-                except GGRSError as exc:  # keep serving the rest
-                    lane.last_error = type(exc).__name__
+            lanes = list(self._lanes.values())
+            if self.batched_pump:
+                errors = self._pump.pump(
+                    [lane.session for lane in lanes], isolate=True
+                )
+                for sess, exc in errors:
+                    for lane in lanes:
+                        if lane.session is sess:
+                            lane.last_error = type(exc).__name__
+                            break
+            else:
+                for lane in lanes:
+                    try:
+                        lane.session.poll_remote_clients()
+                    except GGRSError as exc:  # keep serving the rest
+                        lane.last_error = type(exc).__name__
+            for lane in lanes:
                 evs = lane.session.events()
                 if evs:
                     events[lane.key] = evs
@@ -389,7 +428,20 @@ class SessionHost:
                         if type(ev).__name__ == "DesyncDetected":
                             self.desyncs_observed += 1
 
+        # 1b. drain pass: retire ready fence entries and resolve every
+        # host-ready checksum batch OFF the tick path — with the batched
+        # checksum pump in the sessions, the steady-state tick never
+        # blocks on a device->host transfer (drain_blocked_ticks == 0)
+        t_drain = _time.perf_counter() if tel.enabled else 0.0
+        self.device.ledger.drain_ready()
+        self.device.poll_retired()
+        if tel.enabled:
+            self._m_tax_drain.observe(
+                (_time.perf_counter() - t_drain) * 1000.0
+            )
+
         # 2. advance ready sessions and stage their rows
+        t_parse = _time.perf_counter() if tel.enabled else 0.0
         with GLOBAL_TRACER.span("host/advance", absolute=True):
             for lane in list(self._lanes.values()):
                 if not self._lane_ready(lane):
@@ -437,6 +489,10 @@ class SessionHost:
                 if lane.rows and lane.queued_since_tick is None:
                     lane.queued_since_tick = self._tick_index
                     self._ready.append(lane.key)
+        if tel.enabled:
+            self._m_tax_parse.observe(
+                (_time.perf_counter() - t_parse) * 1000.0
+            )
 
         # 3. dispatch megabatches under the device-window budget
         self._pump_device()

@@ -18,6 +18,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence
 from ..errors import InvalidRequest, NotSynchronized
 from ..frame_info import PlayerInput
 from ..network.network_stats import NetworkStats
+from ..network.pump import GLOBAL_PUMP
 from ..network.protocol import (
     MAX_CHECKSUM_HISTORY_SIZE,
     EvDisconnected,
@@ -139,6 +140,22 @@ class P2PSession:
         self.local_checksum_history: Dict[Frame, int] = {}
         self._pending_checksum_report = PendingChecksumReport()
         self._wire_dispatch = None  # decided on first poll (socket+endpoints)
+        # batched wire pump (network/pump.py): pooled one-pass decode +
+        # field-level apply + batched sends. False pins the legacy
+        # per-message loop — the parity suite's reference arm.
+        self.batched_pump = True
+        self._pump_routes_cache = None
+        # monotonic advance counter: stamps checksum-report captures so
+        # the pump-side flush stays behind the capture frontier
+        self._advance_serial = 0
+        # ticks whose interval-forced checksum flush had to BLOCK on a
+        # device transfer (the host tax the pump-side drain removes);
+        # plain int always maintained, registry counter behind enabled
+        self.drain_blocked_ticks = 0
+        self._m_drain_blocked = GLOBAL_TELEMETRY.registry.counter(
+            "ggrs_drain_blocked_ticks_total",
+            "ticks whose forced checksum flush blocked on a device drain",
+        )
         # desyncs already dumped to a forensics bundle: comparison intervals
         # re-detect the same divergence every pass, one dump per (peer,
         # frame) is the useful quantity
@@ -215,6 +232,7 @@ class P2PSession:
             self.poll_remote_clients()
         if self.state != SessionState.RUNNING:
             raise NotSynchronized()
+        self._advance_serial += 1
 
         requests: List[Request] = []
 
@@ -307,6 +325,33 @@ class P2PSession:
                 for ep in list(self.player_reg.remotes.values())
                 + list(self.player_reg.spectators.values())
             )
+        if (
+            self.batched_pump
+            and not self._wire_dispatch
+            and hasattr(self.socket, "receive_all_wire")
+        ):
+            # batched pump: pooled one-pass decode + field-level apply
+            # (network/pump.py) — the all-native session keeps its raw
+            # wire lane below, where Python decode would be pure overhead
+            GLOBAL_PUMP.pump((self,))
+        else:
+            self._poll_legacy()
+
+    def _poll_legacy(self) -> None:
+        """The unbatched per-message pump: one decode + one
+        handle_message per datagram. Kept as the parity reference
+        (batched_pump=False) and the fallback for sockets without a
+        wire lane; all-native sessions route here for their raw
+        socket -> C++ dispatch."""
+        if self._wire_dispatch is None:
+            # reached directly via the pump's fallback lane: make the
+            # same socket+endpoint decision _poll_remote_clients_impl
+            # would have
+            self._wire_dispatch = hasattr(self.socket, "receive_all_wire") and all(
+                hasattr(ep, "handle_wire")
+                for ep in list(self.player_reg.remotes.values())
+                + list(self.player_reg.spectators.values())
+            )
         if self._wire_dispatch:
             for from_addr, wire in self.socket.receive_all_wire():
                 endpoint = self.player_reg.remotes.get(from_addr)
@@ -323,27 +368,79 @@ class P2PSession:
                 endpoint = self.player_reg.spectators.get(from_addr)
                 if endpoint is not None:
                     endpoint.handle_message(msg)
+        self._pump_post(None)
 
-        for endpoint in self.player_reg.remotes.values():
+    def _pump_routes(self) -> dict:
+        """addr -> ((endpoint, handle_decoded | None, handle_wire |
+        None), ...): the batched pump's per-address dispatch table.
+        Built once — the endpoint registry is fixed at session build."""
+        routes = self._pump_routes_cache
+        if routes is None:
+            routes = {}
+            for reg in (self.player_reg.remotes, self.player_reg.spectators):
+                for addr, ep in reg.items():
+                    routes.setdefault(addr, []).append((
+                        ep,
+                        getattr(ep, "handle_decoded", None),
+                        getattr(ep, "handle_wire", None),
+                    ))
+            routes = {a: tuple(v) for a, v in routes.items()}
+            self._pump_routes_cache = routes
+        return routes
+
+    def _pump_post(self, wire_out=None) -> None:
+        """Timer/event/send phase of one pump pass, shared verbatim by
+        the batched pump and the legacy loop. `wire_out` collects
+        (wire, addr) pairs for a batched socket drain; None sends
+        per-message as before."""
+        remotes = self.player_reg.remotes
+        spectators = self.player_reg.spectators
+        current = self.sync_layer.current_frame
+        for endpoint in remotes.values():
             if endpoint.is_running():
-                endpoint.update_local_frame_advantage(self.sync_layer.current_frame)
+                endpoint.update_local_frame_advantage(current)
 
+        endpoints = list(remotes.values()) + list(spectators.values())
+        now = endpoints[0].clock.now_ms() if endpoints else None
         events = []
-        for endpoint in list(self.player_reg.remotes.values()) + list(
-            self.player_reg.spectators.values()
-        ):
+        for endpoint in endpoints:
             handles = list(endpoint.handles)
             addr = endpoint.peer_addr
-            for event in endpoint.poll(self.local_connect_status):
+            for event in endpoint.poll(self.local_connect_status, now):
                 events.append((event, handles, addr))
 
         for event, handles, addr in events:
             self._handle_event(event, handles, addr)
 
-        for endpoint in self.player_reg.remotes.values():
-            endpoint.send_all_messages(self.socket)
-        for endpoint in self.player_reg.spectators.values():
-            endpoint.send_all_messages(self.socket)
+        # drain-free tick: resolve desync-detection checksums during the
+        # pump, not the tick — see _pump_checksums
+        self._pump_checksums()
+
+        if wire_out is None:
+            for endpoint in endpoints:
+                endpoint.send_all_messages(self.socket)
+        else:
+            for endpoint in endpoints:
+                endpoint.drain_sends(wire_out)
+
+    def _pump_checksums(self) -> None:
+        """Opportunistic, non-blocking drain of pending desync-detection
+        reports on the pump pass: resolve the host-ready ones, prefetch
+        the oldest still-in-flight one, so the interval-forced flush in
+        _check_checksum_send_interval finds the bytes already moved and
+        the tick path never blocks on a checksum transfer in steady
+        state. Entries captured within the last two advances are left
+        untouched (max_serial): their frame's correcting rollback may
+        still sit in an unfulfilled — or, hosted, un-dispatched —
+        request list, and binding the getter early would publish a
+        mid-correction checksum."""
+        pcr = self._pending_checksum_report
+        if len(pcr):
+            pcr.flush(
+                force=False,
+                emit=self._emit_checksum_report,
+                max_serial=self._advance_serial - 2,
+            )
 
     def disconnect_player(self, player_handle: PlayerHandle) -> None:
         """(src/sessions/p2p_session.rs:430-456)"""
@@ -656,9 +753,16 @@ class P2PSession:
         # at tick t covers a frame whose *correcting* rollback may still be
         # in tick t's (unfulfilled) request list — PendingChecksumReport
         # reads the value on a later tick, once the cell is final.
-        self._pending_checksum_report.flush(
+        blocked = self._pending_checksum_report.flush(
             force=current % interval == interval - 1, emit=self._emit_checksum_report
         )
+        if blocked:
+            # the pump-side drain (_pump_checksums) exists to keep this
+            # zero: a nonzero rate means the tick path still pays device
+            # transfers (scripts/check.sh --pump-smoke gates on it)
+            self.drain_blocked_ticks += 1
+            if GLOBAL_TELEMETRY.enabled:
+                self._m_drain_blocked.inc()
         # Deliberate divergence from the reference (p2p_session.rs:903): it
         # reports last_saved-1, which under misprediction is a *speculative*
         # frame — both peers would checksum half-predicted states and raise
@@ -669,7 +773,9 @@ class P2PSession:
             cell = self.sync_layer.saved_state_by_frame(frame_to_send)
             # the confirmed frame may have rotated out of the snapshot ring
             if cell is not None:
-                self._pending_checksum_report.capture(frame_to_send, cell)
+                self._pending_checksum_report.capture(
+                    frame_to_send, cell, serial=self._advance_serial
+                )
         if len(self.local_checksum_history) > MAX_CHECKSUM_HISTORY_SIZE:
             keep_after = current - MAX_CHECKSUM_HISTORY_SIZE
             self.local_checksum_history = {

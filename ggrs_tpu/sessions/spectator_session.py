@@ -16,6 +16,7 @@ from ..errors import NotSynchronized, PredictionThreshold, SpectatorTooFarBehind
 from ..frame_info import PlayerInput
 from ..network.network_stats import NetworkStats
 from ..obs import GLOBAL_TELEMETRY
+from ..network.pump import GLOBAL_PUMP
 from ..network.protocol import (
     EvDisconnected,
     EvInput,
@@ -74,6 +75,9 @@ class SpectatorSession:
         # serve-host attachment (same contract as P2PSession's hooks)
         self._host = None
         self._host_key = None
+        # batched wire pump toggle + route cache (see P2PSession's twins)
+        self.batched_pump = True
+        self._pump_routes_cache = None
 
     def on_host_attach(self, host: Any, key: Any) -> None:
         """SessionHost.attach hook; see P2PSession.on_host_attach."""
@@ -153,15 +157,41 @@ class SpectatorSession:
         return requests
 
     def poll_remote_clients(self) -> None:
+        if self.batched_pump and hasattr(self.socket, "receive_all_wire"):
+            GLOBAL_PUMP.pump((self,))
+        else:
+            self._poll_legacy()
+
+    def _poll_legacy(self) -> None:
+        """Unbatched per-message pump (the batched_pump=False parity
+        reference and the fallback for sockets without a wire lane)."""
         for from_addr, msg in self.socket.receive_all_messages():
             if self.host.is_handling_message(from_addr):
                 self.host.handle_message(msg)
+        self._pump_post(None)
 
+    def _pump_routes(self) -> dict:
+        """Batched-pump dispatch table: the one host endpoint."""
+        routes = self._pump_routes_cache
+        if routes is None:
+            routes = {
+                self.host.peer_addr: ((
+                    self.host,
+                    getattr(self.host, "handle_decoded", None),
+                    getattr(self.host, "handle_wire", None),
+                ),),
+            }
+            self._pump_routes_cache = routes
+        return routes
+
+    def _pump_post(self, wire_out=None) -> None:
         addr = self.host.peer_addr
         for event in self.host.poll(self.host_connect_status):
             self._handle_event(event, addr)
-
-        self.host.send_all_messages(self.socket)
+        if wire_out is None:
+            self.host.send_all_messages(self.socket)
+        else:
+            self.host.drain_sends(wire_out)
 
     def _inputs_at_frame(self, frame_to_grab: Frame):
         """(src/sessions/p2p_spectator_session.rs:173-202)"""

@@ -143,42 +143,66 @@ class PendingChecksumReport:
     def __len__(self) -> int:
         return len(self._pending)
 
-    def capture(self, frame: Frame, cell: GameStateCell) -> None:
-        self._pending.append([frame, cell, None])
+    def capture(self, frame: Frame, cell: GameStateCell, serial: int = 0) -> None:
+        """`serial` stamps the capturing tick (a monotonic advance
+        counter): non-forced flushes can then skip entries whose
+        capturing tick's requests may not be fulfilled yet (see
+        `max_serial` below)."""
+        self._pending.append([frame, cell, None, serial])
         while len(self._pending) > self.MAX_PENDING:
             self._pending.popleft()
 
-    def flush(self, force: bool, emit) -> None:
+    def flush(self, force: bool, emit, max_serial: Optional[int] = None) -> int:
         """emit(frame, checksum) is called at most once per captured report,
-        in capture order."""
+        in capture order. Returns the number of reports that were resolved
+        while NOT host-ready — i.e. forced resolutions that blocked on a
+        device transfer (the drain the pump-side flush exists to make
+        zero in steady state).
+
+        `max_serial` (pump-side, non-forced drains): only entries whose
+        capture serial is <= it are bound/resolved — a report captured at
+        tick t covers a frame whose *correcting* rollback may still sit
+        in tick t's unfulfilled (or, hosted, un-dispatched) request list,
+        so an opportunistic mid-run flush must stay a couple of advances
+        behind the capture frontier; the interval-forced flush passes
+        None and drains everything, exactly as before."""
         from collections import deque
 
-        # bind a getter for EVERY queued report first, not just the head:
-        # binding is cheap and non-blocking, getters are stable across
-        # later ring-slot reuse, and a younger report's slot can be
-        # overwritten while an older value is still in flight — binding
-        # lazily at the head would drop reports that were perfectly
-        # capturable when they queued
+        # bind a getter for EVERY queued (old-enough) report first, not
+        # just the head: binding is cheap and non-blocking, getters are
+        # stable across later ring-slot reuse, and a younger report's
+        # slot can be overwritten while an older value is still in
+        # flight — binding lazily at the head would drop reports that
+        # were perfectly capturable when they queued
         bound = deque()
         for entry in self._pending:
-            frame, cell, getter = entry
+            frame, cell, getter, serial = entry
             if getter is None:
+                if max_serial is not None and serial > max_serial:
+                    bound.append(entry)  # too fresh to bind yet
+                    continue
                 if cell.frame != frame:  # ring slot reused before first read
                     continue
                 entry[2] = cell.checksum_getter()
             bound.append(entry)
         self._pending = bound
+        blocked = 0
         while self._pending:
-            frame, _cell, getter = self._pending[0]
-            if not force and not getattr(getter, "ready", True):
-                prefetch = getattr(getter, "prefetch", None)
-                if callable(prefetch):
-                    prefetch()
-                return
+            frame, _cell, getter, serial = self._pending[0]
+            if getter is None:  # still inside the serial guard
+                return blocked
+            if not getattr(getter, "ready", True):
+                if not force:
+                    prefetch = getattr(getter, "prefetch", None)
+                    if callable(prefetch):
+                        prefetch()
+                    return blocked
+                blocked += 1
             self._pending.popleft()
             checksum = getter()
             if checksum is not None:
                 emit(frame, checksum)
+        return blocked
 
 
 class SavedStates:

@@ -133,6 +133,26 @@ class ChecksumLedger:
         if len(self._pending) > self.MAX_PENDING:
             del self._pending[: -self.MAX_PENDING]
 
+    def drain_ready(self) -> int:
+        """Non-blocking drain for the pump pass (the drain-free tick):
+        resolve every pending batch whose device arrays are already
+        host-ready — a host-memory copy, no transfer wait — and start a
+        background host copy on the oldest still-executing batch so the
+        next pass (or a forced flush) finds its bytes moved. Returns the
+        number of batches still pending."""
+        still: List[_ChecksumBatch] = []
+        for b in self._pending:
+            if b._np is not None:
+                continue
+            if b.ready:
+                b._store(b._his, b._los)
+            else:
+                still.append(b)
+        self._pending = still
+        if still:
+            still[0].prefetch()
+        return len(still)
+
     def flush(self) -> None:
         todo = [b for b in self._pending if b._np is None]
         self._pending.clear()
@@ -393,7 +413,7 @@ class TpuRollbackBackend:
                  speculation_gate: str = "always",
                  defer_speculation: bool = False, lazy_ticks: int = 0,
                  spec_backend: str = "auto", tick_backend: str = "auto",
-                 async_dispatch: bool = False, async_inflight: int = 2,
+                 async_dispatch: bool = False, async_inflight: int = 4,
                  plan_cache: Optional["DispatchPlanCache"] = None,
                  depth_routing: bool = True):
         """`mesh`: optional jax Mesh with an `entity` axis — the world and
@@ -1729,7 +1749,7 @@ class MultiSessionDeviceCore:
     identical values)."""
 
     def __init__(self, game, max_prediction: int, num_players: int,
-                 capacity: int, *, async_inflight: int = 2,
+                 capacity: int, *, async_inflight: int = 4,
                  plan_cache: Optional[DispatchPlanCache] = None,
                  buckets: Optional[Sequence[int]] = None,
                  depth_routing: bool = True):

@@ -42,6 +42,15 @@ constexpr int32_t INT32_MAX_SAFE =
     0x7FFFFFFF - 2 * static_cast<int32_t>(PENDING_OUTPUT_SIZE);
 
 // message body type tags (ggrs_tpu/network/messages.py:22-29)
+// wire-layout sizes, named so the WIRE parity lint can pin them against
+// messages.py's twins (WIRE_HEADER_SIZE etc): the Python batched pump
+// (network/pump.py) gathers fields at these offsets out of pooled byte
+// staging, so a drift here would silently desync the stacks
+constexpr size_t WIRE_HEADER_SIZE = 3;          // magic u16 + body_type u8
+constexpr size_t WIRE_INPUT_HEAD_SIZE = 10;     // start/ack i32 + flags + n
+constexpr size_t WIRE_STATUS_SIZE = 5;          // disconnected u8 + frame i32
+constexpr size_t WIRE_CHECKSUM_BODY_SIZE = 20;  // frame i32 + checksum u128
+
 constexpr uint8_t MSG_SYNC_REQUEST = 0;
 constexpr uint8_t MSG_SYNC_REPLY = 1;
 constexpr uint8_t MSG_INPUT = 2;
@@ -400,6 +409,11 @@ struct Endpoint {
   long handle_message(const uint8_t* buf, long n, uint64_t now) {
     // (protocol.py handle_message; reference protocol.rs:544-575)
     if (state == State::kShutdown) return 0;
+    if (n < static_cast<long>(WIRE_HEADER_SIZE)) return -1;
+    static_assert(WIRE_INPUT_HEAD_SIZE == 2 * sizeof(int32_t) + 2 &&
+                      WIRE_STATUS_SIZE == 1 + sizeof(int32_t) &&
+                      WIRE_CHECKSUM_BODY_SIZE == sizeof(int32_t) + 16,
+                  "wire layout constants drifted from the field reads below");
     Reader r{buf, n};
     uint16_t msg_magic = r.u16();
     uint8_t body_type = r.u8();

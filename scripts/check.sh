@@ -29,6 +29,11 @@
 #   path was actually taken and the megabatch jit cache stayed on the
 #   (row x depth) bucket grid, catching silent depth-routing regressions
 #   (scripts/dispatch_smoke.py, CPU jax, <1 min).
+#   --pump-smoke runs a lossy 16-session loadgen fleet and asserts — via
+#   ggrs_pump_batch_msgs / ggrs_drain_blocked_ticks_total — that the
+#   batched wire pump is the taken path and the steady-state tick never
+#   blocked on a checksum device drain (scripts/pump_smoke.py, CPU jax,
+#   <1 min).
 #   --lint runs the determinism/trace/fence/wire static-analysis gate
 #   (python -m ggrs_tpu.analysis, pure AST, no jax, seconds) against
 #   analysis/baseline.toml, then the retrace-sanitizer smoke
@@ -80,6 +85,12 @@ fi
 if [ "${1:-}" = "--dispatch-smoke" ]; then
   echo "== dispatch smoke (depth routing + zero-rollback fast path) =="
   JAX_PLATFORMS=cpu python scripts/dispatch_smoke.py
+  exit $?
+fi
+
+if [ "${1:-}" = "--pump-smoke" ]; then
+  echo "== pump smoke (batched wire pump taken + drain-free tick) =="
+  JAX_PLATFORMS=cpu python scripts/pump_smoke.py
   exit $?
 fi
 
