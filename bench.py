@@ -1827,6 +1827,61 @@ def bench_serve_host(sessions=64, ticks=120, entities=1024):
     }
 
 
+def bench_env_rollout(num_envs=256, steps=200, entities=256, episode_len=64):
+    """The RL-environment workload (ggrs_tpu/env/): env steps/sec through
+    the megabatch path — N rollback worlds stepped as ONE fast-program
+    dispatch per step, opponent rows sampled from the input model,
+    auto-reset cycling episodes mid-rollout. The training analog of
+    bench_serve_host: the same stacked device core, non-interactive
+    traffic, zero host protocol. Warmup/compile excluded; the window is
+    closed with a true barrier."""
+    from ggrs_tpu.env import InputModelOpponent, RollbackEnv, held_value_trace
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.utils.barrier import true_barrier
+
+    trace = held_value_trace([1, 4, 2, 8, 1, 4, 2, 8, 5, 4])
+    game = ExGame(num_players=2, num_entities=entities)
+    env = RollbackEnv(
+        game,
+        num_envs=num_envs,
+        opponents={1: InputModelOpponent(trace, seed=13)},
+        episode_len=episode_len,
+        warmup=True,
+    )
+    obs = env.reset()
+    actions = np.zeros((num_envs, 1), dtype=np.uint8)
+    for t in range(5):  # unrecorded warm pass (obs/reset programs hot)
+        actions[:] = (t * 3 + 1) % 16
+        obs, _, _, _ = env.step(actions)
+    env.reset()
+    true_barrier(env._device.states["frame"])
+    steps_before = env.steps_total
+    t0 = time.perf_counter()
+    for t in range(steps):
+        actions[:] = (t * 3 + 1) % 16
+        obs, reward, done, _ = env.step(actions)
+    true_barrier(env._device.states["frame"])
+    dt = time.perf_counter() - t0
+    dev = env._device
+    return {
+        "num_envs": num_envs,
+        "steps": steps,
+        "entities": entities,
+        "episode_len": episode_len,
+        "env_steps_per_sec": round((env.steps_total - steps_before) / dt, 1),
+        "batch_steps_per_sec": round(steps / dt, 2),
+        "episodes": env.episodes_total,
+        "mean_megabatch_rows": round(
+            dev.rows_dispatched / max(dev.megabatches, 1), 2
+        ),
+        "dispatch_programs": (
+            dev._dispatch_fn._cache_size()
+            + dev._dispatch_fast_fn._cache_size()
+        ),
+        "dispatch_bucket_budget": dev.dispatch_bucket_budget(),
+    }
+
+
 def _obs_enable():
     """Called inside a phase subprocess (see _run_phase)."""
     from ggrs_tpu.obs import enable_global_telemetry
@@ -1950,7 +2005,7 @@ def main():
         "interleaved_spread_pct", "beam_ab_delta_ms", "beam_ab_wins",
         "history_b8_rate", "parity", "async_parity",
         "serve_sessions_per_sec", "serve_occupancy",
-        "serve_fast_dispatch_rate", "headline_source",
+        "serve_fast_dispatch_rate", "env_steps_per_sec", "headline_source",
     )
 
     def _short_line(partial=False, error=None):
@@ -2183,6 +2238,20 @@ def main():
     full["serve_host_scaling"] = {
         "n16": serve16, "n64": serve64, "n256": serve256,
     }
+    # the RL-env workload (ggrs_tpu/env/): env steps/sec on the same
+    # megabatch path, non-interactive training traffic
+    env256 = phase(
+        "env_rollout_n256",
+        f"bench_env_rollout(num_envs=256, steps={40 if SMOKE else 200})",
+        timeout_s=900,
+    )
+    env1024 = phase(
+        "env_rollout_n1024",
+        f"bench_env_rollout(num_envs=1024, steps={20 if SMOKE else 100})",
+        timeout_s=1200,
+    )
+    full["env_steps_per_sec"] = env256["env_steps_per_sec"]
+    full["env_rollout"] = {"n256": env256, "n1024": env1024}
     beam_exec = phase("_beam_exec", "bench_beam_exec()")
     beam_live = phase(
         "_beam_live",

@@ -1,7 +1,8 @@
 """Pass 1 — determinism lint (DET001..DET004).
 
 Scope: the modules whose code runs (or feeds data) inside the simulated
-world — `tpu/`, `models/`, `ops/`, `sync_layer.py`, `input_queue.py`.
+world — `tpu/`, `models/`, `ops/`, `env/`, `sync_layer.py`,
+`input_queue.py`.
 Everything there must be bitwise-replayable across peers: the rollback
 core's desync detection compares full-state checksums, so ANY
 nondeterminism (wall clock, unseeded RNG, CPython object identity,
@@ -28,6 +29,11 @@ SCOPE_PREFIXES = (
     "ggrs_tpu/tpu/",
     "ggrs_tpu/models/",
     "ggrs_tpu/ops/",
+    # the RL env feeds device tick rows and samples opponent behavior:
+    # its snapshot→branch→restore determinism contract is exactly the
+    # replayability DET enforces (opponents draw counter-based uniforms,
+    # never wall clocks or stateful RNG streams)
+    "ggrs_tpu/env/",
     "ggrs_tpu/sync_layer.py",
     "ggrs_tpu/input_queue.py",
 )

@@ -73,11 +73,16 @@ POLICIES: Dict[str, FencePolicy] = {
             ("MultiSessionDeviceCore", "poll_retired"),
             ("MultiSessionDeviceCore", "_acquire_stage"),
             ("MultiSessionDeviceCore", "dispatch"),
+            # dispatch_rows shares the staged tail; the masked batch
+            # reset is the env workload's slot lifecycle (auto-reset)
+            ("MultiSessionDeviceCore", "_dispatch_staged"),
+            ("MultiSessionDeviceCore", "reset_slots_masked"),
             ("MultiSessionDeviceCore", "reset_slot"),
             ("MultiSessionDeviceCore", "warmup"),
             ("MultiSessionDeviceCore", "_warmup_impl"),
             ("MultiSessionDeviceCore", "block_until_ready"),
             ("MultiSessionDeviceCore", "restore"),
+            ("MultiSessionDeviceCore", "load_stacked"),
             # the plan cache's own accounting lives in its own class
             ("DispatchPlanCache", "__init__"),
             ("DispatchPlanCache", "note"),

@@ -1,0 +1,35 @@
+"""Batched RL environment over the rollback core (the training workload).
+
+Usage:
+
+    from ggrs_tpu.env import RollbackEnv, ScriptedOpponent
+    env = RollbackEnv(game, num_envs=1024,
+                      opponents={1: ScriptedOpponent(fn)},
+                      episode_len=256, warmup=True)
+    obs = env.reset()
+    obs, reward, done, info = env.step(actions)
+
+Or mixed with live serving traffic: `host.attach_env(256, ...)` — env
+steps then share the SessionHost's megabatch with P2P session ticks.
+Importing this package does not import jax (RollbackEnv does, lazily).
+"""
+
+from .opponents import (
+    InputModelOpponent,
+    Opponent,
+    ScriptedOpponent,
+    held_value_trace,
+    unit_uniform,
+)
+from .rollback_env import EnvSnapshot, RollbackEnv, env_instruments
+
+__all__ = [
+    "EnvSnapshot",
+    "InputModelOpponent",
+    "Opponent",
+    "RollbackEnv",
+    "ScriptedOpponent",
+    "env_instruments",
+    "held_value_trace",
+    "unit_uniform",
+]

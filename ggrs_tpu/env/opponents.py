@@ -1,0 +1,252 @@
+"""Opponent layer for the batched RL environment (ggrs_tpu/env/).
+
+A `RollbackEnv` world carries `game.num_players` player handles; the
+trainer's policy drives the agent handles, and every other participating
+handle is driven by an Opponent — the env calls `act(t)` once per step
+and writes the returned rows into the megabatch tick rows exactly where
+a remote peer's inputs would land in the serving workload.
+
+Determinism contract (the env rides the rollback core's bit-parity
+discipline, and the DET lint covers this package): an opponent's output
+must be a pure function of (its seed, the step index, the world index,
+its observed history). Randomized opponents therefore draw COUNTER-BASED
+uniforms — a splitmix64 hash of (seed, t, world) — instead of consuming
+a stateful RNG stream, so a snapshot→branch→restore search episode
+replays byte-identical opponent rows on every branch, and an auto-reset
+world re-converges with a fresh one driven by the same script.
+
+Two concrete opponents:
+
+- `ScriptedOpponent`: a callable `(t, n_envs) -> rows`; the loadgen-style
+  scripted baseline and the parity suite's reference.
+- `InputModelOpponent`: behavior sampled from the PR 1 input model
+  (`tpu/input_model.InputHistoryModel`) — hold the current value, switch
+  with the learned hazard for the current hold length, and pick the next
+  value from the learned transition distribution. Primed from a recorded
+  trace (or any pre-observed model), it generates human-shaped input
+  streams: runs of held values with realistic switch timing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+_M64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+_MIX1 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX2 = np.uint64(0x94D049BB133111EB)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 lanes."""
+    with np.errstate(over="ignore"):
+        x = (x + _GOLDEN) & _M64
+        x = ((x ^ (x >> np.uint64(30))) * _MIX1) & _M64
+        x = ((x ^ (x >> np.uint64(27))) * _MIX2) & _M64
+        return x ^ (x >> np.uint64(31))
+
+
+def unit_uniform(seed: int, t: int, idx: np.ndarray) -> np.ndarray:
+    """Counter-based uniform in [0, 1) per world index: a pure hash of
+    (seed, t, idx) — no RNG state, so replays and branches agree."""
+    with np.errstate(over="ignore"):
+        key = (
+            idx.astype(np.uint64) * np.uint64(0x2545F4914F6CDD1D)
+            ^ (np.uint64(t & 0xFFFFFFFF) * _MIX1)
+            ^ (np.uint64(seed & 0xFFFFFFFF) * _MIX2)
+        ) & _M64
+    return (_splitmix64(key) >> np.uint64(11)).astype(np.float64) * (
+        1.0 / (1 << 53)
+    )
+
+
+def held_value_trace(values, base_hold: int = 3):
+    """Expand a value sequence into a run-length trace for priming
+    InputModelOpponent: value i is held base_hold + (i % 3) frames — the
+    canonical hold/switch workload the bench, smoke gate and tests all
+    prime from (one definition, not four copies)."""
+    trace = []
+    for i, v in enumerate(values):
+        trace += [v] * (base_hold + (i % 3))
+    return trace
+
+
+class Opponent:
+    """Base opponent: bound once to (n_envs, input_size) by the env."""
+
+    n_envs: int = 0
+    input_size: int = 1
+
+    def bind(self, n_envs: int, input_size: int) -> None:
+        self.n_envs = n_envs
+        self.input_size = input_size
+
+    def act(self, t: int) -> np.ndarray:
+        """uint8[n_envs, input_size] rows for step `t`."""
+        raise NotImplementedError
+
+    def on_reset(self, mask: np.ndarray) -> None:
+        """Worlds with mask[i] True just auto-reset (episode boundary):
+        per-world behavioral state restarts there."""
+
+    # search support: snapshot/restore must round-trip any per-world
+    # state an opponent keeps, or branch replays diverge
+    def state_dict(self) -> Optional[dict]:
+        return None
+
+    def load_state_dict(self, state: Optional[dict]) -> None:
+        pass
+
+
+class ScriptedOpponent(Opponent):
+    """Deterministic scripted rows: `fn(t, n_envs)` returns either a
+    scalar input byte (broadcast to every world) or an array-like of
+    shape [n_envs], [n_envs, input_size] — the reference opponent for
+    parity tests and benches."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def act(self, t: int) -> np.ndarray:
+        out = self.fn(t, self.n_envs)
+        if np.isscalar(out):
+            return np.full(
+                (self.n_envs, self.input_size), int(out) & 0xFF, np.uint8
+            )
+        rows = np.asarray(out, dtype=np.uint8)
+        if rows.ndim == 1:
+            assert self.input_size == 1, (
+                "1-D scripted rows need input_size == 1; return "
+                "[n_envs, input_size] for wider inputs"
+            )
+            rows = rows[:, None]
+        assert rows.shape == (self.n_envs, self.input_size)
+        return rows
+
+
+class InputModelOpponent(Opponent):
+    """Behavior sampled from InputHistoryModel statistics.
+
+    Per world: hold the current input value; at step t, switch with
+    probability hazard(hold_len) (a counter-based uniform decides), and
+    a switching world samples its next value from the model's learned
+    transition distribution for the value it held. Worlds with no
+    learned signal hold forever — exactly the reference's repeat-last
+    prediction floor.
+
+    `source` primes the statistics: an `InputHistoryModel` observed
+    elsewhere (its `player` column is read), or a recorded trace — a
+    sequence of input rows (bytes / ints) observed in order.
+    """
+
+    MAX_HOLD = 256  # hazard-table clamp: holds past this reuse the tail
+    SUCC_LIMIT = 8  # successor values sampled from the top of the ranking
+
+    def __init__(self, source, *, seed: int = 0, player: int = 0):
+        self.seed = int(seed)
+        self._source = source
+        self._player = player
+        self._stats = None
+        self._cur: Optional[np.ndarray] = None
+        self._hold: Optional[np.ndarray] = None
+
+    def bind(self, n_envs: int, input_size: int) -> None:
+        # imported here, not at module top: ggrs_tpu.tpu's package init
+        # wires the device stack (and jax); the env package must stay
+        # importable without either
+        from ..tpu.input_model import InputHistoryModel
+
+        super().bind(n_envs, input_size)
+        if isinstance(self._source, InputHistoryModel):
+            self._stats = self._source._stats[self._player]
+        else:
+            model = InputHistoryModel(1, input_size)
+            for row in self._source:
+                if isinstance(row, (int, np.integer)):
+                    row = bytes([int(row) & 0xFF])
+                model.observe(0, bytes(row))
+            self._stats = model._stats[0]
+        # start (and restart after episode resets) on the value the model
+        # most often transitions OUT of — an unobserved value (e.g. an
+        # all-zero row the trace never held) has no learned successors
+        # and would pin the opponent forever
+        trans = self._stats.transitions
+        if trans:
+            src = max(
+                trans.items(), key=lambda kv: (sum(kv[1].values()), kv[0])
+            )[0]
+            self._init_value = np.frombuffer(src, dtype=np.uint8).copy()
+        else:
+            self._init_value = np.zeros((input_size,), dtype=np.uint8)
+        self._cur = np.tile(self._init_value, (n_envs, 1))
+        self._hold = np.ones((n_envs,), dtype=np.int64)
+        self._world_idx = np.arange(n_envs)
+        # hazard table cache: the stats are usually frozen after priming,
+        # but a live shared InputHistoryModel can keep learning — key the
+        # cache on the hold-count population so it refreshes exactly when
+        # the statistics change (the fingerprint is O(support), tiny)
+        self._hz_key = None
+        self._hz = None
+
+    def _hazard_table(self):
+        st = self._stats
+        key = tuple(sorted(st.hold_counts.items()))
+        if key != self._hz_key:
+            hz = np.zeros((self.MAX_HOLD + 1,), dtype=np.float64)
+            for h in range(1, self.MAX_HOLD + 1):
+                hz[h] = st.hazard(h)
+            self._hz_key, self._hz = key, hz
+        return self._hz
+
+    def act(self, t: int) -> np.ndarray:
+        st = self._stats
+        cur, hold = self._cur, self._hold
+        if st is None or st.n_holds() == 0:
+            return cur.copy()
+        hz = self._hazard_table()
+        u = unit_uniform(self.seed, t, self._world_idx)
+        switch = u < hz[np.minimum(hold, self.MAX_HOLD)]
+        if switch.any():
+            u2 = unit_uniform(self.seed ^ 0x5EED, t, self._world_idx)
+            sw = np.nonzero(switch)[0]
+            # group switching worlds by the value they hold: one
+            # transition lookup per distinct value, vectorized sampling
+            # inside each group (np.unique's sorted order is
+            # deterministic)
+            values, inverse = np.unique(cur[sw], axis=0, return_inverse=True)
+            for vi in range(values.shape[0]):
+                worlds = sw[inverse == vi]
+                succ = st.next_values(
+                    values[vi].tobytes(), limit=self.SUCC_LIMIT
+                )
+                if not succ:
+                    continue  # nothing learned after this value: hold
+                probs = np.array([p for _, p in succ], dtype=np.float64)
+                cum = np.cumsum(probs / probs.sum())
+                pick = np.searchsorted(cum, u2[worlds], side="right")
+                pick = np.minimum(pick, len(succ) - 1)
+                rows = np.stack(
+                    [
+                        np.frombuffer(succ[k][0], dtype=np.uint8)
+                        for k in range(len(succ))
+                    ]
+                )
+                cur[worlds] = rows[pick]
+                hold[worlds] = 0  # +1 below lands them at hold 1
+        hold += 1
+        hold[~switch] = np.minimum(hold[~switch], self.MAX_HOLD + 1)
+        return cur.copy()
+
+    def on_reset(self, mask: np.ndarray) -> None:
+        self._cur[mask] = self._init_value
+        self._hold[mask] = 1
+
+    def state_dict(self) -> dict:
+        return {"cur": self._cur.copy(), "hold": self._hold.copy()}
+
+    def load_state_dict(self, state: Optional[dict]) -> None:
+        if state is not None:
+            self._cur[:] = state["cur"]
+            self._hold[:] = state["hold"]

@@ -1,0 +1,636 @@
+"""RollbackEnv: a JAX-native batched RL environment over the rollback core.
+
+The rollback stack already is an RL-environment substrate: a
+deterministic, vmapped, snapshot/restore-capable simulator whose
+megabatch layer (`tpu/backend.MultiSessionDeviceCore`) ticks N stacked
+worlds as ONE gather → vmapped-tick → scatter device program. This module
+exposes that substrate as a batched env API so training loops can drive
+thousands of worlds on-device:
+
+- one env world per device-core slot; `step(actions)` packs the whole
+  fleet's tick rows VECTORIZED (no per-world Python loop) and dispatches
+  them through the same megabatch path live sessions ride — env step rows
+  are zero-rollback shapes, so they take the depth-adaptive FAST program;
+- observations/reward/termination extract on device in one jitted
+  gather+vmap pass over the stacked states (`observe()` hook on the game
+  model, default full-state view; `reward`/`terminal` hooks likewise,
+  overridable per env);
+- auto-reset runs as ONE jitted masked batch reset
+  (`MultiSessionDeviceCore.reset_slots_masked`) over exactly the worlds
+  whose episodes finished — the mask is data, so nothing recompiles;
+- `snapshot()`/`restore()` ride the ring: a snapshot is a save-only
+  megabatch row (the world's state lands in its device ring slot), a
+  restore a load-only row — device-resident backtracking for
+  search-style agents at megabatch cost, no host transfer;
+- non-agent player handles are driven by the opponent layer
+  (`env/opponents.py`): scripted policies or `InputHistoryModel`-sampled
+  behavior, written into the rows exactly where remote peers' inputs
+  land in the serving workload;
+- hosted (mixed-traffic) mode: `SessionHost.attach_env` binds an env to
+  a live host's device core, and every `step()` rides ONE host tick —
+  env rows and ready P2P session rows share the same megabatch dispatch.
+
+Bitwise contract: an env step IS a confirmed-input session tick.
+`tests/test_env.py` pins `RollbackEnv.step` against an equivalent
+solo-session request stream (per-step checksums and device state), and a
+seeded snapshot→branch→restore episode against its own replay.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..errors import InvalidRequest
+from ..obs import GLOBAL_TELEMETRY, LOG2_BUCKETS
+from ..ops.fixed_point import combine_checksum
+from ..types import InputStatus
+from .opponents import Opponent
+
+DEFAULT_MAX_PREDICTION = 8
+
+
+def env_instruments():
+    """The env workload's registry instruments — registered through the
+    shared MetricsRegistry, so both exporters (Prometheus text + JSON)
+    and every telemetry() snapshot carry them with no exporter code."""
+    reg = GLOBAL_TELEMETRY.registry
+    return (
+        reg.counter(
+            "ggrs_env_steps_total",
+            "batched env world-steps executed (worlds x step() calls)",
+        ),
+        reg.counter(
+            "ggrs_env_episodes_total",
+            "env episodes finished (terminated or truncated)",
+        ),
+        reg.histogram(
+            "ggrs_env_episode_len",
+            "finished env episode lengths, in env steps",
+            buckets=LOG2_BUCKETS,
+        ),
+    )
+
+
+class EnvSnapshot:
+    """Handle to one device-resident snapshot set: every world's state
+    captured into ring slot `ring_slot` of its own device ring, plus the
+    host-side episode bookkeeping (frames, episode step counts, opponent
+    state) needed to make `restore()` a bit-exact rewind."""
+
+    __slots__ = (
+        "ring_slot", "frames", "ep_steps", "t", "opponent_state", "valid",
+    )
+
+    def __init__(self, ring_slot, frames, ep_steps, t, opponent_state):
+        self.ring_slot = ring_slot
+        self.frames = frames
+        self.ep_steps = ep_steps
+        self.t = t  # the global step clock: opponents are functions of it
+        self.opponent_state = opponent_state
+        self.valid = True
+
+
+class RollbackEnv:
+    """N rollback worlds behind a gym-shaped batched reset/step API.
+
+    Usage (standalone — the env owns its device core):
+
+        game = ExGame(num_players=2, num_entities=4096)
+        env = RollbackEnv(game, num_envs=1024,
+                          opponents={1: ScriptedOpponent(fn)},
+                          episode_len=256, warmup=True)
+        obs = env.reset()
+        obs, reward, done, info = env.step(actions)   # uint8[N, A, I]
+
+    Usage (mixed traffic — env worlds share a live SessionHost's
+    megabatch with P2P sessions):
+
+        env = host.attach_env(256, opponents=..., episode_len=256)
+        env.reset()
+        env.step(actions)        # one host tick serves envs AND sessions
+
+    `observe_fn`/`reward_fn`/`done_fn` override the game model's
+    `observe`/`reward`/`terminal` hooks (each takes ONE world's state
+    pytree; the env vmaps them). With no hook anywhere, observations are
+    the full state view, reward 0 and termination time-limit-only
+    (`episode_len`)."""
+
+    def __init__(self, game, *, num_envs: int,
+                 max_prediction: int = DEFAULT_MAX_PREDICTION,
+                 agent_handles: Sequence[int] = (0,),
+                 opponents: Optional[Dict[int, Opponent]] = None,
+                 observe_fn=None, reward_fn=None, done_fn=None,
+                 episode_len: int = 0, auto_reset: bool = True,
+                 record_checksums: bool = False,
+                 device=None, slots: Optional[Sequence[int]] = None,
+                 host=None, warmup: bool = False):
+        import jax
+
+        from ..tpu.backend import MultiSessionDeviceCore
+
+        assert num_envs >= 1
+        self.game = game
+        self.num_envs = num_envs
+        self._host = host
+        if device is None:
+            assert host is None and slots is None
+            # standalone: a private device core, one slot per world, ONE
+            # row bucket (every dispatch is padded to the fleet) and the
+            # minimal depth grid — env dispatches are fast-path steps
+            # plus last_active<=1 snapshot/restore rows, so depth bucket
+            # 2 covers everything and warmup compiles 3 programs, not
+            # the serving host's full (row x depth) grid
+            device = MultiSessionDeviceCore(
+                game, max_prediction, game.num_players, num_envs,
+                buckets=(num_envs,), depth_buckets=(2,),
+            )
+            slots = range(num_envs)
+        self._device = device
+        self._core = device.core
+        self._slots = np.asarray(list(slots), dtype=np.int32)
+        assert self._slots.shape == (num_envs,)
+        P = device.num_players
+        I = game.input_size
+        self._P, self._I = P, I
+        self._agent_handles = tuple(agent_handles)
+        self._opponents: Dict[int, Opponent] = dict(opponents or {})
+        driven = set(self._agent_handles) | set(self._opponents)
+        assert driven <= set(range(game.num_players)), (
+            f"handles {sorted(driven)} exceed the game's "
+            f"{game.num_players} players"
+        )
+        assert not (set(self._agent_handles) & set(self._opponents)), (
+            "a handle cannot be both agent-driven and opponent-driven"
+        )
+        for opp in self._opponents.values():
+            opp.bind(num_envs, I)
+        self.auto_reset = auto_reset
+        self.episode_len = episode_len
+        self._record = record_checksums
+        if record_checksums and host is not None:
+            raise InvalidRequest(
+                "record_checksums needs a standalone env: in hosted mode "
+                "env rows share megabatches with session rows, so per-row "
+                "checksum indices are not the env's to hand out"
+            )
+
+        # --- vectorized row templates -------------------------------
+        # step row: no load, ONE advance, all saves masked off — the
+        # zero-rollback fast-program shape. Handles nobody drives are
+        # DISCONNECTED (the game model substitutes its deterministic
+        # dummy input, exactly like the serving layout's padding).
+        core = self._core
+        pad = core.pad_tick_row()
+        rows = np.tile(pad, (num_envs, 1))
+        rows[:, 2] = 1
+        statuses = np.zeros((P,), dtype=np.int32)
+        for h in range(P):
+            if h not in driven:
+                statuses[h] = int(InputStatus.DISCONNECTED)
+        rows[:, core._off_status : core._off_status + P] = statuses
+        self._rows = rows
+        # snapshot row: save-only (state -> ring slot, no advance);
+        # restore row: load-only. Both have last_active <= 1, so they
+        # ride the windowed megabatch program at the smallest depth
+        # bucket — same dispatch machinery as everything else.
+        self._snap_rows = np.tile(pad, (num_envs, 1))
+        self._restore_rows = np.tile(pad, (num_envs, 1))
+        self._restore_rows[:, 0] = 1
+
+        # --- per-world host-side bookkeeping ------------------------
+        self._frames = np.zeros((num_envs,), dtype=np.int64)
+        self._ep_steps = np.zeros((num_envs,), dtype=np.int64)
+        self._t = 0  # global step index (opponent clock)
+        self.steps_total = 0
+        self.episodes_total = 0
+        self._last_batch = None
+        self._staged: List[Tuple[Any, int, List[Tuple[int, np.ndarray]]]] = []
+        # ring-slot free list for snapshots; with record_checksums the
+        # ring is reserved for the per-step trailing saves instead.
+        # _live_snaps tracks outstanding handles: any world reset zeroes
+        # that world's ring, so episode boundaries INVALIDATE every live
+        # snapshot (typed error on restore, never a silent divergence)
+        self._free_ring = (
+            [] if record_checksums else list(range(core.ring_len - 1, -1, -1))
+        )
+        self._live_snaps: List[EnvSnapshot] = []
+
+        # --- device programs ----------------------------------------
+        self._observe_one = (
+            observe_fn
+            if observe_fn is not None
+            else getattr(game, "observe", None) or (lambda s: s)
+        )
+        self._reward_one = (
+            reward_fn if reward_fn is not None else getattr(game, "reward", None)
+        )
+        self._done_one = (
+            done_fn if done_fn is not None else getattr(game, "terminal", None)
+        )
+        self._obs_fn = jax.jit(self._obs_impl)
+        self._checksum_fn = jax.jit(self._checksum_impl)
+
+        # --- instruments (registry-driven: exporters come for free) --
+        self._m_steps, self._m_episodes, self._m_ep_len = env_instruments()
+
+        if warmup:
+            self.warmup()
+
+    # ------------------------------------------------------------------
+    # device programs (pure jit impls)
+    # ------------------------------------------------------------------
+
+    def _obs_impl(self, states, idx):
+        import jax
+        import jax.numpy as jnp
+
+        g = jax.tree.map(lambda a: a[idx], states)
+        obs = jax.vmap(self._observe_one)(g)
+        if self._reward_one is not None:
+            reward = jax.vmap(self._reward_one)(g)
+        else:
+            reward = jnp.zeros((idx.shape[0],), jnp.float32)
+        if self._done_one is not None:
+            done = jax.vmap(self._done_one)(g)
+        else:
+            done = jnp.zeros((idx.shape[0],), bool)
+        return obs, reward, done
+
+    def _checksum_impl(self, states, idx):
+        import jax
+
+        g = jax.tree.map(lambda a: a[idx], states)
+        return jax.vmap(self.game.checksum)(g)
+
+    # ------------------------------------------------------------------
+    # warmup
+    # ------------------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Compile every program a rollout can dispatch before training:
+        the device core's megabatch grid (standalone — a hosted env rides
+        the host's already-warm grid), then the env's own obs/checksum
+        passes. Steps, auto-resets, snapshots and restores after this
+        compile nothing (`GGRS_SANITIZE=1` enforces it)."""
+        from ..analysis.sanitize import warmup_scope
+
+        if self._host is None:
+            self._device.warmup()  # its own warmup_scope / freeze label
+        with warmup_scope("RollbackEnv.warmup"):
+            obs, reward, done = self._obs_fn(
+                self._device.states, self._slots
+            )
+            his, los = self._checksum_fn(self._device.states, self._slots)
+            import jax
+
+            jax.block_until_ready((reward, done, los))
+
+    # ------------------------------------------------------------------
+    # reset / step
+    # ------------------------------------------------------------------
+
+    def reset(self):
+        """Return every world to the pristine init state (one masked
+        batch reset) and return the initial observations."""
+        mask = np.zeros((self._device.capacity,), dtype=bool)
+        mask[self._slots] = True
+        self._invalidate_snapshots()
+        self._device.reset_slots_masked(mask)
+        self._frames[:] = 0
+        self._ep_steps[:] = 0
+        done_all = np.ones((self.num_envs,), dtype=bool)
+        for opp in self._opponents.values():
+            opp.on_reset(done_all)
+        obs, _, _ = self._obs_fn(self._device.states, self._slots)
+        return obs
+
+    def _invalidate_snapshots(self) -> None:
+        """A world reset zeroes its device ring, destroying the bytes
+        every outstanding snapshot depends on — kill the handles (their
+        ring slots recycle) so a later restore raises a typed error
+        instead of silently rewinding into zeroed state. Search agents
+        that want standing snapshots disable auto_reset / episode_len."""
+        for snap in self._live_snaps:
+            snap.valid = False
+            self._free_ring.append(snap.ring_slot)
+        self._live_snaps.clear()
+
+    def _coerce_actions(self, actions) -> np.ndarray:
+        a = np.asarray(actions, dtype=np.uint8)
+        n_agents = len(self._agent_handles)
+        if a.ndim == 2 and n_agents == 1 and a.shape == (
+            self.num_envs, self._I
+        ):
+            a = a[:, None, :]
+        assert a.shape == (self.num_envs, n_agents, self._I), (
+            f"actions must be uint8[{self.num_envs}, {n_agents}, "
+            f"{self._I}] (got {a.shape})"
+        )
+        return a
+
+    def step(self, actions):
+        """Advance every world one frame. `actions`: uint8 rows for the
+        agent handles — [N, A, I], or [N, I] with a single agent handle.
+        Returns (obs, reward, done, info): obs/reward stay DEVICE arrays
+        (feed them straight into a jitted training step), done is a host
+        bool[N] (it drives auto-reset), info carries the step's
+        bookkeeping. One `step()` = one megabatch dispatch (standalone)
+        or one shared host tick (mixed traffic)."""
+        actions = self._coerce_actions(actions)
+        core, rows = self._core, self._rows
+        base = core._off_input
+        I = self._I
+        rows[:, 3] = self._frames
+        for j, h in enumerate(self._agent_handles):
+            rows[:, base + h * I : base + (h + 1) * I] = actions[:, j]
+        for h, opp in self._opponents.items():
+            rows[:, base + h * I : base + (h + 1) * I] = opp.act(self._t)
+        if self._record:
+            # trailing save of the post-step state into the ring (dense-
+            # saving session shape): its checksum is the per-step parity
+            # witness, still fast-path eligible (last_active == 2)
+            rows[:, core._off_save + 1] = (
+                self._frames + 1
+            ) % core.ring_len
+        batch = self._dispatch(rows, fast=True, last_active=None)
+        self._last_batch = batch
+        self._frames += 1
+        self._ep_steps += 1
+        self._t += 1
+        self.steps_total += self.num_envs
+
+        obs, reward, done = self._obs_fn(self._device.states, self._slots)
+        done_np = np.asarray(done)
+        truncated = np.zeros((self.num_envs,), dtype=bool)
+        if self.episode_len:
+            truncated = self._ep_steps >= self.episode_len
+            done_np = done_np | truncated
+        info = {"t": self._t, "truncated": truncated}
+
+        tel = GLOBAL_TELEMETRY
+        if tel.enabled:
+            self._m_steps.inc(self.num_envs)
+        if done_np.any():
+            finished = int(done_np.sum())
+            self.episodes_total += finished
+            if tel.enabled:
+                self._m_episodes.inc(finished)
+                for length in self._ep_steps[done_np]:
+                    self._m_ep_len.observe(int(length))
+            if self.auto_reset:
+                mask = np.zeros((self._device.capacity,), dtype=bool)
+                mask[self._slots[done_np]] = True
+                self._invalidate_snapshots()
+                self._device.reset_slots_masked(mask)
+                self._frames[done_np] = 0
+                self._ep_steps[done_np] = 0
+                for opp in self._opponents.values():
+                    opp.on_reset(done_np)
+                # the returned obs for finished worlds is the NEW
+                # episode's first observation (standard auto-reset)
+                obs, _, _ = self._obs_fn(self._device.states, self._slots)
+        return obs, reward, done_np, info
+
+    # ------------------------------------------------------------------
+    # dispatch plumbing (standalone megabatch / hosted shared megabatch)
+    # ------------------------------------------------------------------
+
+    def _dispatch(self, rows, *, fast: bool, last_active: Optional[int],
+                  sel: Optional[np.ndarray] = None):
+        idx = self._slots if sel is None else self._slots[sel]
+        block = rows if sel is None else rows[sel]
+        if self._host is None:
+            batch, _bucket = self._device.dispatch_rows(
+                idx, block, fast=fast, last_active=last_active
+            )
+            return batch
+        # hosted: stage for the host's megabatch scheduler — env rows
+        # join the session rows' depth groups inside host.tick(), so
+        # training and interactive traffic share one dispatch
+        if self._device.depth_routing:
+            gkey = (
+                "fast"
+                if fast
+                else self._device.depth_bucket_for(last_active)
+            )
+        else:
+            gkey = None
+        entries = [(int(idx[k]), block[k]) for k in range(idx.shape[0])]
+        self._staged.append(
+            (gkey, last_active if last_active is not None else 1, entries)
+        )
+        self._host.tick()
+        assert not self._staged, "host tick left env rows undispatched"
+        return None
+
+    def _take_staged(self):
+        staged, self._staged = self._staged, []
+        return staged
+
+    # ------------------------------------------------------------------
+    # snapshot / restore (device-resident backtracking)
+    # ------------------------------------------------------------------
+
+    @property
+    def snapshot_capacity(self) -> int:
+        """Simultaneously-live snapshots the device ring can hold."""
+        return len(self._free_ring) if not self._record else 0
+
+    def snapshot(self) -> EnvSnapshot:
+        """Capture every world's live state into one ring slot of its
+        own device ring — a save-only megabatch dispatch, no host
+        transfer. Returns a handle; `restore(handle)` rewinds every
+        world (repeatably — branch as many times as the search wants),
+        `release(handle)` frees the ring slot."""
+        if self._record:
+            raise InvalidRequest(
+                "the ring is reserved for per-step checksums "
+                "(record_checksums=True); snapshots need it free"
+            )
+        if not self._free_ring:
+            raise InvalidRequest(
+                f"all {self._core.ring_len} ring slots hold live "
+                "snapshots; release() one first"
+            )
+        k = self._free_ring.pop()
+        rows = self._snap_rows
+        rows[:, self._core._off_save] = k
+        rows[:, 3] = self._frames
+        self._dispatch(rows, fast=False, last_active=1)
+        snap = EnvSnapshot(
+            k,
+            self._frames.copy(),
+            self._ep_steps.copy(),
+            self._t,
+            {
+                h: opp.state_dict()
+                for h, opp in self._opponents.items()
+            },
+        )
+        self._live_snaps.append(snap)
+        return snap
+
+    def restore(self, snap: EnvSnapshot):
+        """Rewind every world to `snap` (a load-only megabatch dispatch)
+        and return the observations there. The handle stays valid —
+        search agents restore the same snapshot once per branch."""
+        if not snap.valid:
+            raise InvalidRequest(
+                "snapshot handle is dead (released, or a world reset "
+                "zeroed the ring bytes it pointed at)"
+            )
+        rows = self._restore_rows
+        rows[:, 1] = snap.ring_slot
+        self._dispatch(rows, fast=False, last_active=1)
+        self._frames[:] = snap.frames
+        self._ep_steps[:] = snap.ep_steps
+        self._t = snap.t
+        for h, opp in self._opponents.items():
+            opp.load_state_dict(snap.opponent_state.get(h))
+        obs, _, _ = self._obs_fn(self._device.states, self._slots)
+        return obs
+
+    def release(self, snap: EnvSnapshot) -> None:
+        if snap.valid:
+            snap.valid = False
+            self._free_ring.append(snap.ring_slot)
+            self._live_snaps.remove(snap)
+
+    # ------------------------------------------------------------------
+    # inspection / parity surfaces
+    # ------------------------------------------------------------------
+
+    def checksums(self) -> List[int]:
+        """Combined (hi << 32 | lo) checksum of every world's LIVE state,
+        computed on device in one vmapped pass — the env-side half of the
+        env-vs-session parity witness."""
+        his, los = self._checksum_fn(self._device.states, self._slots)
+        his = np.asarray(his)
+        los = np.asarray(los)
+        return [
+            combine_checksum(int(h), int(l)) for h, l in zip(his, los)
+        ]
+
+    def step_checksums(self) -> List[int]:
+        """The last step's per-world post-step checksums (requires
+        record_checksums=True): resolved from the same lazy checksum
+        batch machinery session saves use — flat index k*W + 1 is world
+        k's trailing-save slot."""
+        assert self._record and self._last_batch is not None
+        W = self._core.window
+        return [
+            self._last_batch.resolve(k * W + 1)
+            for k in range(self.num_envs)
+        ]
+
+    def state_numpy(self, world: int):
+        """Host copy of one world's live state (parity checks)."""
+        return self._device.state_numpy(int(self._slots[world]))
+
+    @property
+    def slots(self) -> List[int]:
+        return [int(s) for s in self._slots]
+
+    # ------------------------------------------------------------------
+    # durable checkpoint (utils/checkpoint) — resume training mid-rollout
+    # ------------------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Durable checkpoint of a STANDALONE env: the stacked device
+        worlds (rings included — live snapshots survive the round trip
+        only as ring bytes; handles are process state, re-snapshot after
+        restore) plus the env and opponent bookkeeping, via
+        utils/checkpoint. A hosted env rides the host's drain
+        checkpoint instead."""
+        from ..utils.checkpoint import save_device_checkpoint
+
+        assert self._host is None, (
+            "hosted env worlds checkpoint with the host's drain()"
+        )
+        self._device.block_until_ready()
+        tree = {
+            "rings": self._device.rings,
+            "states": self._device.states,
+            "frames": self._frames,
+            "ep_steps": self._ep_steps,
+            "opp": {
+                str(h): state
+                for h, state in (
+                    (h, opp.state_dict())
+                    for h, opp in self._opponents.items()
+                )
+                if state is not None
+            },
+        }
+        save_device_checkpoint(
+            path,
+            tree,
+            {
+                "kind": "RollbackEnv",
+                "num_envs": self.num_envs,
+                "max_prediction": self._core.max_prediction,
+                "episode_len": self.episode_len,
+                "t": self._t,
+                "steps_total": self.steps_total,
+                "episodes_total": self.episodes_total,
+            },
+        )
+
+    @classmethod
+    def restore_from(cls, path: str, game, **kw) -> "RollbackEnv":
+        """Rebuild a standalone env from a save() checkpoint: the caller
+        supplies the same game config and any non-durable knobs
+        (opponents, hooks, warmup); worlds, episode bookkeeping and
+        opponent per-world state resume bit-exactly."""
+        from ..utils.checkpoint import load_device_checkpoint
+
+        tree, meta = load_device_checkpoint(path)
+        assert meta["kind"] == "RollbackEnv"
+        env = cls(
+            game,
+            num_envs=meta["num_envs"],
+            max_prediction=meta["max_prediction"],
+            episode_len=meta.get("episode_len", 0),
+            **kw,
+        )
+        env._device.load_stacked(tree["rings"], tree["states"])
+        env._frames[:] = tree["frames"]
+        env._ep_steps[:] = tree["ep_steps"]
+        env._t = int(meta["t"])
+        env.steps_total = int(meta["steps_total"])
+        env.episodes_total = int(meta["episodes_total"])
+        for h, opp in env._opponents.items():
+            state = tree.get("opp", {}).get(str(h))
+            if state is not None:
+                opp.load_state_dict(state)
+        return env
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+
+    def _env_section(self) -> dict:
+        return {
+            "num_envs": self.num_envs,
+            "steps_total": self.steps_total,
+            "episodes_total": self.episodes_total,
+            "episode_len": self.episode_len,
+            "auto_reset": self.auto_reset,
+            "agent_handles": list(self._agent_handles),
+            "opponent_handles": sorted(self._opponents),
+            "snapshots_live": (
+                0
+                if self._record
+                else self._core.ring_len - len(self._free_ring)
+            ),
+            "mixed_traffic": self._host is not None,
+        }
+
+    def telemetry(self) -> dict:
+        """One structured snapshot: the process-wide obs snapshot plus an
+        `env` section (the hosted twin rides `host.telemetry()`)."""
+        snap = GLOBAL_TELEMETRY.snapshot()
+        snap["env"] = self._env_section()
+        return snap

@@ -253,6 +253,28 @@ class Arena:
 
         return _checksum_generic(state, jnp)
 
+    def observe(self, state: State):
+        """RL observation hook (ggrs_tpu/env/): float32 [num_entities, 6]
+        — pos over the wrapped arena, vel in MAX_SPEED units, hp and
+        energy as remaining fractions. Pure jax, vmap/jit-friendly."""
+        import jax.numpy as jnp
+
+        span = jnp.float32(1 << ARENA_BITS)
+        return jnp.concatenate(
+            [
+                state["pos"].astype(jnp.float32) / span,
+                state["vel"].astype(jnp.float32) / jnp.float32(MAX_SPEED),
+                (state["hp"].astype(jnp.float32) / jnp.float32(HP_INIT))[
+                    :, None
+                ],
+                (
+                    state["energy"].astype(jnp.float32)
+                    / jnp.float32(ENERGY_MAX)
+                )[:, None],
+            ],
+            axis=1,
+        )
+
 
 def init_oracle(num_players: int = 2, num_entities: int = 4096) -> State:
     return _init_arrays(num_entities)

@@ -206,6 +206,27 @@ class ExGame:
 
         return _checksum_generic(state, jnp)
 
+    def observe(self, state: State):
+        """RL observation hook (ggrs_tpu/env/): one world's state as a
+        float32 [num_entities, 5] feature block — pos normalized to the
+        arena, vel in units of MAX_SPEED, heading as a turn fraction in
+        [0, 1). Pure jax and vmap/jit-friendly; RollbackEnv vmaps it over
+        the stacked env worlds (pass observe_fn= to override)."""
+        import jax.numpy as jnp
+
+        pos = state["pos"].astype(jnp.float32)
+        vel = state["vel"].astype(jnp.float32) / jnp.float32(MAX_SPEED)
+        rot = state["rot"].astype(jnp.float32) / jnp.float32(fx.ANGLE_MOD)
+        return jnp.concatenate(
+            [
+                (pos[:, :1] / jnp.float32(MAX_X)),
+                (pos[:, 1:] / jnp.float32(MAX_Y)),
+                vel,
+                rot[:, None],
+            ],
+            axis=1,
+        )
+
 
 # ---------------------------------------------------------------------------
 # Host oracle (numpy) — independent execution path used as ground truth

@@ -166,6 +166,25 @@ class Swarm:
 
         return _checksum_generic(state, jnp)
 
+    def observe(self, state: State):
+        """RL observation hook (ggrs_tpu/env/): float32 [num_entities, 7]
+        — pos over the wrapped torus, vel in MAX_SPEED units, boost
+        charge as a remaining fraction. Pure jax, vmap/jit-friendly."""
+        import jax.numpy as jnp
+
+        span = jnp.float32(1 << SPACE_BITS)
+        return jnp.concatenate(
+            [
+                state["pos"].astype(jnp.float32) / span,
+                state["vel"].astype(jnp.float32) / jnp.float32(MAX_SPEED),
+                (
+                    state["charge"].astype(jnp.float32)
+                    / jnp.float32(CHARGE_MAX)
+                )[:, None],
+            ],
+            axis=1,
+        )
+
 
 # ---------------------------------------------------------------------------
 # Host oracle (numpy) — independent execution path used as ground truth

@@ -192,6 +192,7 @@ class SessionHost:
         self.clock = clock or Clock()
         self.idle_timeout_ms = idle_timeout_ms
         self._lanes: Dict[Any, _Lane] = {}
+        self._envs: List[Any] = []  # attached RollbackEnv blocks
         self._free_slots = list(range(max_sessions - 1, -1, -1))
         # keys with staged rows, ARRIVAL order (the backpressure queue)
         self._ready: deque = deque()
@@ -352,6 +353,55 @@ class SessionHost:
             GLOBAL_TELEMETRY.record(
                 "host_session_detached", key=str(key), slot=lane.slot
             )
+
+    def attach_env(self, num_envs: int, **env_kw):
+        """MIXED-TRAFFIC MODE: reserve `num_envs` device slots for a
+        batched RL environment sharing this host's megabatch. The
+        returned `RollbackEnv` stages its step/snapshot/restore rows
+        with the host, and every `env.step()` runs ONE host tick — env
+        rows join the ready sessions' depth groups, so training and
+        interactive traffic dispatch as one program per group on one
+        device core. Raises HostFull when the slot budget (shared with
+        session admission) cannot cover the block."""
+        from ..env.rollback_env import RollbackEnv
+
+        if self._draining:
+            self._reject()
+            raise HostFull("host is draining: not admitting env blocks")
+        if num_envs < 1 or num_envs > len(self._free_slots):
+            self._reject()
+            raise HostFull(
+                f"env block of {num_envs} exceeds the {len(self._free_slots)}"
+                " free session slots"
+            )
+        slots = [self._free_slots.pop() for _ in range(num_envs)]
+        try:
+            env = RollbackEnv(
+                self.game,
+                num_envs=num_envs,
+                max_prediction=self.max_prediction,
+                device=self.device,
+                slots=slots,
+                host=self,
+                **env_kw,
+            )
+        except BaseException:
+            # a rejected construction (bad knob combination) must not
+            # leak the popped slots out of session admission
+            self._free_slots.extend(slots)
+            raise
+        self._envs.append(env)
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "host_env_attached", num_envs=num_envs,
+                slots=f"{min(slots)}..{max(slots)}",
+            )
+        return env
+
+    def detach_env(self, env) -> None:
+        """Release an env block's device slots back to session admission."""
+        self._envs.remove(env)
+        self._free_slots.extend(env.slots)
 
     def session(self, key: Any):
         return self._lanes[key].session
@@ -627,19 +677,45 @@ class SessionHost:
         its depth — one deep-rollback session no longer drags the other
         63 sessions' rows to the full window. Groups are disjoint lanes,
         so the one-row-per-session-per-megabatch invariant holds within
-        each pass."""
+        each pass.
+
+        Mixed traffic: rows staged by attached env blocks (attach_env)
+        fold into the same groups — env step rows join the fast group,
+        snapshot/restore rows their depth bucket — so one dispatch
+        carries training AND interactive rows. Env rows are synchronous
+        training traffic (env.step blocks on this tick): when the
+        inflight budget is exhausted they retire the fence and dispatch
+        anyway rather than queue."""
         from ..tpu.backend import SnapshotRef, _LazyChecksum
 
         core = self.device.core
-        while self._ready:
+        # env-staged rows for this pass: gkey -> (max last_active, rows)
+        env_groups: Dict[Any, List] = {}
+        for env in self._envs:
+            for gkey, la, entries in env._take_staged():
+                slot = env_groups.setdefault(gkey, [0, []])
+                slot[0] = max(slot[0], la)
+                slot[1].extend(entries)
+        while self._ready or env_groups:
             budget = self.max_inflight_rows - self.device.poll_retired()
             if budget <= 0:
-                break
-            take = min(budget, len(self._ready), self.device.capacity)
+                if not env_groups:
+                    break
+                # env rows must land THIS tick: retire the fence and
+                # take the dispatch slot the budget was protecting
+                self.device.block_until_ready()
+            env_rows = sum(len(e) for _, e in env_groups.values())
+            take = min(
+                max(budget, 0),
+                len(self._ready),
+                max(self.device.capacity - env_rows, 0),
+            )
             picked: List[Tuple[_Lane, _StagedRow]] = []
             for key in list(self._ready)[:take]:
                 lane = self._lanes[key]
                 picked.append((lane, lane.rows[0]))
+            if not picked and not env_groups:
+                break
             if self.depth_routing:
                 groups: Dict[Any, List[Tuple[_Lane, _StagedRow]]] = {}
                 for lane, staged in picked:
@@ -651,20 +727,28 @@ class SessionHost:
                     groups.setdefault(gkey, []).append((lane, staged))
             else:
                 groups = {None: picked}
+            for gkey in list(env_groups):
+                groups.setdefault(gkey, [])
             for gkey, group in groups.items():
+                env_la, env_entries = env_groups.pop(gkey, (0, []))
+                # session entries FIRST: save bindings index the batch by
+                # position, and env rows need no post-dispatch binding
                 entries = [
                     (lane.slot, staged.row) for lane, staged in group
-                ]
+                ] + env_entries
+                if not entries:
+                    continue
                 if gkey == "fast":
                     batch, _bucket = self.device.dispatch(entries, fast=True)
                 elif gkey is None:
                     batch, _bucket = self.device.dispatch(entries)
                 else:
+                    la = max(
+                        [staged.last_active for _, staged in group]
+                        + [env_la],
+                    )
                     batch, _bucket = self.device.dispatch(
-                        entries,
-                        last_active=max(
-                            staged.last_active for _, staged in group
-                        ),
+                        entries, last_active=la
                     )
                 for k, (lane, staged) in enumerate(group):
                     lane.rows.popleft()
@@ -814,6 +898,7 @@ class SessionHost:
             "plan_signatures": len(dev.plan_cache.signatures),
             "buckets": list(dev.buckets),
             "sessions": sessions,
+            "envs": [env._env_section() for env in self._envs],
         }
 
     def telemetry(self) -> dict:

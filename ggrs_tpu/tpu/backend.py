@@ -1752,6 +1752,7 @@ class MultiSessionDeviceCore:
                  capacity: int, *, async_inflight: int = 4,
                  plan_cache: Optional[DispatchPlanCache] = None,
                  buckets: Optional[Sequence[int]] = None,
+                 depth_buckets: Optional[Sequence[int]] = None,
                  depth_routing: bool = True):
         """`num_players` is the HOST-WIDE player layout (the widest
         session the host admits): every hosted session's rows are packed
@@ -1761,6 +1762,14 @@ class MultiSessionDeviceCore:
 
         `buckets`: megabatch row-count pad targets (default: powers of
         two up to capacity, plus capacity itself).
+
+        `depth_buckets`: windowed-program pad targets for the 1-based
+        last-active slot (default: powers of two up to the window, plus
+        the window). A workload that only ever dispatches known shapes —
+        the RL env, whose rows are zero-rollback steps plus last_active=1
+        snapshot/restore rows — can restrict the grid (e.g. `(2,)`) so
+        warmup compiles a fraction of the programs and the jit budget
+        shrinks to match; `depth_bucket_for` raises past the coverage.
 
         `depth_routing`: dispatch one vmapped program per (row-count
         bucket x depth bucket) instead of always vmapping the full-window
@@ -1803,10 +1812,14 @@ class MultiSessionDeviceCore:
         # powers of two up to the window, window included — O(log W)
         # programs per row bucket
         W = self.core.window
-        depths, d = {W}, 2
-        while d < W:
-            depths.add(d)
-            d *= 2
+        if depth_buckets is None:
+            depths, d = {W}, 2
+            while d < W:
+                depths.add(d)
+                d *= 2
+        else:
+            depths = set(int(d) for d in depth_buckets)
+            assert depths and max(depths) <= W
         self.depth_buckets = tuple(sorted(depths))
         S = capacity + 1  # + the dummy pad slot
         self.states = jax.tree.map(
@@ -1815,11 +1828,18 @@ class MultiSessionDeviceCore:
         self.rings = jax.tree.map(
             lambda x: jnp.zeros((S,) + x.shape, x.dtype), self.core.ring
         )
+        # one pristine world for the masked batch reset (the env
+        # workload's auto-reset): built once, passed as a plain argument
+        # so the reset program doesn't bake the init state in as a const
+        self._init_state = self.core.game.init_state()
         self._dispatch_fn = jax.jit(
             self._dispatch_impl, static_argnums=(4,), donate_argnums=(0, 1)
         )
         self._dispatch_fast_fn = jax.jit(
             self._dispatch_fast_impl, donate_argnums=(0, 1)
+        )
+        self._reset_mask_fn = jax.jit(
+            self._reset_masked_impl, donate_argnums=(0, 1)
         )
         self._pad_row = self.core.pad_tick_row()
         # per-row-bucket pooled (idx, rows) staging, async_inflight + 1
@@ -2060,6 +2080,59 @@ class MultiSessionDeviceCore:
                 "fast dispatch carries a row with a load, a multi-advance "
                 "or a save past window slot 1"
             )
+        return self._dispatch_staged(
+            staged, n, bucket, last_active=last_active, fast=fast
+        )
+
+    def dispatch_rows(
+        self, idx_block: np.ndarray, rows_block: np.ndarray, *,
+        last_active: Optional[int] = None, fast: bool = False,
+    ) -> Tuple[_ChecksumBatch, int]:
+        """dispatch() for callers that already hold a whole [n, L] packed
+        row block with its [n] slot vector (the batched RL env builds its
+        fleet's step rows vectorized): the per-row Python pack loop
+        becomes two numpy block copies into the pooled bucket staging.
+        Same contract as dispatch() — at most one row per slot, rows are
+        host-copied before return, non-blocking beyond the fence."""
+        n = int(idx_block.shape[0])
+        assert 0 < n <= self.capacity
+        assert rows_block.shape[0] == n
+        bucket = self.bucket_for(n)
+        staged = self._acquire_stage(bucket)
+        idx, rows, used = staged
+        idx[:n] = idx_block
+        rows[:n] = rows_block
+        if used > n:  # re-pad only what the last use dirtied
+            idx[n:used] = self.capacity
+            rows[n:used] = self._pad_row
+        staged[2] = n
+        if fast:
+            # vectorized fast_eligible over the block: no load, exactly
+            # one advance, no active slot past 1
+            core = self.core
+            tail = rows_block[:, core._off_save + 2 : core._off_status]
+            assert (
+                (rows_block[:, 0] == 0).all()
+                and (rows_block[:, 2] == 1).all()
+                and (tail >= core.ring_len).all()
+            ), (
+                "fast dispatch_rows block carries a row with a load, a "
+                "multi-advance or a save past window slot 1"
+            )
+        return self._dispatch_staged(
+            staged, n, bucket, last_active=last_active, fast=fast
+        )
+
+    def _dispatch_staged(
+        self, staged, n: int, bucket: int, *,
+        last_active: Optional[int], fast: bool,
+    ) -> Tuple[_ChecksumBatch, int]:
+        """Common dispatch tail over a filled bucket-staging buffer:
+        program selection (fast / windowed depth bucket / full window),
+        plan-cache tally, the sanitizer's jit-budget assertion, telemetry
+        and the async fence."""
+        idx, rows, _used = staged
+        if fast:
             sig_depth, nslots, fn_args = 0, 1, ()
             fn = self._dispatch_fast_fn
         elif last_active is not None:
@@ -2146,6 +2219,45 @@ class MultiSessionDeviceCore:
             self.rings,
         )
 
+    def _reset_masked_impl(self, rings, states, mask, init):
+        """Masked batch reset over the stacked pytrees: every slot with
+        mask[slot] set returns to the pristine init world, its ring
+        zeroed; every other slot passes through untouched. mask is DATA
+        (bool[capacity + 1], the dummy slot always False), so one program
+        covers every reset pattern — the env workload's auto-reset
+        resets its whole done-set in one dispatch regardless of which
+        episodes finished."""
+        import jax.numpy as jnp
+
+        def sel(a, x):
+            m = mask.reshape((-1,) + (1,) * (a.ndim - 1))
+            return jnp.where(m, x, a)
+
+        states = jax.tree.map(sel, states, init)
+        rings = jax.tree.map(
+            lambda r: jnp.where(
+                mask.reshape((-1,) + (1,) * (r.ndim - 1)),
+                jnp.zeros((), r.dtype),
+                r,
+            ),
+            rings,
+        )
+        return rings, states
+
+    def reset_slots_masked(self, mask: np.ndarray) -> None:
+        """Return every slot with mask[slot] == True to its initial world
+        in ONE jitted masked pass (bool[capacity]). The batch twin of
+        reset_slot: auto-resetting N finished episodes costs one program
+        dispatch, not N eager per-leaf updates — and the mask is data,
+        so the program compiles once (warmup covers it) no matter which
+        slots finish."""
+        assert mask.shape == (self.capacity,)
+        m = np.zeros((self.capacity + 1,), dtype=bool)
+        m[: self.capacity] = mask
+        self.rings, self.states = self._reset_mask_fn(
+            self.rings, self.states, m, self._init_state
+        )
+
     def state_numpy(self, slot: int):
         """Host copy of one session slot's live world (parity checks)."""
         self.block_until_ready()
@@ -2182,6 +2294,15 @@ class MultiSessionDeviceCore:
                 self.rings, self.states, _, _ = self._dispatch_fn(
                     self.rings, self.states, idx, rows, self.core.window
                 )
+        # the masked batch reset (env auto-reset) with an all-False mask:
+        # a true no-op on the stacked worlds, but the program exists
+        # before the first episode ever finishes mid-serve
+        self.rings, self.states = self._reset_mask_fn(
+            self.rings,
+            self.states,
+            np.zeros((self.capacity + 1,), dtype=bool),
+            self._init_state,
+        )
         self.block_until_ready()
 
     def block_until_ready(self) -> None:
@@ -2223,3 +2344,11 @@ class MultiSessionDeviceCore:
         core.rings = jax.device_put(tree["rings"])
         core.states = jax.device_put(tree["states"])
         return core
+
+    def load_stacked(self, rings, states) -> None:
+        """Adopt checkpointed stacked worlds into THIS core (the env
+        restore path: the env rebuilds its core from config, then loads
+        the saved worlds) — the in-place twin of restore()."""
+        self.block_until_ready()
+        self.rings = jax.device_put(rings)
+        self.states = jax.device_put(states)

@@ -34,6 +34,12 @@
 #   batched wire pump is the taken path and the steady-state tick never
 #   blocked on a checksum device drain (scripts/pump_smoke.py, CPU jax,
 #   <1 min).
+#   --env-smoke runs a 256-world RollbackEnv rollout with auto-reset plus
+#   a snapshot->branch->restore backtracking episode under GGRS_SANITIZE=1
+#   and asserts zero post-warmup recompiles, megabatch coalescing, the
+#   dispatch bucket budget, bit-exact branch replay, and the env
+#   instruments through both exporters (scripts/env_smoke.py, CPU jax,
+#   <1 min).
 #   --lint runs the determinism/trace/fence/wire static-analysis gate
 #   (python -m ggrs_tpu.analysis, pure AST, no jax, seconds) against
 #   analysis/baseline.toml, then the retrace-sanitizer smoke
@@ -91,6 +97,12 @@ fi
 if [ "${1:-}" = "--pump-smoke" ]; then
   echo "== pump smoke (batched wire pump taken + drain-free tick) =="
   JAX_PLATFORMS=cpu python scripts/pump_smoke.py
+  exit $?
+fi
+
+if [ "${1:-}" = "--env-smoke" ]; then
+  echo "== env smoke (256-world rollout + backtracking, recompile-clean) =="
+  GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/env_smoke.py
   exit $?
 fi
 

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""RL-env smoke gate (scripts/check.sh --env-smoke): a 256-world
+RollbackEnv rollout with auto-reset, plus a backtracking search episode
+(snapshot → branch → restore → replay), run under GGRS_SANITIZE=1:
+
+  1. RECOMPILE-CLEAN: after env.warmup() freezes the sanitizer, steps,
+     auto-resets, snapshots and restores must compile NOTHING — a
+     post-warmup recompile is a silent training-throughput regression
+     and fails the gate with its provenance printed;
+  2. the rollout actually rode the megabatch path (megabatch rows > 1)
+     and the jit cache stayed on the dispatch bucket grid
+     (<= dispatch_bucket_budget() programs);
+  3. the backtracking branch replays BIT-IDENTICALLY after restore;
+  4. the env instruments grew and export through BOTH exporters.
+
+Runs on CPU in well under a minute (JAX_PLATFORMS=cpu recommended).
+Exits nonzero with a reason on any failure.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("GGRS_SANITIZE", "1")
+
+from ggrs_tpu import enable_global_telemetry  # noqa: E402
+from ggrs_tpu.obs import GLOBAL_TELEMETRY  # noqa: E402
+
+N_WORLDS = 256
+EPISODE_LEN = 24
+ROLLOUT_STEPS = 60
+BRANCH_STEPS = 8
+
+
+def fail(reason):
+    print(f"env-smoke FAIL: {reason}")
+    sys.exit(1)
+
+
+def main():
+    import numpy as np
+
+    enable_global_telemetry()
+
+    from ggrs_tpu.analysis.sanitize import active_sanitizer
+    from ggrs_tpu.env import (
+        InputModelOpponent,
+        RollbackEnv,
+        held_value_trace,
+    )
+    from ggrs_tpu.models.ex_game import ExGame
+
+    trace = held_value_trace([1, 4, 2, 8, 1, 4, 2, 8, 5, 4])
+    env = RollbackEnv(
+        ExGame(num_players=2, num_entities=64),
+        num_envs=N_WORLDS,
+        opponents={1: InputModelOpponent(trace, seed=9)},
+        episode_len=EPISODE_LEN,
+        warmup=True,
+    )
+    san = active_sanitizer()
+    if san is None:
+        fail("sanitizer not installed (GGRS_SANITIZE=1 expected)")
+    compiles_at_freeze = len(san.compiles)
+
+    # --- 256-world rollout with auto-reset -------------------------
+    env.reset()
+    for t in range(ROLLOUT_STEPS):
+        actions = np.full((N_WORLDS, 1), (t * 3 + 1) % 16, np.uint8)
+        env.step(actions)
+    if env.episodes_total < N_WORLDS:
+        fail(
+            f"auto-reset never cycled: {env.episodes_total} episodes "
+            f"after {ROLLOUT_STEPS} steps at episode_len={EPISODE_LEN}"
+        )
+
+    # --- backtracking search episode -------------------------------
+    snap = env.snapshot()
+    anchor = env.checksums()
+
+    def branch():
+        for t in range(BRANCH_STEPS):
+            env.step(np.full((N_WORLDS, 1), (t * 9 + 2) % 16, np.uint8))
+        return env.checksums()
+
+    first = branch()
+    env.restore(snap)
+    if env.checksums() != anchor:
+        fail("restore did not rewind to the snapshot state")
+    if branch() != first:
+        fail("snapshot->branch->restore replay diverged (not bit-exact)")
+    env.release(snap)
+
+    # 1. recompile-clean under the sanitizer
+    if san.recompiles:
+        fail(
+            f"{len(san.recompiles)} post-warmup recompiles "
+            f"({compiles_at_freeze} compiles at freeze):\n"
+            + "\n".join(e.render() for e in san.recompiles)
+        )
+
+    # 2. megabatch path + bucket grid
+    dev = env._device
+    mean_rows = dev.rows_dispatched / max(dev.megabatches, 1)
+    if mean_rows <= 1.0:
+        fail(f"megabatches never coalesced (mean rows {mean_rows})")
+    budget = dev.dispatch_bucket_budget()
+    programs = (
+        dev._dispatch_fn._cache_size() + dev._dispatch_fast_fn._cache_size()
+    )
+    if programs > budget:
+        fail(f"{programs} dispatch programs exceed the {budget} budget")
+    mega = dev.megabatch_programs()
+    for bucket, d, _count in mega:
+        if d is None or (d != 0 and d not in dev.depth_buckets):
+            fail(f"off-grid megabatch program (bucket={bucket}, depth={d})")
+
+    # 3. instruments through both exporters
+    reg = GLOBAL_TELEMETRY.registry
+    steps = reg.get("ggrs_env_steps_total")
+    episodes = reg.get("ggrs_env_episodes_total")
+    if steps is None or steps.value < N_WORLDS * ROLLOUT_STEPS:
+        fail("ggrs_env_steps_total never grew")
+    if episodes is None or episodes.value <= 0:
+        fail("ggrs_env_episodes_total never grew")
+    snap_t = env.telemetry()
+    if snap_t["env"]["steps_total"] != env.steps_total:
+        fail("telemetry() env section out of sync")
+    prom = GLOBAL_TELEMETRY.prometheus()
+    for name in (
+        "ggrs_env_steps_total",
+        "ggrs_env_episodes_total",
+        "ggrs_env_episode_len_bucket",
+    ):
+        if name not in prom:
+            fail(f"{name} missing from the Prometheus export")
+    import json
+
+    json.loads(GLOBAL_TELEMETRY.to_json())
+
+    print(
+        "env-smoke OK: "
+        f"{env.steps_total} env steps across {N_WORLDS} worlds "
+        f"({env.episodes_total} episodes), mean megabatch rows "
+        f"{mean_rows:.0f}, {programs}/{budget} programs on the bucket "
+        f"grid, backtracking replay bit-exact, 0 post-warmup recompiles"
+    )
+
+
+if __name__ == "__main__":
+    main()
