@@ -1882,6 +1882,73 @@ def bench_env_rollout(num_envs=256, steps=200, entities=256, episode_len=64):
     }
 
 
+def bench_chaos_soak(sessions=32, ticks=100, entities=256):
+    """Fleet operations under fault (ggrs_tpu/serve/chaos.py), three
+    arms over a 2-host HostGroup: (a) CLEAN — single-region mild
+    network, no fault schedule; (b) WAN — regional RTT matrix,
+    Gilbert-Elliott burst loss, reorder spikes, plus 2 live migrations
+    (fps_retained = b/a: the network+migration degradation story,
+    deliberately excluding the kill cycle whose replacement-host warmup
+    compile would swamp it); (c) KILL — a host kill→restore cycle,
+    reporting the availability costs (kill checkpoint wall ms, restore
+    wall ms — warmup-compile dominated; a production fleet warms a
+    standby first — and the blackout ticks). Migration latency reports
+    both ways: wall ms of the handoff itself and virtual ticks from
+    checkpoint to the first resumed advance. Every arm must stay
+    desync-free — this is a robustness bench, not just a speed bench."""
+    from ggrs_tpu.serve.chaos import WanProfile, run_chaos
+
+    common = dict(
+        sessions=sessions, ticks=ticks, hosts=2, entities=entities,
+        seed=7, warmup=True,
+    )
+    clean = run_chaos(
+        migrations=0, kill=False,
+        profile=WanProfile(
+            regions=1, intra_ms=20, jitter_ms=5, reorder=0.0,
+            loss_good=0.01, loss_bad=0.01, duplicate=0.0, seed=7,
+        ),
+        **common,
+    )
+    clean.pop("_group")
+    wan = run_chaos(migrations=2, kill=False, **common)
+    wan.pop("_group")
+    killarm = run_chaos(
+        sessions=max(8, sessions // 2), ticks=max(30, ticks // 2),
+        hosts=2, entities=entities, seed=7, warmup=True,
+        migrations=0, kill=True, kill_pause_ticks=4,
+    )
+    killarm.pop("_group")
+    for name, arm in (("clean", clean), ("wan", wan), ("kill", killarm)):
+        assert arm["desyncs"] == 0, f"{name} arm desynced: {arm}"
+    handoff = wan["migration_wall_ms"]
+    resume = wan["migration_latency_ticks"]
+    return {
+        "sessions": wan["sessions"],
+        "ticks": ticks,
+        "entities": entities,
+        "clean_session_ticks_per_sec": clean["session_ticks_per_sec"],
+        "chaos_session_ticks_per_sec": wan["session_ticks_per_sec"],
+        "fps_retained": round(
+            wan["session_ticks_per_sec"]
+            / max(clean["session_ticks_per_sec"], 1e-9),
+            3,
+        ),
+        "migrations": wan["migrations_done"],
+        "migration_handoff_ms": (
+            round(sum(handoff) / len(handoff), 2) if handoff else None
+        ),
+        "migration_resume_ticks": (
+            round(sum(resume) / len(resume), 2) if resume else None
+        ),
+        "kill": killarm["kill"],
+        "p99_queue_wait_ticks": wan["p99_queue_wait_ticks"],
+        "max_queue_wait_ticks": wan["max_queue_wait_ticks"],
+        "drain_blocked_ticks": wan["drain_blocked_ticks"],
+        "profile": wan["profile"],
+    }
+
+
 def _obs_enable():
     """Called inside a phase subprocess (see _run_phase)."""
     from ggrs_tpu.obs import enable_global_telemetry
@@ -2005,7 +2072,8 @@ def main():
         "interleaved_spread_pct", "beam_ab_delta_ms", "beam_ab_wins",
         "history_b8_rate", "parity", "async_parity",
         "serve_sessions_per_sec", "serve_occupancy",
-        "serve_fast_dispatch_rate", "env_steps_per_sec", "headline_source",
+        "serve_fast_dispatch_rate", "env_steps_per_sec",
+        "chaos_fps_retained", "headline_source",
     )
 
     def _short_line(partial=False, error=None):
@@ -2252,6 +2320,15 @@ def main():
     )
     full["env_steps_per_sec"] = env256["env_steps_per_sec"]
     full["env_rollout"] = {"n256": env256, "n1024": env1024}
+    # fleet operations under fault: WAN-chaos fleet vs clean-network twin
+    # (2 live migrations + 1 host kill->restore per chaos arm)
+    chaos = phase(
+        "chaos_soak",
+        f"bench_chaos_soak(sessions={16 if SMOKE else 32}, "
+        f"ticks={30 if SMOKE else 100})",
+        timeout_s=900,
+    )
+    full["chaos_fps_retained"] = chaos["fps_retained"]
     beam_exec = phase("_beam_exec", "bench_beam_exec()")
     beam_live = phase(
         "_beam_live",

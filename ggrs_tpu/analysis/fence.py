@@ -83,6 +83,9 @@ POLICIES: Dict[str, FencePolicy] = {
             ("MultiSessionDeviceCore", "block_until_ready"),
             ("MultiSessionDeviceCore", "restore"),
             ("MultiSessionDeviceCore", "load_stacked"),
+            # live-migration slot adoption: eager per-leaf writes behind
+            # a full fence flush, the same discipline as reset_slot
+            ("MultiSessionDeviceCore", "import_slot"),
             # the plan cache's own accounting lives in its own class
             ("DispatchPlanCache", "__init__"),
             ("DispatchPlanCache", "note"),

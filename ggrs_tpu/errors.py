@@ -61,6 +61,66 @@ class HostFull(GGRSError):
         self.info = info
 
 
+class DrainStalled(GGRSError):
+    """A host flush (graceful drain, migration export, kill-time
+    checkpoint) failed to empty the ready queue: some staged rows can
+    never dispatch — a wedged fence, a monkeypatched scheduler, or a bug
+    in the budget accounting. Carries the stuck queue depth and the last
+    observed fence state so an operator sees WHAT is wedged, not a bare
+    AssertionError from the guard counter."""
+
+    def __init__(self, info: str, *, queue_depth: int = 0,
+                 inflight_rows: int = 0, passes: int = 0):
+        super().__init__(
+            f"{info} (queue_depth={queue_depth}, "
+            f"inflight_rows={inflight_rows}, passes={passes})"
+        )
+        self.info = info
+        self.queue_depth = queue_depth
+        self.inflight_rows = inflight_rows
+        self.passes = passes
+
+
+class CheckpointIncompatible(GGRSError):
+    """A durable checkpoint cannot be restored here: its format version
+    is newer than this build understands, its payload manifest does not
+    match the file's contents (truncation/corruption), or its meta names
+    a different core/game than the restore target. Carries both versions
+    so the operator-facing message says which side to upgrade, instead of
+    a shape error deep inside the restore."""
+
+    def __init__(self, info: str, *, found=None, expected=None):
+        detail = ""
+        if found is not None or expected is not None:
+            detail = f" (found={found!r}, expected={expected!r})"
+        super().__init__(info + detail)
+        self.info = info
+        self.found = found
+        self.expected = expected
+
+
+class MigrationIncompatible(InvalidRequest):
+    """A live-migration ticket cannot be imported into the destination
+    host: different game config (state tree shapes), input size, window,
+    or ring length. A subclass of InvalidRequest so catch-all admission
+    handling keeps working, but typed so a fleet router can distinguish
+    'pick another host' from 'this ticket is poison'."""
+
+
+class GroupSaturated(HostFull):
+    """Every host in a HostGroup rejected the admission (or handoff)
+    after the bounded retry/backoff ran out: the whole group is at
+    capacity. A subclass of HostFull so single-host callers keep
+    working; carries the attempt count and a per-host occupancy map for
+    the operator."""
+
+    def __init__(self, info: str, *, attempts: int = 0,
+                 per_host=None):
+        super().__init__(info)
+        self.attempts = attempts
+        self.per_host = dict(per_host or {})
+
+
 class RetraceBudgetExceeded(GGRSError):
     """The retrace sanitizer observed more compiled programs than the
     dispatch-bucket budget allows: a jit cache meant to be bounded by the

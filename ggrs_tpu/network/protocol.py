@@ -246,6 +246,25 @@ class PeerEndpoint:
         self.state = ProtocolState.DISCONNECTED
         self.shutdown_timeout = self.clock.now_ms() + UDP_SHUTDOWN_TIMER_MS
 
+    def resume_after_pause(self, now: Optional[int] = None) -> None:
+        """Rebase the receive baseline after the OWNING side was
+        suspended (live migration handoff, host kill→restore): the
+        endpoint was not polled during the blackout, so on the first
+        post-resume poll `last_recv_time` can be a full pause behind —
+        and if the peer's packets were ALSO lost during the blackout
+        (a killed host receives nothing), the disconnect timeout would
+        fire instantly against a peer that is alive and already
+        retransmitting. Granting a fresh full timeout window is the
+        correct bias: a genuinely dead peer still times out one
+        `disconnect_timeout_ms` later, while a live one replays its
+        backlog on the very next pump. Send-side timers are deliberately
+        NOT touched — stale send baselines make the first post-resume
+        poll immediately resend pending output, keep-alive and a quality
+        report, which is exactly the wake-up the peers need."""
+        if now is None:
+            now = self.clock.now_ms()
+        self.last_recv_time = max(self.last_recv_time, now)
+
     def is_synchronized(self) -> bool:
         return self.state in (
             ProtocolState.RUNNING,

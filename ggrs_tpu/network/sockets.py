@@ -107,8 +107,30 @@ class UdpNonBlockingSocket:
         self.sock.close()
 
 
+class FaultProfile(Protocol):
+    """Per-link fault model seam for InMemoryNetwork: given one datagram's
+    (src, dst, now, rng), return the delivery delays in milliseconds —
+    `[]` drops the datagram, one entry delivers once, N entries duplicate
+    it N ways (distinct delays reorder the copies). Implementations must
+    draw ONLY from the passed rng (and their own seeded state) so a run
+    stays deterministic per seed. The WAN-shaped profiles (regional RTT
+    matrices, Gilbert-Elliott loss bursts, reorder spikes) live in
+    ggrs_tpu.serve.chaos."""
+
+    def link(
+        self, src: Any, dst: Any, now_ms: int, rng: random.Random
+    ) -> List[int]: ...
+
+
 class InMemoryNetwork:
-    """A hub of virtual endpoints sharing one fault model and one clock."""
+    """A hub of virtual endpoints sharing one fault model and one clock.
+
+    Two fault tiers: the flat knobs (latency/jitter/loss/duplicate — the
+    original uniform model, untouched defaults) or a `profile` object
+    (FaultProfile) that decides per-link, per-datagram delivery — the
+    chaos loadgen's WAN shapes. `blackholed` addresses drop everything in
+    AND out silently (mass-disconnect storms, dead-host simulation): the
+    sender never learns, exactly like real packet loss."""
 
     def __init__(
         self,
@@ -119,6 +141,7 @@ class InMemoryNetwork:
         loss: float = 0.0,
         duplicate: float = 0.0,
         seed: int = 0,
+        profile: "FaultProfile | None" = None,
     ):
         self.clock = clock
         self.latency_ms = latency_ms
@@ -126,6 +149,8 @@ class InMemoryNetwork:
         self.loss = loss
         self.duplicate = duplicate
         self.rng = random.Random(seed)
+        self.profile = profile
+        self.blackholed: set = set()
         # addr -> heap of (deliver_at_ms, seq, (src, wire_bytes))
         self.queues: Dict[Any, List[Tuple[int, int, Tuple[Any, bytes]]]] = {}
         self._seq = 0
@@ -134,18 +159,38 @@ class InMemoryNetwork:
         self.queues.setdefault(addr, [])
         return InMemorySocket(self, addr)
 
+    def set_blackhole(self, addrs, on: bool = True) -> None:
+        """Silently drop all traffic to AND from these addresses (on) or
+        lift the blackout (off). Queued-but-undelivered datagrams are
+        left to deliver: they were already 'in the air'."""
+        if on:
+            self.blackholed.update(addrs)
+        else:
+            self.blackholed.difference_update(addrs)
+
     def _deliver(self, src: Any, dst: Any, wire: bytes) -> None:
-        if self.rng.random() < self.loss:
+        if src in self.blackholed or dst in self.blackholed:
             return
-        copies = 2 if self.rng.random() < self.duplicate else 1
-        for _ in range(copies):
-            delay = self.latency_ms
-            if self.jitter_ms:
-                delay += self.rng.randint(0, self.jitter_ms)
+        if self.profile is not None:
+            delays = self.profile.link(
+                src, dst, self.clock.now_ms(), self.rng
+            )
+        else:
+            if self.rng.random() < self.loss:
+                return
+            copies = 2 if self.rng.random() < self.duplicate else 1
+            delays = []
+            for _ in range(copies):
+                delay = self.latency_ms
+                if self.jitter_ms:
+                    delay += self.rng.randint(0, self.jitter_ms)
+                delays.append(delay)
+        now = self.clock.now_ms()
+        for delay in delays:
             self._seq += 1
             heapq.heappush(
                 self.queues.setdefault(dst, []),
-                (self.clock.now_ms() + delay, self._seq, (src, wire)),
+                (now + delay, self._seq, (src, wire)),
             )
 
     def _drain_wire(self, addr: Any) -> List[Tuple[Any, bytes]]:

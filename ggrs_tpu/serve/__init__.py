@@ -15,7 +15,15 @@ on the first SessionHost construction. The load-generator harness lives
 in ggrs_tpu.serve.loadgen (imported lazily for the same reason).
 """
 
-from ..errors import HostFull
+from ..errors import GroupSaturated, HostFull
 from .host import SessionHost
+from .migrate import HostGroup, MigrationTicket, migrate_session
 
-__all__ = ["HostFull", "SessionHost"]
+__all__ = [
+    "GroupSaturated",
+    "HostFull",
+    "HostGroup",
+    "MigrationTicket",
+    "SessionHost",
+    "migrate_session",
+]

@@ -1,0 +1,520 @@
+"""WAN-shaped chaos loadgen: seeded fault profiles + fleet-level fault
+injection over a HostGroup.
+
+The plain loadgen (serve/loadgen.py) proves the megabatch path under a
+uniform lossy link. Real fleets fail differently: RTT depends on which
+regions the peers sit in, loss arrives in bursts (congested queues, not
+coin flips), packets reorder when a spike delays one copy past its
+successors, users arrive in flash crowds and leave in mass-disconnect
+storms, and hosts die mid-match. This module models all of that behind
+two seams:
+
+  * `WanProfile` — a `FaultProfile` for InMemoryNetwork: a regional RTT
+    matrix (peers hash to regions), Gilbert-Elliott two-state burst loss
+    per directed link, jitter with occasional reorder spikes, and rare
+    duplication. Every draw comes from the network's seeded rng plus the
+    profile's own seeded link states, so a chaos run is bit-reproducible
+    per seed.
+  * `run_chaos` — the soak driver: >= N scripted sessions in 2-4-player
+    matches spread over a HostGroup, driven in virtual time through a
+    schedule of `ChaosEvent`s (live migrations, a host kill->restore
+    cycle, mass-disconnect storms, flash-crowd arrival waves). The gates
+    the report feeds: ZERO desyncs with real checksum comparisons, and a
+    bounded p99 admission-queue wait.
+
+scripts/check.sh --chaos-smoke runs a small seeded instance of exactly
+this; tests/test_fleet_ops.py pins the >=64-session acceptance soak.
+"""
+
+from __future__ import annotations
+
+import random
+import time as _time
+import zlib
+from typing import Any, Dict, List, Optional
+
+from ..errors import GroupSaturated, HostFull
+from ..network.sockets import InMemoryNetwork
+from ..sessions.builder import SessionBuilder
+from ..types import DesyncDetection, PlayerType, SessionState
+from ..utils.clock import FakeClock
+from .loadgen import FRAME_MS, build_matches, make_scripts, sync_fleet
+from .migrate import HostGroup
+
+
+def _region_of(addr: Any, regions: int) -> int:
+    """Stable, process-independent region assignment (hash() of str is
+    salted per process; crc32 of the repr is not)."""
+    return zlib.crc32(repr(addr).encode("utf-8")) % regions
+
+
+class WanProfile:
+    """Seeded WAN-shaped per-link fault model (FaultProfile).
+
+    Latency: `intra_ms` within a region; across regions,
+    `cross_base_ms + cross_step_ms * |r_src - r_dst|` — a crude but
+    monotone stand-in for geographic distance. Jitter: uniform
+    `[0, jitter_ms]`, plus a `reorder_spike_ms` spike with probability
+    `reorder` (a spiked datagram is overtaken by its successors — real
+    reordering, not just noise). Loss: Gilbert-Elliott per DIRECTED link
+    — a good state losing `loss_good` and a bad (burst) state losing
+    `loss_bad`, with seeded per-datagram transitions — so losses cluster
+    the way congested queues make them cluster. Duplication: `duplicate`
+    per datagram."""
+
+    def __init__(self, *, regions: int = 3, intra_ms: int = 12,
+                 cross_base_ms: int = 40, cross_step_ms: int = 25,
+                 jitter_ms: int = 8, reorder: float = 0.01,
+                 reorder_spike_ms: int = 60, loss_good: float = 0.01,
+                 loss_bad: float = 0.25, p_enter_burst: float = 0.005,
+                 p_exit_burst: float = 0.10, duplicate: float = 0.002,
+                 seed: int = 0):
+        self.regions = regions
+        self.intra_ms = intra_ms
+        self.cross_base_ms = cross_base_ms
+        self.cross_step_ms = cross_step_ms
+        self.jitter_ms = jitter_ms
+        self.reorder = reorder
+        self.reorder_spike_ms = reorder_spike_ms
+        self.loss_good = loss_good
+        self.loss_bad = loss_bad
+        self.p_enter_burst = p_enter_burst
+        self.p_exit_burst = p_exit_burst
+        self.duplicate = duplicate
+        self._link_rng = random.Random(seed ^ 0xC4A05)
+        # directed link -> True while in the bursty (bad) loss state
+        self._burst: Dict[Any, bool] = {}
+        # observability for reports/tests
+        self.delivered = 0
+        self.dropped = 0
+        self.duplicated = 0
+        self.spiked = 0
+
+    def base_latency_ms(self, src: Any, dst: Any) -> int:
+        r_src = _region_of(src, self.regions)
+        r_dst = _region_of(dst, self.regions)
+        if r_src == r_dst:
+            return self.intra_ms
+        return self.cross_base_ms + self.cross_step_ms * abs(r_src - r_dst)
+
+    def link(self, src: Any, dst: Any, now_ms: int,
+             rng: random.Random) -> List[int]:
+        # Gilbert-Elliott state step for this directed link
+        key = (src, dst)
+        burst = self._burst.get(key, False)
+        roll = self._link_rng.random()
+        if burst:
+            if roll < self.p_exit_burst:
+                burst = False
+        else:
+            if roll < self.p_enter_burst:
+                burst = True
+        self._burst[key] = burst
+        if rng.random() < (self.loss_bad if burst else self.loss_good):
+            self.dropped += 1
+            return []
+        delay = self.base_latency_ms(src, dst)
+        if self.jitter_ms:
+            delay += rng.randint(0, self.jitter_ms)
+        if rng.random() < self.reorder:
+            # spike one copy past its successors: genuine reordering
+            delay += self.reorder_spike_ms
+            self.spiked += 1
+        delays = [delay]
+        if rng.random() < self.duplicate:
+            delays.append(delay + rng.randint(0, self.jitter_ms or 1))
+            self.duplicated += 1
+        self.delivered += len(delays)
+        return delays
+
+    def section(self) -> dict:
+        return {
+            "regions": self.regions,
+            "delivered": self.delivered,
+            "dropped": self.dropped,
+            "duplicated": self.duplicated,
+            "reorder_spikes": self.spiked,
+            "links_in_burst": sum(1 for b in self._burst.values() if b),
+        }
+
+
+class ChaosEvent:
+    """One scheduled fault: `tick` (relative to the measured drive),
+    `kind` in {"migrate", "kill", "restore", "storm", "flash_crowd"},
+    plus kind-specific params."""
+
+    __slots__ = ("tick", "kind", "params")
+
+    def __init__(self, tick: int, kind: str, **params):
+        self.tick = tick
+        self.kind = kind
+        self.params = params
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"ChaosEvent({self.tick}, {self.kind!r}, {self.params})"
+
+
+def default_schedule(ticks: int, *, migrations: int = 2,
+                     kill: bool = True, kill_pause_ticks: int = 4,
+                     storm_matches: int = 0,
+                     flash_crowd: int = 0) -> List[ChaosEvent]:
+    """The canonical soak schedule: migrations spread through the run, a
+    kill->restore cycle at the midpoint, an optional flash crowd in the
+    first half and an optional mass-disconnect storm in the second."""
+    events: List[ChaosEvent] = []
+    for i in range(migrations):
+        events.append(
+            ChaosEvent(int(ticks * (i + 1) / (migrations + 2)), "migrate")
+        )
+    if flash_crowd:
+        events.append(
+            ChaosEvent(int(ticks * 0.30), "flash_crowd",
+                       sessions=flash_crowd)
+        )
+    if kill:
+        k = int(ticks * 0.5)
+        events.append(ChaosEvent(k, "kill"))
+        events.append(ChaosEvent(k + kill_pause_ticks, "restore"))
+    if storm_matches:
+        events.append(
+            ChaosEvent(int(ticks * 0.70), "storm", matches=storm_matches)
+        )
+    return sorted(events, key=lambda e: e.tick)
+
+
+def _p99(samples: List[int]) -> int:
+    if not samples:
+        return 0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(0.99 * (len(s) - 1) + 0.999))]
+
+
+def run_chaos(
+    *,
+    sessions: int = 64,
+    ticks: int = 120,
+    hosts: int = 2,
+    entities: int = 16,
+    max_players: int = 4,
+    max_prediction: int = 8,
+    seed: int = 0,
+    profile: Optional[WanProfile] = None,
+    events: Optional[List[ChaosEvent]] = None,
+    migrations: int = 2,
+    kill: bool = True,
+    kill_pause_ticks: int = 4,
+    storm_matches: int = 0,
+    flash_crowd: int = 0,
+    max_inflight_rows: Optional[int] = None,
+    desync_interval: int = 10,
+    sync_ticks: int = 800,
+    warmup: bool = True,
+    checkpoint_path: Optional[str] = None,
+    game=None,
+) -> Dict[str, Any]:
+    """Drive >= `sessions` scripted peers across a `hosts`-wide HostGroup
+    under a seeded WAN fault profile and a chaos schedule; returns a
+    JSON-able report (strip `_group` first). Deterministic per seed.
+
+    The default schedule injects `migrations` live migrations, one host
+    kill->restore cycle (the killed host's sessions pause
+    `kill_pause_ticks`, then resume from the kill-time checkpoint), and
+    optionally a flash crowd and a mass-disconnect storm. The soak's
+    gates: zero desyncs (with real checksum comparisons) and a bounded
+    p99 admission-queue wait."""
+    clock = FakeClock()
+    if profile is None:
+        profile = WanProfile(seed=seed)
+    net = InMemoryNetwork(clock, seed=seed, profile=profile)
+    if game is None:
+        from ..models.ex_game import ExGame
+
+        game = ExGame(num_players=max_players, num_entities=entities)
+    per_host = -(-sessions // hosts) + max_players  # room for overshoot
+    group = HostGroup.build(
+        game,
+        hosts,
+        clock=clock,
+        max_prediction=max_prediction,
+        num_players=max_players,
+        max_sessions=per_host,
+        # tight enough that bursts actually queue (the p99 gate must
+        # measure something real), loose enough to keep the fleet moving
+        max_inflight_rows=(
+            max_inflight_rows
+            if max_inflight_rows is not None
+            else max(8, per_host // 2)
+        ),
+        idle_timeout_ms=0,
+        warmup=warmup,
+    )
+    matches = build_matches(
+        group, net, clock,
+        sessions=sessions, max_prediction=max_prediction,
+        desync_interval=desync_interval, seed=seed,
+    )
+    n_sessions = sum(len(keys) for keys in matches)
+    sync_fleet(group, matches, clock, max_ticks=sync_ticks)
+
+    # measured window starts here: sync-phase queue waits / blocked
+    # flushes are warmup noise, not steady-state evidence
+    for host in group.hosts:
+        host.queue_waits.clear()
+    for keys in matches:
+        for k in keys:
+            sess = group.session(k)
+            if hasattr(sess, "drain_blocked_ticks"):
+                sess.drain_blocked_ticks = 0
+
+    if events is None:
+        events = default_schedule(
+            ticks, migrations=migrations, kill=kill,
+            kill_pause_ticks=kill_pause_ticks,
+            storm_matches=storm_matches, flash_crowd=flash_crowd,
+        )
+    by_tick: Dict[int, List[ChaosEvent]] = {}
+    for ev in events:
+        by_tick.setdefault(ev.tick, []).append(ev)
+
+    own_checkpoint = checkpoint_path is None
+    if own_checkpoint:
+        import os as _os
+        import tempfile
+
+        fd, checkpoint_path = tempfile.mkstemp(
+            prefix=f"ggrs_chaos_s{seed}_", suffix=".npz"
+        )
+        _os.close(fd)
+
+    scripts = make_scripts(matches, ticks, seed)
+    rng = random.Random(seed ^ 0xCA05)
+    desyncs: List[Any] = []
+    stormed: set = set()
+    crowd: List[Any] = []  # (gkey, match_index, peer_index, attach_tick)
+    migrations_done = 0
+    migrations_skipped = 0
+    migration_latency_ticks: List[int] = []
+    migration_wall_ms: List[float] = []
+    crowd_attached = crowd_rejected = 0
+    kill_stats: Dict[str, Any] = {}
+    watching: List[Any] = []  # (gkey, frame_at_migration, tick)
+
+    def collect(evs_by_key) -> None:
+        for gkey, evs in evs_by_key.items():
+            for e in evs:
+                if type(e).__name__ == "DesyncDetected":
+                    desyncs.append((gkey, e))
+
+    def do_migrate(t: int) -> None:
+        nonlocal migrations_done, migrations_skipped
+        alive = [i for i in group._alive()]
+        if len(alive) < 2:
+            migrations_skipped += 1
+            return
+        src = max(alive, key=lambda i: group.hosts[i].active_sessions)
+        candidates = [
+            g for g in group.keys_on(src)
+            if g not in stormed
+            and group.session(g).current_state() == SessionState.RUNNING
+            and not group._records[g].session.spectator_handles()
+        ]
+        if not candidates:
+            migrations_skipped += 1
+            return
+        gkey = candidates[rng.randrange(len(candidates))]
+        f0 = group.session(gkey).current_frame
+        t0 = _time.perf_counter()
+        try:
+            group.migrate(gkey)
+        except HostFull:
+            migrations_skipped += 1
+            return
+        migration_wall_ms.append((_time.perf_counter() - t0) * 1000.0)
+        migrations_done += 1
+        watching.append((gkey, f0, t))
+
+    def do_kill(t: int) -> None:
+        alive = group._alive()
+        if len(alive) < 2:
+            return
+        victim = max(alive, key=lambda i: group.hosts[i].active_sessions)
+        t0 = _time.perf_counter()
+        n = group.kill_host(victim, checkpoint_path)
+        kill_stats.update(
+            host=victim, sessions_suspended=n, killed_at_tick=t,
+            kill_wall_ms=round((_time.perf_counter() - t0) * 1000.0, 2),
+        )
+
+    def do_restore(t: int) -> None:
+        if "host" not in kill_stats or "restored_at_tick" in kill_stats:
+            return
+        t0 = _time.perf_counter()
+        n = group.restore_host(kill_stats["host"], checkpoint_path)
+        # the wall cost is dominated by the replacement host's warmup
+        # compile of the megabatch grid — a production restore would warm
+        # a standby host BEFORE taking traffic; reported so the bench can
+        # separate availability cost from network-degradation cost
+        kill_stats.update(
+            sessions_resumed=n, restored_at_tick=t,
+            restore_wall_ms=round((_time.perf_counter() - t0) * 1000.0, 2),
+        )
+
+    def do_storm(t: int, n_matches: int) -> None:
+        victims = [
+            m for m, keys in enumerate(matches)
+            if not any(k in stormed for k in keys)
+        ][-n_matches:]
+        addrs = []
+        for m in victims:
+            for k, gkey in enumerate(matches[m]):
+                stormed.add(gkey)
+                addrs.append((m, k))
+        net.set_blackhole(addrs)
+
+    def do_flash_crowd(t: int, n: int) -> None:
+        nonlocal crowd_attached, crowd_rejected
+        pairs = -(-n // 2)  # 2-player matches
+        for i in range(pairs):
+            peers = []
+            try:
+                for k in range(2):
+                    b = (
+                        SessionBuilder(input_size=game.input_size)
+                        .with_num_players(2)
+                        .with_max_prediction_window(max_prediction)
+                        .with_input_delay(1)
+                        .with_desync_detection_mode(
+                            DesyncDetection.on(interval=desync_interval)
+                        )
+                        .with_clock(clock)
+                        .with_rng(random.Random(
+                            (seed * 7919 + 0xFC0 + i * 131 + k) & 0xFFFF
+                        ))
+                    )
+                    for h in range(2):
+                        if h == k:
+                            b = b.add_player(PlayerType.local(), h)
+                        else:
+                            b = b.add_player(
+                                PlayerType.remote(("fc", i, h)), h
+                            )
+                    sess = b.start_p2p_session(net.socket(("fc", i, k)))
+                    peers.append(group.attach(sess))
+            except GroupSaturated:
+                # a half-attached pair can never synchronize (its remote
+                # was never built): release the orphan instead of letting
+                # it pin a slot and skew occupancy/queue measurements...
+                for gkey in peers:
+                    group.detach(gkey)
+                # ...and the whole remaining wave counts as rejected, not
+                # just the pair that tripped saturation
+                crowd_rejected += 2 * (pairs - i)
+                break
+            for k, gkey in enumerate(peers):
+                crowd.append((gkey, i, k, t))
+            crowd_attached += len(peers)
+
+    handlers = {
+        "migrate": lambda ev, t: do_migrate(t),
+        "kill": lambda ev, t: do_kill(t),
+        "restore": lambda ev, t: do_restore(t),
+        "storm": lambda ev, t: do_storm(t, ev.params.get("matches", 1)),
+        "flash_crowd": lambda ev, t: do_flash_crowd(
+            t, ev.params.get("sessions", 2)
+        ),
+    }
+
+    t_wall = _time.perf_counter()
+    for t in range(ticks):
+        for ev in by_tick.get(t, ()):
+            handlers[ev.kind](ev, t)
+        # scripted inputs: base matches from the pre-generated scripts,
+        # crowd matches from a derived deterministic stream once RUNNING
+        for m, keys in enumerate(matches):
+            for k, gkey in enumerate(keys):
+                if gkey in stormed:
+                    continue
+                group.submit_input(gkey, k, bytes([scripts[(m, k)][t]]))
+        for gkey, i, k, t_attach in crowd:
+            sess = group._records.get(gkey)
+            if sess is None:
+                continue
+            if sess.session.current_state() == SessionState.RUNNING:
+                group.submit_input(
+                    gkey, k,
+                    bytes([(seed * 31 + i * 17 + k * 7 + t) % 16]),
+                )
+        collect(group.tick())
+        # migration latency: ticks from the handoff to the first
+        # post-handoff frame advance on the destination host
+        for w in list(watching):
+            gkey, f0, t_mig = w
+            rec = group._records.get(gkey)
+            if rec is None:
+                watching.remove(w)
+                continue
+            if rec.session.current_frame > f0:
+                migration_latency_ticks.append(t - t_mig)
+                watching.remove(w)
+        clock.advance(FRAME_MS)
+
+    # cooldown: let in-flight inputs and checksum reports land so the
+    # final comparison intervals actually run
+    for _ in range(3 * max_prediction):
+        collect(group.tick())
+        clock.advance(FRAME_MS)
+    drive_s = _time.perf_counter() - t_wall
+    if own_checkpoint:
+        # the restore consumed it; a driver-owned temp file must not
+        # accumulate across bench/smoke/CI runs
+        import os as _os
+
+        try:
+            _os.unlink(checkpoint_path)
+        except OSError:
+            pass
+
+    survivors = [
+        (m, k, gkey)
+        for m, keys in enumerate(matches)
+        for k, gkey in enumerate(keys)
+        if gkey not in stormed and gkey in group._records
+    ]
+    frames = [group.session(g).current_frame for _, _, g in survivors]
+    checksums_published = sum(
+        len(getattr(group.session(g), "local_checksum_history", ()))
+        for _, _, g in survivors
+    )
+    waits = group.queue_waits()
+    report: Dict[str, Any] = {
+        "sessions": n_sessions,
+        "matches": len(matches),
+        "hosts": hosts,
+        "ticks": ticks,
+        "seed": seed,
+        "desyncs": len(desyncs),
+        "checksums_published": checksums_published,
+        "session_ticks_per_sec": round(n_sessions * ticks / drive_s, 1),
+        "min_frame": min(frames) if frames else 0,
+        "max_frame": max(frames) if frames else 0,
+        "migrations_done": migrations_done,
+        "migrations_skipped": migrations_skipped,
+        "migration_latency_ticks": migration_latency_ticks,
+        "migration_wall_ms": [round(x, 2) for x in migration_wall_ms],
+        "kill": kill_stats or None,
+        "storm_sessions": len(stormed),
+        "flash_crowd": {
+            "attached": crowd_attached, "rejected": crowd_rejected,
+        } if crowd_attached or crowd_rejected else None,
+        "p99_queue_wait_ticks": _p99(waits),
+        "max_queue_wait_ticks": max(waits) if waits else 0,
+        "queue_wait_samples": len(waits),
+        "drain_blocked_ticks": int(sum(
+            getattr(group.session(g), "drain_blocked_ticks", 0)
+            for _, _, g in survivors
+        )),
+        "profile": profile.section(),
+        "group": group.group_section(),
+    }
+    report["_group"] = group  # live handle for callers; strip before JSON
+    return report

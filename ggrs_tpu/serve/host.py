@@ -41,7 +41,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..errors import GGRSError, HostFull, InvalidRequest, PredictionThreshold
+from ..errors import (
+    DrainStalled,
+    GGRSError,
+    HostFull,
+    InvalidRequest,
+    PredictionThreshold,
+)
 from ..obs import GLOBAL_TELEMETRY, SESSION_COUNT_BUCKETS
 from ..types import (
     Event,
@@ -206,6 +212,10 @@ class SessionHost:
         self.sessions_evicted = 0
         self.sessions_gced = 0
         self.desyncs_observed = 0
+        # plain queue-wait samples (ticks a session's staged rows waited
+        # before dispatch), always maintained so chaos harnesses can read
+        # a p99 without telemetry; bounded so a long soak can't grow it
+        self.queue_waits: List[int] = []
         _reg = GLOBAL_TELEMETRY.registry
         self._m_active = _reg.gauge(
             "ggrs_host_sessions_active", "sessions currently attached"
@@ -241,27 +251,13 @@ class SessionHost:
     # admission / lifecycle
     # ------------------------------------------------------------------
 
-    def attach(self, session, *, key: Any = None) -> Any:
-        """Admit a session; returns its host key. Raises HostFull when the
-        host is at max_sessions or draining, InvalidRequest when the
-        session is incompatible with the host layout or already hosted."""
-        if self._draining:
-            self._reject()
-            raise HostFull("host is draining: not admitting sessions")
-        if not self._free_slots:
-            self._reject()
-            raise HostFull(
-                f"host is at max_sessions={self.max_sessions}"
-            )
-        if key is None:
-            key = self._next_key
-            self._next_key += 1
-        if key in self._lanes:
-            raise InvalidRequest(f"host key {key!r} already in use")
-
-        # admission validates EVERYTHING the staging path will assume, so
-        # an incompatible session is rejected here with a clear error
-        # instead of crashing tick() for the whole fleet later
+    def _validate_session(self, session):
+        """The admission checks attach() and adopt() share: session type,
+        player-layout fit, input size, prediction window. Validates
+        EVERYTHING the staging path will assume, so an incompatible
+        session is rejected here with a clear error instead of crashing
+        tick() for the whole fleet later. Returns the lane parameters
+        (kind, n_players, local_handles, max_prediction)."""
         from ..sessions.p2p_session import P2PSession
         from ..sessions.spectator_session import SpectatorSession
 
@@ -292,41 +288,149 @@ class SessionHost:
                     f"session max_prediction {session.max_prediction} "
                     f"exceeds the host window ({self.max_prediction})"
                 )
-            if session.sync_layer.current_frame != 0:
-                raise InvalidRequest(
-                    "host requires a fresh session (frame 0); this one is "
-                    f"at frame {session.sync_layer.current_frame}"
-                )
             local_handles = session.local_player_handles()
             max_prediction = session.max_prediction
         else:
-            if session.current_frame >= 0:
-                raise InvalidRequest(
-                    "host requires a fresh spectator session; this one "
-                    f"already advanced to frame {session.current_frame}"
-                )
             local_handles = []
             max_prediction = self.max_prediction
+        return kind, n_players, local_handles, max_prediction
 
-        # the hook raises on double-attach BEFORE we commit a slot
-        session.on_host_attach(self, key)
+    def _claim_admission(self, key: Any, slot: Optional[int]):
+        """Admission-control gate shared by attach() and adopt(): raises
+        HostFull (draining / out of slots), resolves the key, and claims
+        a device slot — the requested one for a checkpoint-restore
+        re-adoption, else the free-list head."""
+        if self._draining:
+            self._reject()
+            raise HostFull("host is draining: not admitting sessions")
+        if not self._free_slots:
+            self._reject()
+            raise HostFull(
+                f"host is at max_sessions={self.max_sessions}"
+            )
+        if key is None:
+            key = self._next_key
+            self._next_key += 1
+        if key in self._lanes:
+            raise InvalidRequest(f"host key {key!r} already in use")
+        if slot is None:
+            slot = self._free_slots.pop()
+        else:
+            # restore-from-checkpoint re-adoption: the stacked worlds
+            # already hold this session AT ITS OLD SLOT
+            try:
+                self._free_slots.remove(slot)
+            except ValueError:
+                raise InvalidRequest(
+                    f"device slot {slot} is not free on this host"
+                ) from None
+        return key, slot
+
+    def _commit_lane(self, session, key: Any, slot: int, kind: str,
+                     n_players: int, local_handles, max_prediction: int,
+                     current_frame: int) -> _Lane:
         if not self.batched_pump:
             # the legacy-pump host is the parity reference: its sessions
             # must pump per-message too, or the "pre-batched" arm would
             # still ride the batched single-session pump underneath
             session.batched_pump = False
-        slot = self._free_slots.pop()
-        self.device.reset_slot(slot)
-        self._lanes[key] = _Lane(
+        lane = _Lane(
             key, session, slot, kind, n_players, local_handles,
             max_prediction, self.clock.now_ms(),
             self.device.core._packed_len,
         )
+        lane.current_frame = current_frame
+        self._lanes[key] = lane
         self.sessions_admitted += 1
-        tel = GLOBAL_TELEMETRY
-        if tel.enabled:
+        if GLOBAL_TELEMETRY.enabled:
             self._m_active.set(len(self._lanes))
-            tel.record("host_session_attached", key=str(key), slot=slot)
+        return lane
+
+    def attach(self, session, *, key: Any = None) -> Any:
+        """Admit a session; returns its host key. Raises HostFull when the
+        host is at max_sessions or draining, InvalidRequest when the
+        session is incompatible with the host layout or already hosted."""
+        key, slot = self._claim_admission(key, None)
+        try:
+            kind, n_players, local_handles, max_prediction = (
+                self._validate_session(session)
+            )
+            # attach() admits only FRESH sessions: the lane's frame
+            # bookkeeping starts at 0 (mid-match sessions arrive through
+            # adopt(), with their device slot riding a migration ticket)
+            if kind == "p2p" and session.sync_layer.current_frame != 0:
+                raise InvalidRequest(
+                    "host requires a fresh session (frame 0); this one is "
+                    f"at frame {session.sync_layer.current_frame} "
+                    "(mid-match sessions migrate via serve.migrate)"
+                )
+            if kind == "spectator" and session.current_frame >= 0:
+                raise InvalidRequest(
+                    "host requires a fresh spectator session; this one "
+                    f"already advanced to frame {session.current_frame}"
+                )
+            # the hook raises on double-attach BEFORE we commit the slot
+            session.on_host_attach(self, key)
+        except BaseException:
+            self._free_slots.append(slot)
+            raise
+        self.device.reset_slot(slot)
+        self._commit_lane(
+            session, key, slot, kind, n_players, local_handles,
+            max_prediction, 0,
+        )
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "host_session_attached", key=str(key), slot=slot
+            )
+        return key
+
+    def adopt(self, session, *, current_frame: int, slot_state=None,
+              pending_inputs=(), key: Any = None,
+              slot: Optional[int] = None) -> Any:
+        """Admit a MID-MATCH session — the receiving half of a live
+        migration or a kill→restore re-adoption (ggrs_tpu/serve/migrate).
+        `slot_state` is an `export_slot()` payload imported into the
+        claimed slot (validated shape-by-shape, MigrationIncompatible on
+        any mismatch); `slot_state=None` claims `slot` with the worlds
+        already in place (the restore-from-checkpoint path, where
+        load_stacked put every slot's bytes back at once). The lane
+        resumes at `current_frame` with `pending_inputs` re-armed, so the
+        first tick after adoption advances exactly where the source host
+        left off."""
+        key, claimed = self._claim_admission(key, slot)
+        try:
+            kind, n_players, local_handles, max_prediction = (
+                self._validate_session(session)
+            )
+            if kind == "p2p" and (
+                session.sync_layer.current_frame != current_frame
+            ):
+                raise InvalidRequest(
+                    f"adopt() frame {current_frame} disagrees with the "
+                    f"session's own frame "
+                    f"{session.sync_layer.current_frame}"
+                )
+            session.on_host_attach(self, key)
+            try:
+                if slot_state is not None:
+                    self.device.import_slot(claimed, slot_state)
+            except BaseException:
+                session.on_host_detach()
+                raise
+        except BaseException:
+            self._free_slots.append(claimed)
+            raise
+        lane = self._commit_lane(
+            session, key, claimed, kind, n_players, local_handles,
+            max_prediction, current_frame,
+        )
+        lane.pending_inputs = set(pending_inputs)
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "host_session_adopted", key=str(key), slot=claimed,
+                frame=current_frame,
+            )
         return key
 
     def _reject(self) -> None:
@@ -565,15 +669,38 @@ class SessionHost:
             return False
         # mirror sync_layer.add_local_input's prediction-threshold gate so
         # a throttled session never advances into the partially-mutated
-        # PredictionThreshold raise mid-advance
+        # PredictionThreshold raise mid-advance. The watermark must be the
+        # FRESH confirmed frame (min over connected peers, what
+        # advance_frame is about to set) — not the stale
+        # sl.last_confirmed_frame, which only updates inside
+        # advance_frame: gating on the stale value wedges a session
+        # permanently once RTT exceeds the prediction window, because the
+        # advance that would refresh the watermark is exactly what the
+        # gate blocks (found by the WAN-profile chaos soak, where
+        # cross-region links run 10+ frames of RTT). Sparse saving needs
+        # no extra clamp here: set_last_confirmed_frame clamps the
+        # watermark to last_saved_frame, but _check_last_saved_state runs
+        # FIRST in the same advance and repairs last_saved to
+        # min(confirmed, current) whenever the lag reaches the window
+        # (p2p_session asserts it), so in the unrepaired region
+        # current - last_saved < max_prediction and only the confirmed
+        # term below can bind the in-advance PredictionThreshold raise.
         sl = s.sync_layer
-        if (
-            sl.current_frame >= lane.max_prediction
-            and sl.current_frame - sl.last_confirmed_frame
-            >= lane.max_prediction
-        ):
-            lane.throttled_ticks += 1
-            return False
+        if sl.current_frame >= lane.max_prediction:
+            confirmed = min(
+                (
+                    st.last_frame
+                    for st in s.local_connect_status
+                    if not st.disconnected
+                ),
+                default=None,
+            )
+            if (
+                confirmed is None
+                or sl.current_frame - confirmed >= lane.max_prediction
+            ):
+                lane.throttled_ticks += 1
+                return False
         return True
 
     # ------------------------------------------------------------------
@@ -763,10 +890,11 @@ class SessionHost:
                         )
                     if not lane.rows:
                         self._ready.remove(lane.key)
+                        waited = self._tick_index - lane.queued_since_tick
+                        if len(self.queue_waits) < 1 << 16:
+                            self.queue_waits.append(waited)
                         if GLOBAL_TELEMETRY.enabled:
-                            self._m_queue_wait.observe(
-                                self._tick_index - lane.queued_since_tick
-                            )
+                            self._m_queue_wait.observe(waited)
                         lane.queued_since_tick = None
         if GLOBAL_TELEMETRY.enabled:
             self._m_queue_depth.set(len(self._ready))
@@ -823,21 +951,59 @@ class SessionHost:
             )
         self.detach(lane.key)
 
-    def drain(self, checkpoint_path: Optional[str] = None) -> dict:
-        """Graceful shutdown: stop admitting (attach raises HostFull),
-        flush every staged row and the async fence, optionally checkpoint
-        the stacked device worlds, and return a final summary. Sessions
-        stay attached (detach them, or let the process exit)."""
-        self._draining = True
-        guard = 0
+    def _flush_ready(self, reason: str, *, max_passes: int = 10_000) -> None:
+        """Flush every staged row through the device — the shared tail of
+        graceful drain, the non-terminal checkpoint, and a migration
+        export. A queue that refuses to empty (wedged fence, broken
+        budget accounting, a monkeypatched scheduler) raises the typed,
+        operator-facing DrainStalled carrying the stuck depth and fence
+        state — and a flight-recorder event — instead of dying as a bare
+        AssertionError in a shutdown path."""
+        passes = 0
         while self._ready:
             # retire the whole fence first so the budget can never pin the
             # queue: each pass then dispatches at least one megabatch
             self.device.block_until_ready()
             self._pump_device()
-            guard += 1
-            assert guard < 10_000, "drain failed to flush the ready queue"
+            passes += 1
+            if passes >= max_passes and self._ready:
+                depth = len(self._ready)
+                inflight = self.device.inflight_rows
+                if GLOBAL_TELEMETRY.enabled:
+                    GLOBAL_TELEMETRY.record(
+                        "host_drain_stalled", reason=reason,
+                        queue_depth=depth, inflight_rows=inflight,
+                        passes=passes,
+                    )
+                raise DrainStalled(
+                    f"{reason}: ready queue failed to flush",
+                    queue_depth=depth, inflight_rows=inflight,
+                    passes=passes,
+                )
         self.device.block_until_ready()
+
+    def checkpoint(self, path: str) -> None:
+        """Durably checkpoint the stacked device worlds WITHOUT draining:
+        flush staged rows and the fence, write the .npz, keep serving.
+        The periodic crash-recovery story — a kill→restore rebuilds a
+        host from the latest checkpoint (serve/migrate.HostGroup)."""
+        self._flush_ready("checkpoint")
+        self.device.save(path)
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "host_checkpointed", path=str(path),
+                sessions=len(self._lanes),
+            )
+
+    def drain(self, checkpoint_path: Optional[str] = None) -> dict:
+        """Graceful shutdown: stop admitting (attach raises HostFull),
+        flush every staged row and the async fence, optionally checkpoint
+        the stacked device worlds, and return a final summary. Sessions
+        stay attached (detach them, or let the process exit). Raises
+        DrainStalled (typed, with the stuck queue depth and fence state)
+        if the flush cannot make progress."""
+        self._draining = True
+        self._flush_ready("drain")
         if checkpoint_path is not None:
             self.device.save(checkpoint_path)
         self._drained = True

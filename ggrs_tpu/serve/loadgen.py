@@ -104,12 +104,18 @@ def make_scripts(matches, ticks: int, seed: int) -> Dict[Any, List[int]]:
     }
 
 
-def drive_scripted(host, matches, clock, scripts, ticks: int) -> List[Any]:
+def drive_scripted(host, matches, clock, scripts, ticks: int,
+                   on_tick=None) -> List[Any]:
     """Submit every peer's scripted input and tick the host `ticks`
     times; returns the (key, event) DesyncDetected pairs observed. The
-    shared drive loop of run_loadgen and bench.bench_serve_host."""
+    shared drive loop of run_loadgen and bench.bench_serve_host.
+    `on_tick(t)` runs at the top of each tick — the seam fault-injection
+    harnesses hook (the full chaos driver with migrations/kills lives in
+    serve/chaos.py)."""
     desyncs: List[Any] = []
     for t in range(ticks):
+        if on_tick is not None:
+            on_tick(t)
         for m, keys in enumerate(matches):
             for k, key in enumerate(keys):
                 host.submit_input(key, k, bytes([scripts[(m, k)][t]]))
@@ -134,6 +140,8 @@ def run_loadgen(
     latency_ms: int = 20,
     jitter_ms: int = 10,
     loss: float = 0.05,
+    duplicate: float = 0.0,
+    profile=None,
     seed: int = 0,
     host: Optional[SessionHost] = None,
     max_inflight_rows: Optional[int] = None,
@@ -147,14 +155,19 @@ def run_loadgen(
     desyncs, per-session progress, megabatch shape, queue behavior.
 
     `host=None` builds one sized to the fleet (ExGame by default);
-    passing a host lets bench arms reuse a warmed core across runs."""
+    passing a host lets bench arms reuse a warmed core across runs.
+    `profile` plugs a per-link FaultProfile (e.g. serve.chaos.WanProfile)
+    into the virtual network in place of the flat latency/jitter/loss
+    knobs — WAN-shaped soaks without the full chaos schedule."""
     clock = FakeClock()
     net = InMemoryNetwork(
         clock,
         latency_ms=latency_ms,
         jitter_ms=jitter_ms,
         loss=loss,
+        duplicate=duplicate,
         seed=seed,
+        profile=profile,
     )
     if host is None:
         if game is None:

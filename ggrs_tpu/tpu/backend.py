@@ -1841,6 +1841,14 @@ class MultiSessionDeviceCore:
         self._reset_mask_fn = jax.jit(
             self._reset_masked_impl, donate_argnums=(0, 1)
         )
+        # slot export/import (live migration): the slot index is TRACED
+        # data, so one cached program covers every slot — an eager
+        # `.at[slot].set` would bake the index in as a constant and pay
+        # a fresh XLA compile per distinct migrated slot
+        self._export_slot_fn = jax.jit(self._export_slot_impl)
+        self._import_slot_fn = jax.jit(
+            self._import_slot_impl, donate_argnums=(0, 1)
+        )
         self._pad_row = self.core.pad_tick_row()
         # per-row-bucket pooled (idx, rows) staging, async_inflight + 1
         # deep — the dispatch compaction packs straight into these
@@ -2265,6 +2273,80 @@ class MultiSessionDeviceCore:
             lambda a: np.asarray(jax.device_get(a[slot])), self.states
         )
 
+    # ------------------------------------------------------------------
+    # per-slot export/import (live session migration rides this)
+    # ------------------------------------------------------------------
+
+    def _export_slot_impl(self, rings, states, slot):
+        ring = jax.tree.map(lambda a: a[slot], rings)
+        state = jax.tree.map(lambda a: a[slot], states)
+        return ring, state
+
+    def _import_slot_impl(self, rings, states, slot, ring, state):
+        rings = jax.tree.map(lambda a, x: a.at[slot].set(x), rings, ring)
+        states = jax.tree.map(
+            lambda a, x: a.at[slot].set(x), states, state
+        )
+        return rings, states
+
+    def export_slot(self, slot: int) -> dict:
+        """Host copy of ONE slot's complete device residue — live world
+        AND snapshot ring — as {"ring": tree, "state": tree} of numpy
+        arrays: everything a sibling host needs to resume this session
+        bit-exactly (the ring bytes matter — a post-migration rollback
+        loads a pre-migration snapshot). Flushes the fence first so the
+        copy observes every dispatched megabatch that wrote the slot."""
+        assert 0 <= slot < self.capacity
+        self.block_until_ready()
+        ring, state = self._export_slot_fn(
+            self.rings, self.states, np.int32(slot)
+        )
+        return {
+            "ring": jax.tree.map(
+                lambda a: np.asarray(jax.device_get(a)), ring
+            ),
+            "state": jax.tree.map(
+                lambda a: np.asarray(jax.device_get(a)), state
+            ),
+        }
+
+    def import_slot(self, slot: int, payload: dict) -> None:
+        """Adopt an export_slot() payload into one slot of THIS core —
+        the receiving half of a live migration. Validates the payload's
+        tree structure and per-leaf shapes/dtypes against this core's
+        stacked layout and raises MigrationIncompatible naming the first
+        mismatch (a different game config must fail at the handoff, not
+        as an XLA shape error mid-megabatch). Eager per-leaf updates —
+        a lifecycle event, not a hot path — behind a full fence flush,
+        the same discipline as reset_slot."""
+        from ..errors import MigrationIncompatible
+
+        assert 0 <= slot < self.capacity
+        for name, stacked in (("ring", self.rings), ("state", self.states)):
+            flat_dst = jax.tree_util.tree_leaves_with_path(stacked)
+            flat_src = jax.tree_util.tree_leaves_with_path(payload[name])
+            if [p for p, _ in flat_dst] != [p for p, _ in flat_src]:
+                raise MigrationIncompatible(
+                    f"slot payload '{name}' tree does not match this "
+                    f"core's layout (different game model?): "
+                    f"{[jax.tree_util.keystr(p) for p, _ in flat_src]} vs "
+                    f"{[jax.tree_util.keystr(p) for p, _ in flat_dst]}"
+                )
+            for (path, dst), (_, src) in zip(flat_dst, flat_src):
+                want, got = dst.shape[1:], np.asarray(src).shape
+                if want != got or dst.dtype != np.asarray(src).dtype:
+                    raise MigrationIncompatible(
+                        f"slot payload '{name}{jax.tree_util.keystr(path)}' "
+                        f"is {got}/{np.asarray(src).dtype}, this core's "
+                        f"slots are {want}/{dst.dtype} — the hosts run "
+                        "different game configs"
+                    )
+        self.block_until_ready()
+        self.rings, self.states = self._import_slot_fn(
+            self.rings, self.states, np.int32(slot),
+            payload["ring"], payload["state"],
+        )
+
     def warmup(self) -> None:
         """Compile the megabatch program grid — every (row-count bucket x
         depth bucket) plus the zero-rollback fast path per row bucket —
@@ -2303,6 +2385,11 @@ class MultiSessionDeviceCore:
             np.zeros((self.capacity + 1,), dtype=bool),
             self._init_state,
         )
+        # one export->import round trip of slot 0 (same bytes back, a
+        # true no-op): the eager per-leaf slot writes compile their XLA
+        # programs HERE, so the first live migration pays a memcpy, not
+        # a compile stall mid-serve
+        self.import_slot(0, self.export_slot(0))
         self.block_until_ready()
 
     def block_until_ready(self) -> None:
@@ -2334,7 +2421,13 @@ class MultiSessionDeviceCore:
         from ..utils.checkpoint import load_device_checkpoint
 
         tree, meta = load_device_checkpoint(path)
-        assert meta["kind"] == "MultiSessionDeviceCore"
+        if meta.get("kind") != "MultiSessionDeviceCore":
+            from ..errors import CheckpointIncompatible
+
+            raise CheckpointIncompatible(
+                f"checkpoint {path!r} holds a different core kind",
+                found=meta.get("kind"), expected="MultiSessionDeviceCore",
+            )
         core = cls(
             game,
             max_prediction=meta["max_prediction"],

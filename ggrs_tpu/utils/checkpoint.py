@@ -6,6 +6,14 @@ backend's ring + live state) can be written to one .npz file and restored
 bit-exactly, so a determinism soak or a long-running session can stop and
 resume. Format: flattened key-path -> array pairs plus a JSON meta blob;
 integers/arrays only, so restores are exact by construction.
+
+Every checkpoint is stamped with a FORMAT VERSION and a payload MANIFEST
+(array path -> shape/dtype): a restore validates both up front and raises
+a typed `CheckpointIncompatible` naming exactly what differs — a truncated
+file, a corrupted member or a checkpoint written by a newer build fails at
+the door with an operator-facing message, never as a shape error deep
+inside the restore. Version-1 files (pre-stamp) still load: the stamp is
+additive, absence means "legacy, best effort".
 """
 
 from __future__ import annotations
@@ -14,6 +22,15 @@ import json
 from typing import Any, Dict, Tuple
 
 import numpy as np
+
+from ..errors import CheckpointIncompatible
+
+# version 2 added the format stamp + manifest; bump ONLY for layout
+# changes a version-2 reader cannot survive (a new meta key is not one)
+CHECKPOINT_FORMAT_VERSION = 2
+# the key the stamp hides under inside the meta JSON: load pops it back
+# out, so callers' meta round-trips unchanged
+_FORMAT_KEY = "__format__"
 
 
 def _flatten(tree: Any, prefix: str = "") -> Dict[str, Any]:
@@ -43,17 +60,76 @@ def save_device_checkpoint(path: str, tree: Any, meta: Dict[str, Any]) -> None:
 
     host_tree = jax.device_get(tree)
     flat = {f"t/{k}": np.asarray(v) for k, v in _flatten(host_tree).items()}
+    stamped = dict(meta)
+    stamped[_FORMAT_KEY] = {
+        "version": CHECKPOINT_FORMAT_VERSION,
+        "manifest": {
+            k: [list(v.shape), v.dtype.str] for k, v in flat.items()
+        },
+    }
     flat["__meta__"] = np.frombuffer(
-        json.dumps(meta).encode("utf-8"), dtype=np.uint8
+        json.dumps(stamped).encode("utf-8"), dtype=np.uint8
     )
     np.savez_compressed(path, **flat)
 
 
+def _check_format(path: str, fmt: Dict[str, Any],
+                  arrays: Dict[str, np.ndarray]) -> None:
+    """Validate the stamped format against the ALREADY-DECOMPRESSED
+    payload arrays — NpzFile does not cache member reads, so validating
+    off a second `data[name]` pass would decompress every array twice
+    and double the I/O cost of a kill→restore blackout."""
+    version = fmt.get("version")
+    if not isinstance(version, int) or version > CHECKPOINT_FORMAT_VERSION:
+        raise CheckpointIncompatible(
+            f"checkpoint {path!r} was written by a newer build — upgrade "
+            "this process or re-checkpoint from the old one",
+            found=version, expected=CHECKPOINT_FORMAT_VERSION,
+        )
+    for name, (shape, dtype) in fmt.get("manifest", {}).items():
+        arr = arrays.get(name)
+        if arr is None:
+            raise CheckpointIncompatible(
+                f"checkpoint {path!r} is missing payload {name!r} named "
+                "by its manifest — the file is truncated or corrupted",
+                found=sorted(arrays)[:8],
+                expected=name,
+            )
+        if list(arr.shape) != list(shape) or arr.dtype.str != dtype:
+            raise CheckpointIncompatible(
+                f"checkpoint {path!r} payload {name!r} does not match its "
+                "manifest — the file is corrupted or was rewritten",
+                found=[list(arr.shape), arr.dtype.str],
+                expected=[list(shape), dtype],
+            )
+
+
 def load_device_checkpoint(path: str) -> Tuple[Any, Dict[str, Any]]:
-    """Read back (tree, meta); arrays are host numpy (device_put as needed)."""
-    with np.load(path) as data:
-        meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
-        flat = {
-            k[2:]: data[k] for k in data.files if k.startswith("t/")
-        }
+    """Read back (tree, meta); arrays are host numpy (device_put as needed).
+
+    Raises CheckpointIncompatible on a truncated/corrupted file, a payload
+    that disagrees with the stamped manifest, or a format version newer
+    than this build. Legacy (unstamped) checkpoints load best-effort."""
+    try:
+        with np.load(path) as data:
+            meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+            fmt = meta.pop(_FORMAT_KEY, None)
+            arrays = {
+                k: data[k] for k in data.files if k.startswith("t/")
+            }
+            if fmt is not None:
+                _check_format(path, fmt, arrays)
+            flat = {k[2:]: v for k, v in arrays.items()}
+    except CheckpointIncompatible:
+        raise
+    except Exception as exc:
+        # BadZipFile / KeyError("__meta__") / JSONDecodeError / OSError /
+        # a member that dies mid-decompress: all of them mean "this is
+        # not a checkpoint this build can read", which deserves ONE typed
+        # operator-facing error instead of five library-specific ones
+        raise CheckpointIncompatible(
+            f"checkpoint {path!r} is unreadable "
+            f"({type(exc).__name__}: {exc}) — truncated, corrupted, or "
+            "not a ggrs checkpoint",
+        ) from exc
     return _unflatten(flat), meta

@@ -40,6 +40,14 @@
 #   dispatch bucket budget, bit-exact branch replay, and the env
 #   instruments through both exporters (scripts/env_smoke.py, CPU jax,
 #   <1 min).
+#   --chaos-smoke runs a seeded WAN-profile chaos soak on a 2-host
+#   HostGroup with one live session migration and one host
+#   kill->restore-from-checkpoint, gated on zero desyncs, zero
+#   drain-blocked ticks post-sync, bounded p99 queue wait, and the
+#   migration instruments visible through BOTH exporters
+#   (scripts/chaos_smoke.py, CPU jax, ~1 min). Also runs in the default
+#   flow (step 2b): fleet operations are a correctness surface, not an
+#   optional extra.
 #   --lint runs the determinism/trace/fence/wire static-analysis gate
 #   (python -m ggrs_tpu.analysis, pure AST, no jax, seconds) against
 #   analysis/baseline.toml, then the retrace-sanitizer smoke
@@ -106,6 +114,12 @@ if [ "${1:-}" = "--env-smoke" ]; then
   exit $?
 fi
 
+if [ "${1:-}" = "--chaos-smoke" ]; then
+  echo "== chaos smoke (WAN profile + live migration + host kill/restore) =="
+  JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+  exit $?
+fi
+
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
@@ -117,6 +131,9 @@ make -C native
 
 echo "== [2/5] pytest (full suite, virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q
+
+echo "== [2b/5] chaos smoke (fleet operations end to end) =="
+JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
 if [ "$FAST" = "0" ]; then
   echo "== [3/5] UBSAN build + native/wire tests =="

@@ -232,3 +232,116 @@ def test_backend_restore_preserves_performance_knobs(tmp_path):
     )
     assert legacy.lazy_ticks == 0
     assert legacy.speculation_gate == "always"
+
+
+# ----------------------------------------------------------------------
+# format version + payload manifest (fleet-operations hardening): a
+# damaged or foreign checkpoint must fail AT THE DOOR with the typed
+# CheckpointIncompatible, never as a shape error deep inside a restore
+# ----------------------------------------------------------------------
+
+
+def _small_checkpoint(tmp_path, name="fmt.npz"):
+    from ggrs_tpu.utils.checkpoint import save_device_checkpoint
+
+    tree = {
+        "rings": {"pos": np.arange(12, dtype=np.int32).reshape(3, 4)},
+        "states": {"pos": np.ones((4,), np.uint32)},
+    }
+    path = str(tmp_path / name)
+    save_device_checkpoint(path, tree, {"kind": "test", "n": 1})
+    return path, tree
+
+
+def test_checkpoint_stamps_version_and_manifest(tmp_path):
+    import json
+
+    from ggrs_tpu.utils.checkpoint import (
+        CHECKPOINT_FORMAT_VERSION,
+        load_device_checkpoint,
+    )
+
+    path, tree = _small_checkpoint(tmp_path)
+    with np.load(path) as data:  # raw read: the stamp is in the file...
+        meta = json.loads(bytes(data["__meta__"]).decode("utf-8"))
+    fmt = meta["__format__"]
+    assert fmt["version"] == CHECKPOINT_FORMAT_VERSION
+    assert set(fmt["manifest"]) == {"t/rings/pos", "t/states/pos"}
+    # ...and the stamp is INTERNAL: callers' meta round-trips unchanged
+    got, meta_back = load_device_checkpoint(path)
+    assert meta_back == {"kind": "test", "n": 1}
+    np.testing.assert_array_equal(got["rings"]["pos"], tree["rings"]["pos"])
+
+
+def test_checkpoint_truncated_file_raises_typed(tmp_path):
+    import pytest
+
+    from ggrs_tpu.errors import CheckpointIncompatible
+    from ggrs_tpu.utils.checkpoint import load_device_checkpoint
+
+    path, _ = _small_checkpoint(tmp_path)
+    blob = open(path, "rb").read()
+    for cut in (len(blob) // 2, 10):
+        with open(path, "wb") as f:
+            f.write(blob[:cut])
+        with pytest.raises(CheckpointIncompatible):
+            load_device_checkpoint(path)
+
+
+def test_checkpoint_future_version_raises_with_both_versions(tmp_path):
+    import pytest
+
+    from ggrs_tpu.errors import CheckpointIncompatible
+    from ggrs_tpu.utils import checkpoint as ck
+
+    path, tree = _small_checkpoint(tmp_path)
+    orig = ck.CHECKPOINT_FORMAT_VERSION
+    try:
+        ck.CHECKPOINT_FORMAT_VERSION = orig + 5  # "a newer build wrote it"
+        ck.save_device_checkpoint(path, tree, {"kind": "test"})
+    finally:
+        ck.CHECKPOINT_FORMAT_VERSION = orig
+    with pytest.raises(CheckpointIncompatible) as exc_info:
+        ck.load_device_checkpoint(path)
+    assert exc_info.value.found == orig + 5
+    assert exc_info.value.expected == orig
+
+
+def test_checkpoint_manifest_catches_missing_payload(tmp_path):
+    import os
+    import pytest
+    import zipfile
+
+    from ggrs_tpu.errors import CheckpointIncompatible
+    from ggrs_tpu.utils.checkpoint import load_device_checkpoint
+
+    path, _ = _small_checkpoint(tmp_path)
+    clipped = str(tmp_path / "clipped.npz")
+    with zipfile.ZipFile(path) as src, zipfile.ZipFile(clipped, "w") as dst:
+        for item in src.infolist():
+            if item.filename != "t/states/pos.npy":  # drop one payload
+                dst.writestr(item, src.read(item.filename))
+    with pytest.raises(CheckpointIncompatible) as exc_info:
+        load_device_checkpoint(clipped)
+    assert exc_info.value.expected == "t/states/pos"
+    os.remove(clipped)
+
+
+def test_checkpoint_legacy_unstamped_still_loads(tmp_path):
+    """Pre-version checkpoints (no __format__ in meta) load best-effort:
+    the stamp is additive, old files on disk stay restorable."""
+    import json
+
+    from ggrs_tpu.utils.checkpoint import load_device_checkpoint
+
+    path = str(tmp_path / "legacy.npz")
+    flat = {
+        "t/a": np.arange(3, dtype=np.int32),
+        "__meta__": np.frombuffer(
+            json.dumps({"kind": "old"}).encode(), dtype=np.uint8
+        ),
+    }
+    np.savez_compressed(path, **flat)
+    tree, meta = load_device_checkpoint(path)
+    assert meta == {"kind": "old"}
+    np.testing.assert_array_equal(tree["a"], np.arange(3, dtype=np.int32))
