@@ -1719,7 +1719,8 @@ _TELEMETRY_PATH = os.path.join(
 )
 
 
-def bench_serve_host(sessions=64, ticks=120, entities=1024):
+def bench_serve_host(sessions=64, ticks=120, entities=1024,
+                     mesh_devices=0):
     """Cross-session continuous batching throughput (ggrs_tpu/serve/):
     >= `sessions` scripted 2-4-player peers attached to ONE SessionHost
     over a mildly lossy virtual network, driven in virtual time — every
@@ -1729,7 +1730,13 @@ def bench_serve_host(sessions=64, ticks=120, entities=1024):
     request_path: the same interactive tick, amortized across the fleet
     instead of across time) and the megabatch occupancy actually
     achieved. Sync/handshake and compile are excluded from the timed
-    window."""
+    window.
+
+    `mesh_devices` > 0 runs the host's megabatch on a session mesh over
+    that many devices (ShardedMultiSessionDeviceCore: the session axis
+    of the stacked worlds GSPMD-partitioned, slot->shard affinity in the
+    scheduler) and additionally reports sessions-per-chip — the
+    multiplier the sharded core exists to scale."""
     from ggrs_tpu.models.ex_game import ExGame
     from ggrs_tpu.network.sockets import InMemoryNetwork
     from ggrs_tpu.serve import SessionHost
@@ -1745,6 +1752,11 @@ def bench_serve_host(sessions=64, ticks=120, entities=1024):
     net = InMemoryNetwork(
         clock, latency_ms=20, jitter_ms=5, loss=0.01, seed=7
     )
+    mesh = None
+    if mesh_devices:
+        from ggrs_tpu.parallel.mesh import make_session_mesh
+
+        mesh = make_session_mesh(mesh_devices)
     game = ExGame(num_players=4, num_entities=entities)
     host = SessionHost(
         game,
@@ -1754,6 +1766,7 @@ def bench_serve_host(sessions=64, ticks=120, entities=1024):
         clock=clock,
         idle_timeout_ms=0,
         warmup=True,
+        mesh=mesh,
     )
     matches = build_matches(host, net, clock, sessions=sessions, seed=7)
     n_sessions = sum(len(keys) for keys in matches)
@@ -1803,6 +1816,8 @@ def bench_serve_host(sessions=64, ticks=120, entities=1024):
         "matches": len(matches),
         "ticks": ticks,
         "entities": entities,
+        "session_shards": dev.session_shards,
+        "sessions_per_chip": round(n_sessions / dev.session_shards, 2),
         "session_ticks_per_sec": round(n_sessions * ticks / dt, 1),
         "host_ticks_per_sec": round(ticks / dt, 2),
         "mean_megabatch_rows": round(mean_rows, 2),
@@ -1827,18 +1842,28 @@ def bench_serve_host(sessions=64, ticks=120, entities=1024):
     }
 
 
-def bench_env_rollout(num_envs=256, steps=200, entities=256, episode_len=64):
+def bench_env_rollout(num_envs=256, steps=200, entities=256, episode_len=64,
+                      mesh_devices=0):
     """The RL-environment workload (ggrs_tpu/env/): env steps/sec through
     the megabatch path — N rollback worlds stepped as ONE fast-program
     dispatch per step, opponent rows sampled from the input model,
     auto-reset cycling episodes mid-rollout. The training analog of
     bench_serve_host: the same stacked device core, non-interactive
     traffic, zero host protocol. Warmup/compile excluded; the window is
-    closed with a true barrier."""
+    closed with a true barrier.
+
+    `mesh_devices` > 0 splits the world stack over a session mesh of
+    that many devices (the same ShardedMultiSessionDeviceCore the
+    serving host rides) and reports worlds-per-chip."""
     from ggrs_tpu.env import InputModelOpponent, RollbackEnv, held_value_trace
     from ggrs_tpu.models.ex_game import ExGame
     from ggrs_tpu.utils.barrier import true_barrier
 
+    mesh = None
+    if mesh_devices:
+        from ggrs_tpu.parallel.mesh import make_session_mesh
+
+        mesh = make_session_mesh(mesh_devices)
     trace = held_value_trace([1, 4, 2, 8, 1, 4, 2, 8, 5, 4])
     game = ExGame(num_players=2, num_entities=entities)
     env = RollbackEnv(
@@ -1847,6 +1872,7 @@ def bench_env_rollout(num_envs=256, steps=200, entities=256, episode_len=64):
         opponents={1: InputModelOpponent(trace, seed=13)},
         episode_len=episode_len,
         warmup=True,
+        mesh=mesh,
     )
     obs = env.reset()
     actions = np.zeros((num_envs, 1), dtype=np.uint8)
@@ -1868,6 +1894,8 @@ def bench_env_rollout(num_envs=256, steps=200, entities=256, episode_len=64):
         "steps": steps,
         "entities": entities,
         "episode_len": episode_len,
+        "session_shards": dev.session_shards,
+        "worlds_per_chip": round(num_envs / dev.session_shards, 2),
         "env_steps_per_sec": round((env.steps_total - steps_before) / dt, 1),
         "batch_steps_per_sec": round(steps / dt, 2),
         "episodes": env.episodes_total,
@@ -2073,6 +2101,7 @@ def main():
         "history_b8_rate", "parity", "async_parity",
         "serve_sessions_per_sec", "serve_occupancy",
         "serve_fast_dispatch_rate", "env_steps_per_sec",
+        "sharded_vs_single_device_speedup",
         "chaos_fps_retained", "headline_source",
     )
 
@@ -2320,6 +2349,33 @@ def main():
     )
     full["env_steps_per_sec"] = env256["env_steps_per_sec"]
     full["env_rollout"] = {"n256": env256, "n1024": env1024}
+    # the SHARDED serving/rollout arms: the same hosted fleet and env
+    # rollout with the megabatch GSPMD-partitioned over a session mesh
+    # spanning every visible device (ShardedMultiSessionDeviceCore). On
+    # the runner's single CPU device the mesh is 1-wide — the arm then
+    # measures the sharded code path's overhead, not a speedup; on a
+    # real multi-chip host sessions-per-chip is the capacity multiplier.
+    n_dev = len(jax.devices())
+    serve_sharded = phase(
+        "serve_host_sharded_n256",
+        f"bench_serve_host(sessions=256, ticks={20 if SMOKE else 80}, "
+        f"mesh_devices={n_dev})",
+        timeout_s=1200,
+    )
+    env_sharded = phase(
+        "env_rollout_sharded_n1024",
+        f"bench_env_rollout(num_envs=1024, steps={20 if SMOKE else 100}, "
+        f"mesh_devices={n_dev})",
+        timeout_s=1200,
+    )
+    full["serve_host_sharded"] = serve_sharded
+    full["env_rollout_sharded"] = env_sharded
+    if serve_sharded and serve256:
+        full["sharded_vs_single_device_speedup"] = round(
+            serve_sharded["session_ticks_per_sec"]
+            / serve256["session_ticks_per_sec"],
+            3,
+        )
     # fleet operations under fault: WAN-chaos fleet vs clean-network twin
     # (2 live migrations + 1 host kill->restore per chaos arm)
     chaos = phase(

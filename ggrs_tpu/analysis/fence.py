@@ -86,6 +86,15 @@ POLICIES: Dict[str, FencePolicy] = {
             # live-migration slot adoption: eager per-leaf writes behind
             # a full fence flush, the same discipline as reset_slot
             ("MultiSessionDeviceCore", "import_slot"),
+            # the session-mesh serving core's fence-dispatch entry
+            # points: overrides of the SAME protocol methods (GSPMD row
+            # constraints + per-shard instruments wrapped around the
+            # inherited fence discipline), listed under their subclass
+            # qualnames so a future write routed through them stays
+            # inside the policy instead of silently outside it
+            ("ShardedMultiSessionDeviceCore", "__init__"),
+            ("ShardedMultiSessionDeviceCore", "_dispatch_staged"),
+            ("ShardedMultiSessionDeviceCore", "_warmup_impl"),
             # the plan cache's own accounting lives in its own class
             ("DispatchPlanCache", "__init__"),
             ("DispatchPlanCache", "note"),

@@ -125,7 +125,7 @@ class RollbackEnv:
                  episode_len: int = 0, auto_reset: bool = True,
                  record_checksums: bool = False,
                  device=None, slots: Optional[Sequence[int]] = None,
-                 host=None, warmup: bool = False):
+                 host=None, warmup: bool = False, mesh=None):
         import jax
 
         from ..tpu.backend import MultiSessionDeviceCore
@@ -141,16 +141,32 @@ class RollbackEnv:
             # minimal depth grid — env dispatches are fast-path steps
             # plus last_active<=1 snapshot/restore rows, so depth bucket
             # 2 covers everything and warmup compiles 3 programs, not
-            # the serving host's full (row x depth) grid
-            device = MultiSessionDeviceCore(
+            # the serving host's full (row x depth) grid. `mesh=` (a
+            # session mesh, parallel.mesh.make_session_mesh) splits the
+            # world stack over the mesh's `session` axis — same
+            # programs, GSPMD-partitioned — for rollouts bigger than
+            # one chip.
+            device = MultiSessionDeviceCore.create(
                 game, max_prediction, game.num_players, num_envs,
-                buckets=(num_envs,), depth_buckets=(2,),
+                buckets=(num_envs,), depth_buckets=(2,), mesh=mesh,
             )
             slots = range(num_envs)
+        else:
+            assert mesh is None, (
+                "mesh= configures a standalone env's own core; a hosted "
+                "env rides the host's device (sharded or not) unchanged"
+            )
         self._device = device
         self._core = device.core
         self._slots = np.asarray(list(slots), dtype=np.int32)
         assert self._slots.shape == (num_envs,)
+        # the stacked trees are PHYSICAL-layout; every direct gather
+        # (obs/reward/done, checksums) indexes through the device's
+        # logical->physical map (identity on one device, interleaved on
+        # a session mesh)
+        self._phys_slots = np.asarray(
+            device.phys_index(self._slots), dtype=np.int32
+        )
         P = device.num_players
         I = game.input_size
         self._P, self._I = P, I
@@ -280,9 +296,9 @@ class RollbackEnv:
             self._device.warmup()  # its own warmup_scope / freeze label
         with warmup_scope("RollbackEnv.warmup"):
             obs, reward, done = self._obs_fn(
-                self._device.states, self._slots
+                self._device.states, self._phys_slots
             )
-            his, los = self._checksum_fn(self._device.states, self._slots)
+            his, los = self._checksum_fn(self._device.states, self._phys_slots)
             import jax
 
             jax.block_until_ready((reward, done, los))
@@ -303,7 +319,7 @@ class RollbackEnv:
         done_all = np.ones((self.num_envs,), dtype=bool)
         for opp in self._opponents.values():
             opp.on_reset(done_all)
-        obs, _, _ = self._obs_fn(self._device.states, self._slots)
+        obs, _, _ = self._obs_fn(self._device.states, self._phys_slots)
         return obs
 
     def _invalidate_snapshots(self) -> None:
@@ -361,7 +377,7 @@ class RollbackEnv:
         self._t += 1
         self.steps_total += self.num_envs
 
-        obs, reward, done = self._obs_fn(self._device.states, self._slots)
+        obs, reward, done = self._obs_fn(self._device.states, self._phys_slots)
         done_np = np.asarray(done)
         truncated = np.zeros((self.num_envs,), dtype=bool)
         if self.episode_len:
@@ -390,7 +406,7 @@ class RollbackEnv:
                     opp.on_reset(done_np)
                 # the returned obs for finished worlds is the NEW
                 # episode's first observation (standard auto-reset)
-                obs, _, _ = self._obs_fn(self._device.states, self._slots)
+                obs, _, _ = self._obs_fn(self._device.states, self._phys_slots)
         return obs, reward, done_np, info
 
     # ------------------------------------------------------------------
@@ -489,7 +505,7 @@ class RollbackEnv:
         self._t = snap.t
         for h, opp in self._opponents.items():
             opp.load_state_dict(snap.opponent_state.get(h))
-        obs, _, _ = self._obs_fn(self._device.states, self._slots)
+        obs, _, _ = self._obs_fn(self._device.states, self._phys_slots)
         return obs
 
     def release(self, snap: EnvSnapshot) -> None:
@@ -506,7 +522,7 @@ class RollbackEnv:
         """Combined (hi << 32 | lo) checksum of every world's LIVE state,
         computed on device in one vmapped pass — the env-side half of the
         env-vs-session parity witness."""
-        his, los = self._checksum_fn(self._device.states, self._slots)
+        his, los = self._checksum_fn(self._device.states, self._phys_slots)
         his = np.asarray(his)
         los = np.asarray(los)
         return [
@@ -549,10 +565,13 @@ class RollbackEnv:
         assert self._host is None, (
             "hosted env worlds checkpoint with the host's drain()"
         )
-        self._device.block_until_ready()
+        # canonical slot layout (capacity live + one dummy row): a
+        # sharded env's checkpoint restores on a single-device env and
+        # vice versa — same contract as the host's drain checkpoint
+        rings, states = self._device.stacked_canonical()
         tree = {
-            "rings": self._device.rings,
-            "states": self._device.states,
+            "rings": rings,
+            "states": states,
             "frames": self._frames,
             "ep_steps": self._ep_steps,
             "opp": {

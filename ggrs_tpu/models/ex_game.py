@@ -146,11 +146,12 @@ CHECKSUM_KEYS = ("pos", "vel", "rot")
 
 
 def _checksum_generic(state: State, xp):
-    words = xp.concatenate(
-        [state[k].astype(xp.uint32).reshape(-1) for k in CHECKSUM_KEYS]
-        + [state["frame"].astype(xp.uint32).reshape(-1)]
+    # per-key partial sums with global word offsets, NOT one concatenated
+    # sum: bit-identical totals, and the concat-free form is what keeps
+    # entity-sharded worlds exact under GSPMD (fx.weighted_checksum_parts)
+    return fx.weighted_checksum_parts(
+        [state[k] for k in CHECKSUM_KEYS] + [state["frame"]], xp
     )
-    return fx.weighted_checksum(words, xp)
 
 
 # ---------------------------------------------------------------------------

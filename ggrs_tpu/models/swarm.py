@@ -128,11 +128,11 @@ CHECKSUM_KEYS = ("pos", "vel", "charge")
 
 
 def _checksum_generic(state: State, xp):
-    words = xp.concatenate(
-        [state[k].astype(xp.uint32).reshape(-1) for k in CHECKSUM_KEYS]
-        + [state["frame"].astype(xp.uint32).reshape(-1)]
+    # concat-free per-key partial sums (see fx.weighted_checksum_parts):
+    # bit-identical, and exact for entity-sharded worlds under GSPMD
+    return fx.weighted_checksum_parts(
+        [state[k] for k in CHECKSUM_KEYS] + [state["frame"]], xp
     )
-    return fx.weighted_checksum(words, xp)
 
 
 class Swarm:

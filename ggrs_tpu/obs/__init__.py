@@ -19,6 +19,7 @@ from .metrics import (
     LOG2_BUCKETS,
     LOG2_BUCKETS_MS,
     SESSION_COUNT_BUCKETS,
+    SHARD_IMBALANCE_BUCKETS,
     Counter,
     Gauge,
     Histogram,
@@ -40,6 +41,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "SESSION_COUNT_BUCKETS",
+    "SHARD_IMBALANCE_BUCKETS",
     "Telemetry",
     "enable_global_telemetry",
     "jsonable",

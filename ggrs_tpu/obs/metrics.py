@@ -41,6 +41,13 @@ SESSION_COUNT_BUCKETS: Tuple[float, ...] = tuple(
 DISPATCH_DEPTH_BUCKETS: Tuple[float, ...] = (
     1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0,
 )
+# max/mean live rows per session-mesh shard per megabatch dispatch:
+# 1.0 is a perfectly balanced dispatch, the mesh's shard count the
+# worst case (every row on one shard); sub-2 resolution is where the
+# host's slot->shard affinity either works or doesn't
+SHARD_IMBALANCE_BUCKETS: Tuple[float, ...] = (
+    1.0, 1.25, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0, 16.0,
+)
 
 
 def _escape_label(value: str) -> str:

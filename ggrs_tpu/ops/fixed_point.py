@@ -107,6 +107,34 @@ def weighted_checksum(words, xp):
     return hi, lo
 
 
+def weighted_checksum_parts(parts, xp):
+    """`weighted_checksum` over the CONCATENATION of `parts`, computed
+    per-part with GLOBAL word offsets and summed — bit-identical totals
+    (uint32 wraparound addition is exact, associative and commutative),
+    but with no cross-part concatenate in the graph.
+
+    The concatenate-free form matters on a device mesh: jax 0.4.x GSPMD
+    miscompiles `sum(concatenate([...]))` of an entity-sharded operand
+    under a multi-axis mesh into an all-reduce over EVERY mesh axis, so
+    a world replicated over a 2-wide `beam` axis reported exactly 2x the
+    true checksum (the root cause of the four known-red sharded parity
+    tests retired with the serving-mesh work). Per-part `sum(w * iota)`
+    reductions partition correctly on every jax version the repo
+    supports, so the models' `_checksum_generic` builds on this.
+    """
+    hi = xp.uint32(0)
+    lo = xp.uint32(0)
+    off = 0
+    for part in parts:
+        words = part.astype(xp.uint32).reshape(-1)
+        n = int(words.shape[0])
+        idx = xp.arange(off + 1, off + n + 1, dtype=xp.uint32)
+        hi = hi + xp.sum(words * (idx * GOLDEN32), dtype=xp.uint32)
+        lo = lo + xp.sum(words, dtype=xp.uint32)
+        off += n
+    return hi, lo
+
+
 def combine_checksum(hi: int, lo: int) -> int:
     """Fold the device (hi, lo) pair into one Python int (the u128-checksum
     analog of reference src/network/messages.rs:76-79)."""

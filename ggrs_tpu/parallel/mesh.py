@@ -29,3 +29,26 @@ def make_mesh(
     assert n % beam_axis == 0
     dev_array = np.asarray(devices[:n]).reshape(beam_axis, n // beam_axis)
     return Mesh(dev_array, axis_names)
+
+
+def make_session_mesh(
+    n_devices: Optional[int] = None, entity_axis: int = 1
+) -> Mesh:
+    """Build the SERVING mesh: a 2D (session x entity) mesh over the
+    first n devices.
+
+    `session` splits the stacked session worlds of
+    ShardedMultiSessionDeviceCore (data-parallel analog: independent
+    worlds, no communication on this axis). `entity_axis` > 1 additionally
+    shards each world's entity arrays (tensor-parallel analog, for big
+    worlds) — the per-slot checksum reduction is then the only collective
+    in the hot loop and rides ICI, exactly like the single-world `entity`
+    axis of `make_mesh`."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    assert n <= len(devices), f"requested {n} devices, have {len(devices)}"
+    assert entity_axis >= 1 and n % entity_axis == 0, (
+        f"entity_axis {entity_axis} must divide the {n}-device mesh"
+    )
+    dev_array = np.asarray(devices[:n]).reshape(n // entity_axis, entity_axis)
+    return Mesh(dev_array, ("session", "entity"))

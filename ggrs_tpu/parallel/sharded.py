@@ -154,6 +154,141 @@ def sharded_checksum(state, mesh: Mesh, keys=None):
     return _cs(entity_state, state["frame"])
 
 
+# ---------------------------------------------------------------------------
+# stacked (serving) placement: the session axis of MultiSessionDeviceCore's
+# stacked pytrees split over a `session` mesh axis, entity arrays optionally
+# split further over `entity`. THE placement policy for the sharded serving
+# core — ShardedMultiSessionDeviceCore places with these specs and every
+# consumer (host scheduler affinity, the explicit checksum pass, tests)
+# derives shard geometry from the same functions so the contract can't
+# drift from the single-world policy above.
+# ---------------------------------------------------------------------------
+
+
+def _mesh_has_entity(mesh: Mesh) -> bool:
+    return "entity" in mesh.axis_names and mesh.shape["entity"] > 1
+
+
+def stacked_state_specs(stacked_state, mesh: Mesh):
+    """PartitionSpec pytree for a STACKED game-state pytree (leading
+    session axis on every leaf): sessions split over `session` on axis 0;
+    entity arrays (ndim >= 2) additionally split over `entity` on axis 1
+    when the mesh carries one. The serving twin of `state_specs`."""
+    ent = _mesh_has_entity(mesh)
+    return jax.tree.map(
+        lambda x: P("session", "entity") if ent and x.ndim >= 2 else P("session"),
+        stacked_state,
+    )
+
+
+def stacked_ring_specs(stacked_ring, mesh: Mesh):
+    """PartitionSpec pytree for a STACKED snapshot-ring pytree (leading
+    session axis, then the ring-slot axis): sessions over `session`,
+    entity dims (ndim >= 3) over `entity` on axis 2, ring slots always
+    local. The serving twin of `ring_specs`."""
+    ent = _mesh_has_entity(mesh)
+    return jax.tree.map(
+        lambda x: (
+            P("session", None, "entity") if ent and x.ndim >= 3 else P("session")
+        ),
+        stacked_ring,
+    )
+
+
+def shard_stacked_state(stacked_state, mesh: Mesh):
+    """Place a stacked game-state pytree on the mesh per
+    `stacked_state_specs`. The leading (session) axis must divide the
+    `session` axis size — the sharded core pads its dummy-slot tail so
+    it does."""
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        stacked_state,
+        stacked_state_specs(stacked_state, mesh),
+    )
+
+
+def shard_stacked_ring(stacked_ring, mesh: Mesh):
+    """Place a stacked snapshot-ring pytree on the mesh per
+    `stacked_ring_specs` — the ring twin of `shard_stacked_state`."""
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        stacked_ring,
+        stacked_ring_specs(stacked_ring, mesh),
+    )
+
+
+def stacked_sharded_checksum(stacked_state, mesh: Mesh, keys=None):
+    """Per-slot order-invariant checksums of a session-stacked (and
+    optionally entity-sharded) state pytree, with the cross-shard word
+    reduction expressed EXPLICITLY as shard_map + psum over the `entity`
+    axis — the stacked twin of `sharded_checksum`, and the serving
+    core's desync-detection spot-check for big entity-sharded worlds
+    (the megabatch programs' own [B, W] checksums ride the same
+    concat-free partial sums under GSPMD; this pass pins the collective
+    shape by hand so a partitioner regression is caught against it).
+
+    Returns (hi[S], lo[S]) uint32 arrays, slot-aligned with the stack.
+    Bit-identical to vmapping the model's `_checksum_generic` over the
+    slots: word weights run continuously across `keys` + frame with
+    GLOBAL word indices, and the replicated `frame` scalar folds in
+    exactly once (on entity-shard 0). `keys` defaults to ex_game's
+    declared checksum order."""
+    if keys is None:
+        from ..models.ex_game import CHECKSUM_KEYS as keys
+    keys = list(keys)
+    ent = _mesh_has_entity(mesh)
+    offsets = {}
+    off = 0
+    for k in keys:
+        offsets[k] = off
+        off += int(np.prod(stacked_state[k].shape[1:]))
+    frame_offset = off
+
+    entity_state = {k: stacked_state[k] for k in keys}
+    in_state_specs = {
+        k: (P("session", "entity") if ent else P("session")) for k in keys
+    }
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(in_state_specs, P("session")),
+        out_specs=(P("session"), P("session")),
+    )
+    def _cs(local_state, frames):
+        eidx = jax.lax.axis_index("entity") if ent else jnp.uint32(0)
+        s_loc = frames.shape[0]
+        hi = jnp.zeros((s_loc,), jnp.uint32)
+        lo = jnp.zeros((s_loc,), jnp.uint32)
+        for k in keys:
+            # entity axis-0-of-the-slot sharding + row-major flatten =>
+            # entity shard e owns the contiguous per-slot word range
+            # [e * n_local, (e + 1) * n_local) of this key
+            words = local_state[k].astype(jnp.uint32).reshape(s_loc, -1)
+            n_local = words.shape[1]
+            start = (
+                jnp.uint32(offsets[k])
+                + eidx.astype(jnp.uint32) * jnp.uint32(n_local)
+            )
+            gidx = jnp.arange(n_local, dtype=jnp.uint32) + start + jnp.uint32(1)
+            hi = hi + jnp.sum(
+                words * (gidx * GOLDEN32)[None, :], axis=1, dtype=jnp.uint32
+            )
+            lo = lo + jnp.sum(words, axis=1, dtype=jnp.uint32)
+        # frame is replicated across entity shards: fold in on shard 0 only
+        fw = frames.astype(jnp.uint32)
+        fg = jnp.uint32(frame_offset + 1)
+        on_shard0 = (eidx == 0).astype(jnp.uint32)
+        hi = hi + on_shard0 * (fw * (fg * GOLDEN32))
+        lo = lo + on_shard0 * fw
+        if ent:
+            hi = jax.lax.psum(hi, "entity")
+            lo = jax.lax.psum(lo, "entity")
+        return hi, lo
+
+    return _cs(entity_state, stacked_state["frame"])
+
+
 def make_sharded_beam_rollout(game, mesh: Mesh, window: int):
     """jit-compiled W-frame beam rollout over a (beam x entity) mesh.
 

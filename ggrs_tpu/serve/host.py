@@ -156,7 +156,8 @@ class SessionHost:
                  clock: Optional[Clock] = None,
                  idle_timeout_ms: int = DEFAULT_IDLE_TIMEOUT_MS,
                  async_inflight: int = 4, warmup: bool = False,
-                 depth_routing: bool = True, batched_pump: bool = True):
+                 depth_routing: bool = True, batched_pump: bool = True,
+                 mesh=None):
         """`max_inflight_rows`: the device-window budget — session tick
         rows admitted past the fence before ready sessions start queuing
         (default: 2 full megabatches' worth). `idle_timeout_ms`: sessions
@@ -176,13 +177,27 @@ class SessionHost:
         suite's reference). `async_inflight` defaults to 4 megabatches
         (was 2): a wider fence keeps the steady-state tick from ever
         blocking on the oldest dispatch while the checksum ledger drains
-        off the pump pass."""
+        off the pump pass.
+
+        `mesh`: a device mesh with a `session` axis
+        (parallel.mesh.make_session_mesh) puts the stacked session
+        worlds on the mesh via ShardedMultiSessionDeviceCore — the
+        megabatch GSPMD-partitions across chips, and the scheduler adds
+        slot->shard AFFINITY: admission picks slots on the least-loaded
+        shard and lane packing groups each megabatch's rows by shard, so
+        the dispatch's gather/scatter stays mostly shard-local instead
+        of all-to-all. Everything else (sessions, envs, migration,
+        checkpoints — which stay canonical and restore across layouts)
+        is unchanged, and the sharded host is bit-identical to a
+        single-device twin fed the same traffic."""
         from ..network.pump import WirePump, host_tax_histogram
         from ..tpu.backend import MultiSessionDeviceCore
 
-        self.device = MultiSessionDeviceCore(
+        self.mesh = mesh
+        self.device = MultiSessionDeviceCore.create(
             game, max_prediction, num_players, max_sessions,
             async_inflight=async_inflight, depth_routing=depth_routing,
+            mesh=mesh,
         )
         self.depth_routing = depth_routing
         self.game = game
@@ -314,7 +329,7 @@ class SessionHost:
         if key in self._lanes:
             raise InvalidRequest(f"host key {key!r} already in use")
         if slot is None:
-            slot = self._free_slots.pop()
+            slot = self._pick_free_slot()
         else:
             # restore-from-checkpoint re-adoption: the stacked worlds
             # already hold this session AT ITS OLD SLOT
@@ -325,6 +340,62 @@ class SessionHost:
                     f"device slot {slot} is not free on this host"
                 ) from None
         return key, slot
+
+    def _pick_free_slot(self) -> int:
+        """Admission slot choice. Single device: the free-list head. On
+        a session mesh: the free slot whose shard carries the FEWEST
+        live worlds (lanes + attached env blocks; ties to the lowest
+        shard) — slot->shard affinity's admission half, keeping the
+        fleet spread so each megabatch's per-shard row groups stay
+        balanced (the `ggrs_shard_imbalance` histogram is the health
+        surface)."""
+        if self.mesh is None:
+            return self._free_slots.pop()
+        return self._pick_affine_slot(self._shard_load())
+
+    def _shard_load(self) -> List[int]:
+        """Live worlds per shard (lanes + attached env blocks)."""
+        dev = self.device
+        load = [0] * dev.session_shards
+        for lane in self._lanes.values():
+            load[dev.shard_of(lane.slot)] += 1
+        for env in self._envs:
+            for s in env.slots:
+                load[dev.shard_of(s)] += 1
+        return load
+
+    def _pick_affine_slot(self, load: List[int]) -> int:
+        dev = self.device
+        best = min(
+            range(len(self._free_slots)),
+            key=lambda i: (
+                load[dev.shard_of(self._free_slots[i])],
+                dev.shard_of(self._free_slots[i]),
+                self._free_slots[i],  # lowest slot within a shard: a
+                # fresh sharded host assigns the same slots as its
+                # single-device twin (round-robin layout => ascending
+                # slot order IS shard-spread order), which is what lets
+                # parity tests compare canonical stacks slot-for-slot
+            ),
+        )
+        return self._free_slots.pop(best)
+
+    def _pick_free_slots_block(self, n: int) -> List[int]:
+        """Admission's block half: `n` slots for an env block. On a mesh
+        each pick is accounted as in-flight load before the next, so the
+        block itself spreads over the least-loaded shards instead of
+        stacking on whichever shard was lightest at entry. On a fresh
+        host this yields 0..n-1 exactly like the single-device pop order
+        (round-robin layout), keeping env parity tests slot-for-slot."""
+        if self.mesh is None:
+            return [self._free_slots.pop() for _ in range(n)]
+        load = self._shard_load()
+        slots = []
+        for _ in range(n):
+            s = self._pick_affine_slot(load)
+            load[self.device.shard_of(s)] += 1
+            slots.append(s)
+        return slots
 
     def _commit_lane(self, session, key: Any, slot: int, kind: str,
                      n_players: int, local_handles, max_prediction: int,
@@ -478,7 +549,7 @@ class SessionHost:
                 f"env block of {num_envs} exceeds the {len(self._free_slots)}"
                 " free session slots"
             )
-        slots = [self._free_slots.pop() for _ in range(num_envs)]
+        slots = self._pick_free_slots_block(num_envs)
         try:
             env = RollbackEnv(
                 self.game,
@@ -858,6 +929,19 @@ class SessionHost:
                 groups.setdefault(gkey, [])
             for gkey, group in groups.items():
                 env_la, env_entries = env_groups.pop(gkey, (0, []))
+                if self.mesh is not None:
+                    # lane-packing affinity: order each megabatch's rows
+                    # by the shard that owns their world, so the staged
+                    # block's session-axis partitions line up with the
+                    # slots they gather/scatter (stable sorts — in-shard
+                    # arrival order, and the one-row-per-slot invariant,
+                    # are untouched; env rows carry no save bindings)
+                    group.sort(
+                        key=lambda ls: self.device.shard_of(ls[0].slot)
+                    )
+                    env_entries.sort(
+                        key=lambda e: self.device.shard_of(e[0])
+                    )
                 # session entries FIRST: save bindings index the batch by
                 # position, and env rows need no post-dispatch binding
                 entries = [
@@ -1037,6 +1121,8 @@ class SessionHost:
                 "ticks_advanced": lane.ticks_advanced,
                 "throttled_ticks": lane.throttled_ticks,
             }
+            if self.mesh is not None:
+                entry["shard"] = self.device.shard_of(lane.slot)
             if lane.last_error:
                 entry["last_error"] = lane.last_error
             if lane.failed:
@@ -1063,6 +1149,7 @@ class SessionHost:
             ),
             "plan_signatures": len(dev.plan_cache.signatures),
             "buckets": list(dev.buckets),
+            "session_shards": dev.session_shards,
             "sessions": sessions,
             "envs": [env._env_section() for env in self._envs],
         }

@@ -10,8 +10,20 @@ from ..analysis.sanitize import maybe_install_from_env as _maybe_sanitize
 
 _maybe_sanitize()
 
-from .backend import SnapshotRef, TpuRollbackBackend
+from .backend import (
+    MultiSessionDeviceCore,
+    ShardedMultiSessionDeviceCore,
+    SnapshotRef,
+    TpuRollbackBackend,
+)
 from .resim import ResimCore
 from .sync_test import TpuSyncTestSession
 
-__all__ = ["ResimCore", "SnapshotRef", "TpuRollbackBackend", "TpuSyncTestSession"]
+__all__ = [
+    "MultiSessionDeviceCore",
+    "ResimCore",
+    "ShardedMultiSessionDeviceCore",
+    "SnapshotRef",
+    "TpuRollbackBackend",
+    "TpuSyncTestSession",
+]

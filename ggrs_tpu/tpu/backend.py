@@ -30,6 +30,7 @@ from ..obs import (
     LOG2_BUCKETS,
     LOG2_BUCKETS_MS,
     SESSION_COUNT_BUCKETS,
+    SHARD_IMBALANCE_BUCKETS,
 )
 from ..ops.fixed_point import combine_checksum
 from ..types import (
@@ -1821,13 +1822,24 @@ class MultiSessionDeviceCore:
             depths = set(int(d) for d in depth_buckets)
             assert depths and max(depths) <= W
         self.depth_buckets = tuple(sorted(depths))
-        S = capacity + 1  # + the dummy pad slot
-        self.states = jax.tree.map(
-            lambda x: jnp.stack([x] * S), self.core.state
+        # stacked worlds: capacity live slots + >= 1 dummy pad slot (the
+        # sharded subclass pads the dummy tail further so the session
+        # mesh axis divides the stack, and places the trees on the mesh)
+        S = self.stack_slots = self._stack_size()
+        self.states = self._place_states(
+            jax.tree.map(lambda x: jnp.stack([x] * S), self.core.state)
         )
-        self.rings = jax.tree.map(
-            lambda x: jnp.zeros((S,) + x.shape, x.dtype), self.core.ring
+        self.rings = self._place_rings(
+            jax.tree.map(
+                lambda x: jnp.zeros((S,) + x.shape, x.dtype), self.core.ring
+            )
         )
+        # logical slot -> physical stack index (identity on one device;
+        # the sharded subclass interleaves live slots across the session
+        # mesh shards and spreads the dummy padding, so every shard
+        # carries its share of live worlds). `pad_slot` is the PHYSICAL
+        # index pad rows no-op against.
+        self._init_slot_layout()
         # one pristine world for the masked batch reset (the env
         # workload's auto-reset): built once, passed as a plain argument
         # so the reset program doesn't bake the init state in as a const
@@ -1873,6 +1885,70 @@ class MultiSessionDeviceCore:
             "ggrs_host_megabatch_occupancy",
             "live rows / padded bucket size of the last megabatch",
         )
+
+    @classmethod
+    def create(cls, game, max_prediction: int, num_players: int,
+               capacity: int, *, mesh=None, **kw):
+        """THE mesh-dispatching factory: `mesh=None` builds a
+        single-device core, a session mesh builds
+        ShardedMultiSessionDeviceCore — one site for the choice, so the
+        host, the env and checkpoint restore can't drift on how the
+        knob maps to a core class."""
+        if mesh is not None:
+            return ShardedMultiSessionDeviceCore(
+                game, max_prediction, num_players, capacity,
+                mesh=mesh, **kw,
+            )
+        return MultiSessionDeviceCore(
+            game, max_prediction, num_players, capacity, **kw
+        )
+
+    # ------------------------------------------------------------------
+    # stack-layout hooks (the sharded subclass overrides these three; the
+    # dispatch/scheduling machinery above and below is layout-agnostic)
+    # ------------------------------------------------------------------
+
+    def _stack_size(self) -> int:
+        """Slots in the stacked pytrees: capacity live + the dummy pad
+        slot at index `capacity` that padding rows no-op against."""
+        return self.capacity + 1
+
+    def _place_states(self, tree):
+        """Placement hook for the stacked states (identity on one
+        device; the sharded subclass device_puts per the session-axis
+        placement policy in parallel/sharded.py)."""
+        return tree
+
+    def _place_rings(self, tree):
+        """Placement hook for the stacked rings — see `_place_states`."""
+        return tree
+
+    def _init_slot_layout(self) -> None:
+        """Build the logical-slot -> physical-stack-index map. One
+        device: identity, the single dummy at index `capacity`. The
+        public slot API (dispatch entries, reset/export/import, masks,
+        checkpoints) is always LOGICAL; only this layout knows where a
+        slot physically lives in the stack."""
+        self._phys = np.arange(self.capacity, dtype=np.int32)
+        # inverse: physical index -> logical slot (dummies -> capacity,
+        # the checkpoint's canonical dummy row)
+        self._phys_inverse = np.arange(self.stack_slots, dtype=np.int32)
+        self._phys_inverse[self.capacity :] = self.capacity
+        self.pad_slot = self.capacity
+        self.session_shards = 1
+
+    def shard_of(self, slot: int) -> int:
+        """Session-mesh shard a logical slot's world lives on. One
+        device: everything is shard 0. The host scheduler's slot->shard
+        affinity (admission spreading, lane packing) reads THIS so the
+        affinity policy can't drift from the physical layout."""
+        return 0
+
+    def phys_index(self, slots) -> np.ndarray:
+        """Physical stack indices of logical slots — the gather indices
+        any consumer reading `states`/`rings` directly (the env's
+        obs/checksum passes) must use instead of the logical slot."""
+        return self._phys[np.asarray(slots, dtype=np.int32)]
 
     # ------------------------------------------------------------------
 
@@ -2037,7 +2113,7 @@ class MultiSessionDeviceCore:
                 "flip": 0,
                 "bufs": [
                     [
-                        np.full((bucket,), self.capacity, dtype=np.int32),
+                        np.full((bucket,), self.pad_slot, dtype=np.int32),
                         np.tile(self._pad_row, (bucket, 1)),
                         0,  # rows written by this buffer's last use
                     ]
@@ -2075,10 +2151,10 @@ class MultiSessionDeviceCore:
         idx, rows, used = staged
         for k, (slot, row) in enumerate(entries):
             assert 0 <= slot < self.capacity
-            idx[k] = slot
+            idx[k] = self._phys[slot]
             rows[k] = row
         for k in range(n, used):  # re-pad only what the last use dirtied
-            idx[k] = self.capacity
+            idx[k] = self.pad_slot
             rows[k] = self._pad_row
         staged[2] = n
         if fast:
@@ -2108,10 +2184,10 @@ class MultiSessionDeviceCore:
         bucket = self.bucket_for(n)
         staged = self._acquire_stage(bucket)
         idx, rows, used = staged
-        idx[:n] = idx_block
+        idx[:n] = self._phys[idx_block]
         rows[:n] = rows_block
         if used > n:  # re-pad only what the last use dirtied
-            idx[n:used] = self.capacity
+            idx[n:used] = self.pad_slot
             rows[n:used] = self._pad_row
         staged[2] = n
         if fast:
@@ -2218,12 +2294,13 @@ class MultiSessionDeviceCore:
         import jax.numpy as jnp
 
         assert 0 <= slot < self.capacity
+        phys = int(self._phys[slot])
         init = self.core.game.init_state()
         self.states = jax.tree.map(
-            lambda a, x: a.at[slot].set(x), self.states, init
+            lambda a, x: a.at[phys].set(x), self.states, init
         )
         self.rings = jax.tree.map(
-            lambda a: a.at[slot].set(jnp.zeros(a.shape[1:], a.dtype)),
+            lambda a: a.at[phys].set(jnp.zeros(a.shape[1:], a.dtype)),
             self.rings,
         )
 
@@ -2231,7 +2308,7 @@ class MultiSessionDeviceCore:
         """Masked batch reset over the stacked pytrees: every slot with
         mask[slot] set returns to the pristine init world, its ring
         zeroed; every other slot passes through untouched. mask is DATA
-        (bool[capacity + 1], the dummy slot always False), so one program
+        (bool[stack_slots], the dummy tail always False), so one program
         covers every reset pattern — the env workload's auto-reset
         resets its whole done-set in one dispatch regardless of which
         episodes finished."""
@@ -2260,8 +2337,8 @@ class MultiSessionDeviceCore:
         so the program compiles once (warmup covers it) no matter which
         slots finish."""
         assert mask.shape == (self.capacity,)
-        m = np.zeros((self.capacity + 1,), dtype=bool)
-        m[: self.capacity] = mask
+        m = np.zeros((self.stack_slots,), dtype=bool)
+        m[self._phys[np.asarray(mask, dtype=bool)]] = True
         self.rings, self.states = self._reset_mask_fn(
             self.rings, self.states, m, self._init_state
         )
@@ -2269,8 +2346,9 @@ class MultiSessionDeviceCore:
     def state_numpy(self, slot: int):
         """Host copy of one session slot's live world (parity checks)."""
         self.block_until_ready()
+        phys = int(self._phys[slot])
         return jax.tree.map(
-            lambda a: np.asarray(jax.device_get(a[slot])), self.states
+            lambda a: np.asarray(jax.device_get(a[phys])), self.states
         )
 
     # ------------------------------------------------------------------
@@ -2299,7 +2377,7 @@ class MultiSessionDeviceCore:
         assert 0 <= slot < self.capacity
         self.block_until_ready()
         ring, state = self._export_slot_fn(
-            self.rings, self.states, np.int32(slot)
+            self.rings, self.states, np.int32(self._phys[slot])
         )
         return {
             "ring": jax.tree.map(
@@ -2343,7 +2421,7 @@ class MultiSessionDeviceCore:
                     )
         self.block_until_ready()
         self.rings, self.states = self._import_slot_fn(
-            self.rings, self.states, np.int32(slot),
+            self.rings, self.states, np.int32(self._phys[slot]),
             payload["ring"], payload["state"],
         )
 
@@ -2362,7 +2440,7 @@ class MultiSessionDeviceCore:
 
     def _warmup_impl(self) -> None:
         for b in self.buckets:
-            idx = np.full((b,), self.capacity, dtype=np.int32)
+            idx = np.full((b,), self.pad_slot, dtype=np.int32)
             rows = np.tile(self._pad_row, (b, 1))
             if self.depth_routing:
                 self.rings, self.states, _, _ = self._dispatch_fast_fn(
@@ -2382,7 +2460,7 @@ class MultiSessionDeviceCore:
         self.rings, self.states = self._reset_mask_fn(
             self.rings,
             self.states,
-            np.zeros((self.capacity + 1,), dtype=bool),
+            np.zeros((self.stack_slots,), dtype=bool),
             self._init_state,
         )
         # one export->import round trip of slot 0 (same bytes back, a
@@ -2401,13 +2479,45 @@ class MultiSessionDeviceCore:
     # durable checkpoint (graceful drain rides this)
     # ------------------------------------------------------------------
 
+    def stacked_canonical(self) -> Tuple[Any, Any]:
+        """Host copy of the stacked worlds in the CANONICAL slot layout —
+        `capacity` live slots in logical order plus ONE dummy row at
+        index `capacity` — whatever the stack's physical layout
+        (checkpoints and cross-host parity checks are always canonical,
+        so a sharded host's bytes compare/restore against a
+        single-device twin's directly). Returns (rings, states) numpy
+        pytrees; `save()` writes exactly this and `load_stacked()`
+        adopts it back."""
+        self.block_until_ready()
+        idx = np.append(self._phys, np.int32(self.pad_slot))
+        canon = lambda a: np.asarray(jax.device_get(a))[idx]  # noqa: E731
+        return (
+            jax.tree.map(canon, self.rings),
+            jax.tree.map(canon, self.states),
+        )
+
+    def checksum_slots(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(hi, lo) uint32[capacity] checksums of every live slot's
+        world, logical slot order — the host-facing desync spot-check
+        and the cross-layout parity witness (the sharded subclass
+        overrides this with the EXPLICIT shard_map + psum pass from
+        parallel/sharded.py; both must agree bitwise with vmapping the
+        model's checksum). Not a hot path: flushes the fence."""
+        self.block_until_ready()
+        g = jax.tree.map(lambda a: a[self._phys], self.states)
+        his, los = jax.vmap(self.core.game.checksum)(g)
+        return (
+            np.asarray(jax.device_get(his)),
+            np.asarray(jax.device_get(los)),
+        )
+
     def save(self, path: str) -> None:
         from ..utils.checkpoint import save_device_checkpoint
 
-        self.block_until_ready()
+        rings, states = self.stacked_canonical()
         save_device_checkpoint(
             path,
-            {"rings": self.rings, "states": self.states},
+            {"rings": rings, "states": states},
             {
                 "kind": "MultiSessionDeviceCore",
                 "capacity": self.capacity,
@@ -2417,7 +2527,11 @@ class MultiSessionDeviceCore:
         )
 
     @classmethod
-    def restore(cls, path: str, game) -> "MultiSessionDeviceCore":
+    def restore(cls, path: str, game, mesh=None) -> "MultiSessionDeviceCore":
+        """Rebuild a core from a save() checkpoint. Checkpoints are
+        LAYOUT-AGNOSTIC: `mesh=` restores the same worlds onto a sharded
+        core (and a sharded host's checkpoint restores single-device) —
+        the serving twin of TpuRollbackBackend.restore's mesh knob."""
         from ..utils.checkpoint import load_device_checkpoint
 
         tree, meta = load_device_checkpoint(path)
@@ -2428,20 +2542,219 @@ class MultiSessionDeviceCore:
                 f"checkpoint {path!r} holds a different core kind",
                 found=meta.get("kind"), expected="MultiSessionDeviceCore",
             )
-        core = cls(
+        core = cls.create(
             game,
-            max_prediction=meta["max_prediction"],
-            num_players=meta["num_players"],
-            capacity=meta["capacity"],
+            meta["max_prediction"],
+            meta["num_players"],
+            meta["capacity"],
+            mesh=mesh,
         )
-        core.rings = jax.device_put(tree["rings"])
-        core.states = jax.device_put(tree["states"])
+        core.load_stacked(tree["rings"], tree["states"])
         return core
 
     def load_stacked(self, rings, states) -> None:
         """Adopt checkpointed stacked worlds into THIS core (the env
         restore path: the env rebuilds its core from config, then loads
-        the saved worlds) — the in-place twin of restore()."""
+        the saved worlds) — the in-place twin of restore(). The trees
+        carry the CANONICAL capacity + 1 slots (save() writes that
+        layout whatever the stack's physical padding); this expands them
+        into the core's own physical layout — dummy padding replicated
+        from the canonical dummy row — and places per the layout's
+        policy, so a single-device checkpoint restores onto a sharded
+        core (and vice versa) bit-exactly."""
         self.block_until_ready()
-        self.rings = jax.device_put(rings)
-        self.states = jax.device_put(states)
+
+        def expand(a):
+            a = np.asarray(jax.device_get(a))
+            assert a.shape[0] == self.capacity + 1, (
+                f"stacked trees must be canonical (capacity + 1 = "
+                f"{self.capacity + 1} slots; got {a.shape[0]})"
+            )
+            out = np.repeat(
+                a[self.capacity : self.capacity + 1],
+                self.stack_slots,
+                axis=0,
+            )
+            out[self._phys] = a[: self.capacity]
+            return out
+
+        self.rings = self._place_rings(jax.tree.map(expand, rings))
+        self.states = self._place_states(jax.tree.map(expand, states))
+
+
+class ShardedMultiSessionDeviceCore(MultiSessionDeviceCore):
+    """MultiSessionDeviceCore with the SESSION axis of the stacked
+    pytrees split over the `session` axis of a device mesh (and, for big
+    worlds, the entity axis over an `entity` mesh axis) — the serving
+    megabatch GSPMD-partitioned across chips, so one host's capacity
+    multiplies by the session-axis size instead of stacking the whole
+    fleet on device 0.
+
+    Placement is the ONE policy in parallel/sharded.py
+    (`stacked_state_specs`/`stacked_ring_specs` via
+    `shard_stacked_state`/`shard_stacked_ring`): sessions split over
+    `session` on the stack's leading axis, entity arrays additionally
+    over `entity` when the mesh carries one, ring-slot axes always
+    local. The slot layout interleaves live slots round-robin across the
+    session shards — logical slot i lives on shard i % n at local offset
+    i // n — so a fleet that fills slots in admission order spreads over
+    every chip, and the dummy pad tail is distributed so the session
+    axis divides the stack. The public API stays LOGICAL-slot throughout
+    (dispatch entries, reset masks, export/import, checkpoints — which
+    stay canonical, so a sharded host's checkpoint restores on a
+    single-device twin and vice versa).
+
+    Every program of the base core — the (row-bucket x depth-bucket)
+    megabatch grid, the zero-rollback fast path, `reset_slots_masked`,
+    `dispatch_rows`, export/import, `load_stacked` — runs GSPMD-
+    partitioned from the operand shardings; the dispatch impls
+    additionally constrain the staged (idx, rows) batch onto the
+    `session` axis, so the vmapped row work partitions across shards
+    (the host's slot->shard affinity keeps most rows on the shard that
+    owns their world, so the gather/scatter crosses ICI only for the
+    stragglers). The per-megabatch [B, W] checksum reduction rides the
+    models' concat-free partial sums (ops/fixed_point.
+    weighted_checksum_parts — exact under any partitioning);
+    `checksum_slots()` additionally pins the collective shape BY HAND
+    via parallel/sharded.stacked_sharded_checksum (shard_map + psum over
+    `entity`), the spot-check a partitioner regression is caught
+    against.
+
+    Bitwise contract (pinned by tests/test_sharded_serve.py and the
+    dryrun's sharded-host stage): a sharded host produces bit-identical
+    per-slot device state, ring bytes and checksum histories to a
+    single-device twin fed the same traffic."""
+
+    def __init__(self, game, max_prediction: int, num_players: int,
+                 capacity: int, *, mesh, **kw):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        assert "session" in mesh.axis_names, (
+            f"serving mesh needs a 'session' axis (got {mesh.axis_names};"
+            " build it with parallel.mesh.make_session_mesh)"
+        )
+        self.mesh = mesh
+        self.session_shards = int(mesh.shape["session"])
+        self._row_sharding = NamedSharding(mesh, PartitionSpec("session"))
+        super().__init__(game, max_prediction, num_players, capacity, **kw)
+        _reg = GLOBAL_TELEMETRY.registry
+        self._m_shard_rows = _reg.gauge(
+            "ggrs_shard_rows",
+            "live megabatch rows routed to this session-mesh shard in "
+            "the last dispatch",
+            labelnames=("shard",),
+        )
+        self._m_shard_imbalance = _reg.histogram(
+            "ggrs_shard_imbalance",
+            "max/mean live rows per session-mesh shard per megabatch "
+            "dispatch (1.0 = perfectly balanced)",
+            buckets=SHARD_IMBALANCE_BUCKETS,
+        )
+        # labeled children resolved once, not per dispatch: .labels() is
+        # a str-key dict path and _dispatch_staged is the hot tick path
+        self._shard_row_gauges = [
+            self._m_shard_rows.labels(str(s))
+            for s in range(self.session_shards)
+        ]
+
+    # ------------------------------------------------------------------
+    # stack-layout hooks (see the base class: everything else — dispatch,
+    # staging, fence, lifecycle — is layout-agnostic and inherited)
+    # ------------------------------------------------------------------
+
+    def _stack_size(self) -> int:
+        """capacity live slots + a dummy tail padded so the session mesh
+        axis divides the stack (>= 1 dummy total, so pad rows always
+        have a world to no-op against)."""
+        n = self.session_shards
+        self._per_shard = -(-(self.capacity + 1) // n)  # ceil
+        return self._per_shard * n
+
+    def _place_states(self, tree):
+        from ..parallel.sharded import shard_stacked_state
+
+        return shard_stacked_state(tree, self.mesh)
+
+    def _place_rings(self, tree):
+        from ..parallel.sharded import shard_stacked_ring
+
+        return shard_stacked_ring(tree, self.mesh)
+
+    def _init_slot_layout(self) -> None:
+        per, n = self._per_shard, self.session_shards
+        slots = np.arange(self.capacity, dtype=np.int32)
+        # round-robin: shard s owns physical rows [s*per, (s+1)*per) of
+        # the equally-split stack; logical slot i -> shard i % n, local
+        # offset i // n (< per by construction of _stack_size)
+        self._phys = (slots % n) * per + slots // n
+        self._phys_inverse = np.full(
+            (self.stack_slots,), self.capacity, dtype=np.int32
+        )
+        self._phys_inverse[self._phys] = slots
+        dummies = np.setdiff1d(
+            np.arange(self.stack_slots, dtype=np.int32), self._phys
+        )
+        self.pad_slot = int(dummies[0])
+
+    def shard_of(self, slot: int) -> int:
+        return int(slot) % self.session_shards
+
+    # ------------------------------------------------------------------
+    # GSPMD dispatch: same impls, the staged batch constrained onto the
+    # session axis so the row work partitions across shards
+    # ------------------------------------------------------------------
+
+    def _dispatch_impl(self, rings, states, idx, rows, nslots):
+        idx = jax.lax.with_sharding_constraint(idx, self._row_sharding)
+        rows = jax.lax.with_sharding_constraint(rows, self._row_sharding)
+        return super()._dispatch_impl(rings, states, idx, rows, nslots)
+
+    def _dispatch_fast_impl(self, rings, states, idx, rows):
+        idx = jax.lax.with_sharding_constraint(idx, self._row_sharding)
+        rows = jax.lax.with_sharding_constraint(rows, self._row_sharding)
+        return super()._dispatch_fast_impl(rings, states, idx, rows)
+
+    def _dispatch_staged(self, staged, n, bucket, *, last_active, fast):
+        if GLOBAL_TELEMETRY.enabled:
+            # per-shard live-row census of THIS dispatch: the affinity
+            # health surface (registry-driven, so both exporters and
+            # host.telemetry() carry it with no extra code)
+            counts = np.bincount(
+                staged[0][:n] // self._per_shard,
+                minlength=self.session_shards,
+            )
+            for s in range(self.session_shards):
+                self._shard_row_gauges[s].set(int(counts[s]))
+            self._m_shard_imbalance.observe(
+                float(counts.max()) * self.session_shards / n
+            )
+        return super()._dispatch_staged(
+            staged, n, bucket, last_active=last_active, fast=fast
+        )
+
+    # ------------------------------------------------------------------
+    # the explicit cross-shard checksum pass
+    # ------------------------------------------------------------------
+
+    def checksum_slots(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(hi, lo) uint32[capacity], logical slot order, computed with
+        the EXPLICIT shard_map + psum collective from
+        parallel/sharded.stacked_sharded_checksum — bit-identical to the
+        base class's vmapped model checksum (the parity tests pin both
+        against each other), with the cross-shard word reduction's
+        collective shape pinned by hand for entity-sharded worlds."""
+        from ..parallel.sharded import stacked_sharded_checksum
+
+        self.block_until_ready()
+        his, los = stacked_sharded_checksum(
+            self.states, self.mesh, keys=self.core.game.checksum_keys
+        )
+        his = np.asarray(jax.device_get(his))[self._phys]
+        los = np.asarray(jax.device_get(los))[self._phys]
+        return his, los
+
+    def _warmup_impl(self) -> None:
+        super()._warmup_impl()
+        # the explicit cross-shard checksum pass compiles here too, so a
+        # mid-serve desync spot-check never pays its first compile
+        self.checksum_slots()

@@ -40,6 +40,14 @@
 #   dispatch bucket budget, bit-exact branch replay, and the env
 #   instruments through both exporters (scripts/env_smoke.py, CPU jax,
 #   <1 min).
+#   --shard-smoke runs a SessionHost on an 8-virtual-device session mesh
+#   (ShardedMultiSessionDeviceCore) against a single-device twin fed
+#   identical lossy traffic under GGRS_SANITIZE=1, gated on bitwise
+#   state/ring/checksum-history parity, zero post-warmup recompiles, the
+#   megabatch jit cache within dispatch_bucket_budget(), and the shard
+#   instruments through BOTH exporters (scripts/shard_smoke.py, CPU jax,
+#   ~1 min). The multi-chip dryrun (step 5) additionally gates the same
+#   core inside dryrun_multichip.
 #   --chaos-smoke runs a seeded WAN-profile chaos soak on a 2-host
 #   HostGroup with one live session migration and one host
 #   kill->restore-from-checkpoint, gated on zero desyncs, zero
@@ -111,6 +119,14 @@ fi
 if [ "${1:-}" = "--env-smoke" ]; then
   echo "== env smoke (256-world rollout + backtracking, recompile-clean) =="
   GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/env_smoke.py
+  exit $?
+fi
+
+if [ "${1:-}" = "--shard-smoke" ]; then
+  echo "== shard smoke (sharded SessionHost vs single-device twin) =="
+  GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python scripts/shard_smoke.py
   exit $?
 fi
 

@@ -26,24 +26,18 @@ from ggrs_tpu.utils.clock import FakeClock
 NUM_PLAYERS = 2
 ENTITIES = 128  # divisible by the 4-wide entity axis of the 8-device mesh
 
-# On jax versions without a top-level jax.shard_map, the package runs the
-# compat shim in ggrs_tpu/parallel/sharded.py (jax.experimental.shard_map
-# with check_vma translated to check_rep — CHANGES.md PR 1). Under that
-# shim, four sharded parity tests are KNOWN-RED on this jax version (the
-# experimental lowering diverges bitwise for these program shapes); they
-# are gated with an explicit skip so tier-1 signal stays clean instead of
-# carrying known failures. They run — and must pass — wherever the native
-# jax.shard_map exists.
-import jax
-
-requires_native_shard_map = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason=(
-        "known-red under the jax.experimental.shard_map compat shim "
-        "(ggrs_tpu/parallel/sharded.py; jax without top-level "
-        "jax.shard_map) — sharded parity diverges on this jax version"
-    ),
-)
+# History: on jax versions without a top-level jax.shard_map (< 0.6),
+# four sharded parity tests here were KNOWN-RED and skip-gated. The root
+# cause was never the jax.experimental.shard_map compat shim in
+# ggrs_tpu/parallel/sharded.py: jax 0.4.x GSPMD miscompiles
+# `sum(concatenate([...]))` of an entity-sharded operand on a multi-axis
+# mesh into an all-reduce over EVERY mesh axis, so a world replicated
+# over the 2-wide `beam` axis reported exactly 2x the true checksum. The
+# models' `_checksum_generic` now computes per-key partial sums with
+# global word offsets (ops/fixed_point.weighted_checksum_parts —
+# bit-identical totals, no concatenate), and all four tests pass under
+# the shim on jax 0.4.37 as well as under the native jax.shard_map.
+import jax  # noqa: F401  (kept: the fixture and parity tests poke jax)
 
 
 @pytest.fixture(scope="module")
@@ -108,7 +102,6 @@ def test_sharded_backend_bit_parity(mesh, check_distance):
     assert_state_equal(sharded.state_numpy(), plain.state_numpy())
 
 
-@requires_native_shard_map
 def test_sharded_backend_with_beam(mesh):
     """Beam speculation over the sharded core: candidate futures shard the
     `beam` axis, adoption still bit-matches the plain resim path."""
@@ -155,7 +148,6 @@ def test_sharded_backend_with_lazy_ticks(mesh):
     assert_state_equal(sharded_lazy.state_numpy(), unsharded.state_numpy())
 
 
-@requires_native_shard_map
 def test_sharded_pallas_tick_bit_parity(mesh):
     """The sharded request path on the entity-tiled pallas kernel
     (ShardedPallasTickCore: one local kernel per device + psum'd checksum
@@ -197,7 +189,6 @@ def test_sharded_pallas_tick_bit_parity(mesh):
     assert shard.data.shape[0] == 512 // mesh.shape["entity"]
 
 
-@requires_native_shard_map
 def test_sharded_pallas_beam_bit_parity(mesh):
     """The SHARDED pallas beam rollout (ShardedPallasBeamRollout: one
     local entity-tiled rollout per device, psum'd checksum partials —
@@ -360,7 +351,6 @@ def sync_sessions(sessions, clock):
     raise AssertionError("sessions failed to synchronize")
 
 
-@requires_native_shard_map
 def test_p2p_sharded_vs_unsharded_peer(mesh):
     """One peer runs the mesh-sharded backend, the other the single-device
     backend, desync detection on: the framework's own detector must stay

@@ -1,0 +1,361 @@
+"""Sharded serving: the SessionHost megabatch GSPMD-partitioned over a
+`session` device mesh (ShardedMultiSessionDeviceCore) on the conftest's
+8 virtual CPU devices.
+
+The correctness contract is the bitwise one the repo already enforces
+everywhere: a sharded host/env must produce bit-identical per-slot
+device state, ring bytes and checksum histories to a single-device twin
+fed the same traffic — checkpoints and migration payloads stay CANONICAL
+(logical slot order), so the two layouts interoperate freely."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ggrs_tpu.models.ex_game import ExGame
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.obs import GLOBAL_TELEMETRY
+from ggrs_tpu.parallel.mesh import make_session_mesh
+from ggrs_tpu.serve import SessionHost, migrate_session
+from ggrs_tpu.tpu.backend import (
+    MultiSessionDeviceCore,
+    ShardedMultiSessionDeviceCore,
+)
+from ggrs_tpu.utils.clock import FakeClock
+
+ENTITIES = 16
+FRAME_MS = 16
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_session_mesh(8)  # 8-wide session axis, no entity split
+
+
+def _assert_tree_equal(ta, tb, what):
+    la = jax.tree_util.tree_leaves_with_path(ta)
+    lb = jax.tree_util.tree_leaves(tb)
+    assert len(la) == len(lb)
+    for (path, a), b in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"{what}{jax.tree_util.keystr(path)}",
+        )
+
+
+def build_fleet(mesh, *, seed=13, sessions=8, ticks=40, loss=0.03,
+                **host_kw):
+    from ggrs_tpu.serve.loadgen import (
+        build_matches,
+        drive_scripted,
+        make_scripts,
+        sync_fleet,
+    )
+
+    clock = FakeClock()
+    net = InMemoryNetwork(
+        clock, latency_ms=20, jitter_ms=8, loss=loss, seed=seed
+    )
+    host = SessionHost(
+        ExGame(num_players=4, num_entities=ENTITIES),
+        max_prediction=8, num_players=4, max_sessions=sessions + 4,
+        clock=clock, idle_timeout_ms=0, mesh=mesh, **host_kw,
+    )
+    matches = build_matches(host, net, clock, sessions=sessions, seed=seed)
+    sync_fleet(host, matches, clock)
+    scripts = make_scripts(matches, ticks, seed=seed)
+    desyncs = drive_scripted(host, matches, clock, scripts, ticks)
+    assert not desyncs, f"lossy fleet desynced (mesh={mesh is not None})"
+    host.device.block_until_ready()
+    return host, [k for keys in matches for k in keys]
+
+
+# ----------------------------------------------------------------------
+# hosted fleet bitwise parity vs the single-device twin
+# ----------------------------------------------------------------------
+
+
+def test_sharded_host_fleet_bitwise_parity(mesh):
+    """A lossy 8-session hosted fleet on the 8-shard session mesh vs a
+    single-device twin fed identical traffic: every session's checksum
+    history, the canonical stacked state AND ring bytes, and the
+    explicit cross-shard checksum pass all bit-match — and the fleet
+    actually spread across shards (slot->shard affinity)."""
+    host_s, keys_s = build_fleet(mesh)
+    host_p, keys_p = build_fleet(None)
+    assert isinstance(host_s.device, ShardedMultiSessionDeviceCore)
+    assert type(host_p.device) is MultiSessionDeviceCore
+    for ka, kb in zip(keys_s, keys_p):
+        sa, sb = host_s.session(ka), host_p.session(kb)
+        assert sa.current_frame == sb.current_frame > 0
+        assert sa.local_checksum_history == sb.local_checksum_history
+        assert len(sa.local_checksum_history) > 0  # non-vacuous
+    rs, ss = host_s.device.stacked_canonical()
+    rp, sp = host_p.device.stacked_canonical()
+    _assert_tree_equal(rs, rp, "rings")
+    _assert_tree_equal(ss, sp, "states")
+    hi_s, lo_s = host_s.device.checksum_slots()
+    hi_p, lo_p = host_p.device.checksum_slots()
+    np.testing.assert_array_equal(hi_s, hi_p)
+    np.testing.assert_array_equal(lo_s, lo_p)
+    # admission affinity spread the 8 sessions over all 8 shards
+    shards = {
+        host_s.device.shard_of(host_s._lanes[k].slot) for k in keys_s
+    }
+    assert len(shards) == 8
+
+
+def test_sharded_slot_layout_round_trip(mesh, tmp_path):
+    """The interleaved logical->physical slot map is a bijection onto
+    the non-dummy stack rows, shard_of matches the physical placement,
+    and checkpoints round-trip ACROSS layouts bit-exactly."""
+    game = ExGame(num_players=2, num_entities=ENTITIES)
+    core = ShardedMultiSessionDeviceCore(game, 8, 2, 10, mesh=mesh)
+    assert core.stack_slots % core.session_shards == 0
+    assert len(set(core._phys.tolist())) == core.capacity
+    per = core._per_shard
+    for slot in range(core.capacity):
+        phys = int(core._phys[slot])
+        assert core.shard_of(slot) == phys // per
+        assert int(core._phys_inverse[phys]) == slot
+    assert int(core._phys_inverse[core.pad_slot]) == core.capacity
+    # write something slot-distinct, round-trip through a checkpoint
+    # onto the OTHER layout and back
+    rows = np.tile(core.core.pad_tick_row(), (core.capacity, 1))
+    rows[:, 2] = 1
+    rows[:, core.core._off_input] = np.arange(core.capacity) % 16
+    core.dispatch_rows(
+        np.arange(core.capacity, dtype=np.int32), rows, fast=True
+    )
+    path = str(tmp_path / "ggrs_sharded_roundtrip.npz")
+    core.save(path)
+    plain = MultiSessionDeviceCore.restore(path, game)
+    back = MultiSessionDeviceCore.restore(path, game, mesh=mesh)
+    assert isinstance(back, ShardedMultiSessionDeviceCore)
+    for a, b in zip(plain.stacked_canonical(), back.stacked_canonical()):
+        _assert_tree_equal(a, b, "roundtrip")
+    for slot in (0, core.capacity - 1):
+        _assert_tree_equal(
+            core.state_numpy(slot), plain.state_numpy(slot), f"slot{slot}"
+        )
+
+
+# ----------------------------------------------------------------------
+# migration across a sharded <-> unsharded host pair
+# ----------------------------------------------------------------------
+
+
+def test_migration_across_sharded_and_unsharded_hosts(mesh):
+    """A live mid-match migration from a SHARDED host to a single-device
+    host (export_slot -> import_slot through the canonical per-slot
+    payload), peers none the wiser, then back again — checksum exchange
+    keeps running across both handoffs and the final world bit-matches
+    an undisturbed twin match."""
+    import random
+
+    from ggrs_tpu import PlayerType, SessionBuilder, SessionState
+    from ggrs_tpu.types import DesyncDetection
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=20, jitter_ms=0, loss=0.0)
+
+    def peer(addr, other, handle, seed):
+        return (
+            SessionBuilder(input_size=1)
+            .with_num_players(2)
+            .with_max_prediction_window(8)
+            .with_input_delay(1)
+            .with_desync_detection_mode(DesyncDetection.on(interval=10))
+            .with_clock(clock)
+            .with_rng(random.Random(seed * 131 + handle + 7))
+            .add_player(PlayerType.local(), handle)
+            .add_player(PlayerType.remote(other), 1 - handle)
+            .start_p2p_session(net.socket(addr))
+        )
+
+    def make_host(m):
+        return SessionHost(
+            ExGame(num_players=2, num_entities=ENTITIES),
+            max_prediction=8, num_players=2, max_sessions=6,
+            clock=clock, idle_timeout_ms=0, mesh=m,
+        )
+
+    h_shard, h_plain = make_host(mesh), make_host(None)
+    a0 = peer("a0", "a1", 0, seed=1)
+    a1 = peer("a1", "a0", 1, seed=2)
+    b0 = peer("b0", "b1", 0, seed=3)  # undisturbed twin match
+    b1 = peer("b1", "b0", 1, seed=4)
+    ka0, ka1 = h_shard.attach(a0), h_shard.attach(a1)
+    kb0, kb1 = h_shard.attach(b0), h_shard.attach(b1)
+
+    for _ in range(600):
+        h_shard.tick()
+        h_plain.tick()
+        clock.advance(FRAME_MS)
+        if all(
+            s.current_state() == SessionState.RUNNING
+            for s in (a0, a1, b0, b1)
+        ):
+            break
+    assert a0.current_state() == SessionState.RUNNING
+
+    script = lambda h, t: (t * 3 + h * 5 + 1) % 16  # noqa: E731
+    desyncs = []
+    keymap = [
+        (a0, [h_shard, ka0], 0), (a1, [h_shard, ka1], 1),
+        (b0, [h_shard, kb0], 0), (b1, [h_shard, kb1], 1),
+    ]
+
+    def drive(t):
+        for _sess, (host, key), h in keymap:
+            host.submit_input(key, h, bytes([script(h, t)]))
+        for host in (h_shard, h_plain):
+            for _key, evs in host.tick().items():
+                desyncs.extend(
+                    e for e in evs if type(e).__name__ == "DesyncDetected"
+                )
+        clock.advance(FRAME_MS)
+
+    for t in range(20):
+        drive(t)
+    # sharded -> single-device, mid-match
+    k_on_plain = migrate_session(h_shard, h_plain, ka0)
+    keymap[0][1][:] = [h_plain, k_on_plain]
+    for t in range(20, 50):
+        drive(t)
+    # ...and back onto the mesh
+    k_back = migrate_session(h_plain, h_shard, k_on_plain)
+    keymap[0][1][:] = [h_shard, k_back]
+    for t in range(50, 80):
+        drive(t)
+
+    assert not desyncs, f"cross-layout migration desynced: {desyncs[:3]}"
+    assert a0.current_frame == b0.current_frame > 40
+    common = set(a0.local_checksum_history) & set(b0.local_checksum_history)
+    assert common, "no comparable frames published"
+    for f in common:
+        assert a0.local_checksum_history[f] == b0.local_checksum_history[f]
+    migrated = h_shard.device.state_numpy(h_shard._lanes[k_back].slot)
+    twin = h_shard.device.state_numpy(h_shard._lanes[kb0].slot)
+    _assert_tree_equal(migrated, twin, "migrated-vs-twin")
+
+
+# ----------------------------------------------------------------------
+# sharded env: masked auto-reset parity
+# ----------------------------------------------------------------------
+
+
+def test_sharded_env_masked_auto_reset_parity(mesh):
+    """A sharded standalone RollbackEnv vs a single-device twin through
+    episode boundaries (auto-reset = the masked batch reset on-mesh):
+    per-step checksums, rewards and done flags bit-match; a PARTIAL
+    reset mask (arbitrary slots) also bit-matches across layouts."""
+    from ggrs_tpu.env import (
+        InputModelOpponent,
+        RollbackEnv,
+        held_value_trace,
+    )
+
+    trace = held_value_trace([1, 4, 2, 8, 1, 4, 2, 8, 5, 4])
+
+    def build(m):
+        return RollbackEnv(
+            ExGame(num_players=2, num_entities=ENTITIES),
+            num_envs=16,
+            opponents={1: InputModelOpponent(trace, seed=9)},
+            episode_len=6,
+            mesh=m,
+        )
+
+    es, ep = build(mesh), build(None)
+    assert isinstance(es._device, ShardedMultiSessionDeviceCore)
+    es.reset()
+    ep.reset()
+    for t in range(14):  # crosses the episode_len=6 boundary twice
+        a = np.full((16, 1), (t * 3 + 1) % 16, np.uint8)
+        _, rs, ds, _ = es.step(a)
+        _, rp, dp, _ = ep.step(a)
+        assert es.checksums() == ep.checksums(), f"step {t}"
+        np.testing.assert_array_equal(ds, dp)
+        np.testing.assert_array_equal(np.asarray(rs), np.asarray(rp))
+    assert es.episodes_total == ep.episodes_total >= 32
+    # partial masked reset, arbitrary slot pattern, both layouts
+    mask = np.zeros((16,), dtype=bool)
+    mask[[1, 4, 7, 10, 15]] = True
+    es._device.reset_slots_masked(mask)
+    ep._device.reset_slots_masked(mask)
+    assert es.checksums() == ep.checksums()
+    for tree_s, tree_p in zip(
+        es._device.stacked_canonical(), ep._device.stacked_canonical()
+    ):
+        _assert_tree_equal(tree_s, tree_p, "post-partial-reset")
+
+
+# ----------------------------------------------------------------------
+# jit-cache budget under the sanitizer
+# ----------------------------------------------------------------------
+
+
+def test_sharded_jit_cache_budget_under_sanitizer(mesh):
+    """GGRS_SANITIZE semantics on the sharded core: warmup compiles the
+    whole (row-bucket x depth-bucket) grid on-mesh, the lossy serve
+    afterwards compiles NOTHING, and the megabatch jit cache stays
+    within dispatch_bucket_budget()."""
+    from ggrs_tpu.analysis.sanitize import (
+        install_sanitizer,
+        uninstall_sanitizer,
+    )
+
+    san = install_sanitizer()
+    try:
+        host, keys = build_fleet(mesh, sessions=6, ticks=25, warmup=True)
+        assert not san.recompiles, (
+            "post-warmup recompile on the sharded host:\n"
+            + "\n".join(e.render() for e in san.recompiles)
+        )
+        dev = host.device
+        cache = (
+            dev._dispatch_fn._cache_size()
+            + dev._dispatch_fast_fn._cache_size()
+        )
+        assert cache <= dev.dispatch_bucket_budget()
+        assert dev.megabatches > 0
+    finally:
+        uninstall_sanitizer()
+
+
+# ----------------------------------------------------------------------
+# lossy soak: zero desyncs + shard instruments
+# ----------------------------------------------------------------------
+
+
+def test_sharded_lossy_soak_zero_desyncs(mesh):
+    """A lossier, longer soak on the sharded host: zero desyncs (real
+    checksum comparisons — desync detection is on in every match), rows
+    actually coalesced, and the shard instruments
+    (ggrs_shard_rows{shard=} + ggrs_shard_imbalance) populated through
+    the registry-driven exporters."""
+    from ggrs_tpu import enable_global_telemetry
+
+    enable_global_telemetry()
+    try:
+        host, keys = build_fleet(
+            mesh, seed=5, sessions=10, ticks=60, loss=0.08
+        )
+        dev = host.device
+        assert dev.megabatches > 0
+        assert dev.rows_dispatched / dev.megabatches > 1.0
+        snap = host.telemetry()
+        assert snap["host"]["desyncs_observed"] == 0
+        assert snap["host"]["session_shards"] == 8
+        rows_metric = snap["metrics"]["ggrs_shard_rows"]
+        assert rows_metric["type"] == "gauge" and rows_metric["values"]
+        imb = snap["metrics"]["ggrs_shard_imbalance"]
+        assert next(iter(imb["values"].values()))["count"] > 0
+        prom = GLOBAL_TELEMETRY.prometheus()
+        assert "ggrs_shard_rows{" in prom
+        assert "ggrs_shard_imbalance" in prom
+    finally:
+        GLOBAL_TELEMETRY.enabled = False
+        GLOBAL_TELEMETRY.reset()
