@@ -42,6 +42,7 @@ CORE_STATE: FrozenSet[str] = frozenset({
     "_pad_row", "_tick_rows", "_tick_future", "_buffered_last_active",
     "plan_cache", "dispatch_signatures",
     "rings", "states",
+    "_draft_stage_pools",
 })
 
 
@@ -86,6 +87,14 @@ POLICIES: Dict[str, FencePolicy] = {
             # live-migration slot adoption: eager per-leaf writes behind
             # a full fence flush, the same discipline as reset_slot
             ("MultiSessionDeviceCore", "import_slot"),
+            # speculative bubble-filling: the draft rollout stages rows
+            # through its own fenced pool (reads rings only — no
+            # stacked-world write), and the per-slot adopt writes the
+            # stacked worlds through the same fence discipline as
+            # dispatch
+            ("MultiSessionDeviceCore", "draft"),
+            ("MultiSessionDeviceCore", "adopt_slot"),
+            ("MultiSessionDeviceCore", "_acquire_draft_stage"),
             # the session-mesh serving core's fence-dispatch entry
             # points: overrides of the SAME protocol methods (GSPMD row
             # constraints + per-shard instruments wrapped around the

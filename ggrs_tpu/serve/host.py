@@ -62,6 +62,19 @@ from ..utils.tracing import GLOBAL_TRACER
 
 DEFAULT_IDLE_TIMEOUT_MS = 30_000
 
+# lazily-resolved backend types (importing ggrs_tpu.serve must not pull
+# jax; the per-row retire path must not re-run import machinery either)
+_BACKEND_REFS = None
+
+
+def _backend_refs():
+    global _BACKEND_REFS
+    if _BACKEND_REFS is None:
+        from ..tpu.backend import SnapshotRef, _LazyChecksum
+
+        _BACKEND_REFS = (SnapshotRef, _LazyChecksum)
+    return _BACKEND_REFS
+
 
 class _StagedRow:
     """One parsed request segment awaiting its megabatch: the packed
@@ -69,18 +82,24 @@ class _StagedRow:
     lazy checksums bound when the dispatch happens. `last_active` (the
     row's 1-based last active slot) and `fast` (zero-rollback fast-path
     eligibility) are the scheduler's depth-routing keys, computed once
-    at parse time so grouping never rescans rows."""
+    at parse time so grouping never rescans rows. `adopt` (None on
+    ordinary rows) marks a row the verify pass matched against a
+    standing speculative draft: (DraftBatch, packed adopt row) — it
+    dispatches through device.adopt_slot instead of joining a megabatch
+    group, serving the matched prefix from the draft trajectory."""
 
     __slots__ = ("row", "saves", "start_frame", "count", "last_active",
-                 "fast")
+                 "fast", "adopt")
 
-    def __init__(self, row, saves, start_frame, count, last_active, fast):
+    def __init__(self, row, saves, start_frame, count, last_active, fast,
+                 adopt=None):
         self.row = row
         self.saves = saves
         self.start_frame = start_frame
         self.count = count
         self.last_active = last_active
         self.fast = fast
+        self.adopt = adopt
 
 
 class _Lane:
@@ -99,6 +118,7 @@ class _Lane:
         "max_prediction", "rows", "current_frame", "last_activity_ms",
         "pending_inputs", "queued_since_tick", "ticks_advanced",
         "throttled_ticks", "last_error", "failed", "row_pool", "row_flip",
+        "starved", "confirmed_watermark",
     )
 
     def __init__(self, key, session, slot, kind, num_players,
@@ -119,6 +139,11 @@ class _Lane:
         self.throttled_ticks = 0
         self.last_error: Optional[str] = None
         self.failed = False  # quarantined: stops advancing, app detaches
+        # input starvation (the prediction-threshold gate blocked this
+        # tick) + the fresh confirmed watermark the gate computed —
+        # the speculative bubble-filling scheduler's draft keys
+        self.starved = False
+        self.confirmed_watermark: Optional[int] = None
         # pooled packed-row buffers (pack_tick_row_into targets): staging
         # a segment allocates nothing on the steady-state path
         self.row_pool = [
@@ -157,7 +182,8 @@ class SessionHost:
                  idle_timeout_ms: int = DEFAULT_IDLE_TIMEOUT_MS,
                  async_inflight: int = 4, warmup: bool = False,
                  depth_routing: bool = True, batched_pump: bool = True,
-                 mesh=None):
+                 mesh=None, speculation: bool = False,
+                 speculation_seed: int = 0):
         """`max_inflight_rows`: the device-window budget — session tick
         rows admitted past the fence before ready sessions start queuing
         (default: 2 full megabatches' worth). `idle_timeout_ms`: sessions
@@ -179,6 +205,20 @@ class SessionHost:
         blocking on the oldest dispatch while the checksum ledger drains
         off the pump pass.
 
+        `speculation=True` turns input starvation into useful device
+        work: a lane the prediction gate blocks gets a width-1 draft of
+        its near future (learned input model, counter-based draws)
+        rolled out on device beside the confirmed megabatch work, and
+        the arriving inputs verify against the draft per frame — a full
+        prefix hit serves the whole tick via one adopt dispatch instead
+        of a full-window resim, a misprediction truncates to the
+        longest-correct prefix (the suffix resimulates inside the same
+        adopt program), a total miss falls back to the normal rollback
+        path. Bitwise-identical to a speculation=False twin in every
+        arrival pattern (tests/test_speculation.py pins it); requires
+        the game to declare statuses_contract='disconnect-only'.
+        `speculation_seed` keys the drafts' counter-based draws.
+
         `mesh`: a device mesh with a `session` axis
         (parallel.mesh.make_session_mesh) puts the stacked session
         worlds on the mesh via ShardedMultiSessionDeviceCore — the
@@ -193,11 +233,24 @@ class SessionHost:
         from ..network.pump import WirePump, host_tax_histogram
         from ..tpu.backend import MultiSessionDeviceCore
 
+        if speculation:
+            # the adopt route replays drafted frames rolled out with
+            # all-CONFIRMED statuses — only correct for games whose step
+            # reads statuses solely to substitute DISCONNECTED players'
+            # inputs (the same contract the single-session beam enforces)
+            contract = getattr(game, "statuses_contract", None)
+            if contract != "disconnect-only":
+                raise ValueError(
+                    "host speculation adopts drafts rolled out with "
+                    "all-CONFIRMED statuses; declare statuses_contract = "
+                    "'disconnect-only' on the game class to opt in "
+                    f"(got {contract!r} on {type(game).__name__})"
+                )
         self.mesh = mesh
         self.device = MultiSessionDeviceCore.create(
             game, max_prediction, num_players, max_sessions,
             async_inflight=async_inflight, depth_routing=depth_routing,
-            mesh=mesh,
+            mesh=mesh, speculation=speculation,
         )
         self.depth_routing = depth_routing
         self.game = game
@@ -259,6 +312,30 @@ class SessionHost:
         self._pump = WirePump()
         self._m_tax_parse = host_tax_histogram().labels("parse")
         self._m_tax_drain = host_tax_histogram().labels("drain")
+        # speculative bubble-filling (serve/speculation.py): when the
+        # prediction gate starves a lane, the scheduler drafts its near
+        # future from the lane's learned input model into the megabatch
+        # and serves the arrival rollback from the draft (verify-and-
+        # adopt) — bitwise-identical to a never-speculating twin in
+        # every arrival pattern. Off by default; the parity suite's
+        # reference arm is a speculation=False host.
+        self.speculation = speculation
+        if speculation:
+            from .speculation import SpeculationPlanner
+
+            core = self.device.core
+            self._spec = SpeculationPlanner(
+                num_players=num_players,
+                input_size=game.input_size,
+                window=core.window,
+                ring_len=core.ring_len,
+                max_prediction=max_prediction,
+                seed=speculation_seed,
+            )
+        else:
+            self._spec = None
+        # pooled draft-row buffers, grown to device capacity on first use
+        self._draft_row_pool: List[np.ndarray] = []
         if warmup:
             self.device.warmup()
 
@@ -413,6 +490,8 @@ class SessionHost:
         lane.current_frame = current_frame
         self._lanes[key] = lane
         self.sessions_admitted += 1
+        if self._spec is not None and kind == "p2p":
+            self._spec.attach(key, num_players=n_players)
         if GLOBAL_TELEMETRY.enabled:
             self._m_active.set(len(self._lanes))
         return lane
@@ -522,6 +601,8 @@ class SessionHost:
             except ValueError:
                 pass
         lane.session.on_host_detach()
+        if self._spec is not None:
+            self._spec.drop(key)
         self._free_slots.append(lane.slot)
         if GLOBAL_TELEMETRY.enabled:
             self._m_active.set(len(self._lanes))
@@ -722,11 +803,146 @@ class SessionHost:
         # 3. dispatch megabatches under the device-window budget
         self._pump_device()
 
+        # 3b. speculative bubble-filling: draft the input-starved lanes'
+        # futures into the device (one vmapped rollout batch riding the
+        # same bucket grid) so their empty megabatch rows become standing
+        # drafts the arrival tick can adopt. AFTER the confirmed
+        # dispatches and capped by the budget they left over: draft work
+        # fills genuinely idle device window, it never crowds a ready
+        # session's row out of this tick
+        if self._spec is not None and not self._draining:
+            self._launch_drafts()
+
         # 4. lifecycle: disconnect GC, then idle eviction
         self._run_gc(events)
         return events
 
+    def _launch_drafts(self) -> None:
+        """Collect every starved p2p lane that can be drafted this tick
+        (fresh watermark, anchor snapshot live in its ring, played
+        history complete) and launch ONE draft batch for all of them —
+        bubbles fill as a fleet, not one dispatch per lane. Entries
+        order by owning shard on a session mesh, the same lane-packing
+        affinity as ordinary megabatch rows."""
+        device = self.device
+        core = device.core
+        # the budget the confirmed dispatches left over this tick: draft
+        # rows fill idle window only — a saturated device has no bubbles
+        # to fill, so skip rather than add inflight work real sessions
+        # will queue behind next tick
+        budget = self.max_inflight_rows - device.poll_retired()
+        if budget <= 0:
+            return
+        entries: List[Tuple[int, np.ndarray]] = []
+        metas = []
+        for lane in self._lanes.values():
+            if (
+                not lane.starved
+                or lane.rows
+                or lane.failed
+                or lane.kind != "p2p"
+            ):
+                continue
+            # the host already KNOWS what each local player will play
+            # next — the inputs submitted during the starvation sit in
+            # the session's pending map — so the draft pins them instead
+            # of guessing
+            pending = getattr(lane.session, "local_inputs", None) or {}
+            local_pins = {
+                h: pi.buf
+                for h, pi in pending.items()
+                if h in lane.local_handles
+            }
+            # inputs that ARRIVED during the stall sit confirmed in the
+            # session's per-player queues (the gate blocks on the
+            # watermark, not on every queue) — the draft pins those true
+            # values instead of guessing, and the per-player confirmed
+            # frontier is the draft's freshness fingerprint: any new
+            # arrival makes the standing draft stale, so it re-drafts
+            # with the fresh truth pinned in
+            sl = getattr(lane.session, "sync_layer", None)
+            queues = sl.input_queues if sl is not None else None
+            fingerprint = (
+                tuple(q.last_added_frame for q in queues)
+                if queues is not None
+                else None
+            )
+
+            def lookup(p, frame, _qs=queues):
+                if _qs is None or p >= len(_qs):
+                    return None
+                q = _qs[p]
+                # NativeInputQueue keeps its ring in C++ (no host-visible
+                # .inputs): drafts for such a lane just guess instead of
+                # pinning arrived truth — still correct, less informed
+                ring = getattr(q, "inputs", None)
+                if ring is None:
+                    return None
+                rec = ring[frame % len(ring)]
+                if frame <= q.last_added_frame and rec.frame == frame:
+                    return rec.buf
+                return None
+
+            plan = self._spec.plan_draft(
+                lane.key,
+                current_frame=lane.current_frame,
+                watermark=lane.confirmed_watermark,
+                local_pins=local_pins,
+                confirmed_lookup=lookup,
+                fingerprint=fingerprint,
+            )
+            if plan is None:
+                continue
+            anchor, scripts, statuses = plan
+            metas.append((lane, anchor, scripts, statuses, fingerprint))
+        if not metas:
+            return
+        if self.mesh is not None:
+            # the same lane-packing affinity as ordinary megabatch rows:
+            # a lane's member rows stay adjacent on their owning shard
+            metas.sort(key=lambda m: device.shard_of(m[0].slot))
+        # pack every lane's member scripts as rows of ONE draft batch,
+        # capped at the device capacity (member 0 — the lineage script —
+        # wins the last slots over extra bet members); rows come from a
+        # host-level pool (device.draft copies them into its own pooled
+        # staging, so reuse next tick is safe) — the steady-state draft
+        # path allocates nothing, same discipline as _Lane.row_pool
+        pool = self._draft_row_pool
+        while len(pool) < device.capacity:
+            pool.append(np.empty((device._draft_len,), dtype=np.int32))
+        cap = min(device.capacity, budget)
+        packed_metas = []
+        for lane, anchor, scripts, statuses, fingerprint in metas:
+            room = cap - len(entries)
+            if room < 1:
+                break
+            members = []
+            for script in scripts[:room]:
+                row = pool[len(entries)]
+                device.pack_draft_row_into(
+                    row, anchor % core.ring_len, statuses, script
+                )
+                members.append(len(entries))
+                entries.append((lane.slot, row))
+            packed_metas.append(
+                (lane, anchor, scripts[: len(members)], members,
+                 fingerprint)
+            )
+        batch = device.draft(entries)
+        for lane, anchor, scripts, members, fingerprint in packed_metas:
+            self._spec.install_draft(
+                lane.key, anchor=anchor, scripts=scripts, batch=batch,
+                members=members, watermark=lane.confirmed_watermark,
+                fingerprint=fingerprint,
+            )
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "spec_draft_launched", lanes=len(packed_metas),
+                rows=len(entries),
+            )
+
     def _lane_ready(self, lane: _Lane) -> bool:
+        lane.starved = False
         if lane.failed:  # quarantined by a staging error
             return False
         if lane.rows:  # staged rows must dispatch before the next advance
@@ -771,6 +987,12 @@ class SessionHost:
                 or sl.current_frame - confirmed >= lane.max_prediction
             ):
                 lane.throttled_ticks += 1
+                # INPUT-STARVED: every local input is in but the gate
+                # blocks on missing remote inputs — the lane's megabatch
+                # row would be a device bubble. The speculation scheduler
+                # drafts these lanes' futures instead (_launch_drafts).
+                lane.starved = True
+                lane.confirmed_watermark = confirmed
                 return False
         return True
 
@@ -836,6 +1058,50 @@ class SessionHost:
             (load is not None, count, last_active, trailing is not None),
             frame=start_frame,
         )
+        # speculative bubble-filling: record what this lane actually
+        # played (the verify pass's ground truth + the input model's
+        # training stream), then check the segment against any standing
+        # draft — a matched prefix turns this row into an ADOPT row
+        # served from the draft trajectory instead of a resim
+        adopt = None
+        if self._spec is not None and lane.kind == "p2p":
+            load_frame = load.frame if load is not None else None
+            # verify BEFORE record_segment: the lineage check reads the
+            # played rows strictly before the load point (unaffected by
+            # this segment), and record_segment's stale-draft discard
+            # must not kill the draft the segment is about to adopt — a
+            # load AT the anchor is the deepest serveable rollback
+            hit = None
+            if not lane.rows:
+                hit = self._spec.verify(
+                    lane.key, load_frame=load_frame, start=start_frame,
+                    count=count, inputs=inputs, statuses=statuses,
+                )
+            self._spec.record_segment(
+                lane.key, load_frame=load_frame, start=start_frame,
+                count=count, inputs=inputs, statuses=statuses,
+                saves=saves,
+            )
+            if hit is not None:
+                draft, member, shift, matched = hit
+                packed = core.pack_adopt_row(
+                    member,
+                    (load.frame % core.ring_len)
+                    if load is not None
+                    else 0,
+                    count, shift, start_frame, matched, save_slots,
+                    statuses=statuses, inputs=inputs,
+                )
+                adopt = (draft.batch, packed)
+        if adopt is not None:
+            lane.rows.append(
+                _StagedRow(
+                    None, saves, start_frame, count, last_active, False,
+                    adopt=adopt,
+                )
+            )
+            lane.current_frame = start_frame + count
+            return
         # pack straight into the lane's pooled row buffer (no per-tick
         # allocation); the scheduler's depth grouping reads the routing
         # keys off the staged row instead of rescanning it
@@ -884,8 +1150,6 @@ class SessionHost:
         training traffic (env.step blocks on this tick): when the
         inflight budget is exhausted they retire the fence and dispatch
         anyway rather than queue."""
-        from ..tpu.backend import SnapshotRef, _LazyChecksum
-
         core = self.device.core
         # env-staged rows for this pass: gkey -> (max last_active, rows)
         env_groups: Dict[Any, List] = {}
@@ -909,11 +1173,26 @@ class SessionHost:
                 max(self.device.capacity - env_rows, 0),
             )
             picked: List[Tuple[_Lane, _StagedRow]] = []
+            adopts: List[Tuple[_Lane, _StagedRow]] = []
             for key in list(self._ready)[:take]:
                 lane = self._lanes[key]
-                picked.append((lane, lane.rows[0]))
-            if not picked and not env_groups:
+                staged = lane.rows[0]
+                if staged.adopt is not None:
+                    adopts.append((lane, staged))
+                else:
+                    picked.append((lane, staged))
+            if not picked and not adopts and not env_groups:
                 break
+            # ADOPT rows first: each serves its lane's tick from a
+            # standing draft in one per-slot dispatch (prefix from the
+            # trajectory, mispredicted suffix resimulated in-program) —
+            # the whole point of having drafted the bubble
+            for lane, staged in adopts:
+                draft_batch, packed = staged.adopt
+                batch = self.device.adopt_slot(
+                    lane.slot, draft_batch, packed
+                )
+                self._retire_row(lane, staged, batch, 0)
             if self.depth_routing:
                 groups: Dict[Any, List[Tuple[_Lane, _StagedRow]]] = {}
                 for lane, staged in picked:
@@ -962,26 +1241,33 @@ class SessionHost:
                         entries, last_active=la
                     )
                 for k, (lane, staged) in enumerate(group):
-                    lane.rows.popleft()
-                    base = k * core.window
-                    for slot_i, save in staged.saves:
-                        save.cell.save_lazy(
-                            save.frame,
-                            SnapshotRef(
-                                save.frame, save.frame % core.ring_len
-                            ),
-                            _LazyChecksum(batch, base + slot_i),
-                        )
-                    if not lane.rows:
-                        self._ready.remove(lane.key)
-                        waited = self._tick_index - lane.queued_since_tick
-                        if len(self.queue_waits) < 1 << 16:
-                            self.queue_waits.append(waited)
-                        if GLOBAL_TELEMETRY.enabled:
-                            self._m_queue_wait.observe(waited)
-                        lane.queued_since_tick = None
+                    self._retire_row(lane, staged, batch, k * core.window)
         if GLOBAL_TELEMETRY.enabled:
             self._m_queue_depth.set(len(self._ready))
+
+    def _retire_row(self, lane: _Lane, staged: _StagedRow, batch,
+                    base: int) -> None:
+        """Post-dispatch bookkeeping shared by megabatch rows and adopt
+        rows: pop the staged row, bind its saves' lazy checksums at
+        `base` into the dispatch's checksum batch, and settle the lane's
+        queue-wait accounting when its last row dispatched."""
+        SnapshotRef, _LazyChecksum = _backend_refs()
+        ring_len = self.device.core.ring_len
+        lane.rows.popleft()
+        for slot_i, save in staged.saves:
+            save.cell.save_lazy(
+                save.frame,
+                SnapshotRef(save.frame, save.frame % ring_len),
+                _LazyChecksum(batch, base + slot_i),
+            )
+        if not lane.rows:
+            self._ready.remove(lane.key)
+            waited = self._tick_index - lane.queued_since_tick
+            if len(self.queue_waits) < 1 << 16:
+                self.queue_waits.append(waited)
+            if GLOBAL_TELEMETRY.enabled:
+                self._m_queue_wait.observe(waited)
+            lane.queued_since_tick = None
 
     # ------------------------------------------------------------------
     # eviction / GC / drain
@@ -1152,7 +1438,29 @@ class SessionHost:
             "session_shards": dev.session_shards,
             "sessions": sessions,
             "envs": [env._env_section() for env in self._envs],
+            # speculative bubble-filling hit rate and volume (absent on
+            # non-speculating hosts, so old readers stay compatible)
+            **(
+                {"speculation": self._spec.section()}
+                if self._spec is not None
+                else {}
+            ),
         }
+
+    @property
+    def frames_served_from_speculation(self) -> int:
+        """Frames adopted from speculative drafts (0 on a
+        non-speculating host) — the gated live bench arm's headline."""
+        return self._spec.frames_adopted if self._spec is not None else 0
+
+    @property
+    def spec_hit_rate(self) -> float:
+        """Adopted / serveable frames (one member's window per draft;
+        0.0 on a non-speculating host) — prediction quality, independent
+        of the draft width."""
+        if self._spec is None or not self._spec.frames_draftable:
+            return 0.0
+        return self._spec.frames_adopted / self._spec.frames_draftable
 
     def telemetry(self) -> dict:
         """One structured snapshot: the process-wide obs snapshot

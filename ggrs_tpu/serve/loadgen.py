@@ -104,6 +104,48 @@ def make_scripts(matches, ticks: int, seed: int) -> Dict[Any, List[int]]:
     }
 
 
+def held_scripts(matches, ticks: int, seed: int) -> Dict[Any, List[int]]:
+    """Hold-shaped per-(match, peer, tick) scripts: runs of held values
+    cycling a fixed per-peer sequence — hold lengths vary (seeded, 6-18
+    frames: direction keys held across a dozen frames, the shape real
+    input streams have), the value TRANSITIONS are deterministic. The
+    human-shaped traffic the speculation input model can actually learn:
+    stalls landing inside a hold recover with the prediction intact (the
+    lineage member serves them); stalls crossing a switch need a timing
+    bet. THE one definition — bench_spec_bubble and spec_smoke must
+    starve against identical traffic shapes."""
+    out: Dict[Any, List[int]] = {}
+    for m, keys in enumerate(matches):
+        for k in range(len(keys)):
+            rng = random.Random(seed * 7919 + m * 131 + k)
+            cycle = [1, 4, 2, 8, 5][(m + k) % 3:][:3]
+            vals: List[int] = []
+            i = 0
+            while len(vals) < ticks:
+                vals += [cycle[i % len(cycle)]] * rng.randrange(6, 19)
+                i += 1
+            out[(m, k)] = vals[:ticks]
+    return out
+
+
+def starve_on_tick(net, matches, *, hole_every: int, hole_len: int):
+    """`drive_scripted` on_tick hook forcing input starvation: peer 0 of
+    every match goes dark (blackholed) for `hole_len` ticks every
+    `hole_every` — the WAN-outage shape that stalls the other peers past
+    the prediction gate. THE one definition — bench_spec_bubble,
+    spec_smoke and the speculation parity suite must starve against
+    identical traffic."""
+    holes = [(m, 0) for m in range(len(matches))]
+
+    def on_tick(t):
+        if hole_every and t > 0 and t % hole_every == 0:
+            net.set_blackhole(holes, True)
+        if hole_every and t % hole_every == hole_len:
+            net.set_blackhole(holes, False)
+
+    return on_tick
+
+
 def drive_scripted(host, matches, clock, scripts, ticks: int,
                    on_tick=None) -> List[Any]:
     """Submit every peer's scripted input and tick the host `ticks`

@@ -1,0 +1,621 @@
+"""Speculative bubble-filling: the host-side draft/verify bookkeeping.
+
+`SessionHost._lane_ready` rejects a lane whenever the prediction-
+threshold gate blocks it — remote inputs haven't arrived and the session
+has speculated as far as its window allows. Before this module those
+lanes simply left their megabatch rows empty (device bubbles). Now the
+scheduler DRAFTS each starved lane's near future instead: a width-1
+input script from the lane's learned InputHistoryModel (hazard/
+transition draws, counter-based like env/opponents — never a stateful
+RNG stream), rolled out on device from the lane's ring anchor as one
+vmapped batch beside the confirmed work (MultiSessionDeviceCore.draft —
+a ring-parked branch; confirmed state is never touched).
+
+When the real inputs arrive and the session stages its next rows, the
+VERIFY pass here compares them against the drafted script per frame:
+
+- a full prefix hit serves the whole row from the draft via the
+  resim.adopt route (one adopt dispatch instead of a full-window resim);
+- a misprediction truncates to the longest-correct prefix — the adopt
+  serves the prefix and resimulates only the mispredicted suffix in the
+  same program — and the rest of the draft is discarded;
+- a total miss (or an arrival rollback that rewrites history at or
+  before the draft's anchor) discards the draft and resumes the normal
+  rollback path untouched.
+
+Every case is bitwise-identical to a never-speculating twin: the drafted
+trajectory replays the lane's PLAYED rows from the same ring snapshot
+(the prefix check rejects any divergence verbatim), drafted statuses are
+all-CONFIRMED under the game's declared `statuses_contract =
+"disconnect-only"`, and adopted ring writes/checksums come from the same
+states a resim would compute. tests/test_speculation.py pins all three
+arrival patterns against a non-speculating twin.
+
+This module is pure host-side numpy bookkeeping — it never touches the
+device core's fenced state (FEN001 keeps serve/ at zero allowances);
+dispatches go through the owning `MultiSessionDeviceCore` methods.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..obs import DISPATCH_DEPTH_BUCKETS, GLOBAL_TELEMETRY
+from ..types import InputStatus
+
+_DISC = int(InputStatus.DISCONNECTED)
+_PRED = int(InputStatus.PREDICTED)
+
+
+def speculation_instruments():
+    """The four speculative-bubble-filling instruments, get-or-created on
+    the global registry (registry-driven: both exporters and
+    host.telemetry() carry them with no extra code): frames drafted /
+    adopted / discarded counters plus the verified-prefix-length
+    histogram (0 = total miss; the host section's hit rate is adopted /
+    SERVEABLE frames — one member's window per draft — while the
+    drafted counter measures device work across all members)."""
+    reg = GLOBAL_TELEMETRY.registry
+    drafted = reg.counter(
+        "ggrs_spec_frames_drafted_total",
+        "speculative frames drafted into megabatch bubbles for "
+        "input-starved sessions",
+    )
+    adopted = reg.counter(
+        "ggrs_spec_frames_adopted_total",
+        "drafted frames served as (a prefix of) a session tick via the "
+        "adopt route",
+    )
+    discarded = reg.counter(
+        "ggrs_spec_frames_discarded_total",
+        "drafted frames retired unserved (miss, truncation, stale "
+        "watermark, anchor rewrite, lane detach)",
+    )
+    prefix = reg.histogram(
+        "ggrs_spec_prefix_len",
+        "verified draft prefix length per arrival (frames adopted; "
+        "0 = total miss)",
+        buckets=DISPATCH_DEPTH_BUCKETS,
+    )
+    return drafted, adopted, discarded, prefix
+
+
+class StandingDraft:
+    """One lane's live draft: the anchor frame, the drafted input
+    scripts (host copies, the verify pass's comparison keys — member 0
+    is the PLAYED-LINEAGE script that serves no-rollback recoveries,
+    members 1+ are sampled switch-timing bets that serve rollback
+    arrivals), and each script's member row in the device DraftBatch."""
+
+    __slots__ = ("anchor", "scripts", "batch", "members", "watermark",
+                 "fingerprint", "served", "covered")
+
+    def __init__(self, anchor, scripts, batch, members, watermark,
+                 fingerprint):
+        self.anchor = anchor
+        self.scripts = scripts
+        self.batch = batch
+        self.members = members
+        self.watermark = watermark
+        # per-player confirmed-input frontier at launch: any NEW
+        # confirmation makes the draft stale (freshly-arrived real
+        # inputs beat drawn guesses, so re-draft)
+        self.fingerprint = fingerprint
+        self.served = 0
+        # highest verified window index so far: a rollback arrival can
+        # re-verify frames an earlier full-hit adopt already served from
+        # this same draft — the adopt dispatch legitimately serves them
+        # again, but the DISTINCT-frame counters must not double-count
+        # (hit_rate would exceed 1.0)
+        self.covered = 0
+
+
+class _PlayedRing:
+    """Fixed-depth pooled store of a lane's played rows keyed by frame —
+    the dict-of-fresh-arrays it replaces allocated two arrays per played
+    frame per staged segment (the host's staging path is otherwise
+    allocation-free). put() copies into preallocated storage; get()
+    returns views (every caller copies or compares, never retains past
+    the next put); `floor` is the prune frontier the dict's O(n) sweep
+    used to maintain — an O(1) ratchet here."""
+
+    __slots__ = ("frames", "inputs", "statuses", "floor")
+
+    def __init__(self, depth: int, num_players: int, input_size: int):
+        self.frames = np.full((depth,), np.iinfo(np.int64).min,
+                              dtype=np.int64)
+        self.inputs = np.zeros((depth, num_players, input_size),
+                               dtype=np.uint8)
+        self.statuses = np.zeros((depth, num_players), dtype=np.int32)
+        self.floor = -(2 ** 60)
+
+    def put(self, frame: int, inputs: np.ndarray,
+            statuses: np.ndarray) -> None:
+        i = frame % len(self.frames)
+        self.frames[i] = frame
+        self.inputs[i][:] = inputs
+        self.statuses[i][:] = statuses
+
+    def get(self, frame: int) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        if frame < self.floor:
+            return None
+        i = frame % len(self.frames)
+        if self.frames[i] != frame:
+            return None
+        return self.inputs[i], self.statuses[i]
+
+
+class _LaneSpec:
+    """Per-lane speculation bookkeeping: the played-row history the
+    prefix check and the input model learn from, the ring-slot -> frame
+    map that proves a draft's anchor snapshot is live, and the standing
+    draft."""
+
+    __slots__ = ("played", "ring_frames", "model", "finalized_to",
+                 "draft", "seed", "num_players")
+
+    def __init__(self, model, seed, num_players, played: _PlayedRing):
+        self.played = played
+        self.ring_frames: Dict[int, int] = {}
+        self.model = model
+        self.finalized_to = -1
+        self.draft: Optional[StandingDraft] = None
+        self.seed = seed
+        # the lane's REAL player count: columns at or past it are
+        # host-layout padding, deterministically DISCONNECTED — not
+        # player behavior, and never a reason to refuse a draft
+        self.num_players = num_players
+
+
+class SpeculationPlanner:
+    """Host-side speculation state for one SessionHost's p2p lanes."""
+
+    # default draft width: member 0 is the played-lineage script (wins
+    # exactly the no-rollback recoveries), each extra member is an
+    # independently-seeded switch-timing bet (wins rollback arrivals
+    # when the sampled switch frame and value land) — all members ride
+    # ONE vmapped draft dispatch, so extra width fills more of the
+    # device bubble rather than adding dispatches
+    DEFAULT_WIDTH = 2
+
+    def __init__(self, *, num_players: int, input_size: int, window: int,
+                 ring_len: int, max_prediction: int, seed: int = 0,
+                 width: int = DEFAULT_WIDTH):
+        from ..tpu.input_model import InputHistoryModel
+
+        self.num_players = num_players
+        self.input_size = input_size
+        self.window = window
+        self.ring_len = ring_len
+        self.max_prediction = max_prediction
+        self.seed = seed
+        self.width = max(1, width)
+        self._model_cls = InputHistoryModel
+        self._lanes: Dict[Any, _LaneSpec] = {}
+        # lifetime stats (host section + bench short line, no telemetry
+        # dependency — plain ints like the host's session counters)
+        self.drafts_launched = 0
+        self.frames_drafted = 0
+        # serveable frames: ONE member's window per draft — only one
+        # member can ever serve a given frame, so the hit rate divides
+        # adopted by this, not by frames_drafted (which counts device
+        # work across all members and would cap the rate at 1/width)
+        self.frames_draftable = 0
+        self.frames_adopted = 0
+        self.frames_discarded = 0
+        self.spec_adopts = 0
+        self.spec_misses = 0
+        (self._m_drafted, self._m_adopted, self._m_discarded,
+         self._m_prefix) = speculation_instruments()
+
+    # ------------------------------------------------------------------
+    # lane lifecycle
+    # ------------------------------------------------------------------
+
+    def attach(self, key: Any, *, num_players: Optional[int] = None) -> None:
+        self._lanes[key] = _LaneSpec(
+            self._model_cls(self.num_players, self.input_size),
+            # per-lane counter-rng stream id: a crc of the host key (a
+            # pure function of the key — hash() is process-salted and
+            # the DET lint rightly rejects it)
+            self.seed ^ zlib.crc32(repr(key).encode()),
+            self.num_players if num_players is None else num_players,
+            # live frames span [current - window - max_prediction,
+            # current]: +2 keeps a put from ever colliding with a
+            # still-readable slot
+            _PlayedRing(self.window + self.max_prediction + 2,
+                        self.num_players, self.input_size),
+        )
+
+    def drop(self, key: Any) -> None:
+        ls = self._lanes.pop(key, None)
+        if ls is not None and ls.draft is not None:
+            self._discard(ls)
+
+    # ------------------------------------------------------------------
+    # per-segment bookkeeping (host._stage_segment calls this for every
+    # staged p2p segment, adopted or not)
+    # ------------------------------------------------------------------
+
+    def record_segment(self, key: Any, *, load_frame: Optional[int],
+                       start: int, count: int, inputs: np.ndarray,
+                       statuses: np.ndarray, saves) -> None:
+        """Record what the lane actually played this segment (the prefix
+        check's ground truth), which ring slots now hold which frames,
+        and feed newly-FINALIZED rows to the lane's input model — the
+        same finalization discipline as TpuRollbackBackend: only frames
+        beyond rollback reach enter the statistics, so a later
+        correction can never have polluted them."""
+        ls = self._lanes.get(key)
+        if ls is None:
+            return
+        # an arrival rollback that rewrites history strictly BEFORE the
+        # draft's anchor invalidates the anchor snapshot's lineage (a
+        # load AT the anchor replays from the very snapshot the draft
+        # rolled out of — still serveable, shift 0; the host runs verify
+        # before this bookkeeping so such a segment can adopt)
+        if (
+            ls.draft is not None
+            and load_frame is not None
+            and load_frame < ls.draft.anchor
+        ):
+            self._discard(ls)
+        for f in range(count):
+            ls.played.put(start + f, inputs[f], statuses[f])
+        for _slot_i, save in saves:
+            ls.ring_frames[save.frame % self.ring_len] = save.frame
+        current_after = start + count
+        final_horizon = current_after - self.max_prediction
+        horizon = current_after - self.window - self.max_prediction
+        f = ls.finalized_to + 1
+        if f < horizon:
+            f = horizon
+            for p in range(self.num_players):
+                ls.model.break_run(p)
+        while f < final_horizon:
+            rec = ls.played.get(f)
+            if rec is None:
+                for p in range(ls.num_players):
+                    ls.model.break_run(p)
+            else:
+                pin, pst = rec
+                for p in range(ls.num_players):
+                    if pst[p] >= _DISC:
+                        ls.model.break_run(p)
+                    else:
+                        ls.model.observe(p, pin[p].tobytes())
+            ls.finalized_to = f
+            f += 1
+        if horizon > ls.played.floor:
+            ls.played.floor = horizon
+
+    # ------------------------------------------------------------------
+    # drafting
+    # ------------------------------------------------------------------
+
+    def plan_draft(self, key: Any, *, current_frame: int,
+                   watermark: Optional[int],
+                   local_pins: Optional[Dict[int, bytes]] = None,
+                   confirmed_lookup=None,
+                   fingerprint: Any = None):
+        """Build a starved lane's draft script, or None when the lane
+        cannot be drafted this tick (no confirmed watermark, anchor
+        snapshot not live in the ring, played history incomplete, or a
+        fresh draft already standing). A standing draft goes stale when
+        the confirmed watermark moves — newly-arrived inputs may
+        contradict drafted cells — and is re-drafted.
+
+        The script covers frames anchor .. anchor + window - 1 with
+        anchor = watermark + 1 (the deepest frame the arrival rollback
+        can load). The PLAYED rows (anchor .. current_frame - 1) pin the
+        session's played bytes VERBATIM — predictions included: the
+        adopt's load reads a ring snapshot whose lineage is exactly what
+        the session played, so a draft that deviates there can never be
+        adopted by a no-rollback recovery. Future rows' cells draw from
+        the lane's learned input model (InputHistoryModel.draft_script,
+        counter-based). Two kinds of TRUTH override the defaults:
+        `local_pins` (handle -> input bytes) carries the lane's PENDING
+        local inputs — submitted during the starvation but not yet
+        advanced, so the host already knows what the local player will
+        play next — and `confirmed_lookup(p, frame)` resolves inputs
+        that ARRIVED during the stall but haven't been advanced over yet
+        (the session's input queues hold them). A confirmed value that
+        contradicts a played prediction is safe to pin over it: that
+        frame is exactly one the arrival rollback will load at or
+        before, so it lands in the verify region (compared against the
+        same truth), never in the played-lineage prefix. `fingerprint`
+        is the per-player confirmed frontier: a standing draft goes
+        stale the moment any new confirmation lands — but if the
+        re-drafted script comes out byte-identical (the arrivals
+        confirmed what was already drafted), the standing draft is
+        refreshed in place and NO new dispatch happens."""
+        ls = self._lanes.get(key)
+        if ls is None or watermark is None:
+            return None
+        if ls.draft is not None:
+            if ls.draft.fingerprint == fingerprint:
+                return None  # still fresh: nothing new arrived since
+            if confirmed_lookup is not None and self._standing_survives(
+                ls, confirmed_lookup
+            ):
+                # the arrivals are consistent with at least one standing
+                # member — that member can still win the verify, so keep
+                # the standing draft (NO new dispatch) rather than spend
+                # a rollout re-guessing what it already guessed right
+                ls.draft.fingerprint = fingerprint
+                return None
+        anchor = watermark + 1
+        S = current_frame - anchor
+        D, P, I = self.window, self.num_players, self.input_size
+        if S < 1 or S >= D:
+            if ls.draft is not None:
+                self._discard(ls)
+            return None
+        if ls.ring_frames.get(anchor % self.ring_len) != anchor:
+            # anchor snapshot not (or no longer) in the ring
+            if ls.draft is not None:
+                self._discard(ls)
+            return None
+        n = ls.num_players
+        base = np.zeros((D, P, I), dtype=np.uint8)
+        # host-layout pad columns are pinned to the dummy zero input the
+        # resim substitutes for them (the draft rollout marks them
+        # DISCONNECTED too, see `statuses` below)
+        pinned = np.zeros((D, P), dtype=bool)
+        pinned[:, n:] = True
+        if local_pins:
+            for h, buf in local_pins.items():
+                if 0 <= h < n:
+                    base[S:, h] = np.frombuffer(buf, dtype=np.uint8)
+                    pinned[S:, h] = True
+        # two pin masks over the same base values: the LINEAGE mask pins
+        # every played cell verbatim (predictions included — the ring
+        # snapshot an arrival loads embodies exactly what was played, so
+        # member 0 can serve any load the played history survives), the
+        # BET mask leaves played PREDICTED cells free for members 1+ to
+        # re-draw — a rollback arrival's first corrected frame is by
+        # definition one where the played prediction was wrong, so only
+        # a script that DEVIATES from it there can serve a rollback
+        pin_bets = pinned
+        for j in range(S):
+            rec = ls.played.get(anchor + j)
+            if rec is None:
+                if ls.draft is not None:
+                    self._discard(ls)
+                return None
+            pin, pst = rec
+            if (pst[:n] >= _DISC).any():
+                # disconnect rows are not draftable behavior
+                if ls.draft is not None:
+                    self._discard(ls)
+                return None
+            base[j, :n] = pin[:n]
+            pin_bets[j, :n] = pst[:n] != _PRED
+        pin_lineage = pin_bets.copy()
+        pin_lineage[:S, :n] = True
+        rollback_certain = False
+        if confirmed_lookup is not None:
+            # inputs that arrived during the stall: pin the TRUE values
+            # over played predictions and drawn guesses alike. A truth
+            # that CONTRADICTS a played prediction makes the arrival
+            # rollback certain — the lineage member is then provably
+            # dead (its pinned played history can never be the verify's
+            # longest prefix), so its slot is better spent on another
+            # timing bet
+            for j in range(D):
+                for p in range(n):
+                    v = confirmed_lookup(p, anchor + j)
+                    if v is not None:
+                        arr = np.frombuffer(v, dtype=np.uint8)
+                        if j < S and not np.array_equal(base[j, p], arr):
+                            rollback_certain = True
+                        base[j, p] = arr
+                        pin_bets[j, p] = True
+                        pin_lineage[j, p] = True
+        # per-player stream state entering the window: the value played
+        # at anchor - 1 and its backward run length
+        init_v = np.zeros((P, I), dtype=np.uint8)
+        init_h = np.ones((P,), dtype=np.int64)
+        prev = ls.played.get(anchor - 1)
+        if prev is not None:
+            init_v[:] = prev[0]
+            for p in range(P):
+                run, f = 1, anchor - 2
+                while run < 64:
+                    rec = ls.played.get(f)
+                    if rec is None or not np.array_equal(
+                        rec[0][p], init_v[p]
+                    ):
+                        break
+                    run += 1
+                    f -= 1
+                init_h[p] = run
+        # member 0: the played-lineage script (skipped when the rollback
+        # is already certain); members 1+: independently counter-seeded
+        # switch-timing bets (deduped — a bet whose draws never fire
+        # inside the window collapses onto an earlier member)
+        scripts = []
+        if not rollback_certain:
+            scripts.append(
+                ls.model.draft_script(
+                    base.copy(), pin_lineage, anchor_frame=anchor,
+                    seed=ls.seed, init_values=init_v, init_holds=init_h,
+                )
+            )
+        m = 1
+        while len(scripts) < self.width and m <= 2 * self.width:
+            cand = ls.model.draft_script(
+                base.copy(), pin_bets, anchor_frame=anchor,
+                seed=ls.seed ^ (m * 0x9E3779B1), init_values=init_v,
+                init_holds=init_h,
+            )
+            if not any(np.array_equal(cand, s) for s in scripts):
+                scripts.append(cand)
+            m += 1
+        if not scripts:
+            return None
+        if ls.draft is not None:
+            # reaching here means every standing member is contradicted
+            # (or no lookup was supplied): replace it
+            self._discard(ls)
+        statuses = np.zeros((P,), dtype=np.int32)
+        statuses[n:] = _DISC
+        return anchor, scripts, statuses
+
+    def _standing_survives(self, ls: _LaneSpec, confirmed_lookup) -> bool:
+        """True while at least one standing member is consistent with
+        every input confirmed so far over the drafted window — the cheap
+        filter that decides redraft-vs-keep when new arrivals land: a
+        surviving member can still win the verify, a fully-contradicted
+        draft is worthless and worth replacing with fresh truth pinned
+        in."""
+        d = ls.draft
+        n = ls.num_players
+        D = len(d.scripts[0])
+        alive = [True] * len(d.scripts)
+        for j in range(D):
+            for p in range(n):
+                v = confirmed_lookup(p, d.anchor + j)
+                if v is None:
+                    continue
+                arr = np.frombuffer(v, dtype=np.uint8)
+                for mi, script in enumerate(d.scripts):
+                    if alive[mi] and not np.array_equal(script[j, p], arr):
+                        alive[mi] = False
+            if not any(alive):
+                return False
+        return True
+
+    def install_draft(self, key: Any, *, anchor: int, scripts,
+                      batch, members, watermark: int,
+                      fingerprint: Any = None) -> None:
+        ls = self._lanes[key]
+        assert ls.draft is None
+        assert len(scripts) == len(members) >= 1
+        ls.draft = StandingDraft(
+            anchor, scripts, batch, members, watermark, fingerprint
+        )
+        self.drafts_launched += 1
+        drafted = sum(len(s) for s in scripts)
+        self.frames_drafted += drafted
+        self.frames_draftable += max(len(s) for s in scripts)
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_drafted.inc(drafted)
+
+    # ------------------------------------------------------------------
+    # verify-and-adopt
+    # ------------------------------------------------------------------
+
+    def verify(self, key: Any, *, load_frame: Optional[int], start: int,
+               count: int, inputs: np.ndarray,
+               statuses: np.ndarray) -> Optional[Tuple[StandingDraft, int, int, int]]:
+        """The arrival check: compare the staged segment's real inputs
+        against every member of the standing draft per frame. Returns
+        (draft, member, shift, matched) for the best member that can
+        serve the row via the adopt route (matched >= 1), else None.
+        A full hit leaves the draft standing (the next rows keep serving
+        until it exhausts); a truncation or miss discards it; exhaustion
+        (the row runs past the drafted window) discards it too."""
+        ls = self._lanes.get(key)
+        if ls is None or ls.draft is None or count < 1:
+            return None
+        d = ls.draft
+        # record_segment already dropped anchor-rewriting drafts; a load
+        # AT the anchor is serveable (shift 0: the adopt's load reads the
+        # same ring snapshot the draft anchored on)
+        shift = start - d.anchor
+        D = len(d.scripts[0])
+        if shift < 0 or shift + count > D:
+            self._discard(ls)
+            return None
+        n = ls.num_players
+        # longest clean run of the arrival: stop before any row with a
+        # real player disconnected (drafted statuses marked real players
+        # CONFIRMED)
+        clean = 0
+        while clean < count and (statuses[clean, :n] < _DISC).all():
+            clean += 1
+        best_member, best_matched = -1, 0
+        for member, script in zip(d.members, d.scripts):
+            # the member's lineage must equal the PLAYED rows between
+            # anchor and the row's start — verbatim, disconnect-free
+            # among the lane's REAL players (pad columns are
+            # deterministic): the adopt's load reads a ring snapshot
+            # whose history is exactly what was played
+            ok = True
+            for j in range(shift):
+                rec = ls.played.get(d.anchor + j)
+                if (
+                    rec is None
+                    or (rec[1][:n] >= _DISC).any()
+                    or not np.array_equal(script[j], rec[0])
+                ):
+                    ok = False
+                    break
+            if not ok:
+                continue
+            matched = 0
+            while matched < clean and np.array_equal(
+                script[shift + matched], inputs[matched]
+            ):
+                matched += 1
+            if matched > best_matched:
+                best_member, best_matched = member, matched
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_prefix.observe(best_matched)
+        if best_matched == 0:
+            self.spec_misses += 1
+            self._discard(ls)
+            return None
+        # DISTINCT frames this adopt serves for the first time: a
+        # rollback arrival re-covering a region an earlier full-hit
+        # adopt already served counts only the fresh extension
+        extent = shift + best_matched
+        newly = max(0, extent - d.covered)
+        d.covered = max(d.covered, extent)
+        d.served += newly
+        self.frames_adopted += newly
+        self.spec_adopts += 1
+        if GLOBAL_TELEMETRY.enabled and newly:
+            self._m_adopted.inc(newly)
+        out = (d, best_member, shift, best_matched)
+        if best_matched < count:
+            # truncation: the drafted suffix diverged — the adopt
+            # resimulates it; nothing past it can ever match
+            self._discard(ls)
+        return out
+
+    def _discard(self, ls: _LaneSpec) -> None:
+        d = ls.draft
+        ls.draft = None
+        if d is None:
+            return
+        unserved = max(sum(len(s) for s in d.scripts) - d.served, 0)
+        self.frames_discarded += unserved
+        if GLOBAL_TELEMETRY.enabled and unserved:
+            self._m_discarded.inc(unserved)
+
+    # ------------------------------------------------------------------
+
+    def section(self) -> dict:
+        """The host telemetry section's speculation block."""
+        return {
+            "drafts": self.drafts_launched,
+            "frames_drafted": self.frames_drafted,
+            "frames_draftable": self.frames_draftable,
+            "frames_adopted": self.frames_adopted,
+            "frames_discarded": self.frames_discarded,
+            "adopts": self.spec_adopts,
+            "misses": self.spec_misses,
+            # adopted over SERVEABLE frames (one member's window per
+            # draft): prediction quality, independent of draft width —
+            # frames_drafted measures device work across members
+            "hit_rate": (
+                round(self.frames_adopted / self.frames_draftable, 4)
+                if self.frames_draftable
+                else 0.0
+            ),
+        }

@@ -54,6 +54,23 @@ class SnapshotRef:
     ring_slot: int
 
 
+@dataclass(frozen=True)
+class DraftBatch:
+    """One draft dispatch's device-resident results: per-member per-frame
+    trajectories (traj pytree [B, W, ...]), per-frame post-step checksums
+    (his/los [B, W]) and the anchor checksums (a_hi/a_lo [B]) — the
+    "ring-parked branch" a later adopt_slot serves (a prefix of) a
+    session tick from. Member k is the k-th drafted slot of the launch;
+    the confirmed stacked worlds are never touched by a draft."""
+
+    traj: Any
+    his: Any
+    los: Any
+    a_hi: Any
+    a_lo: Any
+    bucket: int
+
+
 def _array_is_ready(arr) -> bool:
     is_ready = getattr(arr, "is_ready", None)
     return bool(is_ready()) if callable(is_ready) else True
@@ -1754,7 +1771,7 @@ class MultiSessionDeviceCore:
                  plan_cache: Optional[DispatchPlanCache] = None,
                  buckets: Optional[Sequence[int]] = None,
                  depth_buckets: Optional[Sequence[int]] = None,
-                 depth_routing: bool = True):
+                 depth_routing: bool = True, speculation: bool = False):
         """`num_players` is the HOST-WIDE player layout (the widest
         session the host admits): every hosted session's rows are packed
         at this width, with absent players padded as DISCONNECTED so the
@@ -1771,6 +1788,17 @@ class MultiSessionDeviceCore:
         snapshot/restore rows — can restrict the grid (e.g. `(2,)`) so
         warmup compiles a fraction of the programs and the jit budget
         shrinks to match; `depth_bucket_for` raises past the coverage.
+
+        `speculation`: enable the SPECULATIVE BUBBLE-FILLING programs —
+        `draft()` rolls input-starved slots' futures forward from a ring
+        anchor as a vmapped batch (a ring-parked branch: per-frame
+        trajectories + checksums off to the side, confirmed state never
+        touched), and `adopt_slot()` serves (a prefix of) a later session
+        tick row from a standing draft through the proven
+        ResimCore._adopt_impl route — one adopt instead of a full-window
+        resim; the mispredicted suffix resimulates inside the same
+        dispatch. One draft + one adopt program per row bucket, compiled
+        at warmup and counted in dispatch_bucket_budget().
 
         `depth_routing`: dispatch one vmapped program per (row-count
         bucket x depth bucket) instead of always vmapping the full-window
@@ -1862,6 +1890,31 @@ class MultiSessionDeviceCore:
             self._import_slot_impl, donate_argnums=(0, 1)
         )
         self._pad_row = self.core.pad_tick_row()
+        # speculative bubble-filling programs (serve/speculation drives
+        # them): the draft rollout reads rings only (no donation — the
+        # confirmed worlds are reused untouched), the per-slot adopt
+        # writes one slot through the proven ResimCore adopt body
+        self.speculation = speculation
+        self.drafts_launched = 0
+        self.spec_adopts = 0
+        if speculation:
+            self._draft_fn = jax.jit(self._draft_impl)
+            self._adopt_slot_fn = jax.jit(
+                self._adopt_slot_impl, donate_argnums=(0, 1)
+            )
+            # draft packed row: [anchor_ring_slot] + statuses[P] +
+            # inputs[W * P * I]. The per-player statuses are STATIC for
+            # the whole rollout: CONFIRMED for the lane's real players
+            # (the drafting contract) and DISCONNECTED for host-layout
+            # pad columns, so a narrow session's draft substitutes the
+            # same deterministic dummy inputs its resim would
+            self._draft_len = (
+                1
+                + num_players
+                + self.core.window * num_players * game.input_size
+            )
+            self._draft_pad_row = np.zeros((self._draft_len,), np.int32)
+            self._draft_stage_pools: dict = {}
         # per-row-bucket pooled (idx, rows) staging, async_inflight + 1
         # deep — the dispatch compaction packs straight into these
         # instead of allocating + re-tiling pad rows every megabatch
@@ -2068,9 +2121,13 @@ class MultiSessionDeviceCore:
     def dispatch_bucket_budget(self) -> int:
         """The jit-cache bound depth routing guarantees: one program per
         (row bucket x depth bucket) plus the fast path per row bucket —
-        O(log capacity x log window). The soak tests pin the live
-        signature population inside this."""
-        return len(self.buckets) * (len(self.depth_buckets) + 1)
+        O(log capacity x log window) — plus, under speculation, one
+        draft rollout and one per-slot adopt program per row bucket.
+        The soak tests pin the live signature population inside this."""
+        base = len(self.buckets) * (len(self.depth_buckets) + 1)
+        if self.speculation:
+            base += 2 * len(self.buckets)
+        return base
 
     def megabatch_programs(self) -> List[Tuple[int, Optional[int], int]]:
         """The plan cache's megabatch-program population as structured
@@ -2243,10 +2300,7 @@ class MultiSessionDeviceCore:
             # compiled past the budget names its call site and raises
             # instead of silently growing the cache mid-serve
             san.check_dispatch_budget(
-                {
-                    "_dispatch_impl": self._dispatch_fn,
-                    "_dispatch_fast_impl": self._dispatch_fast_fn,
-                },
+                self._budget_fns(),
                 self.dispatch_bucket_budget(),
                 context="MultiSessionDeviceCore.dispatch",
             )
@@ -2282,6 +2336,197 @@ class MultiSessionDeviceCore:
             _, rows = self._inflight.popleft()
             self.inflight_rows -= rows
         return self.inflight_rows
+
+    # ------------------------------------------------------------------
+    # speculative bubble-filling (serve/speculation.py drives this):
+    # draft input-starved slots' futures into the megabatch, adopt on
+    # arrival — the serving twin of the TpuRollbackBackend beam
+    # ------------------------------------------------------------------
+
+    def _budget_fns(self) -> dict:
+        """Every jitted dispatch function whose cache the bucket budget
+        bounds — THE one dict the sanitizer's budget assertion checks at
+        every dispatch site, so the draft/adopt programs can never grow
+        the cache invisibly."""
+        fns = {
+            "_dispatch_impl": self._dispatch_fn,
+            "_dispatch_fast_impl": self._dispatch_fast_fn,
+        }
+        if self.speculation:
+            fns["_draft_impl"] = self._draft_fn
+            fns["_adopt_slot_impl"] = self._adopt_slot_fn
+        return fns
+
+    def _draft_impl(self, rings, idx, rows):
+        """Vectorized speculative rollout over [B] input-starved slots:
+        gather each slot's anchor snapshot from its ring, scan the
+        drafted input script forward W frames with each row's STATIC
+        per-player statuses — CONFIRMED for real players (the
+        statuses_contract='disconnect-only' adoption contract),
+        DISCONNECTED for host-layout pad columns — and return
+        per-member per-frame trajectories plus
+        post-step checksums — a ring-parked branch. rings are READ ONLY
+        (no donation): a draft can never clobber confirmed state, and
+        the confirmed worlds keep flowing through the ordinary megabatch
+        programs while the draft stands."""
+        import jax.numpy as jnp
+
+        core = self.core
+        W, P, I = core.window, self.num_players, self.input_size
+        g_ring = jax.tree.map(lambda a: a[idx], rings)
+
+        def one(ring, row):
+            anchor_slot = row[0]
+            statuses = row[1 : 1 + P]
+            inputs = row[1 + P :].astype(jnp.uint8).reshape(W, P, I)
+            anchor = jax.tree.map(
+                lambda r: jax.lax.dynamic_index_in_dim(
+                    r, anchor_slot, 0, keepdims=False
+                ),
+                ring,
+            )
+            a_hi, a_lo = core.game.checksum(anchor)
+
+            def body(s, inp):
+                nxt = core.game.step(s, inp, statuses)
+                hi, lo = core.game.checksum(nxt)
+                return nxt, (nxt, hi, lo)
+
+            _, (traj, his, los) = jax.lax.scan(body, anchor, inputs)
+            return traj, his, los, a_hi, a_lo
+
+        return jax.vmap(one)(g_ring, rows)
+
+    def _adopt_slot_impl(self, rings, states, slot, traj, his, los,
+                         a_hi, a_lo, packed):
+        """Serve one slot's tick row from a standing draft: gather the
+        slot's ring, run the proven single-session adopt body (prefix
+        states/checksums from the trajectory, mispredicted suffix
+        resimulated in the same program), scatter back. packed is the
+        ResimCore adopt layout; packed[0] (member) picks the draft-batch
+        row this slot owns."""
+        member = packed[0]
+        ring = jax.tree.map(lambda a: a[slot], rings)
+        ring, state, _, out_his, out_los = self.core._adopt_impl(
+            ring, traj, his, los,
+            jax.lax.dynamic_index_in_dim(a_hi, member, 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(a_lo, member, 0, keepdims=False),
+            {}, packed,
+        )
+        rings = jax.tree.map(lambda a, b: a.at[slot].set(b), rings, ring)
+        states = jax.tree.map(
+            lambda a, b: a.at[slot].set(b), states, state
+        )
+        return rings, states, out_his, out_los
+
+    def pack_draft_row_into(self, out: np.ndarray, anchor_slot: int,
+                            statuses: np.ndarray,
+                            inputs: np.ndarray) -> np.ndarray:
+        """Pack one slot's draft row ([anchor_ring_slot] + the static
+        per-player i32[P] statuses + the u8[W,P,I] drafted input script)
+        into a caller-owned int32 buffer."""
+        P = self.num_players
+        out[0] = anchor_slot
+        out[1 : 1 + P] = statuses
+        out[1 + P :] = inputs.reshape(-1)
+        return out
+
+    def _acquire_draft_stage(self, bucket: int):
+        """Rotate the pooled (idx, rows) draft staging for one row-count
+        bucket — the draft twin of _acquire_stage, under the same fence
+        reuse guarantee."""
+        pool = self._draft_stage_pools.get(bucket)
+        if pool is None:
+            pool = {
+                "flip": 0,
+                "bufs": [
+                    [
+                        np.full((bucket,), self.pad_slot, dtype=np.int32),
+                        np.tile(self._draft_pad_row, (bucket, 1)),
+                        0,
+                    ]
+                    for _ in range(self.async_inflight + 1)
+                ],
+            }
+            self._draft_stage_pools[bucket] = pool
+        pool["flip"] = (pool["flip"] + 1) % len(pool["bufs"])
+        return pool["bufs"][pool["flip"]]
+
+    def draft(self, entries) -> DraftBatch:
+        """Launch one speculative draft megabatch: `entries` is a list of
+        (slot, draft_row) — at most one per slot — packed into the same
+        pow2 row buckets as ordinary dispatches, so the fleet's starved
+        lanes fill device bubbles with ONE extra program per bucket.
+        Returns the device-resident DraftBatch (member k = entry k);
+        non-blocking beyond the async fence, confirmed state untouched."""
+        assert self.speculation, "core built without speculation=True"
+        n = len(entries)
+        assert 0 < n <= self.capacity
+        bucket = self.bucket_for(n)
+        staged = self._acquire_draft_stage(bucket)
+        idx, rows, used = staged
+        for k, (slot, row) in enumerate(entries):
+            assert 0 <= slot < self.capacity
+            idx[k] = self._phys[slot]
+            rows[k] = row
+        for k in range(n, used):
+            idx[k] = self.pad_slot
+            rows[k] = self._draft_pad_row
+        staged[2] = n
+        self.plan_cache.note(("spec_draft", bucket), metrics=False)
+        traj, his, los, a_hi, a_lo = self._draft_fn(self.rings, idx, rows)
+        san = active_sanitizer()
+        if san is not None:
+            san.check_dispatch_budget(
+                self._budget_fns(),
+                self.dispatch_bucket_budget(),
+                context="MultiSessionDeviceCore.draft",
+            )
+        self.drafts_launched += 1
+        self._note_inflight(his, n)
+        return DraftBatch(traj, his, los, a_hi, a_lo, bucket)
+
+    def adopt_slot(self, slot: int, draft: DraftBatch,
+                   packed: np.ndarray) -> _ChecksumBatch:
+        """Serve (a prefix of) one session tick row from a standing
+        draft instead of dispatching its resim: ring writes and saved
+        checksums for the matched prefix come from the draft trajectory,
+        the mispredicted suffix resimulates in the same program — a
+        misprediction costs an adopt/truncate, never a full-window
+        resim. `packed` is ResimCore.pack_adopt_row's layout with
+        packed[0] = the slot's member index in `draft`. Returns the [W]
+        checksum batch for the row's save bindings (flat index = window
+        slot)."""
+        assert self.speculation, "core built without speculation=True"
+        assert 0 <= slot < self.capacity
+        advance_count, matched = int(packed[2]), int(packed[5])
+        assert 1 <= matched <= advance_count
+        self.plan_cache.note(("spec_adopt", draft.bucket), metrics=False)
+        self.rings, self.states, his, los = self._adopt_slot_fn(
+            self.rings, self.states, np.int32(self._phys[slot]),
+            draft.traj, draft.his, draft.los, draft.a_hi, draft.a_lo,
+            packed,
+        )
+        san = active_sanitizer()
+        if san is not None:
+            san.check_dispatch_budget(
+                self._budget_fns(),
+                self.dispatch_bucket_budget(),
+                context="MultiSessionDeviceCore.adopt_slot",
+            )
+        self.megabatches += 1
+        self.rows_dispatched += 1
+        self.spec_adopts += 1
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_batch_rows.observe(1)
+            # the depth histogram records what the device actually
+            # resimulated: the mispredicted suffix (1 on a full hit) —
+            # the "adopt, not full-window resim" acceptance surface
+            depth = max(advance_count - matched, 1)
+            self.core._m_depth.observe(depth)
+            self.core._m_waste.inc(self.core.window - depth)
+        self._note_inflight(his, 1)
+        return _ChecksumBatch(his, los, self.ledger)
 
     # ------------------------------------------------------------------
     # slot lifecycle
@@ -2454,6 +2699,38 @@ class MultiSessionDeviceCore:
                 self.rings, self.states, _, _ = self._dispatch_fn(
                     self.rings, self.states, idx, rows, self.core.window
                 )
+        if self.speculation:
+            core = self.core
+            W = core.window
+            scratch = np.full((W,), core.scratch_slot, dtype=np.int32)
+            statuses = np.zeros((W, self.num_players), dtype=np.int32)
+            inputs = np.zeros(
+                (W, self.num_players, self.input_size), dtype=np.uint8
+            )
+            for b in self.buckets:
+                # draft rollout per bucket: pad rows anchor on the dummy
+                # world's zeroed ring (discarded results, a pure compile)
+                idx = np.full((b,), self.pad_slot, dtype=np.int32)
+                rows = np.tile(self._draft_pad_row, (b, 1))
+                traj, his, los, a_hi, a_lo = self._draft_fn(
+                    self.rings, idx, rows
+                )
+                # per-slot adopt per bucket, against the DUMMY slot with
+                # scratch-only saves: no ring bytes move, and the dummy
+                # state the adopt steps is restored below — live slots
+                # never observe the warmup
+                packed = core.pack_adopt_row(
+                    0, 0, 1, 1, 0, 1, scratch,
+                    statuses=statuses, inputs=inputs,
+                )
+                self.rings, self.states, _, _ = self._adopt_slot_fn(
+                    self.rings, self.states, np.int32(self.pad_slot),
+                    traj, his, los, a_hi, a_lo, packed,
+                )
+            init = core.game.init_state()
+            self.states = jax.tree.map(
+                lambda a, x: a.at[self.pad_slot].set(x), self.states, init
+            )
         # the masked batch reset (env auto-reset) with an all-False mask:
         # a true no-op on the stacked worlds, but the program exists
         # before the first episode ever finishes mid-serve
@@ -2713,6 +2990,17 @@ class ShardedMultiSessionDeviceCore(MultiSessionDeviceCore):
         idx = jax.lax.with_sharding_constraint(idx, self._row_sharding)
         rows = jax.lax.with_sharding_constraint(rows, self._row_sharding)
         return super()._dispatch_fast_impl(rings, states, idx, rows)
+
+    def _draft_impl(self, rings, idx, rows):
+        # the draft batch partitions across the session shards like any
+        # other staged row block (the host's slot->shard affinity orders
+        # draft entries by owning shard, so the rollout's ring gathers
+        # stay mostly shard-local); the per-slot adopt needs no
+        # constraint — it is a single-slot gather/scatter GSPMD already
+        # partitions from the operand shardings
+        idx = jax.lax.with_sharding_constraint(idx, self._row_sharding)
+        rows = jax.lax.with_sharding_constraint(rows, self._row_sharding)
+        return super()._draft_impl(rings, idx, rows)
 
     def _dispatch_staged(self, staged, n, bucket, *, last_active, fast):
         if GLOBAL_TELEMETRY.enabled:

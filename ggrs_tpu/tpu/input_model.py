@@ -230,3 +230,103 @@ class InputHistoryModel:
                     out.append((p, offset, row))
             rank += 1
         return out
+
+    # per-player cap on successor values sampled by draft_script draws
+    DRAFT_SUCC_LIMIT = 8
+    # a width-1 draft only deviates from repeat-last when the learned
+    # transition is CONFIDENT: the verify pass ANDs every cell of a row
+    # (one wrong player kills the frame), so betting a cell on a value
+    # the model gives < ~half its mass is negative-EV — with the floor,
+    # unpredictable streams degrade to exactly the repeat-last floor
+    # (which is what serves no-rollback recoveries), while streams with
+    # a dominant successor keep the switch bets that serve rollbacks
+    MIN_SWITCH_CONF = 0.45
+
+    def draft_script(
+        self,
+        base_rows: np.ndarray,
+        pinned: np.ndarray,
+        *,
+        anchor_frame: int,
+        seed: int,
+        init_values: np.ndarray,
+        init_holds: np.ndarray,
+    ) -> np.ndarray:
+        """Fill the unpinned cells of `base_rows` (u8[D, P, I], row j =
+        the input fed at frame anchor_frame + j) with hold/switch draws
+        from the learned statistics — the WIDTH-1 drafted script the
+        serving host's speculative bubble-filling rolls out for an
+        input-starved session.
+
+        `pinned` (bool[D, P]) marks ground-truth cells (played local
+        inputs and confirmed remote inputs): they are left verbatim and
+        RE-ANCHOR the per-player hold run. Every other cell draws like
+        env/opponents.InputModelOpponent: at each frame the player
+        switches with probability hazard(current hold length) — a
+        counter-based splitmix64 uniform of (seed, absolute frame,
+        player) decides, never a stateful RNG stream (the DET-lint
+        determinism contract), so re-drafting the same anchor with the
+        same statistics reproduces a byte-identical script — and a
+        switching player samples its next value from the learned
+        transition distribution (a second counter uniform). Players with
+        no learned signal hold forever: exactly the reference's
+        repeat-last prediction floor, which is also what maximizes the
+        verify pass's prefix hits on streams of held values.
+
+        `init_values` (u8[P, I]) / `init_holds` (int[P]) are each
+        player's value and run length entering row 0 (derived from the
+        played history before the anchor). The per-frame switch and
+        successor uniforms are drawn VECTORIZED across the player axis
+        (two unit_uniform calls per frame); the sequential frame
+        loop is irreducible — each draw's hazard depends on the hold run
+        the previous draw produced. Fills in place and returns
+        base_rows."""
+        # runtime import: ggrs_tpu.env's package init pulls the env
+        # workload; the draw helper is all this module needs from it
+        from ..env.opponents import unit_uniform
+
+        D, P, I = base_rows.shape
+        assert pinned.shape == (D, P)
+        ids = np.arange(P)
+        cur = np.array(init_values, dtype=np.uint8, copy=True)
+        hold = np.array(init_holds, dtype=np.int64, copy=True)
+        for j in range(D):
+            frame = anchor_frame + j
+            u = unit_uniform(seed, frame, ids)
+            u2 = unit_uniform(seed ^ 0x5EED, frame, ids)
+            for p in range(P):
+                if pinned[j, p]:
+                    v = base_rows[j, p]
+                    if np.array_equal(v, cur[p]):
+                        hold[p] += 1
+                    else:
+                        cur[p] = v
+                        hold[p] = 1
+                    continue
+                st = self._stats[p]
+                if st.n_holds():
+                    if u[p] < st.hazard(int(hold[p])):
+                        succ = [
+                            sv
+                            for sv in st.next_values(
+                                cur[p].tobytes(),
+                                limit=self.DRAFT_SUCC_LIMIT,
+                            )
+                            if sv[1] >= self.MIN_SWITCH_CONF
+                        ]
+                        if succ:
+                            probs = np.array(
+                                [w for _, w in succ], dtype=np.float64
+                            )
+                            cum = np.cumsum(probs / probs.sum())
+                            k = int(
+                                np.searchsorted(cum, u2[p], side="right")
+                            )
+                            k = min(k, len(succ) - 1)
+                            cur[p] = np.frombuffer(
+                                succ[k][0], dtype=np.uint8
+                            )
+                            hold[p] = 0
+                hold[p] += 1
+                base_rows[j, p] = cur[p]
+        return base_rows

@@ -1170,6 +1170,29 @@ class ResimCore:
             los.append(lo)
         return ring, state, verify, jnp.stack(his), jnp.stack(los)
 
+    def pack_adopt_row(self, member: int, load_slot: int,
+                       advance_count: int, shift: int, load_frame: int,
+                       matched: int, save_slots: np.ndarray,
+                       statuses: Optional[np.ndarray] = None,
+                       inputs: Optional[np.ndarray] = None) -> np.ndarray:
+        """Build one adoption's packed control-word row (the _adopt_impl
+        layout) — THE one definition of the adopt layout, shared by
+        adopt() and the serving megabatch's per-slot adoption
+        (MultiSessionDeviceCore.adopt_slot)."""
+        packed = np.zeros((self._apacked_len,), dtype=np.int32)
+        packed[0] = member
+        packed[1] = load_slot
+        packed[2] = advance_count
+        packed[3] = shift
+        packed[4] = load_frame
+        packed[5] = matched
+        packed[self._aoff_save : self._aoff_status] = save_slots
+        if statuses is not None:
+            packed[self._aoff_status : self._aoff_input] = statuses.reshape(-1)
+        if inputs is not None:
+            packed[self._aoff_input :] = inputs.reshape(-1)
+        return packed
+
     def adopt(self, spec, member: int, load_slot: int, save_slots: np.ndarray,
               advance_count: int, shift: int = 0, load_frame: int = 0,
               inputs: Optional[np.ndarray] = None,
@@ -1188,20 +1211,11 @@ class ResimCore:
         assert matched == advance_count or inputs is not None, (
             "partial adoption needs the corrected inputs for the suffix"
         )
-        W, P, I = self.window, self.num_players, self.game.input_size
         traj, spec_his, spec_los, a_hi, a_lo = spec
-        packed = np.zeros((self._apacked_len,), dtype=np.int32)
-        packed[0] = member
-        packed[1] = load_slot
-        packed[2] = advance_count
-        packed[3] = shift
-        packed[4] = load_frame
-        packed[5] = matched
-        packed[self._aoff_save : self._aoff_status] = save_slots
-        if statuses is not None:
-            packed[self._aoff_status : self._aoff_input] = statuses.reshape(-1)
-        if inputs is not None:
-            packed[self._aoff_input :] = inputs.reshape(-1)
+        packed = self.pack_adopt_row(
+            member, load_slot, advance_count, shift, load_frame, matched,
+            save_slots, statuses=statuses, inputs=inputs,
+        )
         # full hits route to the branchless pure-data-movement program
         # (see the _adopt_full_fn comment in __init__); partial hits keep
         # the cond program for its genuine suffix resimulation

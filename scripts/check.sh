@@ -136,6 +136,14 @@ if [ "${1:-}" = "--chaos-smoke" ]; then
   exit $?
 fi
 
+if [ "${1:-}" = "--spec-smoke" ]; then
+  echo "== spec smoke (speculative bubble-filling, single-device + sharded) =="
+  GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python scripts/spec_smoke.py
+  exit $?
+fi
+
 FAST=0
 [ "${1:-}" = "--fast" ] && FAST=1
 
@@ -150,6 +158,11 @@ python -m pytest tests/ -q
 
 echo "== [2b/5] chaos smoke (fleet operations end to end) =="
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+
+echo "== [2c/5] spec smoke (speculative bubble-filling end to end) =="
+GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python scripts/spec_smoke.py
 
 if [ "$FAST" = "0" ]; then
   echo "== [3/5] UBSAN build + native/wire tests =="

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Speculative bubble-filling smoke gate (scripts/check.sh --spec-smoke):
+a WAN-shaped 16-session loadgen fleet under forced input starvation —
+per-match blackhole windows longer than the prediction window, the
+outage shape that leaves megabatch rows empty — on a
+SessionHost(speculation=True), under GGRS_SANITIZE=1, on BOTH the
+single-device core and the 8-virtual-device session mesh:
+
+  1. SPECULATION ENGAGED: a nonzero fraction of frames served from
+     drafts (frames_served_from_speculation > 0) with at least one
+     adopt dispatch — the number BENCH_r03 reported as 0 on the old
+     sidecar beam arm;
+  2. BITWISE TWIN: the speculating host's canonical stacked worlds
+     (state AND ring bytes) and every session's checksum history equal
+     a speculation=False twin fed identical traffic, zero desyncs;
+  3. RECOMPILE-CLEAN: warmup compiles the draft/adopt programs with the
+     megabatch grid; the starved serve afterwards compiles NOTHING and
+     every dispatch-function cache stays within
+     dispatch_bucket_budget() (which counts the two speculative
+     programs per row bucket);
+  4. the four speculation instruments (frames drafted/adopted/discarded
+     + prefix-length histogram) export through BOTH exporters and the
+     host telemetry section reports the hit rate.
+
+Runs on CPU (JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count=8,
+both self-applied) in about a minute. Exits nonzero with a reason on any
+failure.
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("GGRS_SANITIZE", "1")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+from ggrs_tpu import enable_global_telemetry  # noqa: E402
+from ggrs_tpu.obs import GLOBAL_TELEMETRY  # noqa: E402
+
+SESSIONS = 16
+TICKS = 90
+HOLE_EVERY = 30
+HOLE_LEN = 12
+
+
+def fail(reason):
+    print(f"spec-smoke FAIL: {reason}")
+    sys.exit(1)
+
+
+def validate_prometheus(text):
+    sample = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(\{[a-zA-Z_][a-zA-Z0-9_:]*="(\\.|[^"\\])*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})?'
+        r" -?[0-9.eE+-]+$"
+    )
+    comment = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*( .*)?$")
+    for line in text.strip().splitlines():
+        ok = comment.match(line) if line.startswith("#") else sample.match(line)
+        if not ok:
+            fail(f"unparseable prometheus line: {line!r}")
+    return text
+
+
+def build_starved_fleet(mesh, *, speculation, seed=7):
+    """Held-value input scripts (runs the input model can learn) over a
+    WAN-shaped lossy mesh, with peer 0 of every match blackholed for
+    HOLE_LEN ticks every HOLE_EVERY — stalls longer than the prediction
+    window, so the gate starves the other peers and the scheduler
+    drafts their futures."""
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.network.sockets import InMemoryNetwork
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.serve.loadgen import (
+        build_matches,
+        drive_scripted,
+        held_scripts,
+        starve_on_tick,
+        sync_fleet,
+    )
+    from ggrs_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    net = InMemoryNetwork(
+        clock, latency_ms=20, jitter_ms=6, loss=0.01, seed=seed
+    )
+    host = SessionHost(
+        ExGame(num_players=4, num_entities=16),
+        max_prediction=8, num_players=4, max_sessions=SESSIONS + 4,
+        clock=clock, idle_timeout_ms=0, warmup=True, mesh=mesh,
+        speculation=speculation,
+    )
+    matches = build_matches(host, net, clock, sessions=SESSIONS, seed=seed)
+    sync_fleet(host, matches, clock)
+    scripts = held_scripts(matches, TICKS, seed)
+    drive_scripted(
+        host, matches, clock, scripts, TICKS,
+        on_tick=starve_on_tick(
+            net, matches, hole_every=HOLE_EVERY, hole_len=HOLE_LEN
+        ),
+    )
+    host.device.block_until_ready()
+    if host.desyncs_observed:
+        fail(
+            f"starved fleet desynced (mesh={mesh is not None}, "
+            f"speculation={speculation})"
+        )
+    return host, [k for keys in matches for k in keys]
+
+
+def check_arm(mesh, san):
+    import jax
+    import numpy as np
+
+    label = "sharded" if mesh is not None else "single-device"
+    # bracket the speculating arm's run: events before `base` belong to
+    # earlier arms, events past `floor` to the twin's own warmup — only
+    # the [base:floor] window is this arm's post-warmup behavior
+    base = len(san.recompiles)
+    host_on, keys_on = build_starved_fleet(mesh, speculation=True)
+    floor = len(san.recompiles)
+    host_off, keys_off = build_starved_fleet(None, speculation=False)
+
+    # --- 1. speculation actually engaged -----------------------------
+    if host_on.frames_served_from_speculation <= 0:
+        fail(
+            f"[{label}] no frames served from speculation "
+            f"(section: {host_on._spec.section()})"
+        )
+    sec = host_on._spec.section()
+    if sec["adopts"] < 1:
+        fail(f"[{label}] no adopt dispatch ever ran: {sec}")
+    if host_on.device.drafts_launched < 1:
+        fail(f"[{label}] no draft megabatch ever dispatched")
+
+    # --- 2. bitwise twin ---------------------------------------------
+    for ka, kb in zip(keys_on, keys_off):
+        sa, sb = host_on.session(ka), host_off.session(kb)
+        if sa.current_frame != sb.current_frame:
+            fail(
+                f"[{label}] frame divergence: "
+                f"{sa.current_frame} vs {sb.current_frame}"
+            )
+        if sa.local_checksum_history != sb.local_checksum_history:
+            fail(f"[{label}] checksum history divergence at session {ka}")
+    ra, sa_ = host_on.device.stacked_canonical()
+    rb, sb_ = host_off.device.stacked_canonical()
+    for name, (ta, tb) in (("rings", (ra, rb)), ("states", (sa_, sb_))):
+        for la, lb in zip(jax.tree.leaves(ta), jax.tree.leaves(tb)):
+            if not np.array_equal(la, lb):
+                fail(f"[{label}] canonical {name} diverge from the twin")
+
+    # --- 3. recompile-clean + budget (speculating arm only: the twin
+    # legitimately compiles its own host's programs at ITS warmup) -----
+    on_recompiles = san.recompiles[base:floor]
+    if on_recompiles:
+        fail(
+            f"[{label}] post-warmup recompile on the speculating host:\n"
+            + "\n".join(e.render() for e in on_recompiles)
+        )
+    dev = host_on.device
+    cache = sum(fn._cache_size() for fn in dev._budget_fns().values())
+    budget = dev.dispatch_bucket_budget()
+    if cache > budget:
+        fail(f"[{label}] jit cache {cache} exceeds budget {budget}")
+    print(
+        f"  [{label}] served={host_on.frames_served_from_speculation} "
+        f"adopts={sec['adopts']} hit_rate={sec['hit_rate']} "
+        f"drafts={sec['drafts']} cache={cache}/{budget}"
+    )
+    return host_on
+
+
+def main():
+    import jax
+
+    enable_global_telemetry()
+
+    import ggrs_tpu.tpu  # noqa: F401  (installs the GGRS_SANITIZE wrapper)
+    from ggrs_tpu.analysis.sanitize import active_sanitizer
+    from ggrs_tpu.parallel.mesh import make_session_mesh
+
+    san = active_sanitizer()
+    if san is None:
+        fail("sanitizer not installed (GGRS_SANITIZE=1 expected)")
+    if len(jax.devices()) < 8:
+        fail(f"expected 8 virtual devices, found {len(jax.devices())}")
+
+    host = check_arm(None, san)
+
+    # --- 4. instruments through both exporters -----------------------
+    snap = host.telemetry()
+    m = snap["metrics"]
+    for name in (
+        "ggrs_spec_frames_drafted_total",
+        "ggrs_spec_frames_adopted_total",
+        "ggrs_spec_frames_discarded_total",
+        "ggrs_spec_prefix_len",
+    ):
+        if name not in m:
+            fail(f"{name} missing from the snapshot exporter")
+    if snap["host"]["speculation"]["hit_rate"] <= 0.0:
+        fail(f"host section hit_rate not positive: {snap['host']}")
+    prom = validate_prometheus(GLOBAL_TELEMETRY.prometheus())
+    for name in (
+        "ggrs_spec_frames_drafted_total",
+        "ggrs_spec_frames_adopted_total",
+        "ggrs_spec_frames_discarded_total",
+        "ggrs_spec_prefix_len_bucket",
+    ):
+        if name not in prom:
+            fail(f"{name} missing from the prometheus exporter")
+
+    # --- the sharded arm ---------------------------------------------
+    GLOBAL_TELEMETRY.registry.reset()
+    check_arm(make_session_mesh(8), san)
+
+    print("spec-smoke OK")
+
+
+if __name__ == "__main__":
+    main()

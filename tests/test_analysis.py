@@ -81,6 +81,37 @@ def test_det002_unseeded_rng():
     assert rules_fired(clean, ["determinism"])[0] == []
 
 
+def test_det002_stateful_rng_draft_path():
+    # the speculative-bubble-filling draft contract: every draw in a
+    # draft script is a counter-based uniform of (seed, frame, player)
+    # (tpu/input_model.draft_script, env/opponents.unit_uniform). A
+    # draft path that keeps a STATEFUL RNG stream instead — where the
+    # k-th draw depends on how many draws preceded it, so a re-draft of
+    # the same anchor yields a different script — must be a DET002
+    # true positive, not something the lint waves through.
+    bad = {"ggrs_tpu/tpu/draftfx.py": (
+        "import numpy as np\n"
+        "class Drafter:\n"
+        "    def __init__(self):\n"
+        "        self._rng = np.random.default_rng()\n"
+        "    def draft_script(self, depth):\n"
+        "        # stateful stream: draw k depends on draws 0..k-1\n"
+        "        return [self._rng.random() for _ in range(depth)]\n"
+    )}
+    rules, findings = rules_fired(bad, ["determinism"])
+    assert rules == ["DET002"]
+    assert findings[0].path == "ggrs_tpu/tpu/draftfx.py"
+    # the shipped shape: counter-based draws keyed on (seed, frame,
+    # player) — byte-identical on re-draft, nothing for the lint to say
+    clean = {"ggrs_tpu/tpu/draftfx.py": (
+        "from ggrs_tpu.env.opponents import unit_uniform\n"
+        "def draft_script(seed, anchor, depth, players):\n"
+        "    return [unit_uniform(seed, anchor + j, players)\n"
+        "            for j in range(depth)]\n"
+    )}
+    assert rules_fired(clean, ["determinism"])[0] == []
+
+
 def test_det003_id_hash():
     bad = {"ggrs_tpu/sync_layer.py": (
         "def key(cell):\n"

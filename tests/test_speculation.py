@@ -1,0 +1,357 @@
+"""Speculative bubble-filling: bitwise parity of a speculating host vs
+a never-speculating twin.
+
+The contract under test is the ISSUE's acceptance surface: a
+`SessionHost(speculation=True)` fed the same seeded starved traffic as a
+`speculation=False` twin must land on bit-identical per-session checksum
+histories, stacked device state and ring bytes in EVERY arrival pattern
+— full prefix hit (the drafted future was right: the tick is served
+from the draft via the adopt route), partial prefix (truncate to the
+longest-correct prefix, resimulate the suffix), and total miss (the
+draft is discarded, the normal rollback path runs untouched). Input
+starvation is forced the way WAN outages force it: per-match blackhole
+windows longer than the prediction window on a lossy in-memory mesh.
+
+Also pinned here: the draft/adopt jit programs are warmup-compiled and
+the cache stays frozen within dispatch_bucket_budget() under the
+sanitizer, and the four speculation instruments flow through both
+registry-driven exporters and host.telemetry().
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from ggrs_tpu.models.ex_game import ExGame
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.obs import GLOBAL_TELEMETRY
+from ggrs_tpu.serve import SessionHost
+from ggrs_tpu.serve.loadgen import (
+    build_matches,
+    drive_scripted,
+    held_scripts,
+    starve_on_tick,
+    sync_fleet,
+)
+from ggrs_tpu.serve.speculation import SpeculationPlanner
+from ggrs_tpu.utils.clock import FakeClock
+
+ENTITIES = 16
+
+
+def _assert_tree_equal(ta, tb, what):
+    la = jax.tree_util.tree_leaves_with_path(ta)
+    lb = jax.tree_util.tree_leaves(tb)
+    assert len(la) == len(lb)
+    for (path, a), b in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"{what}{jax.tree_util.keystr(path)}",
+        )
+
+
+def run_starved(scripts_fn, *, speculation, sessions=4, ticks=90,
+                hole_every=30, hole_len=12, seed=7, loss=0.0,
+                mesh=None, **host_kw):
+    """One hosted fleet under blackhole-forced input starvation: peer 0
+    of every match goes dark for `hole_len` ticks every `hole_every`
+    ticks (longer than the prediction window, so the other peers starve
+    at the gate), inputs scripted per (match, peer, tick)."""
+    clock = FakeClock()
+    net = InMemoryNetwork(
+        clock, latency_ms=16, jitter_ms=4, loss=loss, seed=seed
+    )
+    host = SessionHost(
+        ExGame(num_players=4, num_entities=ENTITIES),
+        max_prediction=8, num_players=4, max_sessions=sessions + 4,
+        clock=clock, idle_timeout_ms=0, speculation=speculation,
+        mesh=mesh, **host_kw,
+    )
+    matches = build_matches(host, net, clock, sessions=sessions, seed=seed)
+    sync_fleet(host, matches, clock)
+    scripts = scripts_fn(matches, ticks, seed)
+    drive_scripted(
+        host, matches, clock, scripts, ticks,
+        on_tick=starve_on_tick(
+            net, matches, hole_every=hole_every, hole_len=hole_len
+        ),
+    )
+    host.device.block_until_ready()
+    return host, [k for keys in matches for k in keys]
+
+
+def assert_bitwise_twin(host_on, keys_on, host_off, keys_off):
+    """The full parity surface: per-session frames + checksum
+    histories, canonical stacked state and ring bytes, and the explicit
+    whole-fleet checksum pass."""
+    for ka, kb in zip(keys_on, keys_off):
+        sa, sb = host_on.session(ka), host_off.session(kb)
+        assert sa.current_frame == sb.current_frame > 0
+        assert sa.local_checksum_history == sb.local_checksum_history
+        assert len(sa.local_checksum_history) > 0  # non-vacuous
+    r_on, s_on = host_on.device.stacked_canonical()
+    r_off, s_off = host_off.device.stacked_canonical()
+    _assert_tree_equal(s_on, s_off, "states")
+    _assert_tree_equal(r_on, r_off, "rings")
+    hi_a, lo_a = host_on.device.checksum_slots()
+    hi_b, lo_b = host_off.device.checksum_slots()
+    np.testing.assert_array_equal(hi_a, hi_b)
+    np.testing.assert_array_equal(lo_a, lo_b)
+    assert host_on.desyncs_observed == host_off.desyncs_observed == 0
+
+
+# ----------------------------------------------------------------------
+# input script shapes
+# ----------------------------------------------------------------------
+
+
+def constant_scripts(matches, ticks, seed):
+    """Every player holds one value forever: repeat-last predictions are
+    always right, so every starved stall ends in a no-rollback recovery
+    — the lineage member's deterministic FULL HIT."""
+    return {
+        (m, k): [17 + 3 * m + k] * ticks
+        for m, keys in enumerate(matches)
+        for k in range(len(keys))
+    }
+
+
+def adversarial_scripts(matches, ticks, seed):
+    """Fresh pseudorandom value every tick: unlearnable, so drafted
+    guesses are wrong at the first corrected frame — TOTAL MISSES."""
+    out = {}
+    for m, keys in enumerate(matches):
+        for k in range(len(keys)):
+            rng = random.Random(seed * 997 + m * 31 + k)
+            out[(m, k)] = [rng.randrange(1, 250) for _ in range(ticks)]
+    return out
+
+
+# held_scripts comes from loadgen: THE traffic shape the bench arm and
+# the smoke starve against — the parity this suite pins must cover the
+# same streams those gates measure
+
+
+class VerifyRecorder:
+    """Records every SpeculationPlanner.verify outcome (matched, count)
+    so tests can assert which arrival patterns actually occurred."""
+
+    def __init__(self):
+        self.outcomes = []
+
+    def install(self, monkeypatch):
+        orig = SpeculationPlanner.verify
+        rec = self
+
+        def wrapped(self, key, **kw):
+            out = orig(self, key, **kw)
+            rec.outcomes.append(
+                (out[3] if out is not None else 0, kw["count"])
+            )
+            return out
+
+        monkeypatch.setattr(SpeculationPlanner, "verify", wrapped)
+        return self
+
+    def full_hits(self):
+        return [o for o in self.outcomes if o[0] == o[1] and o[0] > 0]
+
+    def partials(self):
+        return [o for o in self.outcomes if 0 < o[0] < o[1]]
+
+    def misses(self):
+        return [o for o in self.outcomes if o[0] == 0]
+
+
+# ----------------------------------------------------------------------
+# the three arrival patterns, bitwise vs the twin
+# ----------------------------------------------------------------------
+
+
+def test_full_hit_parity(monkeypatch):
+    """Constant inputs: every stall ends in a no-rollback recovery the
+    lineage member serves whole — frames flow from the draft (adopt
+    route), zero misses, and the speculating host is bit-identical to
+    the never-speculating twin."""
+    rec = VerifyRecorder().install(monkeypatch)
+    host_on, keys_on = run_starved(constant_scripts, speculation=True)
+    host_off, keys_off = run_starved(constant_scripts, speculation=False)
+    sec = host_on._spec.section()
+    assert host_on.frames_served_from_speculation > 0
+    # the planner's own miss counter: zero genuine mispredictions (the
+    # recorder's zero rows are draft-window exhaustions, not misses)
+    assert sec["adopts"] > 0 and sec["misses"] == 0
+    assert rec.full_hits()
+    assert host_on.spec_hit_rate > 0.0
+    assert host_off.frames_served_from_speculation == 0
+    assert_bitwise_twin(host_on, keys_on, host_off, keys_off)
+
+
+def test_total_miss_parity(monkeypatch):
+    """Unlearnable per-tick random inputs: drafts can only miss — the
+    normal rollback path serves every arrival and the twin parity
+    still holds bitwise."""
+    rec = VerifyRecorder().install(monkeypatch)
+    host_on, keys_on = run_starved(adversarial_scripts, speculation=True)
+    host_off, keys_off = run_starved(adversarial_scripts, speculation=False)
+    sec = host_on._spec.section()
+    assert sec["frames_drafted"] > 0
+    assert sec["misses"] > 0
+    assert_bitwise_twin(host_on, keys_on, host_off, keys_off)
+
+
+def test_partial_prefix_parity(monkeypatch):
+    """Hold/switch streams across a lossy mesh: among the arrivals are
+    PARTIAL prefix hits (a timing bet matched the first corrected
+    frames, then diverged — the adopt serves the prefix and resimulates
+    the suffix) and the twins still match bit for bit."""
+    rec = VerifyRecorder().install(monkeypatch)
+    host_on, keys_on = run_starved(
+        held_scripts, speculation=True, sessions=7, ticks=150,
+        loss=0.02, seed=11,
+    )
+    host_off, keys_off = run_starved(
+        held_scripts, speculation=False, sessions=7, ticks=150,
+        loss=0.02, seed=11,
+    )
+    assert host_on.frames_served_from_speculation > 0
+    assert rec.partials(), (
+        f"no partial-prefix adoption occurred (outcomes: {rec.outcomes})"
+    )
+    assert_bitwise_twin(host_on, keys_on, host_off, keys_off)
+
+
+# ----------------------------------------------------------------------
+# jit discipline + instruments
+# ----------------------------------------------------------------------
+
+
+def test_jit_cache_frozen_after_warmup():
+    """Speculation's draft/adopt programs are warmup-compiled on the
+    bucket grid: the starved serve afterwards compiles NOTHING (the
+    sanitizer turns any post-warmup compile into a hard failure) and
+    every dispatch-function cache stays within
+    dispatch_bucket_budget() — which counts the two extra speculative
+    programs per row bucket."""
+    from ggrs_tpu.analysis.sanitize import (
+        install_sanitizer,
+        uninstall_sanitizer,
+    )
+
+    san = install_sanitizer()
+    try:
+        host, keys = run_starved(
+            held_scripts, speculation=True, warmup=True,
+        )
+        assert not san.recompiles, (
+            "post-warmup recompile on the speculating host:\n"
+            + "\n".join(e.render() for e in san.recompiles)
+        )
+        dev = host.device
+        assert dev.drafts_launched > 0  # the draft program actually ran
+        cache = sum(
+            fn._cache_size() for fn in dev._budget_fns().values()
+        )
+        assert cache <= dev.dispatch_bucket_budget()
+        base = len(dev.buckets) * (len(dev.depth_buckets) + 1)
+        assert dev.dispatch_bucket_budget() == base + 2 * len(dev.buckets)
+    finally:
+        uninstall_sanitizer()
+
+
+def test_spec_instruments_through_exporters():
+    """The four speculation instruments are registry-driven: one
+    starved speculating run populates them in the snapshot exporter,
+    the Prometheus text exporter, AND the host telemetry section's
+    speculation block (hit rate included)."""
+    from ggrs_tpu import enable_global_telemetry
+
+    enable_global_telemetry()
+    try:
+        host, keys = run_starved(constant_scripts, speculation=True)
+        assert host.frames_served_from_speculation > 0
+        snap = host.telemetry()
+        m = snap["metrics"]
+        for name in (
+            "ggrs_spec_frames_drafted_total",
+            "ggrs_spec_frames_adopted_total",
+            "ggrs_spec_frames_discarded_total",
+        ):
+            assert m[name]["type"] == "counter", name
+        drafted = next(iter(
+            m["ggrs_spec_frames_drafted_total"]["values"].values()
+        ))
+        adopted = next(iter(
+            m["ggrs_spec_frames_adopted_total"]["values"].values()
+        ))
+        assert drafted > 0 and 0 < adopted <= drafted
+        hist = m["ggrs_spec_prefix_len"]
+        assert hist["type"] == "histogram"
+        assert next(iter(hist["values"].values()))["count"] > 0
+        spec = snap["host"]["speculation"]
+        assert spec["frames_adopted"] == adopted
+        assert spec["hit_rate"] > 0.0
+        prom = GLOBAL_TELEMETRY.prometheus()
+        assert "ggrs_spec_frames_drafted_total" in prom
+        assert "ggrs_spec_frames_adopted_total" in prom
+        assert "ggrs_spec_frames_discarded_total" in prom
+        assert "ggrs_spec_prefix_len_bucket" in prom
+    finally:
+        GLOBAL_TELEMETRY.enabled = False
+        GLOBAL_TELEMETRY.reset()
+
+
+def test_non_speculating_host_untouched():
+    """speculation=False (the default) builds no planner, reports zero
+    frames served, and its telemetry host section has no speculation
+    block — old readers stay compatible."""
+    host, keys = run_starved(
+        constant_scripts, speculation=False, ticks=40, hole_every=0,
+    )
+    assert host._spec is None
+    assert host.frames_served_from_speculation == 0
+    assert host.spec_hit_rate == 0.0
+    assert "speculation" not in host._host_section()
+    base = len(host.device.buckets) * (len(host.device.depth_buckets) + 1)
+    assert host.device.dispatch_bucket_budget() == base
+
+
+def test_speculation_requires_statuses_contract():
+    """The adopt route replays drafts rolled out with all-CONFIRMED
+    statuses — a game that hasn't declared statuses_contract =
+    'disconnect-only' must be rejected at host construction."""
+
+    class OpaqueGame(ExGame):
+        statuses_contract = None
+
+    with pytest.raises(ValueError, match="statuses_contract"):
+        SessionHost(
+            OpaqueGame(num_players=2, num_entities=ENTITIES),
+            max_prediction=8, num_players=2, max_sessions=4,
+            clock=FakeClock(), speculation=True,
+        )
+
+
+# ----------------------------------------------------------------------
+# sharded host
+# ----------------------------------------------------------------------
+
+
+def test_sharded_speculation_parity():
+    """Speculation on the session-mesh host (drafts respect slot->shard
+    affinity): the sharded speculating fleet adopts frames and stays
+    bit-identical to the single-device NON-speculating twin."""
+    from ggrs_tpu.parallel.mesh import make_session_mesh
+    from ggrs_tpu.tpu.backend import ShardedMultiSessionDeviceCore
+
+    mesh = make_session_mesh(8)
+    host_on, keys_on = run_starved(
+        constant_scripts, speculation=True, mesh=mesh,
+    )
+    assert isinstance(host_on.device, ShardedMultiSessionDeviceCore)
+    host_off, keys_off = run_starved(constant_scripts, speculation=False)
+    assert host_on.frames_served_from_speculation > 0
+    assert_bitwise_twin(host_on, keys_on, host_off, keys_off)
