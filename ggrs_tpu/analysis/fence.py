@@ -114,6 +114,23 @@ POLICIES: Dict[str, FencePolicy] = {
         protected=CORE_STATE,
         allowed=frozenset(),
     ),
+    # the multi-process fleet rides the SAME device cores from another
+    # process boundary: wire tickets export/import slots and the agent
+    # drives the host — all of it must go through the core's entry
+    # points above, never by reaching into `host.device.<state>` (zero
+    # allowances, the serve/host.py discipline)
+    "ggrs_tpu/fleet/ticket.py": FencePolicy(
+        protected=CORE_STATE,
+        allowed=frozenset(),
+    ),
+    "ggrs_tpu/fleet/agent.py": FencePolicy(
+        protected=CORE_STATE,
+        allowed=frozenset(),
+    ),
+    "ggrs_tpu/fleet/island.py": FencePolicy(
+        protected=CORE_STATE,
+        allowed=frozenset(),
+    ),
     # the batched wire pump's pooled decode staging (network/pump.py):
     # the offset/length scratch is reused across pump passes — only the
     # staging's own grow path may rebind the arrays (the byte pool is

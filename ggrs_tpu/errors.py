@@ -121,6 +121,73 @@ class GroupSaturated(HostFull):
         self.per_host = dict(per_host or {})
 
 
+class FleetError(GGRSError):
+    """Base for multi-process fleet control-plane failures
+    (ggrs_tpu.fleet): RPC transport faults, fencing rejections,
+    placement exhaustion."""
+
+
+class RpcTimeout(FleetError):
+    """A control-plane RPC ran out of retries: every attempt (with
+    exponential backoff + jitter between them) timed out without a
+    reply. Carries the peer, op and attempt count so the operator sees
+    WHICH link is dead, not a bare socket timeout."""
+
+    def __init__(self, info: str, *, peer=None, op: str = "",
+                 attempts: int = 0):
+        super().__init__(
+            f"{info} (peer={peer!r}, op={op!r}, attempts={attempts})"
+        )
+        self.info = info
+        self.peer = peer
+        self.op = op
+        self.attempts = attempts
+
+
+class CircuitOpen(RpcTimeout):
+    """The per-peer circuit breaker is open: enough consecutive RPC
+    failures that further calls are refused outright until the cooldown
+    elapses (then one half-open trial decides). A subclass of RpcTimeout
+    so 'peer unavailable' handling catches both; typed so a router can
+    distinguish 'do not even try' from 'tried and died'."""
+
+    def __init__(self, info: str, *, peer=None, op: str = "",
+                 until_ms: int = 0):
+        super().__init__(info, peer=peer, op=op, attempts=0)
+        self.until_ms = until_ms
+
+
+class Fenced(FleetError):
+    """A control message carried a stale host epoch: the director
+    already fenced that incarnation (bumped its epoch) and re-placed its
+    sessions. The only correct reaction for the sender is to stop
+    advancing state and terminate — its world is no longer the world."""
+
+    def __init__(self, info: str, *, host_id=None, stale_epoch: int = 0,
+                 current_epoch: int = 0):
+        super().__init__(
+            f"{info} (host={host_id!r}, stale_epoch={stale_epoch}, "
+            f"current_epoch={current_epoch})"
+        )
+        self.info = info
+        self.host_id = host_id
+        self.stale_epoch = stale_epoch
+        self.current_epoch = current_epoch
+
+
+class FleetSaturated(HostFull):
+    """Every agent in the fleet rejected (or could not be reached for)
+    an admission after the bounded retry/jittered-backoff schedule ran
+    out. The cross-process twin of GroupSaturated — a subclass of
+    HostFull so single-host callers keep working; carries the attempt
+    count and the per-host occupancy the director last observed."""
+
+    def __init__(self, info: str, *, attempts: int = 0, per_host=None):
+        super().__init__(info)
+        self.attempts = attempts
+        self.per_host = dict(per_host or {})
+
+
 class RetraceBudgetExceeded(GGRSError):
     """The retrace sanitizer observed more compiled programs than the
     dispatch-bucket budget allows: a jit cache meant to be bounded by the

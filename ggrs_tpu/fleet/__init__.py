@@ -1,0 +1,42 @@
+"""Multi-process fleet: a real control plane over real sockets.
+
+Everything below `serve/` — HostGroup, migration, chaos — runs inside
+one Python process; every "kill" there is simulated and every fault is
+polite. This package goes distributed: a **director** service plus
+per-host **agent** processes speaking length-prefixed control frames
+over TCP (ggrs_tpu.fleet.wire), with the session data plane kept
+strictly out of the control plane's way — an agent keeps ticking its
+matches whether or not the director is reachable (the BubbleSpec
+discipline: the control plane must never stall the data plane).
+
+The pieces:
+
+  * `wire`     — length-prefixed control framing + fault-injection seam
+  * `rpc`      — timeout/retry/jittered-backoff + per-peer circuit breaker
+  * `ticket`   — wire tickets: whole match islands serialized for
+                 cross-process migration, drain and crash recovery
+  * `island`   — co-located match islands (the placement unit) + the
+                 single-process twin the chaos soaks compare against
+  * `agent`    — AgentCore (sans-io, testable in-process) + the
+                 `python -m ggrs_tpu.fleet.agent` process entry
+  * `director` — placement with FleetSaturated, heartbeat suspicion,
+                 monotonic host epochs as fencing tokens, fenced
+                 failover, rolling upgrades
+  * `chaos`    — the process-level chaos soak: real SIGKILLs, control
+                 partitions, delayed/duplicated RPCs, twin parity
+
+Importing this package does not import jax (the device core
+materializes inside AgentCore / the twin runner).
+"""
+
+from ..errors import CircuitOpen, Fenced, FleetError, FleetSaturated, RpcTimeout
+from .island import MatchSpec
+
+__all__ = [
+    "CircuitOpen",
+    "Fenced",
+    "FleetError",
+    "FleetSaturated",
+    "MatchSpec",
+    "RpcTimeout",
+]

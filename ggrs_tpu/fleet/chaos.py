@@ -1,0 +1,583 @@
+"""Process-level chaos: the PR 8 loadgen promoted into an acceptance
+harness that kills real processes.
+
+`run_process_chaos` spawns a director (in this process) plus N agent
+subprocesses on loopback, places scripted WAN-profile matches, then
+drives a `ChaosEvent` schedule (serve/chaos.py's event type, grown
+process-level kinds) against them:
+
+    sigkill    — SIGKILL a real agent process; the heartbeat detector
+                 suspects, fences, seizes the checkpoint, restores on a
+                 survivor (an auto-respawned replacement keeps the fleet
+                 at strength for the next kill)
+    partition  — the control socket goes dark both ways while the data
+                 plane keeps ticking (the BubbleSpec discipline, proven
+                 by cursor progress during the blackout)
+    rpc_delay  — director→agent frames held for N ms (retry food)
+    rpc_dup    — duplicated control frames (reply-cache food)
+    migrate    — live cross-process migration mid-schedule
+
+The gates ride the repo's one determinism contract: mem-plane islands
+are pure functions of (spec, step count), so the harness replays the
+same specs through `run_twin` in THIS process and compares checksum
+histories and canonical state digests bit-for-bit. Kill-restored
+matches replay from their checkpoint's pickled instant with identical
+rng draws, so even THEY converge to the twin's exact bytes — the
+faulted/unfaulted split in the report is an expectation label, not a
+weaker gate.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time as _time
+from typing import Any, Dict, List, Optional
+
+from ..errors import CircuitOpen, RpcTimeout
+from ..serve.chaos import ChaosEvent
+from .director import Director
+from .island import MatchSpec, run_twin
+
+__all__ = ["run_process_chaos", "process_schedule", "compare_with_twin"]
+
+
+def process_schedule(ticks: int, *, kills: int = 1,
+                     partition_ms: int = 1200,
+                     rpc_delay_ms: int = 300, rpc_dup: int = 1,
+                     migrations: int = 1) -> List[ChaosEvent]:
+    """The canonical process-level soak schedule, in match-progress
+    ticks: RPC faults early (they must not break placement-adjacent
+    traffic), a control partition in the first half, kills spread
+    through the middle, a live migration between them."""
+    events: List[ChaosEvent] = []
+    if rpc_delay_ms:
+        events.append(
+            ChaosEvent(int(ticks * 0.10), "rpc_delay", ms=rpc_delay_ms)
+        )
+    if rpc_dup:
+        events.append(ChaosEvent(int(ticks * 0.12), "rpc_dup", copies=rpc_dup))
+    if partition_ms:
+        events.append(
+            ChaosEvent(int(ticks * 0.25), "partition", ms=partition_ms)
+        )
+    for i in range(migrations):
+        # after the partition heals (a migration whose source is
+        # partitioned would just be skipped as unreachable)
+        events.append(
+            ChaosEvent(int(ticks * (0.52 + 0.06 * i)), "migrate")
+        )
+    for i in range(kills):
+        events.append(
+            ChaosEvent(int(ticks * (0.6 + 0.25 * i / max(kills, 1))), "sigkill")
+        )
+    return sorted(events, key=lambda e: e.tick)
+
+
+def _spawn_agent(index: int, *, port: int, base_dir: str, players: int,
+                 entities: int, max_sessions: int, hb_interval_ms: int,
+                 checkpoint_every: int, tick_interval_ms: float,
+                 warmup: bool) -> subprocess.Popen:
+    argv = [
+        sys.executable, "-m", "ggrs_tpu.fleet.agent",
+        "--director", f"127.0.0.1:{port}",
+        "--base-dir", base_dir,
+        "--label", f"agent{index}",
+        "--players", str(players),
+        "--entities", str(entities),
+        "--max-sessions", str(max_sessions),
+        "--hb-interval-ms", str(hb_interval_ms),
+        "--checkpoint-every", str(checkpoint_every),
+        "--tick-interval-ms", str(tick_interval_ms),
+        "--platform", "cpu",
+    ]
+    if warmup:
+        argv.append("--warmup")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    log = open(os.path.join(base_dir, f"agent{index}.log"), "ab")
+    try:
+        return subprocess.Popen(
+            argv, cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            )),
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+    finally:
+        log.close()  # the child inherited the fd; don't leak ours
+
+
+def compare_with_twin(specs: List[MatchSpec],
+                      fleet_reports: Dict[int, dict],
+                      faulted: set) -> dict:
+    """Replay mem-plane specs through the single-process twin and
+    compare per-peer checksum HISTORIES (frame -> checksum, exact dict
+    equality) and canonical state DIGESTS. Returns per-match verdicts;
+    a mismatch carries enough context to debug."""
+    mem = [s for s in specs if s.data_plane == "mem"]
+    twins = run_twin(mem)
+    host = next(iter(twins.values()))._twin_host if twins else None
+    out: Dict[str, Any] = {"matches": {}, "clean_exact": True,
+                           "faulted_exact": True}
+    # fold every agent's report islands into one mid -> entry map
+    fleet: Dict[int, dict] = {}
+    for rep in fleet_reports.values():
+        for mid_s, entry in rep.get("islands", {}).items():
+            fleet[int(mid_s)] = entry
+    for spec in mem:
+        twin = twins[spec.match_id]
+        entry = fleet.get(spec.match_id)
+        verdict: Dict[str, Any] = {
+            "faulted": spec.match_id in faulted,
+        }
+        if entry is None:
+            verdict["status"] = "missing-from-fleet"
+            out["clean_exact"] = False
+        else:
+            twin_hist = {
+                str(k): {str(f): c for f, c in h.items()}
+                for k, h in twin.histories().items()
+            }
+            twin_digest = {
+                str(k): v for k, v in twin.state_digest(host).items()
+            }
+            hist_ok = entry.get("histories") == twin_hist
+            fleet_digest = {
+                str(k): v for k, v in (entry.get("digest") or {}).items()
+            }
+            digest_ok = fleet_digest == twin_digest
+            frames_ok = entry.get("frames") == {
+                str(k): v for k, v in twin.frames().items()
+            }
+            verdict.update(
+                status="ok" if (hist_ok and digest_ok and frames_ok)
+                else "mismatch",
+                histories_equal=hist_ok,
+                digest_equal=digest_ok,
+                frames_equal=frames_ok,
+                checksums_compared=sum(
+                    len(h) for h in twin_hist.values()
+                ),
+            )
+            if verdict["status"] != "ok":
+                which = (
+                    "faulted_exact" if spec.match_id in faulted
+                    else "clean_exact"
+                )
+                out[which] = False
+        out["matches"][str(spec.match_id)] = verdict
+    return out
+
+
+def run_process_chaos(
+    *,
+    agents: int = 2,
+    matches: int = 4,
+    players: int = 2,
+    ticks: int = 600,
+    entities: int = 8,
+    seed: int = 0,
+    wan: bool = True,
+    kills: int = 1,
+    # 0 = auto: comfortably SHORTER than the suspicion window, so the
+    # partition proves control/data decoupling (the host keeps ticking,
+    # heals, is never fenced). A partition LONGER than suspicion is a
+    # legitimate fence-the-zombie scenario — pass it explicitly
+    partition_ms: int = 0,
+    rpc_delay_ms: int = 300,
+    rpc_dup: int = 1,
+    migrations: int = 1,
+    spread_udp: bool = False,
+    events: Optional[List[ChaosEvent]] = None,
+    base_dir: Optional[str] = None,
+    # generous control-plane timescales: the soak boxes are small (2
+    # CPU cores for director + agents + twin), and a scheduler stall
+    # under that contention must read as noise, not as death
+    hb_interval_ms: int = 250,
+    suspicion_misses: int = 6,
+    checkpoint_every: int = 24,
+    # the data plane must not RACE the control plane: suspicion windows
+    # and partitions are wall-clock, so the island frame loop is paced
+    # to keep the whole drive a couple orders slower than one heartbeat
+    tick_interval_ms: float = 20.0,
+    warmup: bool = True,
+    respawn: bool = True,
+    twin: bool = True,
+    startup_timeout_s: float = 240.0,
+    drive_timeout_s: float = 420.0,
+) -> Dict[str, Any]:
+    """Run the 1+N-process chaos soak; returns a JSON-able report (the
+    `_director` entry is the live object — strip before JSON)."""
+    own_dir = base_dir is None
+    if own_dir:
+        base_dir = tempfile.mkdtemp(prefix=f"ggrs_fleet_s{seed}_")
+    if partition_ms == 0:
+        partition_ms = hb_interval_ms * max(1, suspicion_misses - 2)
+    director = Director(
+        base_dir=base_dir, seed=seed, hb_interval_ms=hb_interval_ms,
+        suspicion_misses=suspicion_misses,
+    )
+    port = director.listen()
+    # the survivor must absorb the whole fleet after a kill
+    max_sessions = matches * players + (2 if spread_udp else 0)
+    spawn_kw = dict(
+        port=port, base_dir=base_dir, players=players, entities=entities,
+        max_sessions=max_sessions, hb_interval_ms=hb_interval_ms,
+        checkpoint_every=checkpoint_every,
+        tick_interval_ms=tick_interval_ms, warmup=warmup,
+    )
+    procs: List[subprocess.Popen] = []
+    completed = False
+    report: Dict[str, Any] = {
+        "agents": agents, "matches": matches, "players": players,
+        "ticks": ticks, "seed": seed, "kills_requested": kills,
+    }
+    try:
+        for i in range(agents):
+            procs.append(_spawn_agent(i, **spawn_kw))
+        deadline = _time.monotonic() + startup_timeout_s
+        while len(director.hosts) < agents:
+            director.step()
+            _time.sleep(0.005)
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {len(director.hosts)}/{agents} agents "
+                    f"registered (logs in {base_dir})"
+                )
+
+        specs = [
+            MatchSpec(
+                match_id=m, players=players, ticks=ticks,
+                seed=(seed * 7919 + m * 977) & 0xFFFFFF,
+                entities=entities,
+                wan={} if wan else None,
+            )
+            for m in range(matches)
+        ]
+        for spec in specs:
+            director.place_match(spec)
+        if spread_udp:
+            sp = MatchSpec(
+                match_id=10_000, players=2, ticks=ticks,
+                seed=seed & 0xFFFFFF, entities=entities,
+                data_plane="udp",
+            )
+            hids = sorted(director.hosts)[:2]
+            director.place_spread_match(
+                sp, {0: hids[0], 1: hids[1 % len(hids)]}
+            )
+            specs.append(sp)
+
+        if events is None:
+            events = process_schedule(
+                ticks, kills=kills, partition_ms=partition_ms,
+                rpc_delay_ms=rpc_delay_ms, rpc_dup=rpc_dup,
+                migrations=migrations,
+            )
+        pending = sorted(events, key=lambda e: e.tick)
+        faulted: set = set()
+        kill_log: List[dict] = []
+        partition_log: List[dict] = []
+        migrate_log: List[dict] = []
+        # single-flight respawn bookkeeping: exactly ONE replacement in
+        # flight at a time (agent startup is tens of seconds of jax
+        # import + warmup; a respawn-per-tick storm starves the box and
+        # the very registration it is waiting for)
+        spawn_inflight: Optional[subprocess.Popen] = None
+        hosts_before_spawn = 0
+
+        def placed_progress() -> int:
+            cursors = []
+            for mid, rec in director.matches.items():
+                if rec["state"] != "placed":
+                    continue
+                owners = (
+                    [rec["host"]] if rec.get("spread") is None
+                    else set(rec["spread"].values())
+                )
+                for hid in owners:
+                    hr = director.hosts.get(hid)
+                    if hr is None or not hr.alive():
+                        continue
+                    entry = hr.islands.get(str(mid))
+                    if entry is not None:
+                        cursors.append(entry.get("cursor", 0))
+            return min(cursors) if cursors else 0
+
+        def all_done() -> bool:
+            for mid, rec in director.matches.items():
+                if rec["state"] != "placed":
+                    continue
+                owners = (
+                    [rec["host"]] if rec.get("spread") is None
+                    else set(rec["spread"].values())
+                )
+                for hid in owners:
+                    hr = director.hosts.get(hid)
+                    if hr is None or not hr.alive():
+                        return False
+                    entry = hr.islands.get(str(mid))
+                    if entry is None or not (
+                        entry.get("done") or entry.get("failed")
+                    ):
+                        return False
+            return True
+
+        def fire(ev: ChaosEvent) -> None:
+            alive = [
+                hid for hid, hr in director.hosts.items() if hr.alive()
+            ]
+            if ev.kind == "sigkill":
+                victims = [
+                    h for h in alive
+                    if any(
+                        rec["state"] == "placed" and rec.get("host") == h
+                        for rec in director.matches.values()
+                    )
+                ] or alive
+                victim = ev.params.get("host", max(
+                    victims,
+                    key=lambda h: director.hosts[h].sessions,
+                ))
+                for rec in director.matches.values():
+                    if rec["state"] == "placed" and (
+                        rec.get("host") == victim
+                        or victim in (rec.get("spread") or {}).values()
+                    ):
+                        faulted.add(rec["spec"].match_id)
+                director.sigkill(victim)
+                kill_log.append({
+                    "host": victim, "at_progress": placed_progress(),
+                    "wall": _time.monotonic(),
+                })
+            elif ev.kind == "partition":
+                target = ev.params.get("host")
+                if target is None:
+                    target = min(
+                        alive,
+                        key=lambda h: director.hosts[h].sessions,
+                    )
+                before = {
+                    mid: entry.get("cursor", 0)
+                    for mid, entry in director.hosts[target].islands.items()
+                }
+                ms = int(ev.params.get("ms", 1000))
+                director.inject_partition(target, ms)
+                partition_log.append({
+                    "host": target, "ms": ms,
+                    "cursor_before": before,
+                    "_heal_wall": _time.monotonic() + ms / 1000.0,
+                })
+            elif ev.kind == "rpc_delay":
+                for hid in alive:
+                    director.inject_rpc_delay(
+                        hid, int(ev.params.get("ms", 200))
+                    )
+            elif ev.kind == "rpc_dup":
+                for hid in alive:
+                    director.inject_rpc_dup(
+                        hid, int(ev.params.get("copies", 1))
+                    )
+            elif ev.kind == "migrate":
+                # only REACHABLE hosts participate: a partitioned or
+                # suspected host's export would just eat the retry ladder
+                heals = getattr(director, "_partition_heal_at", {})
+                reachable = [
+                    h for h in alive
+                    if director.hosts[h].state == "up"
+                    and director.hosts[h].hb_misses == 0
+                    and h not in heals
+                ]
+                candidates = [
+                    (mid, rec) for mid, rec in director.matches.items()
+                    if rec["state"] == "placed"
+                    and rec.get("spread") is None
+                    and rec.get("host") in reachable
+                ]
+                if len(reachable) >= 2 and candidates:
+                    mid, rec = max(
+                        candidates,
+                        key=lambda mr: director.hosts[mr[1]["host"]].sessions,
+                    )
+                    dst = min(
+                        (h for h in reachable if h != rec["host"]),
+                        key=lambda h: director.hosts[h].sessions,
+                        default=None,
+                    )
+                    if dst is not None:
+                        try:
+                            director.migrate_match(mid, dst)
+                            migrate_log.append({"match": mid, "to": dst})
+                        except (RpcTimeout, CircuitOpen) as exc:
+                            migrate_log.append({
+                                "match": mid, "skipped": type(exc).__name__,
+                            })
+
+        deadline = _time.monotonic() + drive_timeout_s
+        while _time.monotonic() < deadline:
+            director.step()
+            director.heal_partitions()
+            # measure partition liveness at heal+fresh-heartbeat time,
+            # while the host still lives (a later kill must not erase
+            # the evidence that the data plane ran through the blackout)
+            for entry in partition_log:
+                if "cursor_after" in entry:
+                    continue
+                hr = director.hosts.get(entry["host"])
+                if hr is None or not hr.alive():
+                    continue
+                if (
+                    _time.monotonic() > entry["_heal_wall"]
+                    and hr.hb_misses == 0
+                ):
+                    after = {
+                        mid: e.get("cursor", 0)
+                        for mid, e in hr.islands.items()
+                    }
+                    entry["cursor_after"] = after
+                    entry["advanced_during"] = any(
+                        after.get(mid, 0) > c0
+                        for mid, c0 in entry["cursor_before"].items()
+                    ) if entry["cursor_before"] else None
+            progress = placed_progress()
+
+            def fireable(ev: ChaosEvent) -> bool:
+                # a SIGKILL with no live restore target proves nothing:
+                # hold it until the respawned replacement registers
+                if ev.kind == "sigkill":
+                    return sum(
+                        1 for hr in director.hosts.values() if hr.alive()
+                    ) >= 2
+                return True
+
+            while (
+                pending
+                and progress >= pending[0].tick
+                and fireable(pending[0])
+            ):
+                fire(pending.pop(0))
+            if respawn:
+                if spawn_inflight is not None:
+                    if len(director.hosts) > hosts_before_spawn:
+                        spawn_inflight = None  # it registered
+                    elif spawn_inflight.poll() is not None:
+                        spawn_inflight = None  # it died; try again
+                alive_n = sum(
+                    1 for hr in director.hosts.values() if hr.alive()
+                )
+                if (
+                    spawn_inflight is None
+                    and alive_n < agents
+                    # only respawn once the failover for the dead host ran
+                    and len(director.failovers) >= len(kill_log)
+                ):
+                    hosts_before_spawn = len(director.hosts)
+                    spawn_inflight = _spawn_agent(len(procs), **spawn_kw)
+                    procs.append(spawn_inflight)
+            if (
+                not pending
+                and all_done()
+                # every kill's failover must have RUN before the drive
+                # ends, even when the victim's matches were already done
+                # (the detector needs its suspicion window)
+                and len(director.failovers) >= len(kill_log)
+            ):
+                break
+            _time.sleep(0.004)
+        else:
+            raise TimeoutError(
+                f"chaos drive did not finish (progress "
+                f"{placed_progress()}/{ticks}, logs in {base_dir})"
+            )
+
+        # fallback for partitions whose heal the loop never revisited
+        for entry in partition_log:
+            entry.pop("_heal_wall", None)
+            if "cursor_after" in entry:
+                continue
+            hr = director.hosts.get(entry["host"])
+            after = {}
+            if hr is not None and hr.alive():
+                after = {
+                    mid: e.get("cursor", 0)
+                    for mid, e in hr.islands.items()
+                }
+            entry["cursor_after"] = after
+            entry["advanced_during"] = any(
+                after.get(mid, 0) > c0
+                for mid, c0 in entry["cursor_before"].items()
+            ) if entry["cursor_before"] else None
+
+        reports = director.collect_reports()
+        # the fleet is done: shut it down BEFORE the twin replay — the
+        # twin needs the cores the idling agents would otherwise burn
+        director.shutdown_fleet()
+        exit_deadline = _time.monotonic() + 15
+        for p in procs:
+            while p.poll() is None and _time.monotonic() < exit_deadline:
+                _time.sleep(0.02)
+            if p.poll() is None:
+                p.kill()
+        report["agent_exit_codes"] = [p.poll() for p in procs]
+        parity = (
+            compare_with_twin(specs, reports, faulted)
+            if twin else None
+        )
+        restore_exact = all(
+            not fo["restored"] or all(
+                fo["checkpoint_frames"].get(mid) == frames
+                for mid, frames in fo["restored"].items()
+            )
+            for fo in director.failovers
+        )
+        report.update({
+            "base_dir": base_dir,
+            "desyncs": sum(
+                sum(
+                    e.get("desyncs", 0)
+                    for e in rep.get("islands", {}).values()
+                )
+                for rep in reports.values()
+            ),
+            "checksums_compared": sum(
+                len(h)
+                for rep in reports.values()
+                for e in rep.get("islands", {}).values()
+                for h in e.get("histories", {}).values()
+            ),
+            "kills": kill_log,
+            "partitions": partition_log,
+            "migrations": migrate_log,
+            "failovers": director.failovers,
+            "restore_frame_exact": restore_exact,
+            "fence_rejections": sum(
+                hr.fence_rejections for hr in director.hosts.values()
+            ),
+            "lost_matches": sorted(set(director.matches_lost)),
+            "parity": parity,
+            "director": director.section(),
+        })
+        if own_dir:
+            report["base_dir"] = None  # cleaned up below, post-reap
+        completed = True
+        return {**report, "_director": director}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        if own_dir and completed:
+            # a harness-owned temp tree (fleet tickets carry whole
+            # device residues) must not pile up across soak runs; a
+            # FAILED run leaves it behind for forensics. Only after the
+            # reap: a live agent writing a checkpoint into a deleted
+            # directory would die confused
+            import shutil
+
+            shutil.rmtree(base_dir, ignore_errors=True)

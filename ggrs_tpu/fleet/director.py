@@ -1,0 +1,943 @@
+"""The fleet director: placement, failure detection, fenced recovery,
+rolling upgrades.
+
+One director owns the control plane for N agent processes. Its
+authority is the **host epoch**: every agent registers into a
+monotonically increasing epoch, every control frame carries the
+sender's epoch in its header, and the director validates it on every
+frame. Fencing a host = bumping its epoch — from that instant every
+frame the old incarnation ever sends is rejected with a `fenced` reply
+(the agent self-terminates on seeing one), and the director **seizes**
+the host's last checkpoint bytes immediately, so a zombie that keeps
+writing checkpoints after the fence is shouting into a void: its
+writes land in files nobody will ever read, its acks bounce, and the
+re-placed sessions' history is untouchable by it. (On one machine the
+seize-at-fence read gives the same guarantee a fencing-token check at a
+blob store gives a real deployment; real UDP data planes get a second
+fence for free — the kernel refuses the restored copy's port bind while
+a zombie still holds it, and refuses the zombie's re-bind once the
+restored copy holds it.)
+
+Failure detection is heartbeat arithmetic, not magic: an agent reports
+every `hb_interval_ms`; a host that is a full interval late has missed
+one; `suspicion_misses` consecutive misses fence it and trigger
+failover — seize checkpoint, pick the least-loaded survivor, `import`
+the ticket there, re-point the match table. Every step of that pipeline
+is a control-plane RPC and therefore rides the rpc.py discipline:
+per-attempt timeout, jittered backoff, per-peer circuit breaker.
+
+Placement generalizes HostGroup's least-loaded spillover across
+processes: occupancy-ordered attempts, HostFull routes to the next
+sibling, whole-fleet rejection backs off (seeded jitter) and retries,
+and exhaustion raises the typed `FleetSaturated` with the per-host
+occupancy map the operator needs.
+
+Rolling upgrade = for one host at a time: hold that host's admissions
+(others keep admitting — the fleet stays open for business), `drain`
+(the agent quiesces, exports every island as one wire ticket, exits),
+respawn via the injectable `spawn` callable, await the replacement's
+registration, `import` the ticket there. Zero sessions and zero
+confirmed frames lost, by construction: the ticket is the same
+observationally-neutral serialization the crash checkpoints use, taken
+at a quiesced instant.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time as _time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..errors import (
+    CheckpointIncompatible,
+    CircuitOpen,
+    Fenced,
+    FleetSaturated,
+    InvalidRequest,
+    RpcTimeout,
+)
+from ..obs import GLOBAL_TELEMETRY
+from ..utils.clock import Clock
+from .island import MatchSpec
+from .metrics import (
+    failover_ms_histogram,
+    failovers_total,
+    fenced_total,
+    fleet_saturated_total,
+    heartbeats_missed_total,
+    host_epoch_gauge,
+    placements_total,
+    rpc_retries_total,
+)
+from .rpc import CircuitBreaker, RetryPolicy, RpcError, RpcPeer, call
+from .ticket import peek_ticket
+from .wire import FleetConn, listener
+
+
+class HostRecord:
+    """Everything the director knows about one agent."""
+
+    def __init__(self, host_id: int, peer: RpcPeer, epoch: int,
+                 now_ms: int, *, pid: Optional[int] = None,
+                 max_sessions: int = 0, label: str = ""):
+        self.host_id = host_id
+        self.peer = peer
+        self.epoch = epoch
+        self.state = "up"  # up | suspect | dead | drained
+        self.pid = pid
+        self.label = label
+        self.max_sessions = max_sessions
+        self.sessions = 0
+        self.free_slots = max_sessions
+        self.tick = 0
+        self.desyncs = 0
+        self.islands: Dict[str, dict] = {}
+        self.checkpoint: Optional[dict] = None
+        self.last_hb_ms = now_ms
+        self.hb_misses = 0
+        self.admissions_held = False
+        self.fence_rejections = 0
+        self._frames_seen = 0
+
+    def alive(self) -> bool:
+        return self.state in ("up", "suspect")
+
+    def occupancy(self) -> str:
+        return f"{self.sessions}/{self.max_sessions}"
+
+
+class Director:
+    def __init__(self, *, clock: Optional[Clock] = None, seed: int = 0,
+                 base_dir: str = ".", hb_interval_ms: int = 150,
+                 suspicion_misses: int = 4,
+                 rpc_policy: Optional[RetryPolicy] = None,
+                 place_attempts: int = 3, place_backoff_ms: int = 64,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_ms: int = 2000,
+                 on_wait: Optional[Callable[[], None]] = None):
+        self.clock = clock or Clock()
+        self.seed = seed
+        self.base_dir = base_dir
+        self.hb_interval_ms = hb_interval_ms
+        self.suspicion_misses = suspicion_misses
+        self.rpc_policy = rpc_policy or RetryPolicy(seed=seed)
+        self.place_attempts = place_attempts
+        self.place_backoff_ms = place_backoff_ms
+        self._place_rng_policy = RetryPolicy(
+            base_ms=place_backoff_ms, seed=seed ^ 0x97AC,
+        )
+        self._breaker_kw = dict(
+            threshold=breaker_threshold, cooldown_ms=breaker_cooldown_ms
+        )
+        self.on_wait = on_wait or (lambda: _time.sleep(0.001))
+        # pre-register every fleet instrument (the endpoint convention:
+        # instruments exist from construction, so both exporters carry
+        # the series at zero instead of only after the first fault)
+        heartbeats_missed_total()
+        host_epoch_gauge()
+        rpc_retries_total()
+        fenced_total()
+        failovers_total()
+        failover_ms_histogram()
+        placements_total()
+        fleet_saturated_total()
+        self.hosts: Dict[int, HostRecord] = {}
+        self._next_host_id = 0
+        self._listen = None
+        self._unregistered: List[RpcPeer] = []
+        # match table: mid -> {"spec", "host": int | None,
+        #                      "spread": {peer: host_id} | None, "state"}
+        self.matches: Dict[int, dict] = {}
+        self.failovers: List[dict] = []
+        self.upgrades: List[dict] = []
+        self.matches_lost: List[int] = []
+        # (host_id, match_id) -> first-observed ms: orphan copies
+        # awaiting release (a spawn or import that executed after its
+        # reply timed out, observed via heartbeat reconciliation);
+        # drained by step() after a persistence grace
+        self._orphan_queue: Dict[Tuple[int, int], int] = {}
+        self.orphans_released: List[Tuple[int, int]] = []
+        # while a placement/migration/failover/upgrade is mid-flight
+        # the match table intentionally lags the agents (the adopt
+        # executes before the table re-points): orphan detection is
+        # suspended for the window, or a freshly adopted match would
+        # look like a double-host and get torn down (reentrant
+        # heartbeat processing during blocking calls makes this real)
+        self._table_mutating = 0
+
+    # ------------------------------------------------------------------
+    # transport plumbing
+    # ------------------------------------------------------------------
+
+    def listen(self, addr: Tuple[str, int] = ("127.0.0.1", 0)) -> int:
+        self._listen = listener(addr)
+        return self._listen.getsockname()[1]
+
+    def attach_conn(self, conn: FleetConn) -> None:
+        """Adopt an already-connected control conn (in-process tests use
+        socketpairs; the TCP listener feeds through here too)."""
+        self._unregistered.append(
+            RpcPeer(conn, breaker=CircuitBreaker(**self._breaker_kw))
+        )
+
+    def _accept(self) -> None:
+        if self._listen is None:
+            return
+        while True:
+            try:
+                sock, _addr = self._listen.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            self.attach_conn(FleetConn(sock))
+
+    def step(self) -> None:
+        """One control-plane cycle: accept, pump every conn (register /
+        heartbeats / fencing), then heartbeat-deadline arithmetic and any
+        failover it demands."""
+        now = self.clock.now_ms()
+        self._accept()
+        self._pump_all(now)
+        self._check_deadlines(now)
+        self._release_orphans()
+
+    def _release_orphans(self) -> None:
+        """Tear down orphan match copies heartbeat reconciliation found
+        (double-placement after a timed-out spawn/import executed
+        anyway). Ownership is re-validated at action time after a
+        two-heartbeat persistence grace — the match table is
+        authoritative, the orphan is the non-owner's copy — and nothing
+        fires while a placement/migration/upgrade has the table
+        mid-mutation."""
+        if self._table_mutating or not self._orphan_queue:
+            return
+        now = self.clock.now_ms()
+        for key in list(self._orphan_queue):
+            host_id, mid = key
+            hr = self.hosts.get(host_id)
+            rec = self.matches.get(mid)
+            if (
+                hr is None or not hr.alive() or rec is None
+                or rec.get("host") == host_id or rec.get("spread")
+            ):
+                self._orphan_queue.pop(key, None)
+                continue
+            if now - self._orphan_queue[key] < 2 * self.hb_interval_ms:
+                continue  # must persist across heartbeats, not a blip
+            self._orphan_queue.pop(key, None)
+            try:
+                self.call(hr, "release_match", {"match": mid})
+            except (RpcError, RpcTimeout, CircuitOpen, Fenced):
+                continue  # it will be re-observed on the next heartbeat
+            self.orphans_released.append((host_id, mid))
+            if GLOBAL_TELEMETRY.enabled:
+                GLOBAL_TELEMETRY.record(
+                    "fleet_orphan_released", host=host_id, match=mid,
+                )
+
+    def _pump_all(self, now: Optional[int] = None) -> None:
+        now = self.clock.now_ms() if now is None else now
+        for peer in list(self._unregistered):
+            peer.pump(on_frame=lambda e, b, bl, p=peer: (
+                self._on_register(p, e, b, now)
+            ))
+            if peer.conn.closed:
+                self._unregistered.remove(peer)
+        for hr in self.hosts.values():
+            self._pump_host(hr, now)
+
+    def _pump_host(self, hr: HostRecord, now: int) -> None:
+        hr.peer.conn.flush(now)
+        hr.peer.pump(on_frame=lambda e, b, bl: (
+            self._on_host_call(hr, e, b, bl, now)
+        ))
+        while hr.peer.inbox_calls:
+            e, b, bl = hr.peer.inbox_calls.pop(0)
+            self._on_host_call(hr, e, b, bl, now)
+        # ANY frame is proof of life, not just heartbeats: an agent deep
+        # in a director-issued import/drain cannot heartbeat (single
+        # threaded by design), but its RPC replies arrive on this same
+        # conn — suspecting a host BECAUSE it is busy serving our own
+        # call would be the control plane stalling the data plane
+        if hr.peer.conn.frames_recv > hr._frames_seen:
+            hr._frames_seen = hr.peer.conn.frames_recv
+            if hr.alive():
+                hr.last_hb_ms = now
+                hr.hb_misses = 0
+                if hr.state == "suspect":
+                    hr.state = "up"
+
+    # ------------------------------------------------------------------
+    # agent-originated frames
+    # ------------------------------------------------------------------
+
+    def _on_register(self, peer: RpcPeer, epoch: int, body: dict,
+                     now: int) -> None:
+        if body.get("op") != "register":
+            return  # pre-registration noise
+        host_id = self._next_host_id
+        self._next_host_id += 1
+        hr = HostRecord(
+            host_id, peer, 1, now,
+            pid=body.get("pid"),
+            max_sessions=int(body.get("max_sessions", 0)),
+            label=body.get("label", ""),
+        )
+        peer.label = f"host{host_id}"
+        self.hosts[host_id] = hr
+        if peer in self._unregistered:
+            self._unregistered.remove(peer)
+        host_epoch_gauge().labels(str(host_id)).set(hr.epoch)
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "fleet_agent_registered", host=host_id,
+                pid=body.get("pid", -1), label=body.get("label", ""),
+            )
+        peer.reply(hr.epoch, body["rid"], {
+            "host_id": host_id, "epoch": hr.epoch,
+        }, now_ms=now)
+
+    def _on_host_call(self, hr: HostRecord, epoch: int, body: dict,
+                      blob: bytes, now: int) -> None:
+        rid = body.get("rid")
+        if rid is None:
+            return
+        if epoch != hr.epoch:
+            # THE fence: a zombie incarnation's every write/ack bounces
+            hr.fence_rejections += 1
+            fenced_total().labels(str(hr.host_id)).inc()
+            if GLOBAL_TELEMETRY.enabled:
+                GLOBAL_TELEMETRY.record(
+                    "fleet_fence_rejected", host=hr.host_id,
+                    stale_epoch=epoch, epoch=hr.epoch,
+                    op=body.get("op", ""),
+                )
+            hr.peer.reply(hr.epoch, rid, {
+                "kind": "fenced", "epoch": hr.epoch,
+                "host_id": hr.host_id,
+                "error": f"epoch {epoch} was fenced (current {hr.epoch})",
+            }, ok=False, now_ms=now)
+            return
+        if hr.peer.replay_cached(rid, now):
+            return
+        op = body.get("op", "")
+        if op == "heartbeat":
+            hr.last_hb_ms = now
+            hr.hb_misses = 0
+            if hr.state == "suspect":
+                hr.state = "up"  # it came back before the fence
+            hr.tick = int(body.get("tick", hr.tick))
+            hr.sessions = int(body.get("sessions", hr.sessions))
+            hr.free_slots = int(body.get("free_slots", hr.free_slots))
+            hr.islands = body.get("islands", hr.islands)
+            hr.checkpoint = body.get("checkpoint", hr.checkpoint)
+            hr.desyncs = int(body.get("desyncs", hr.desyncs))
+            # reconcile against the agent's island list — the ground
+            # truth for what it actually hosts
+            reported = {int(m) for m in hr.islands}
+            for mid, rec in self.matches.items():
+                if (
+                    rec["state"] == "suspect-export"
+                    and rec.get("host") == hr.host_id
+                ):
+                    if mid in reported:
+                        # the export never executed: still placed here
+                        rec["state"] = "placed"
+                    else:
+                        # the export DID execute and its reply (the
+                        # only copy of the ticket) was lost: the match
+                        # is gone — record it, don't park it forever
+                        rec["state"] = "lost"
+                        self.matches_lost.append(mid)
+                        if GLOBAL_TELEMETRY.enabled:
+                            GLOBAL_TELEMETRY.record(
+                                "fleet_match_lost", match=mid,
+                                host=hr.host_id, reason="export-reply-lost",
+                            )
+                elif (
+                    not self._table_mutating
+                    and rec["state"] == "placed"
+                    and rec.get("spread") is None
+                    and rec.get("host") != hr.host_id
+                    and mid in reported
+                ):
+                    # an orphan copy: a spawn/import whose reply timed
+                    # out executed anyway after the director placed the
+                    # match elsewhere — schedule a release of THIS
+                    # host's copy (the match table is authoritative)
+                    self._orphan_queue.setdefault((hr.host_id, mid), now)
+            hr.peer.reply(hr.epoch, rid, {}, now_ms=now)
+            return
+        hr.peer.reply(hr.epoch, rid, {
+            "kind": "InvalidRequest", "error": f"unknown director op {op!r}",
+        }, ok=False, now_ms=now)
+
+    # ------------------------------------------------------------------
+    # failure detection: heartbeat deadlines -> suspicion -> fence
+    # ------------------------------------------------------------------
+
+    def _check_deadlines(self, now: int) -> None:
+        for hr in list(self.hosts.values()):
+            if not hr.alive():
+                continue
+            overdue = now - hr.last_hb_ms
+            misses = max(0, overdue // self.hb_interval_ms - 1)
+            if misses > hr.hb_misses:
+                heartbeats_missed_total().labels(str(hr.host_id)).inc(
+                    misses - hr.hb_misses
+                )
+                hr.hb_misses = misses
+                if hr.state == "up" and misses >= 2:
+                    hr.state = "suspect"
+                    if GLOBAL_TELEMETRY.enabled:
+                        GLOBAL_TELEMETRY.record(
+                            "fleet_suspicion", host=hr.host_id,
+                            misses=misses, overdue_ms=overdue,
+                        )
+            if hr.hb_misses >= self.suspicion_misses:
+                self.fail_over(hr.host_id)
+
+    # ------------------------------------------------------------------
+    # RPC plumbing
+    # ------------------------------------------------------------------
+
+    def call(self, hr: HostRecord, op: str,
+             body: Optional[dict] = None, blob: bytes = b"",
+             *, policy: Optional[RetryPolicy] = None) -> tuple:
+        now = self.clock.now_ms()
+        return call(
+            hr.peer, op, body, blob,
+            epoch=hr.epoch,
+            clock=self.clock,
+            policy=policy or self.rpc_policy,
+            on_wait=self.on_wait,
+            pump_others=lambda: self._pump_others(hr),
+        )
+
+    def _pump_others(self, busy: HostRecord) -> None:
+        now = self.clock.now_ms()
+        self._accept()
+        for hr in self.hosts.values():
+            if hr is not busy:
+                self._pump_host(hr, now)
+
+    @contextmanager
+    def _table_mutation(self):
+        """Suspend orphan detection while a placement/migration/
+        failover/upgrade intentionally lets the match table lag the
+        agents (the remote adopt executes before the table re-points;
+        heartbeats processed reentrantly during the blocking call must
+        not read that window as double-hosting)."""
+        self._table_mutating += 1
+        try:
+            yield
+        finally:
+            self._table_mutating -= 1
+
+    # ------------------------------------------------------------------
+    # placement
+    # ------------------------------------------------------------------
+
+    def _placeable(self) -> List[HostRecord]:
+        return sorted(
+            (
+                hr for hr in self.hosts.values()
+                if hr.alive() and not hr.admissions_held
+            ),
+            key=lambda hr: (hr.sessions, hr.host_id),
+        )
+
+    def _occupancy_map(self) -> Dict[str, str]:
+        return {
+            f"host{hid}": (
+                hr.occupancy() if hr.alive() else hr.state
+            )
+            for hid, hr in self.hosts.items()
+        }
+
+    def place_match(self, spec: MatchSpec) -> int:
+        """Occupancy-aware placement with bounded retry and jittered
+        exponential backoff; typed FleetSaturated when the whole fleet
+        rejects. Returns the owning host_id."""
+        with self._table_mutation():
+            return self._place_match_impl(spec)
+
+    def _place_match_impl(self, spec: MatchSpec) -> int:
+        attempts = 0
+        for round_ in range(self.place_attempts):
+            for hr in self._placeable():
+                attempts += 1
+                try:
+                    self.call(hr, "spawn_match", {"spec": spec.to_json()})
+                except RpcError as exc:
+                    if exc.kind == "HostFull":
+                        continue
+                    raise
+                except (RpcTimeout, CircuitOpen):
+                    continue
+                self.matches[spec.match_id] = {
+                    "spec": spec, "host": hr.host_id, "spread": None,
+                    "state": "placed",
+                }
+                hr.sessions += spec.players  # optimistic; hb refreshes
+                placements_total().inc()
+                return hr.host_id
+            if round_ + 1 < self.place_attempts:
+                wake = self.clock.now_ms() + self._place_rng_policy.backoff_ms(
+                    round_
+                )
+                while self.clock.now_ms() < wake:
+                    self._pump_all()
+                    self.on_wait()
+        fleet_saturated_total().inc()
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "fleet_saturated", attempts=attempts,
+                match=spec.match_id,
+            )
+        raise FleetSaturated(
+            f"every agent rejected match {spec.match_id} "
+            f"({self._occupancy_map()})",
+            attempts=attempts, per_host=self._occupancy_map(),
+        )
+
+    def place_spread_match(self, spec: MatchSpec,
+                           assignment: Dict[int, int]) -> None:
+        """Place a udp match with peers split across agents: reserve
+        every peer's port first (each agent binds and reports), then
+        spawn each half with the full port map."""
+        with self._table_mutation():
+            self._place_spread_impl(spec, assignment)
+
+    def _place_spread_impl(self, spec: MatchSpec,
+                           assignment: Dict[int, int]) -> None:
+        if spec.data_plane != "udp":
+            raise InvalidRequest("only udp matches can spread across agents")
+        by_host: Dict[int, List[int]] = {}
+        for peer_idx, hid in assignment.items():
+            by_host.setdefault(hid, []).append(peer_idx)
+        ports: Dict[int, int] = {}
+        for hid, peers in sorted(by_host.items()):
+            body, _ = self.call(self.hosts[hid], "reserve_ports", {
+                "match": spec.match_id, "peers": peers,
+            })
+            for p, port in body["ports"].items():
+                ports[int(p)] = port
+        spec.udp_ports = ports
+        for hid, peers in sorted(by_host.items()):
+            self.call(self.hosts[hid], "spawn_spread", {
+                "spec": spec.to_json(), "peers": peers,
+            })
+            self.hosts[hid].sessions += len(peers)
+        self.matches[spec.match_id] = {
+            "spec": spec, "host": None, "spread": dict(assignment),
+            "state": "placed",
+        }
+        placements_total().inc()
+
+    def release_match(self, match_id: int) -> None:
+        """Tear a match down fleet-wide (every owning half)."""
+        rec = self.matches[match_id]
+        owners = (
+            sorted(set(rec["spread"].values()))
+            if rec.get("spread") else [rec["host"]]
+        )
+        for hid in owners:
+            hr = self.hosts.get(hid)
+            if hr is None or not hr.alive():
+                continue
+            try:
+                self.call(hr, "release_match", {"match": match_id})
+                hr.sessions = max(0, hr.sessions - rec["spec"].players)
+            except (RpcError, RpcTimeout, CircuitOpen):
+                pass  # a dead owner's slots die with it
+        rec["state"] = "released"
+
+    # ------------------------------------------------------------------
+    # cross-process migration (with crash rollback)
+    # ------------------------------------------------------------------
+
+    def migrate_match(self, match_id: int, dst_host_id: int) -> None:
+        """Live cross-host migration: export (detaches at the source) →
+        import at the destination. A destination that dies mid-migration
+        must not cost the session: the ticket re-imports into the SOURCE
+        (the cross-process extension of migrate_session's rollback), and
+        if even that fails the ticket is persisted for operator replay
+        before the error surfaces."""
+        with self._table_mutation():
+            self._migrate_match_impl(match_id, dst_host_id)
+
+    def _migrate_match_impl(self, match_id: int, dst_host_id: int) -> None:
+        rec = self.matches[match_id]
+        if rec.get("spread"):
+            raise InvalidRequest(f"match {match_id} is spread; cannot migrate")
+        src = self.hosts[rec["host"]]
+        dst = self.hosts[dst_host_id]
+        try:
+            _, blob = self.call(src, "export_match", {"match": match_id})
+        except (RpcTimeout, CircuitOpen):
+            # ambiguous: the agent may or may not have detached before
+            # the replies were lost. Its next heartbeat reconciles (the
+            # island list is ground truth); until then the match is
+            # suspect, not schedulable
+            rec["state"] = "suspect-export"
+            raise
+        try:
+            self.call(dst, "import", blob=blob)
+        except BaseException as exc:
+            try:
+                self.call(src, "import", blob=blob)
+                rec["state"] = "placed"  # rolled back onto the source
+            except BaseException:
+                orphan = os.path.join(
+                    self.base_dir, f"orphan_m{match_id}.ckpt"
+                )
+                from ..utils.checkpoint import atomic_write_bytes
+
+                atomic_write_bytes(orphan, blob)
+                rec["state"] = "orphaned"
+                rec["orphan_path"] = orphan
+                raise RpcTimeout(
+                    f"migration of match {match_id} failed and the source "
+                    f"rollback failed too; ticket persisted at {orphan}",
+                    peer=dst.peer.label, op="import",
+                ) from exc
+            raise
+        rec["host"] = dst_host_id  # occupancy: next heartbeats refresh
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "fleet_match_migrated", match=match_id,
+                src=src.host_id, dst=dst_host_id,
+            )
+
+    # ------------------------------------------------------------------
+    # fenced failover
+    # ------------------------------------------------------------------
+
+    def fence(self, host_id: int) -> int:
+        """Bump the host's epoch — the point of no return for its old
+        incarnation — and mark it dead. Returns the FENCED epoch."""
+        hr = self.hosts[host_id]
+        old = hr.epoch
+        hr.epoch += 1
+        hr.state = "dead"
+        host_epoch_gauge().labels(str(host_id)).set(hr.epoch)
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "fleet_fenced", host=host_id, old_epoch=old,
+                epoch=hr.epoch,
+            )
+        return old
+
+    def _seize_checkpoint(self, hr: HostRecord,
+                          fenced_epoch: int) -> Tuple[Optional[bytes], dict]:
+        """Read the fenced host's last checkpoint NOW — before any
+        zombie can rewrite it — and validate its stamped (host, epoch)
+        against the incarnation we just fenced."""
+        cp = hr.checkpoint
+        if not cp or not cp.get("path"):
+            return None, {}
+        try:
+            with open(cp["path"], "rb") as f:
+                blob = f.read()
+            header = peek_ticket(blob)
+        except (OSError, CheckpointIncompatible) as exc:
+            if GLOBAL_TELEMETRY.enabled:
+                GLOBAL_TELEMETRY.record(
+                    "fleet_checkpoint_unreadable", host=hr.host_id,
+                    error=type(exc).__name__,
+                )
+            return None, {}
+        meta = header.get("meta", {})
+        if meta.get("host_id") != hr.host_id or meta.get("epoch") != fenced_epoch:
+            if GLOBAL_TELEMETRY.enabled:
+                GLOBAL_TELEMETRY.record(
+                    "fleet_checkpoint_rejected", host=hr.host_id,
+                    expected_epoch=fenced_epoch,
+                    found_epoch=meta.get("epoch", -1),
+                )
+            return None, {}
+        return blob, meta
+
+    def fail_over(self, host_id: int) -> dict:
+        """Fence the host, seize its checkpoint, restore its co-located
+        matches on the least-loaded survivor (falling through survivors
+        on failure), re-point the match table. Spread halves and
+        checkpoint-less matches are recorded lost."""
+        with self._table_mutation():
+            return self._fail_over_impl(host_id)
+
+    def _fail_over_impl(self, host_id: int) -> dict:
+        hr = self.hosts[host_id]
+        t0 = self.clock.now_ms()
+        fenced_epoch = self.fence(host_id)
+        blob, meta = self._seize_checkpoint(hr, fenced_epoch)
+        owned = [
+            mid for mid, rec in self.matches.items()
+            if rec.get("host") == host_id and rec["state"] == "placed"
+        ]
+        record: dict = {
+            "host": host_id, "fenced_epoch": fenced_epoch,
+            "matches": owned, "checkpoint_tick": meta.get("tick"),
+            "checkpoint_frames": meta.get("frames", {}),
+            "restored_on": None, "restored": {}, "lost": [],
+        }
+        restored_ids: List[int] = []
+        if blob is not None:
+            for survivor in self._placeable():
+                try:
+                    body, _ = self.call(survivor, "import", blob=blob)
+                except (RpcError, RpcTimeout, CircuitOpen):
+                    continue
+                record["restored_on"] = survivor.host_id
+                record["restored"] = body.get("adopted", {})
+                restored_ids = [int(m) for m in record["restored"]]
+                for mid in restored_ids:
+                    if mid in self.matches:
+                        self.matches[mid]["host"] = survivor.host_id
+                # occupancy refreshes from the survivor's next heartbeat
+                # (a manual bump here double-counts whenever an import-
+                # era heartbeat already landed during the call)
+                break
+        for mid in owned:
+            if mid not in restored_ids:
+                self.matches[mid]["state"] = "lost"
+                self.matches_lost.append(mid)
+                record["lost"].append(mid)
+        for mid, rec in self.matches.items():
+            spread = rec.get("spread")
+            if spread and host_id in spread.values() and rec["state"] == "placed":
+                rec["state"] = "lost"  # the sibling half cannot rewind
+                self.matches_lost.append(mid)
+                record["lost"].append(mid)
+        latency = self.clock.now_ms() - t0
+        record["latency_ms"] = latency
+        failovers_total().inc()
+        failover_ms_histogram().observe(latency)
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "fleet_failover", host=host_id,
+                restored_on=(
+                    record["restored_on"]
+                    if record["restored_on"] is not None else -1
+                ),
+                matches=len(owned), lost=len(record["lost"]),
+                latency_ms=latency,
+            )
+        self.failovers.append(record)
+        return record
+
+    # ------------------------------------------------------------------
+    # rolling upgrade
+    # ------------------------------------------------------------------
+
+    def rolling_upgrade(
+        self,
+        spawn: Callable[[int], Any],
+        *,
+        register_timeout_ms: int = 30_000,
+        drain_policy: Optional[RetryPolicy] = None,
+    ) -> List[dict]:
+        """Drain → respawn → re-adopt, ONE host at a time; admissions
+        held for the draining host only. `spawn(old_host_id)` launches
+        the replacement process (or attaches a fresh in-process
+        AgentCore) — the director waits for its registration before
+        importing the drained ticket, then moves to the next host."""
+        results = []
+        for host_id in sorted(
+            hid for hid, hr in self.hosts.items() if hr.alive()
+        ):
+            results.append(self._upgrade_one(host_id, spawn,
+                                             register_timeout_ms,
+                                             drain_policy))
+        return results
+
+    def _upgrade_one(self, host_id: int, spawn, register_timeout_ms,
+                     drain_policy) -> dict:
+        with self._table_mutation():
+            hr = self.hosts[host_id]
+            hr.admissions_held = True
+            try:
+                return self._upgrade_one_held(
+                    hr, host_id, spawn, register_timeout_ms, drain_policy
+                )
+            finally:
+                # never leak the hold: on failure the host (if still
+                # alive) must rejoin placement, not sit idle forever
+                hr.admissions_held = False
+
+    def _upgrade_one_held(self, hr, host_id, spawn, register_timeout_ms,
+                          drain_policy) -> dict:
+        before = {hid for hid in self.hosts}
+        body, blob = self.call(
+            hr, "drain",
+            policy=drain_policy or RetryPolicy(
+                attempts=2, timeout_ms=max(
+                    4 * self.rpc_policy.timeout_ms, 2000
+                ),
+                seed=self.seed ^ host_id,
+            ),
+        )
+        hr.state = "drained"
+        hr.sessions = 0
+        try:
+            spawn(host_id)
+            replacement = self._await_registration(
+                before, register_timeout_ms
+            )
+            self.call(replacement, "import", blob=blob)
+        except BaseException:
+            # the drained agent already exited: `blob` is the ONLY copy
+            # of its sessions. A failed respawn/import must persist it
+            # for operator replay (the migration rollback's discipline),
+            # never let it die with this stack frame
+            from ..utils.checkpoint import atomic_write_bytes
+
+            rescue = os.path.join(
+                self.base_dir, f"upgrade_host{host_id}.ckpt"
+            )
+            atomic_write_bytes(rescue, blob)
+            for mid, rec in self.matches.items():
+                if rec.get("host") == host_id and rec["state"] == "placed":
+                    rec["state"] = "orphaned"
+                    rec["orphan_path"] = rescue
+            if GLOBAL_TELEMETRY.enabled:
+                GLOBAL_TELEMETRY.record(
+                    "fleet_upgrade_ticket_rescued", host=host_id,
+                    path=rescue,
+                )
+            raise
+        moved = [
+            mid for mid, rec in self.matches.items()
+            if rec.get("host") == host_id and rec["state"] == "placed"
+        ]
+        for mid in moved:
+            self.matches[mid]["host"] = replacement.host_id
+        entry = {
+            "old_host": host_id, "new_host": replacement.host_id,
+            "matches": moved, "exported": body.get("exported", 0),
+        }
+        self.upgrades.append(entry)
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "fleet_rolling_upgrade", old_host=host_id,
+                new_host=replacement.host_id, matches=len(moved),
+            )
+        return entry
+
+    def _await_registration(self, before: set,
+                            timeout_ms: int) -> HostRecord:
+        deadline = self.clock.now_ms() + timeout_ms
+        while self.clock.now_ms() < deadline:
+            self.step()
+            for hid, hr in self.hosts.items():
+                if hid not in before and hr.alive():
+                    return hr
+            self.on_wait()
+        raise RpcTimeout(
+            "replacement agent never registered",
+            op="register", attempts=1,
+        )
+
+    # ------------------------------------------------------------------
+    # chaos levers + reporting
+    # ------------------------------------------------------------------
+
+    def sigkill(self, host_id: int) -> None:
+        """Kill the agent PROCESS outright (no drain, no goodbye): the
+        failure detector does the rest. Real violence, not simulation."""
+        pid = self.hosts[host_id].pid
+        assert pid, "agent registered without a pid"
+        os.kill(pid, signal.SIGKILL)
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "fleet_sigkill", host=host_id, pid=pid
+            )
+
+    def inject_partition(self, host_id: int, duration_ms: int) -> None:
+        """Partition the control socket both ways for `duration_ms`:
+        the agent goes dark on control (told first, then silence) while
+        its data plane keeps ticking. The director side drops too."""
+        hr = self.hosts[host_id]
+        self.call(hr, "partition", {"ms": duration_ms})
+        hr.peer.conn.partitioned = True
+        self._partition_heal_at = getattr(self, "_partition_heal_at", {})
+        self._partition_heal_at[host_id] = (
+            self.clock.now_ms() + duration_ms
+        )
+
+    def heal_partitions(self) -> None:
+        """Called from the drive loop: lift director-side partitions
+        whose duration elapsed (the agent lifts its own side)."""
+        heals = getattr(self, "_partition_heal_at", {})
+        now = self.clock.now_ms()
+        for host_id, at in list(heals.items()):
+            if now >= at:
+                self.hosts[host_id].peer.conn.partitioned = False
+                heals.pop(host_id)
+
+    def inject_rpc_delay(self, host_id: int, delay_ms: int) -> None:
+        """Hold director→agent frames for `delay_ms` (released by the
+        conn's own flush once the time passes): delayed RPCs, the retry
+        ladder's food."""
+        conn = self.hosts[host_id].peer.conn
+        conn.hold_until_ms = self.clock.now_ms() + delay_ms
+
+    def inject_rpc_dup(self, host_id: int, copies: int = 1) -> None:
+        """Duplicate the next director→agent frame `copies` extra times
+        (the reply cache on the agent absorbs them)."""
+        self.hosts[host_id].peer.conn.dup_next = copies
+
+    def collect_reports(self, *, digests: bool = True) -> Dict[int, dict]:
+        out = {}
+        for hid, hr in self.hosts.items():
+            if not hr.alive():
+                continue
+            try:
+                body, _ = self.call(hr, "report", {"digests": digests})
+            except (RpcError, RpcTimeout, CircuitOpen):
+                # a host that died between the last deadline check and
+                # this sweep: the detector will fence it on the next
+                # step; a report sweep must not die with it
+                continue
+            out[hid] = body
+        return out
+
+    def shutdown_fleet(self) -> None:
+        for hr in self.hosts.values():
+            if hr.alive():
+                try:
+                    self.call(hr, "shutdown", policy=RetryPolicy(
+                        attempts=1, timeout_ms=self.rpc_policy.timeout_ms,
+                        seed=self.seed,
+                    ))
+                except (RpcError, RpcTimeout, CircuitOpen, Fenced):
+                    pass
+                hr.state = "dead"
+
+    def section(self) -> dict:
+        return {
+            "hosts": {
+                str(hid): {
+                    "state": hr.state, "epoch": hr.epoch,
+                    "sessions": hr.sessions, "tick": hr.tick,
+                    "hb_misses": hr.hb_misses,
+                    "fence_rejections": hr.fence_rejections,
+                    "desyncs": hr.desyncs,
+                }
+                for hid, hr in self.hosts.items()
+            },
+            "matches": {
+                str(mid): {
+                    "host": rec.get("host"), "state": rec["state"],
+                    "spread": rec.get("spread") is not None,
+                }
+                for mid, rec in self.matches.items()
+            },
+            "failovers": len(self.failovers),
+            "upgrades": len(self.upgrades),
+            "lost": sorted(set(self.matches_lost)),
+        }

@@ -1,0 +1,442 @@
+"""Match islands: the fleet's placement unit, and the single-process
+twin the chaos soaks compare against.
+
+An **island** is one whole match — every peer session, their private
+virtual network (seeded `InMemoryNetwork`, optionally WAN-shaped) and
+their private `FakeClock` — co-located on one agent. Co-location is the
+invariant that makes fenced recovery exact: a checkpoint pickles the
+whole island as ONE object graph (sessions, input queues, endpoint
+reliability state, in-flight datagrams, rng state), so a restore rewinds
+every peer of the match TOGETHER to the same instant and the replay is a
+pure function of (pickled state, scripts) — bit-identical to the run the
+SIGKILL interrupted. Tearing a match across processes would leave
+acks/retransmission state referencing a peer that rewound without it
+(the classic wedge rollback netcode cannot recover from).
+
+The exception is the `udp` data plane: peers talk through REAL loopback
+UDP sockets (`ReboundUdpSocket`, picklable by port). Those matches can
+span agents — the chaos harness uses one to prove the data plane keeps
+flowing while the control socket is partitioned — but they trade away
+determinism (kernel timing) and, when spread, kill-recovery (the
+surviving half cannot rewind). UDP port exclusivity doubles as the
+data-plane fence on one machine: a zombie still bound to the port makes
+the restored copy's bind fail loudly instead of double-hosting.
+
+Every arm — fleet agents AND the in-process twin — drives islands
+through the SAME `step_islands` loop, so "bitwise parity vs a
+single-process twin" compares two executions of identical code under
+identical virtual time, differing only in which process ran them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..errors import InvalidRequest
+from ..network.sockets import InMemoryNetwork, UdpNonBlockingSocket
+from ..sessions.builder import SessionBuilder
+from ..types import DesyncDetection, PlayerType, SessionState
+from ..utils.clock import FakeClock
+
+FRAME_MS = 16
+
+
+@dataclass
+class MatchSpec:
+    """Everything needed to build one match identically anywhere:
+    the twin rebuilds from the same spec the director placed."""
+
+    match_id: int
+    players: int = 2
+    ticks: int = 120
+    seed: int = 0
+    entities: int = 8  # informational; the game is fleet-wide
+    data_plane: str = "mem"  # "mem" (deterministic) | "udp" (real sockets)
+    wan: Optional[Dict[str, Any]] = None  # WanProfile kwargs (mem only)
+    max_prediction: int = 8
+    input_delay: int = 1
+    desync_interval: int = 10
+    # udp spread matches: peer index -> ("127.0.0.1", port); filled by
+    # the director's port-reservation pass, None for co-located matches
+    udp_ports: Optional[Dict[int, int]] = None
+
+    def to_json(self) -> dict:
+        d = {k: getattr(self, k) for k in (
+            "match_id", "players", "ticks", "seed", "entities",
+            "data_plane", "wan", "max_prediction", "input_delay",
+            "desync_interval",
+        )}
+        if self.udp_ports is not None:
+            d["udp_ports"] = {str(k): v for k, v in self.udp_ports.items()}
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "MatchSpec":
+        d = dict(d)
+        ports = d.pop("udp_ports", None)
+        if ports is not None:
+            ports = {int(k): v for k, v in ports.items()}
+        return cls(udp_ports=ports, **d)
+
+
+class ReboundUdpSocket:
+    """A UDP loopback socket that survives a cross-process hop: pickles
+    as its PORT, rebinds lazily in the adopting process. The bind raises
+    EADDRINUSE if the previous owner still lives — on one machine that
+    exclusivity IS the data-plane fence: a zombie host cannot be
+    double-hosted because the kernel refuses the second bind."""
+
+    def __init__(self, port: int = 0):
+        self._sock = UdpNonBlockingSocket(port)
+        self.port = self._sock.local_port
+
+    def _ensure(self) -> UdpNonBlockingSocket:
+        if self._sock is None:
+            self._sock = UdpNonBlockingSocket(self.port)
+        return self._sock
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return ("127.0.0.1", self.port)
+
+    def send_to(self, msg, addr) -> None:
+        self._ensure().send_to(msg, addr)
+
+    def send_wire(self, wire: bytes, addr) -> None:
+        self._ensure().send_wire(wire, addr)
+
+    def send_wire_batch(self, batch) -> None:
+        self._ensure().send_wire_batch(batch)
+
+    def receive_all_wire(self):
+        return self._ensure().receive_all_wire()
+
+    def receive_all_messages(self):
+        return self._ensure().receive_all_messages()
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def __getstate__(self):
+        return {"port": self.port}
+
+    def __setstate__(self, state):
+        self.port = state["port"]
+        self._sock = None  # rebound on first use in the new process
+
+
+def _island_scripts(spec: MatchSpec) -> Dict[int, List[int]]:
+    """Deterministic per-(peer, tick) input scripts from the spec seed —
+    the same derivation in every process, which is what lets the twin
+    replay identical traffic."""
+    rng = random.Random(spec.seed ^ (spec.match_id * 0x9E37) ^ 0x5EED)
+    return {
+        k: [rng.randrange(0, 16) for _ in range(spec.ticks)]
+        for k in range(spec.players)
+    }
+
+
+class MatchIsland:
+    """One match's sessions + network + clock + drive cursor. `peers`
+    maps peer index -> session for the peers THIS island instance hosts
+    (all of them for co-located matches; a subset for a spread udp
+    match). `keys` maps peer index -> host key once attached."""
+
+    COOLDOWN_FACTOR = 3  # cooldown ticks = factor * max_prediction
+
+    def __init__(self, spec: MatchSpec, clock: FakeClock,
+                 net: Optional[InMemoryNetwork], peers: Dict[int, Any],
+                 sockets: Dict[int, Any]):
+        self.spec = spec
+        self.clock = clock
+        self.net = net
+        self.peers = peers
+        self.sockets = sockets
+        self.keys: Dict[int, Any] = {}
+        self.scripts = _island_scripts(spec)
+        self.cursor = 0
+        self.cooldown = 0
+        self.synced = False
+        self.done = False
+        self.failed = False  # a lane vanished under us; quarantined
+        self.desyncs = 0
+        self.sync_steps = 0
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def build(cls, spec: MatchSpec, *,
+              local_peers: Optional[List[int]] = None,
+              reserved: Optional[Dict[int, "ReboundUdpSocket"]] = None,
+              ) -> "MatchIsland":
+        """Build the island's sessions (not yet attached to a host).
+        `local_peers` restricts construction to a subset for spread udp
+        matches; co-located islands build every peer. `reserved` hands
+        in pre-bound udp sockets from the director's port-reservation
+        pass (every half must know every port before any half builds)."""
+        local = sorted(local_peers) if local_peers is not None else list(
+            range(spec.players)
+        )
+        clock = FakeClock()
+        net = None
+        sockets: Dict[int, Any] = {}
+        addr_of: Dict[int, Any] = {}
+        if spec.data_plane == "mem":
+            if local != list(range(spec.players)):
+                raise InvalidRequest(
+                    "mem-plane matches are co-located by contract "
+                    "(kill-recovery rewinds the whole match together)"
+                )
+            profile = None
+            if spec.wan is not None:
+                from ..serve.chaos import WanProfile
+
+                profile = WanProfile(**{"seed": spec.seed, **spec.wan})
+            net = InMemoryNetwork(clock, seed=spec.seed, profile=profile)
+            for k in local:
+                addr_of[k] = ("m", spec.match_id, k)
+                sockets[k] = net.socket(addr_of[k])
+        elif spec.data_plane == "udp":
+            ports = dict(spec.udp_ports or {})
+            for k in local:
+                if reserved is not None and k in reserved:
+                    sockets[k] = reserved[k]
+                else:
+                    sockets[k] = ReboundUdpSocket(ports.get(k, 0))
+                ports[k] = sockets[k].port
+            if len(ports) < spec.players:
+                raise InvalidRequest(
+                    "spread udp match is missing peer ports: reserve "
+                    "every peer's port before building any half"
+                )
+            spec.udp_ports = ports
+            for k in range(spec.players):
+                addr_of[k] = ("127.0.0.1", ports[k])
+        else:
+            raise InvalidRequest(f"unknown data plane {spec.data_plane!r}")
+
+        peers: Dict[int, Any] = {}
+        for k in local:
+            b = (
+                SessionBuilder(input_size=1)
+                .with_num_players(spec.players)
+                .with_max_prediction_window(spec.max_prediction)
+                .with_input_delay(spec.input_delay)
+                .with_desync_detection_mode(
+                    DesyncDetection.on(interval=spec.desync_interval)
+                )
+                .with_clock(clock)
+                .with_rng(random.Random(
+                    (spec.seed * 7919 + spec.match_id * 131 + k) & 0xFFFF
+                ))
+            )
+            if spec.data_plane == "udp":
+                # a spread match's halves live in different processes
+                # that pace independently; generous protocol timers so a
+                # sibling's GC pause cannot masquerade as a disconnect
+                b = b.with_disconnect_timeout(20_000)
+            for h in range(spec.players):
+                if h == k:
+                    b = b.add_player(PlayerType.local(), h)
+                else:
+                    b = b.add_player(PlayerType.remote(addr_of[h]), h)
+            peers[k] = b.start_p2p_session(sockets[k])
+        return cls(spec, clock, net, peers, sockets)
+
+    def attach(self, host) -> None:
+        for k, session in sorted(self.peers.items()):
+            self.keys[k] = host.attach(session)
+
+    def adopt(self, host, lanes: Dict[int, dict],
+              slot_state: Dict[int, Any]) -> None:
+        """Re-admit every peer mid-match (the receiving half of a wire
+        ticket import): udp sockets rebind FIRST, so a double-hosting
+        attempt dies before any slot is claimed. The keys pickled into
+        the ticket are the SOURCE host's and mean nothing here — they
+        are discarded up front, so a partial-failure rollback can only
+        ever touch lanes adopted by THIS attempt (a stale key that
+        happens to collide with an unrelated local lane must never get
+        it detached)."""
+        for sock in self.sockets.values():
+            if isinstance(sock, ReboundUdpSocket):
+                sock._ensure()
+        self.keys = {}
+        for k, session in sorted(self.peers.items()):
+            meta = lanes[k]
+            self.keys[k] = host.adopt(
+                session,
+                current_frame=meta["current_frame"],
+                slot_state=slot_state[k],
+                pending_inputs=meta["pending_inputs"],
+            )
+
+    # ------------------------------------------------------------------
+    # driving (the ONE loop both the agents and the twin run)
+    # ------------------------------------------------------------------
+
+    def stage_inputs(self, host) -> None:
+        """One island tick's host-side half: check sync, submit scripted
+        inputs, advance the island cursor. The host tick itself happens
+        once per agent step, AFTER every island staged (step_islands)."""
+        if self.done or self.failed:
+            return
+        if any(k not in host._lanes for k in self.keys.values()):
+            # a lane vanished (evicted / detached behind our back):
+            # quarantine THIS island — one sick match must never crash
+            # the agent serving the rest of the fleet
+            self.failed = True
+            for key in self.keys.values():
+                if key in host._lanes:
+                    host.detach(key)
+            self.keys = {}  # no longer hosted: checkpoints skip it
+            return
+        if not self.synced:
+            self.sync_steps += 1
+            if all(
+                s.current_state() == SessionState.RUNNING
+                for s in self.peers.values()
+            ):
+                self.synced = True
+            else:
+                return
+        if self.cursor < self.spec.ticks:
+            for k, key in self.keys.items():
+                host.submit_input(
+                    key, k, bytes([self.scripts[k][self.cursor]])
+                )
+            self.cursor += 1
+        else:
+            # cooldown: let in-flight inputs and checksum reports land
+            # so the final comparison intervals actually run
+            self.cooldown += 1
+            if self.cooldown >= self.COOLDOWN_FACTOR * self.spec.max_prediction:
+                self.done = True
+
+    def advance_clock(self) -> None:
+        self.clock.advance(FRAME_MS)
+
+    # ------------------------------------------------------------------
+    # reporting / parity surfaces
+    # ------------------------------------------------------------------
+
+    def frames(self) -> Dict[int, int]:
+        return {k: s.current_frame for k, s in self.peers.items()}
+
+    def histories(self) -> Dict[int, Dict[int, int]]:
+        return {
+            k: dict(s.local_checksum_history)
+            for k, s in self.peers.items()
+        }
+
+    def state_digest(self, host) -> Dict[int, str]:
+        """Per-peer sha256 over the slot's canonical device residue
+        (world + snapshot ring, sorted leaf order) — the cross-process
+        'bitwise state parity' witness."""
+        import jax
+
+        out = {}
+        for k, key in sorted(self.keys.items()):
+            lane = host._lanes[key]
+            payload = host.device.export_slot(lane.slot)
+            h = hashlib.sha256()
+            for name in ("ring", "state"):
+                leaves = jax.tree_util.tree_leaves_with_path(payload[name])
+                for path, leaf in sorted(
+                    leaves, key=lambda pl: jax.tree_util.keystr(pl[0])
+                ):
+                    h.update(leaf.tobytes())
+            out[k] = h.hexdigest()
+        return out
+
+    def section(self) -> dict:
+        """JSON-able heartbeat/report entry."""
+        return {
+            "cursor": self.cursor,
+            "synced": self.synced,
+            "done": self.done,
+            "failed": self.failed,
+            "desyncs": self.desyncs,
+            "frames": {str(k): v for k, v in self.frames().items()},
+        }
+
+
+def step_islands(host, islands: List[MatchIsland]) -> int:
+    """One fleet step: every island stages its scripted inputs, ONE host
+    tick megabatches the lot, island clocks advance one frame, desync
+    events route back to their islands. Returns desyncs observed this
+    step. THE shared drive loop — agents and the single-process twin
+    call exactly this, which is what makes twin parity an apples-to-
+    apples comparison."""
+    key_to_island = {}
+    for island in islands:
+        for key in island.keys.values():
+            key_to_island[key] = island
+        island.stage_inputs(host)
+    events = host.tick()
+    desyncs = 0
+    for key, evs in events.items():
+        island = key_to_island.get(key)
+        if island is None:
+            continue
+        for e in evs:
+            if type(e).__name__ == "DesyncDetected":
+                island.desyncs += 1
+                desyncs += 1
+    for island in islands:
+        island.advance_clock()
+    return desyncs
+
+
+def make_game(players: int = 4, entities: int = 8):
+    from ..models.ex_game import ExGame
+
+    return ExGame(num_players=players, num_entities=entities)
+
+
+def run_twin(specs: List[MatchSpec], *, host=None, max_steps: int = 20_000,
+             game=None) -> Dict[int, MatchIsland]:
+    """The single-process reference arm: build every spec's island
+    locally, drive them through step_islands until all are done, return
+    the islands for parity comparison. Only mem-plane (deterministic)
+    specs participate — a udp spec's kernel timing is not replayable."""
+    from ..serve.host import SessionHost
+    from ..utils.clock import FakeClock as _FC
+
+    specs = [s for s in specs if s.data_plane == "mem"]
+    if game is None:
+        game = make_game(
+            players=max((s.players for s in specs), default=2),
+            entities=max((s.entities for s in specs), default=8),
+        )
+    if host is None:
+        host = SessionHost(
+            game,
+            max_prediction=max(s.max_prediction for s in specs),
+            num_players=max(s.players for s in specs),
+            max_sessions=sum(s.players for s in specs),
+            clock=_FC(),
+            idle_timeout_ms=0,
+        )
+    islands = {}
+    for spec in specs:
+        island = MatchIsland.build(spec)
+        island.attach(host)
+        islands[spec.match_id] = island
+    todo = list(islands.values())
+    for _ in range(max_steps):
+        if all(i.done for i in todo):
+            break
+        step_islands(host, todo)
+        host.clock.advance(FRAME_MS)
+    else:
+        raise AssertionError("twin islands failed to finish")
+    for island in islands.values():
+        island._twin_host = host  # digest access for the comparator
+    return islands

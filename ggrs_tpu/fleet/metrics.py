@@ -1,0 +1,70 @@
+"""Fleet control-plane instruments: get-or-create helpers, one
+definition each, shared by the director, the RPC layer and the
+smoke/soak gates that assert on them (the serve/migrate.py pattern).
+All registry-driven, so both exporters and telemetry snapshots carry
+them with no extra wiring.
+"""
+
+from __future__ import annotations
+
+from ..obs import GLOBAL_TELEMETRY, LOG2_BUCKETS_MS
+
+
+def heartbeats_missed_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_fleet_heartbeats_missed_total",
+        "heartbeat deadlines a host crossed without reporting",
+        ("host",),
+    )
+
+
+def host_epoch_gauge():
+    return GLOBAL_TELEMETRY.registry.gauge(
+        "ggrs_fleet_host_epoch",
+        "current fencing epoch per host (bumped on every fence)",
+        ("host",),
+    )
+
+
+def rpc_retries_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_fleet_rpc_retries_total",
+        "control-plane RPC attempts past the first (timeout -> backoff -> retry)",
+    )
+
+
+def fenced_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_fleet_fenced_total",
+        "control frames rejected for carrying a stale host epoch",
+        ("host",),
+    )
+
+
+def failovers_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_fleet_failovers_total",
+        "fenced recoveries: a suspected host's sessions re-placed on a sibling",
+    )
+
+
+def failover_ms_histogram():
+    return GLOBAL_TELEMETRY.registry.histogram(
+        "ggrs_fleet_failover_ms",
+        "suspicion-confirmed to restore-acknowledged, per failover",
+        buckets=LOG2_BUCKETS_MS,
+    )
+
+
+def placements_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_fleet_placements_total",
+        "match islands the director placed onto agents",
+    )
+
+
+def fleet_saturated_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_fleet_saturated_total",
+        "placements the whole fleet rejected after retry/backoff",
+    )

@@ -1,0 +1,244 @@
+"""Control-plane RPC discipline: timeout → jittered-backoff retry →
+per-peer circuit breaker.
+
+Every director↔agent exchange is a CALL frame carrying a request id and
+a REPLY echoing it (both directions share one duplex connection, so a
+reply can interleave with the peer's own calls — the caller parks
+non-matching frames in the peer's inbox instead of dropping them).
+`call()` is the one way to issue a blocking RPC: per-attempt timeout,
+exponential backoff with SEEDED jitter between attempts (a fleet of
+synchronized retry timers is a retry storm; the seed keeps soak runs
+reproducible), a typed `RpcTimeout` when the schedule runs out, and a
+per-peer `CircuitBreaker` so a dead agent costs one fast `CircuitOpen`
+instead of a full retry ladder per call — with a half-open trial after
+the cooldown deciding whether to close it again.
+
+Duplicate CALL frames (the chaos harness injects them; a real network
+can too) are absorbed by the callee's reply cache: a rid it already
+served is answered with the CACHED reply, never re-executed — the
+idempotency half of at-least-once delivery.
+"""
+
+from __future__ import annotations
+
+import random
+import time as _time
+from typing import Any, Callable, Dict, Optional
+
+from ..errors import CircuitOpen, Fenced, RpcTimeout
+from ..obs import GLOBAL_TELEMETRY
+from ..utils.clock import Clock
+from .metrics import rpc_retries_total
+from .wire import FRAME_CALL, FRAME_REPLY, FleetConn
+
+
+class RetryPolicy:
+    """Deterministic jittered-exponential schedule: attempt i backs off
+    uniform over [base<<i / 2, base<<i], capped at `max_ms` — drawn from
+    a seeded rng in call order, so a unit test can pin the exact
+    schedule a seed produces."""
+
+    def __init__(self, *, attempts: int = 4, timeout_ms: int = 400,
+                 base_ms: int = 50, max_ms: int = 2000, seed: int = 0):
+        assert attempts >= 1
+        self.attempts = attempts
+        self.timeout_ms = timeout_ms
+        self.base_ms = base_ms
+        self.max_ms = max_ms
+        self._rng = random.Random(seed ^ 0x59C1E7)
+
+    def backoff_ms(self, attempt: int) -> int:
+        base = min(self.base_ms << attempt, self.max_ms)
+        return self._rng.randrange(base // 2, base + 1)
+
+
+class CircuitBreaker:
+    """Per-peer failure gate: `threshold` consecutive failures open it
+    for `cooldown_ms`; after the cooldown ONE call is let through
+    (half-open) — its outcome closes or re-opens the circuit."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, *, threshold: int = 3, cooldown_ms: int = 2000):
+        self.threshold = threshold
+        self.cooldown_ms = cooldown_ms
+        self.state = self.CLOSED
+        self.failures = 0
+        self.open_until_ms = 0
+
+    def allow(self, now_ms: int) -> bool:
+        if self.state == self.OPEN:
+            if now_ms >= self.open_until_ms:
+                self.state = self.HALF_OPEN  # one trial
+                return True
+            return False
+        return True  # CLOSED or HALF_OPEN (the trial is in flight)
+
+    def record_success(self) -> None:
+        self.state = self.CLOSED
+        self.failures = 0
+
+    def record_failure(self, now_ms: int) -> None:
+        self.failures += 1
+        if self.state == self.HALF_OPEN or self.failures >= self.threshold:
+            self.state = self.OPEN
+            self.open_until_ms = now_ms + self.cooldown_ms
+
+
+class RpcError(Exception):
+    """A structured error REPLY from the peer: `kind` names the remote
+    exception type (HostFull, InvalidRequest, ...) so callers route on
+    it without string-matching messages."""
+
+    def __init__(self, kind: str, info: str):
+        super().__init__(f"{kind}: {info}")
+        self.kind = kind
+        self.info = info
+
+
+class RpcPeer:
+    """One peer's RPC state: the framed conn, the breaker, the reply
+    inbox, and the queue of the PEER's own calls that arrived while we
+    were waiting for a reply (pumped by the owner, never dropped)."""
+
+    def __init__(self, conn: FleetConn, *, breaker: Optional[CircuitBreaker] = None,
+                 label: Any = None):
+        self.conn = conn
+        self.breaker = breaker or CircuitBreaker()
+        self.label = label
+        self.replies: Dict[int, tuple] = {}
+        self.inbox_calls: list = []  # (epoch, body, blob) pending dispatch
+        self._next_rid = 1
+        # served-reply cache: duplicate CALLs re-send the cached reply
+        # instead of re-executing (idempotency under at-least-once)
+        self._reply_cache: Dict[int, tuple] = {}
+        self._reply_cache_order: list = []
+        self.reply_cache_hits = 0
+
+    def next_rid(self) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        return rid
+
+    def pump(self, on_frame=None) -> None:
+        """Drain the conn: REPLY frames land in the inbox; CALL frames
+        queue for the owner's dispatcher (or go straight to `on_frame`)."""
+        for ftype, epoch, body, blob in self.conn.recv():
+            if ftype == FRAME_REPLY:
+                rid = body.get("rid")
+                if rid is not None:
+                    self.replies[rid] = (epoch, body, blob)
+            elif on_frame is not None:
+                on_frame(epoch, body, blob)
+            else:
+                self.inbox_calls.append((epoch, body, blob))
+        while len(self.replies) > 128:
+            # replies to calls whose retry ladder already gave up: the
+            # caller will never collect them, don't hoard the blobs
+            self.replies.pop(next(iter(self.replies)))
+
+    # ------------------------------------------------------------------
+    # callee side
+    # ------------------------------------------------------------------
+
+    def reply(self, epoch: int, rid: int, body: Dict[str, Any],
+              blob: bytes = b"", *, ok: bool = True,
+              now_ms: Optional[int] = None) -> None:
+        payload = {"rid": rid, "ok": ok, **body}
+        self._reply_cache[rid] = (epoch, payload, blob)
+        self._reply_cache_order.append(rid)
+        while len(self._reply_cache_order) > 64:
+            self._reply_cache.pop(self._reply_cache_order.pop(0), None)
+        self.conn.send(FRAME_REPLY, epoch, payload, blob, now_ms=now_ms)
+
+    def replay_cached(self, rid: int, now_ms: Optional[int] = None) -> bool:
+        """Re-send the cached reply for a duplicate CALL; True if known."""
+        cached = self._reply_cache.get(rid)
+        if cached is None:
+            return False
+        epoch, payload, blob = cached
+        self.reply_cache_hits += 1
+        self.conn.send(FRAME_REPLY, epoch, payload, blob, now_ms=now_ms)
+        return True
+
+
+def call(
+    peer: RpcPeer,
+    op: str,
+    body: Optional[Dict[str, Any]] = None,
+    blob: bytes = b"",
+    *,
+    epoch: int = 0,
+    clock: Optional[Clock] = None,
+    policy: Optional[RetryPolicy] = None,
+    on_wait: Optional[Callable[[], None]] = None,
+    pump_others: Optional[Callable[[], None]] = None,
+) -> tuple:
+    """THE blocking control-plane RPC: returns (reply_body, reply_blob).
+
+    Raises CircuitOpen without touching the wire when the peer's breaker
+    is open; RpcTimeout when every attempt's deadline passes unanswered;
+    RpcError carrying the remote `kind` on a structured failure reply;
+    Fenced when the peer rejected our epoch. `on_wait` runs each poll
+    iteration (default: a 1ms sleep) — in-process tests step the callee
+    and advance a FakeClock there; `pump_others` lets the owner keep
+    sibling connections drained during a long call (heartbeats from
+    other agents must not rot in kernel buffers while one agent is slow).
+    """
+    clock = clock or Clock()
+    policy = policy or RetryPolicy()
+    now = clock.now_ms()
+    if not peer.breaker.allow(now):
+        raise CircuitOpen(
+            f"circuit open for {op!r}",
+            peer=peer.label, op=op, until_ms=peer.breaker.open_until_ms,
+        )
+    if on_wait is None:
+        on_wait = lambda: _time.sleep(0.001)  # noqa: E731
+    body = dict(body or {})
+    rid = peer.next_rid()
+    body["rid"] = rid
+    body["op"] = op
+    for attempt in range(policy.attempts):
+        if attempt > 0:
+            if GLOBAL_TELEMETRY.enabled:
+                rpc_retries_total().inc()
+            wake = clock.now_ms() + policy.backoff_ms(attempt - 1)
+            while clock.now_ms() < wake and not peer.conn.closed:
+                peer.pump()
+                if pump_others is not None:
+                    pump_others()
+                on_wait()
+        peer.conn.send(FRAME_CALL, epoch, body, blob, now_ms=clock.now_ms())
+        deadline = clock.now_ms() + policy.timeout_ms
+        while clock.now_ms() < deadline:
+            peer.conn.flush(clock.now_ms())
+            peer.pump()
+            if pump_others is not None:
+                pump_others()
+            got = peer.replies.pop(rid, None)
+            if got is not None:
+                r_epoch, r_body, r_blob = got
+                if r_body.get("ok", False):
+                    peer.breaker.record_success()
+                    return r_body, r_blob
+                kind = r_body.get("kind", "error")
+                if kind == "fenced":
+                    # a fencing rejection is not a transport failure: the
+                    # breaker stays closed, the caller must REACT
+                    raise Fenced(
+                        f"peer rejected {op!r}",
+                        host_id=r_body.get("host_id"),
+                        stale_epoch=epoch,
+                        current_epoch=r_body.get("epoch", 0),
+                    )
+                peer.breaker.record_success()  # the link works; the op failed
+                raise RpcError(kind, r_body.get("error", ""))
+            if peer.conn.closed:
+                break
+            on_wait()
+    peer.breaker.record_failure(clock.now_ms())
+    raise RpcTimeout(
+        f"no reply to {op!r}",
+        peer=peer.label, op=op, attempts=policy.attempts,
+    )

@@ -1,0 +1,187 @@
+"""Wire tickets: whole match islands serialized for cross-process hops.
+
+`serve/migrate.py`'s MigrationTicket moves a session between hosts by
+REFERENCE — the session object is the continuity. Across processes the
+reference is gone, so the ticket must carry the session's entire
+reliability state by VALUE: the island pickle (sessions, input queues,
+endpoint timers/acks, the virtual network's in-flight datagrams, rng
+streams, drive cursor) plus each peer's exported device slot residue
+(world + snapshot ring, `export_slot`). Serialization is
+observationally neutral to the data plane: still-lazy checksums resolve
+to their values (GameStateCell/PendingChecksumReport pickle hooks),
+which changes WHEN a device read happens but never what any peer emits
+— so a periodic checkpoint does not perturb the run it checkpoints, and
+a restored island's replay is bit-identical to the uninterrupted run.
+
+On-disk fleet checkpoints are `header-json \\n pickle-blob`, written via
+`utils.checkpoint.atomic_write_bytes` (temp + fsync + os.replace): a
+SIGKILL mid-write can only truncate the invisible temp file. The header
+carries (host_id, **epoch**, tick): the director validates the epoch at
+seizure time, so a fenced zombie's later rewrites are ignored by
+construction.
+
+The blob is pickle between OUR OWN processes on one trust domain (the
+director spawned the agents); it is not an interchange format — the
+header says so.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+from typing import Any, Dict, List, Tuple
+
+from ..errors import CheckpointIncompatible
+from ..utils.checkpoint import atomic_write_bytes
+from .island import MatchIsland
+
+FLEET_TICKET_VERSION = 1
+_HEADER_TAG = "ggrs-fleet-ticket"
+
+
+class _PickleScope:
+    """Temporarily make live hosted sessions picklable: stash and clear
+    host backrefs (pickling must not drag the SessionHost + device core
+    into the blob) and force-resolve nothing else — the pickle hooks on
+    cells/reports handle laziness. Restores everything on exit even if
+    pickling dies."""
+
+    def __init__(self, islands: List[MatchIsland]):
+        self.islands = islands
+        self._stash: List[Tuple[Any, Any, Any]] = []
+
+    def __enter__(self):
+        for island in self.islands:
+            for session in island.peers.values():
+                self._stash.append(
+                    (session, session._host, session._host_key)
+                )
+                session._host = None
+                session._host_key = None
+        return self
+
+    def __exit__(self, *exc):
+        for session, host, key in self._stash:
+            session._host = host
+            session._host_key = key
+        return False
+
+
+def export_islands(host, islands: List[MatchIsland], *,
+                   detach: bool = False) -> List[dict]:
+    """Build ticket entries for `islands` hosted on `host`: flush the
+    staged rows through the fence once (fleet-wide), export each peer's
+    device slot, capture lane bookkeeping. `detach=True` removes the
+    sessions from the host (migration/drain export); False leaves them
+    serving (the periodic crash-recovery checkpoint)."""
+    if any(island.keys for island in islands):
+        host._flush_ready("fleet ticket export")
+    entries = []
+    for island in islands:
+        lanes: Dict[int, dict] = {}
+        slots: Dict[int, Any] = {}
+        for k, key in sorted(island.keys.items()):
+            lane = host._lanes[key]
+            lanes[k] = {
+                "current_frame": lane.current_frame,
+                "pending_inputs": sorted(lane.pending_inputs),
+            }
+            slots[k] = host.device.export_slot(lane.slot)
+        entries.append({
+            "island": island,
+            "lanes": lanes,
+            "slots": slots,
+        })
+        if detach:
+            for key in island.keys.values():
+                host.detach(key)
+            island.keys = {}
+    return entries
+
+
+def dumps_ticket(entries: List[dict], meta: Dict[str, Any]) -> bytes:
+    """Serialize ticket entries + JSON-able meta into one blob:
+    `header-json \\n pickle`. The header repeats the fencing-relevant
+    meta OUTSIDE the pickle so a seizure can validate epoch/host
+    without deserializing session state."""
+    islands = [e["island"] for e in entries]
+    header = json.dumps({
+        "tag": _HEADER_TAG,
+        "version": FLEET_TICKET_VERSION,
+        "meta": meta,
+        "matches": [i.spec.match_id for i in islands],
+    }, separators=(",", ":")).encode("utf-8")
+    buf = io.BytesIO()
+    with _PickleScope(islands):
+        payload = pickle.dumps(
+            {"entries": entries, "meta": meta}, protocol=5
+        )
+    buf.write(header)
+    buf.write(b"\n")
+    buf.write(payload)
+    return buf.getvalue()
+
+
+def peek_ticket(blob: bytes) -> Dict[str, Any]:
+    """Header-only read (no unpickling): the director's fencing
+    validation path. Raises CheckpointIncompatible on anything that is
+    not a readable fleet ticket of a version this build understands."""
+    try:
+        head, _, _ = blob.partition(b"\n")
+        header = json.loads(head.decode("utf-8"))
+        assert header.get("tag") == _HEADER_TAG
+    except Exception as exc:
+        raise CheckpointIncompatible(
+            f"not a fleet ticket ({type(exc).__name__}: {exc})"
+        ) from exc
+    if header.get("version", 0) > FLEET_TICKET_VERSION:
+        raise CheckpointIncompatible(
+            "fleet ticket written by a newer build",
+            found=header.get("version"), expected=FLEET_TICKET_VERSION,
+        )
+    return header
+
+
+def loads_ticket(blob: bytes) -> Tuple[List[dict], Dict[str, Any]]:
+    header = peek_ticket(blob)
+    _, _, payload = blob.partition(b"\n")
+    try:
+        data = pickle.loads(payload)
+    except Exception as exc:
+        raise CheckpointIncompatible(
+            f"fleet ticket payload unreadable "
+            f"({type(exc).__name__}: {exc}) — truncated or corrupted"
+        ) from exc
+    return data["entries"], {**header.get("meta", {}), **data.get("meta", {})}
+
+
+def import_islands(host, entries: List[dict]) -> List[MatchIsland]:
+    """Adopt ticket entries into `host`: every peer re-admitted at its
+    exact exported frame with its slot residue imported. Returns the
+    live islands. All-or-nothing per island: a failed adopt rolls the
+    already-adopted peers of THAT island back off the host before
+    re-raising, so a half-imported match can never tick."""
+    adopted: List[MatchIsland] = []
+    for entry in entries:
+        island: MatchIsland = entry["island"]
+        try:
+            island.adopt(host, entry["lanes"], entry["slots"])
+        except BaseException:
+            for key in island.keys.values():
+                if key in host._lanes:
+                    host.detach(key)
+            island.keys = {}
+            raise
+        adopted.append(island)
+    return adopted
+
+
+def write_ticket_file(path: str, entries: List[dict],
+                      meta: Dict[str, Any]) -> None:
+    atomic_write_bytes(path, dumps_ticket(entries, meta))
+
+
+def read_ticket_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()

@@ -1,0 +1,259 @@
+"""Control-plane wire framing: length-prefixed frames over a stream
+socket.
+
+The data plane already has a codec (network/messages.py: `magic u16 |
+body u8 | body`, little-endian, length-prefixed payloads); this module
+is its control-plane sibling over TCP. Every frame is
+
+    magic u16 | version u8 | type u8 | epoch u32 | json_len u32 | blob_len u32
+    | json bytes | blob bytes
+
+— a fixed 16-byte header, a JSON body (op, rid, arguments) and an
+optional opaque binary attachment (wire tickets, checkpoint payloads).
+The `epoch` field is the sender's **host epoch**, the fencing token the
+director validates on every frame (ggrs_tpu.fleet.director): stamping
+it into the header — not the JSON — makes the fence check unconditional
+and un-forgettable, the same reasoning that puts `magic` in the data
+plane's header.
+
+`FleetConn` wraps one connected stream socket non-blockingly: sends
+buffer until the kernel accepts them, receives accumulate until whole
+frames parse. It also carries the chaos harness's fault-injection seam:
+outgoing frames can be *held* (delayed) until a release time or
+*duplicated* — the "delay/duplicate director RPCs" events — and
+`partitioned` drops both directions silently, which is how a control
+partition looks from inside one process while the UDP data plane keeps
+flowing.
+"""
+
+from __future__ import annotations
+
+import json
+import socket as _socket
+import struct
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+FLEET_MAGIC = 0x47F1
+FLEET_WIRE_VERSION = 1
+
+FRAME_CALL = 1
+FRAME_REPLY = 2
+
+_HEADER = struct.Struct("<HBBIII")
+FLEET_HEADER_SIZE = _HEADER.size
+
+# a JSON body past this is a protocol bug, not a workload
+MAX_JSON_LEN = 1 << 20
+# blobs carry whole match islands (worlds + snapshot rings); generous,
+# but still a cap so a corrupted length can't ask for the address space
+MAX_BLOB_LEN = 1 << 30
+
+
+class FrameError(ValueError):
+    """The byte stream is not speaking this protocol (bad magic/version/
+    length): the connection is poisoned and must be dropped — unlike the
+    datagram plane, a stream cannot resynchronize past garbage."""
+
+
+def encode_frame(frame_type: int, epoch: int, body: Dict[str, Any],
+                 blob: bytes = b"") -> bytes:
+    payload = json.dumps(body, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_JSON_LEN:
+        raise FrameError(f"JSON body of {len(payload)} bytes exceeds cap")
+    if len(blob) > MAX_BLOB_LEN:
+        raise FrameError(f"blob of {len(blob)} bytes exceeds cap")
+    return (
+        _HEADER.pack(
+            FLEET_MAGIC, FLEET_WIRE_VERSION, frame_type, epoch,
+            len(payload), len(blob),
+        )
+        + payload
+        + blob
+    )
+
+
+def decode_frames(buf: bytearray) -> List[Tuple[int, int, Dict[str, Any], bytes]]:
+    """Parse every complete frame off the front of `buf` IN PLACE,
+    returning (type, epoch, body, blob) tuples; a trailing partial frame
+    stays buffered for the next read. Raises FrameError on garbage."""
+    out: List[Tuple[int, int, Dict[str, Any], bytes]] = []
+    while True:
+        if len(buf) < _HEADER.size:
+            return out
+        magic, version, ftype, epoch, json_len, blob_len = _HEADER.unpack_from(
+            buf, 0
+        )
+        if magic != FLEET_MAGIC or version != FLEET_WIRE_VERSION:
+            raise FrameError(
+                f"bad frame header (magic={magic:#x}, version={version})"
+            )
+        if json_len > MAX_JSON_LEN or blob_len > MAX_BLOB_LEN:
+            raise FrameError(
+                f"frame lengths out of range (json={json_len}, blob={blob_len})"
+            )
+        total = _HEADER.size + json_len + blob_len
+        if len(buf) < total:
+            return out
+        try:
+            body = json.loads(
+                bytes(buf[_HEADER.size:_HEADER.size + json_len]).decode("utf-8")
+            )
+        except ValueError as exc:
+            raise FrameError(f"undecodable frame body: {exc}") from exc
+        blob = bytes(buf[_HEADER.size + json_len:total])
+        del buf[:total]
+        out.append((ftype, epoch, body, blob))
+
+
+class FleetConn:
+    """One non-blocking framed control connection.
+
+    `send()` queues a frame and opportunistically flushes; `recv()`
+    drains the socket and returns complete frames. `closed` flips on any
+    transport error — the owner decides whether that peer is dead or
+    merely suspected.
+
+    Fault injection (driven by the chaos harness, ignored in
+    production): `hold_until_ms` delays outgoing frames until the given
+    time (release happens inside send/flush once `now_ms` passes it),
+    `dup_next` duplicates the next N outgoing frames, and `partitioned`
+    silently drops both directions — the sender never learns, exactly
+    like a real partition."""
+
+    def __init__(self, sock: _socket.socket):
+        sock.setblocking(False)
+        try:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # AF_UNIX socketpairs (in-process tests) have no TCP
+        self.sock = sock
+        self.closed = False
+        self._recvbuf = bytearray()
+        self._sendbuf = bytearray()
+        # chaos fault seam
+        self.partitioned = False
+        self.hold_until_ms: Optional[int] = None
+        self.dup_next = 0
+        self._held: deque = deque()
+        # tallies (the director's per-peer health surface)
+        self.frames_sent = 0
+        self.frames_recv = 0
+        self.frames_dropped = 0
+
+    def fileno(self) -> int:
+        return self.sock.fileno()
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    # sending
+    # ------------------------------------------------------------------
+
+    def send(self, frame_type: int, epoch: int, body: Dict[str, Any],
+             blob: bytes = b"", now_ms: Optional[int] = None) -> None:
+        if self.closed:
+            return
+        if self.partitioned:
+            self.frames_dropped += 1
+            return
+        wire = encode_frame(frame_type, epoch, body, blob)
+        copies = 1 + max(0, self.dup_next)
+        if self.dup_next:
+            self.dup_next = 0
+        for _ in range(copies):
+            if self.hold_until_ms is not None:
+                self._held.append(wire)
+            else:
+                self._sendbuf += wire
+        self.frames_sent += copies
+        self.flush(now_ms)
+
+    def flush(self, now_ms: Optional[int] = None) -> None:
+        """Push buffered bytes into the kernel; releases held (delayed)
+        frames whose hold expired when `now_ms` is provided."""
+        if self.closed:
+            return
+        if (
+            self.hold_until_ms is not None
+            and now_ms is not None
+            and now_ms >= self.hold_until_ms
+        ):
+            self.hold_until_ms = None
+            while self._held:
+                self._sendbuf += self._held.popleft()
+        while self._sendbuf:
+            try:
+                n = self.sock.send(self._sendbuf)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self.closed = True
+                return
+            if n <= 0:
+                return
+            del self._sendbuf[:n]
+
+    # ------------------------------------------------------------------
+    # receiving
+    # ------------------------------------------------------------------
+
+    def recv(self) -> List[Tuple[int, int, Dict[str, Any], bytes]]:
+        """Drain the socket; returns complete (type, epoch, body, blob)
+        frames. A partitioned conn reads AND DISCARDS — bytes that
+        arrive during a partition are gone, like any partitioned
+        network; the RPC layer's retries are what recover."""
+        if self.closed:
+            return []
+        while True:
+            try:
+                chunk = self.sock.recv(1 << 16)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self.closed = True
+                break
+            if not chunk:  # orderly peer close
+                self.closed = True
+                break
+            if self.partitioned:
+                self.frames_dropped += 1
+                continue
+            self._recvbuf += chunk
+        if self.partitioned:
+            self._recvbuf.clear()
+            return []
+        try:
+            frames = decode_frames(self._recvbuf)
+        except FrameError:
+            self.closed = True
+            return []
+        self.frames_recv += len(frames)
+        return frames
+
+
+def connect(addr: Tuple[str, int], timeout_s: float = 5.0) -> FleetConn:
+    """Blocking connect (process startup only), non-blocking thereafter."""
+    sock = _socket.create_connection(addr, timeout=timeout_s)
+    return FleetConn(sock)
+
+
+def listener(addr: Tuple[str, int] = ("127.0.0.1", 0)) -> _socket.socket:
+    sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    sock.bind(addr)
+    sock.listen(16)
+    sock.setblocking(False)
+    return sock
+
+
+def conn_pair() -> Tuple[FleetConn, FleetConn]:
+    """An in-process connected pair (AF_UNIX socketpair) — the unit
+    tests' transport: real kernel buffering and framing, no ports."""
+    a, b = _socket.socketpair()
+    return FleetConn(a), FleetConn(b)

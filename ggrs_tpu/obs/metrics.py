@@ -54,6 +54,27 @@ def _escape_label(value: str) -> str:
     return value.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
 
 
+def _restore_instrument(kind, name, help, labelnames, buckets):
+    """Unpickle target for instruments: get-or-create from the process's
+    GLOBAL registry, so a deserialized object graph (a fleet wire ticket
+    carrying sessions between host processes, ggrs_tpu.fleet.ticket)
+    lands on LIVE instruments in the receiving process — its increments
+    show up in that process's exporters — instead of an orphaned copy
+    whose updates nobody can scrape."""
+    from .telemetry import GLOBAL_TELEMETRY
+
+    reg = GLOBAL_TELEMETRY.registry
+    if kind == "counter":
+        return reg.counter(name, help, labelnames)
+    if kind == "gauge":
+        return reg.gauge(name, help, labelnames)
+    return reg.histogram(name, help, labelnames, buckets=buckets)
+
+
+def _restore_bound(kind, name, help, labelnames, buckets, key):
+    return _restore_instrument(kind, name, help, labelnames, buckets).labels(*key)
+
+
 def _fmt_value(v: float) -> str:
     # integers render without a trailing .0 — easier on the eyes and on
     # naive parsers; everything else keeps full float repr
@@ -62,10 +83,27 @@ def _fmt_value(v: float) -> str:
     return repr(float(v))
 
 
-class BoundCounter:
+class _BoundPickle:
+    """Bound children pickle BY NAME, not by cell: unpickling re-binds
+    through the receiving process's global registry (see
+    _restore_bound), so objects that pre-bind labeled children in their
+    constructors — endpoints, input queues — survive a cross-process
+    hop (fleet wire tickets) with live instruments."""
+
+    __slots__ = ()
+
+    def __reduce__(self):
+        inst, key = self._origin
+        return (_restore_bound, (
+            inst.kind, inst.name, inst.help, inst.labelnames,
+            getattr(inst, "buckets", None), key,
+        ))
+
+
+class BoundCounter(_BoundPickle):
     """A counter child bound to one label-value tuple."""
 
-    __slots__ = ("_cell",)
+    __slots__ = ("_cell", "_origin")
 
     def __init__(self, cell: List[float]):
         self._cell = cell
@@ -78,8 +116,8 @@ class BoundCounter:
         return self._cell[0]
 
 
-class BoundGauge:
-    __slots__ = ("_cell",)
+class BoundGauge(_BoundPickle):
+    __slots__ = ("_cell", "_origin")
 
     def __init__(self, cell: List[float]):
         self._cell = cell
@@ -115,8 +153,8 @@ class _HistCell:
         self.count = 0
 
 
-class BoundHistogram:
-    __slots__ = ("_cell", "_buckets")
+class BoundHistogram(_BoundPickle):
+    __slots__ = ("_cell", "_buckets", "_origin")
 
     def __init__(self, cell: _HistCell, buckets: Tuple[float, ...]):
         self._cell = cell
@@ -169,6 +207,7 @@ class _Instrument:
                 cell = self._new_cell()
                 self._children[key] = cell
             bound = self._bind(cell)
+            bound._origin = (self, key)  # pickle-by-name backref
             self._bound[key] = bound
         return bound
 
@@ -185,6 +224,15 @@ class _Instrument:
                 cell.zero()
             else:
                 cell[0] = 0.0
+
+    def __reduce__(self):
+        # instruments pickle by name and re-resolve from the receiving
+        # process's global registry — the same live-rebinding contract
+        # as bound children (_BoundPickle)
+        return (_restore_instrument, (
+            self.kind, self.name, self.help, self.labelnames,
+            getattr(self, "buckets", None),
+        ))
 
 
 class Counter(_Instrument):

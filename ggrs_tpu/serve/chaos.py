@@ -140,8 +140,19 @@ class WanProfile:
 
 class ChaosEvent:
     """One scheduled fault: `tick` (relative to the measured drive),
-    `kind` in {"migrate", "kill", "restore", "storm", "flash_crowd"},
-    plus kind-specific params."""
+    `kind`, plus kind-specific params.
+
+    In-process kinds (run_chaos, this module): "migrate", "kill",
+    "restore", "storm", "flash_crowd" — every fault is simulated inside
+    one Python process.
+
+    Process-level kinds (ggrs_tpu.fleet.chaos.run_process_chaos, which
+    consumes this same event type): "sigkill" (a REAL agent process
+    dies), "partition" (the control socket goes dark while the UDP/
+    island data plane keeps ticking), "rpc_delay" / "rpc_dup" (director
+    RPC frames held / duplicated). There `tick` is match progress, and
+    recovery is the director's fenced failover rather than this
+    module's polite kill→restore."""
 
     __slots__ = ("tick", "kind", "params")
 

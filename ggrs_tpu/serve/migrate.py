@@ -31,6 +31,7 @@ The degradation ladder, in order of increasing violence: backpressure
 
 from __future__ import annotations
 
+import random as _random
 import time as _time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -217,13 +218,20 @@ class HostGroup:
 
     def __init__(self, hosts: List[SessionHost], *,
                  clock=None, host_factory=None,
-                 max_attempts: int = 3, backoff_ms: int = 32):
+                 max_attempts: int = 3, backoff_ms: int = 32,
+                 backoff_seed: int = 0):
         assert hosts, "a HostGroup needs at least one host"
         self.hosts = list(hosts)
         self.clock = clock or hosts[0].clock
         self._host_factory = host_factory
         self.max_attempts = max_attempts
         self.backoff_ms = backoff_ms
+        # seeded jitter source for the admission backoff: a FIXED
+        # exponential schedule synchronizes every rejected admission in a
+        # flash crowd onto the same retry instants (a retry storm that
+        # re-collides forever); jitter decorrelates them, the seed keeps
+        # a soak bit-reproducible
+        self._backoff_rng = _random.Random(backoff_seed ^ 0xB0FF)
         self.dead: set = set()
         self._records: Dict[Any, _GroupRecord] = {}
         self._by_host: List[Dict[Any, Any]] = [dict() for _ in self.hosts]
@@ -242,7 +250,7 @@ class HostGroup:
     @classmethod
     def build(cls, game, n_hosts: int, *, clock=None,
               max_attempts: int = 3, backoff_ms: int = 32,
-              **host_kw) -> "HostGroup":
+              backoff_seed: int = 0, **host_kw) -> "HostGroup":
         """Construct `n_hosts` identically-configured SessionHosts plus
         the factory kill→restore needs to rebuild one."""
         factory = lambda: SessionHost(game, clock=clock, **host_kw)  # noqa: E731
@@ -250,6 +258,7 @@ class HostGroup:
         return cls(
             hosts, clock=clock, host_factory=factory,
             max_attempts=max_attempts, backoff_ms=backoff_ms,
+            backoff_seed=backoff_seed,
         )
 
     # ------------------------------------------------------------------
@@ -341,15 +350,23 @@ class HostGroup:
             attempts=attempts, per_host=self._occupancy(),
         )
 
+    def backoff_delay_ms(self, attempt: int) -> int:
+        """One jittered exponential backoff draw: uniform over
+        [base/2, base] with base = backoff_ms << attempt. Exposed (and
+        consumed in draw order) so a unit test can pin the exact retry
+        schedule a seed produces."""
+        base = self.backoff_ms << attempt
+        return self._backoff_rng.randrange(base // 2, base + 1)
+
     def _backoff(self, attempt: int) -> None:
         """Between admission attempts: give eviction/disconnect GC a
         chance to free slots — tick the fleet and advance the injectable
-        clock exponentially (2^attempt * backoff_ms). Events surfaced by
-        the backoff ticks are buffered into the next tick() result, not
-        dropped."""
+        clock by a seeded-jittered exponential delay (backoff_delay_ms).
+        Events surfaced by the backoff ticks are buffered into the next
+        tick() result, not dropped."""
         advance = getattr(self.clock, "advance", None)
         if callable(advance):
-            advance(self.backoff_ms << attempt)
+            advance(self.backoff_delay_ms(attempt))
         for gkey, evs in self.tick().items():
             self._pending_events.setdefault(gkey, []).extend(evs)
 

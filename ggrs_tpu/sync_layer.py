@@ -104,6 +104,41 @@ class GameStateCell:
             value = self._state.checksum
             return lambda: value
 
+    def __getstate__(self):
+        # cross-process hop (fleet wire tickets): the lock is rebuilt on
+        # the other side, and a still-lazy checksum is RESOLVED here —
+        # the getter contract makes early resolution observationally
+        # neutral (same value, cached in place), while a pickled lazy fn
+        # would drag device arrays into the ticket
+        return {
+            "frame": self.frame,
+            "data": self.load(),
+            "checksum": self.checksum,  # forces _checksum_fn if pending
+        }
+
+    def __setstate__(self, state):
+        self._lock = threading.Lock()
+        self._state = GameState()
+        self._state.frame = state["frame"]
+        self._state.data = state["data"]
+        self._state.checksum = state["checksum"]
+        self._checksum_fn = None
+
+
+class _ResolvedGetter:
+    """A picklable stand-in for a bound checksum getter whose value was
+    resolved before a cross-process hop: same call contract as
+    GameStateCell.checksum_getter's return (callable, `ready` True)."""
+
+    __slots__ = ("value",)
+    ready = True
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self):
+        return self.value
+
 
 class PendingChecksumReport:
     """Deferred desync-detection reports, shared by the Python and native P2P
@@ -142,6 +177,26 @@ class PendingChecksumReport:
 
     def __len__(self) -> int:
         return len(self._pending)
+
+    def __getstate__(self):
+        # cross-process hop: entries travel with their cell references
+        # (object sharing with saved_states is preserved by pickle), and
+        # an already-BOUND getter — a device-lazy checksum or a closure,
+        # both unpicklable — is resolved to its value now. Resolution is
+        # value-only (no emit), so it cannot perturb message timing: the
+        # flush on the receiving side emits the identical report at the
+        # identical tick the un-serialized twin would have.
+        entries = []
+        for frame, cell, getter, serial in self._pending:
+            if getter is not None and not isinstance(getter, _ResolvedGetter):
+                getter = _ResolvedGetter(getter())
+            entries.append([frame, cell, getter, serial])
+        return {"pending": entries}
+
+    def __setstate__(self, state):
+        from collections import deque
+
+        self._pending = deque(state["pending"])
 
     def capture(self, frame: Frame, cell: GameStateCell, serial: int = 0) -> None:
         """`serial` stamps the capturing tick (a monotonic advance

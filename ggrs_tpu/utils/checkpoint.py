@@ -18,7 +18,10 @@ additive, absence means "legacy, best effort".
 
 from __future__ import annotations
 
+import io
 import json
+import os
+import tempfile
 from typing import Any, Dict, Tuple
 
 import numpy as np
@@ -54,8 +57,57 @@ def _unflatten(flat: Dict[str, Any]) -> Any:
     return root
 
 
+def atomic_write_bytes(path: str, data: bytes, *,
+                       durable: bool = True) -> None:
+    """Crash-safe write: a temp file in the target's directory, then ONE
+    atomic os.replace — a reader (or a restore after the writer was
+    SIGKILLed mid-write) observes either the previous complete file or
+    the new complete file, never a torn prefix. `durable=True` adds
+    fsync of the file AND the directory entry, extending the guarantee
+    from process death to power loss; high-cadence writers whose threat
+    model is SIGKILL (the fleet agents' periodic wire-ticket
+    checkpoints, written every few hundred ms between heartbeats) pass
+    False — os.replace alone already makes a torn file impossible, and
+    an fsync stall there starves the heartbeat loop. Shared by the npz
+    checkpoint writer below and ggrs_tpu.fleet.ticket."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(path) + ".tmp."
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if not durable:
+        return
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+    except OSError:  # pragma: no cover - exotic filesystems
+        return
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
 def save_device_checkpoint(path: str, tree: Any, meta: Dict[str, Any]) -> None:
-    """Write a (nested-dict) pytree of arrays + JSON-serializable meta."""
+    """Write a (nested-dict) pytree of arrays + JSON-serializable meta.
+
+    The write is ATOMIC (temp file + fsync + os.replace): a host killed
+    mid-checkpoint — the exact moment a SIGKILL chaos event or an OOM
+    likes to strike, since checkpointing is the longest host-side pause —
+    can truncate only the invisible temp file. The previous checkpoint at
+    `path` stays intact, so kill→restore always finds a complete file
+    instead of one `CheckpointIncompatible` rejects at the worst time."""
     import jax
 
     host_tree = jax.device_get(tree)
@@ -70,7 +122,13 @@ def save_device_checkpoint(path: str, tree: Any, meta: Dict[str, Any]) -> None:
     flat["__meta__"] = np.frombuffer(
         json.dumps(stamped).encode("utf-8"), dtype=np.uint8
     )
-    np.savez_compressed(path, **flat)
+    # np.savez appends .npz to extensionless string paths; the buffered
+    # atomic path must keep that contract for existing callers
+    if not path.endswith(".npz"):
+        path += ".npz"
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **flat)
+    atomic_write_bytes(path, buf.getvalue())
 
 
 def _check_format(path: str, fmt: Dict[str, Any],

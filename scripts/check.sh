@@ -56,6 +56,14 @@
 #   (scripts/chaos_smoke.py, CPU jax, ~1 min). Also runs in the default
 #   flow (step 2b): fleet operations are a correctness surface, not an
 #   optional extra.
+#   --fleet-smoke spawns a director plus 2 real agent subprocesses on
+#   loopback, places WAN-profile matches, partitions one agent's control
+#   socket (data plane must keep advancing), SIGKILLs one agent for
+#   real, and gates on fenced failover restoring every session at the
+#   exact checkpoint frame, zero desyncs, bitwise twin parity, and the
+#   ggrs_fleet_* instruments through BOTH exporters
+#   (scripts/fleet_smoke.py, CPU jax, ~2-3 min). Also runs in the
+#   default flow (step 2d): the control plane is a correctness surface.
 #   --lint runs the determinism/trace/fence/wire static-analysis gate
 #   (python -m ggrs_tpu.analysis, pure AST, no jax, seconds) against
 #   analysis/baseline.toml, then the retrace-sanitizer smoke
@@ -136,6 +144,12 @@ if [ "${1:-}" = "--chaos-smoke" ]; then
   exit $?
 fi
 
+if [ "${1:-}" = "--fleet-smoke" ]; then
+  echo "== fleet smoke (director + 2 agent processes, SIGKILL + fenced failover) =="
+  JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+  exit $?
+fi
+
 if [ "${1:-}" = "--spec-smoke" ]; then
   echo "== spec smoke (speculative bubble-filling, single-device + sharded) =="
   GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
@@ -163,6 +177,9 @@ echo "== [2c/5] spec smoke (speculative bubble-filling end to end) =="
 GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python scripts/spec_smoke.py
+
+echo "== [2d/5] fleet smoke (multi-process control plane, real SIGKILL) =="
+JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 
 if [ "$FAST" = "0" ]; then
   echo "== [3/5] UBSAN build + native/wire tests =="

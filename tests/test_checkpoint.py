@@ -345,3 +345,101 @@ def test_checkpoint_legacy_unstamped_still_loads(tmp_path):
     tree, meta = load_device_checkpoint(path)
     assert meta == {"kind": "old"}
     np.testing.assert_array_equal(tree["a"], np.arange(3, dtype=np.int32))
+
+
+def test_atomic_write_failure_leaves_previous_file_intact(tmp_path):
+    """A write that dies mid-flight (the SIGKILL-shaped failure) must
+    leave the PREVIOUS complete file at the path and no visible torn
+    file — os.replace is the commit point, everything before it is
+    invisible."""
+    import os
+
+    import pytest
+
+    from ggrs_tpu.utils.checkpoint import atomic_write_bytes
+
+    path = str(tmp_path / "state.bin")
+    atomic_write_bytes(path, b"v1" * 1000)
+
+    real_replace = os.replace
+
+    def dying_replace(src, dst):
+        raise OSError("simulated death at the commit point")
+
+    os.replace = dying_replace
+    try:
+        with pytest.raises(OSError):
+            atomic_write_bytes(path, b"v2" * 1000)
+    finally:
+        os.replace = real_replace
+    with open(path, "rb") as f:
+        assert f.read() == b"v1" * 1000
+    leftovers = [p for p in os.listdir(tmp_path) if ".tmp." in p]
+    assert leftovers == []  # the temp file was cleaned up
+
+
+def test_save_device_checkpoint_crash_mid_write_keeps_old_checkpoint(
+    tmp_path, monkeypatch
+):
+    """save_device_checkpoint dying mid-serialization must not touch the
+    checkpoint already on disk: the old file still loads, bit-exact."""
+    import numpy as _np
+    import pytest
+
+    from ggrs_tpu.utils import checkpoint as ckpt
+
+    path = str(tmp_path / "host.npz")
+    tree = {"a": np.arange(8, dtype=np.int32)}
+    ckpt.save_device_checkpoint(path, tree, {"kind": "t"})
+
+    def dying_savez(buf, **arrays):
+        buf.write(b"PK\x03\x04partial")  # a torn zip prefix
+        raise RuntimeError("killed mid-write")
+
+    monkeypatch.setattr(_np, "savez_compressed", dying_savez)
+    with pytest.raises(RuntimeError):
+        ckpt.save_device_checkpoint(
+            path, {"a": np.arange(8, dtype=np.int32) + 1}, {"kind": "t"}
+        )
+    monkeypatch.undo()
+    loaded, meta = ckpt.load_device_checkpoint(path)
+    np.testing.assert_array_equal(loaded["a"], tree["a"])
+    assert meta == {"kind": "t"}
+
+
+def test_atomic_write_survives_real_sigkill_mid_write(tmp_path):
+    """The real thing: a child process SIGKILLed while overwriting the
+    same path in a tight loop can never leave a torn file — every
+    observation is one COMPLETE payload (old or new)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    path = str(tmp_path / "hammer.bin")
+    child = subprocess.Popen([
+        sys.executable, "-c",
+        "import sys; sys.path.insert(0, %r)\n"
+        "from ggrs_tpu.utils.checkpoint import atomic_write_bytes\n"
+        "i = 0\n"
+        "while True:\n"
+        "    payload = bytes([i %% 256]) * 65536\n"
+        "    atomic_write_bytes(%r, payload, durable=False)\n"
+        "    i += 1\n"
+        % (os.getcwd(), path),
+    ], cwd=os.getcwd())
+    try:
+        deadline = time.monotonic() + 10
+        while not os.path.exists(path):
+            assert child.poll() is None, "writer died before first write"
+            assert time.monotonic() < deadline, "writer never wrote"
+            time.sleep(0.01)
+        time.sleep(0.25)  # let it hammer through many replace cycles
+    finally:
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=10)
+    with open(path, "rb") as f:
+        data = f.read()
+    assert len(data) == 65536  # complete payload, never a torn prefix
+    assert data == bytes([data[0]]) * 65536

@@ -570,3 +570,56 @@ def test_chaos_soak_64_sessions_wan_profile():
     finally:
         GLOBAL_TELEMETRY.enabled = False
         GLOBAL_TELEMETRY.reset()
+
+
+def test_hostgroup_backoff_jitter_schedule_pinned_by_seed():
+    """The admission backoff is jittered-exponential from a SEEDED rng:
+    a fixed schedule synchronizes every rejected admission in a flash
+    crowd onto the same retry instants (a storm that re-collides
+    forever); the seed keeps soaks reproducible. The FakeClock pins the
+    exact virtual-time schedule a seed produces."""
+    clock = FakeClock()
+    game = ExGame(num_players=2, num_entities=ENTITIES)
+    group = HostGroup.build(
+        game, 1, clock=clock, max_prediction=8, num_players=2,
+        max_sessions=2, idle_timeout_ms=0, max_attempts=4, backoff_ms=32,
+        backoff_seed=9,
+    )
+    # the same seed replays the same draw sequence, each inside the
+    # jittered-exponential envelope [base/2, base]
+    twin = HostGroup.build(
+        game, 1, clock=FakeClock(), max_prediction=8, num_players=2,
+        max_sessions=2, idle_timeout_ms=0, max_attempts=4, backoff_ms=32,
+        backoff_seed=9,
+    )
+    expected = [twin.backoff_delay_ms(a) for a in range(3)]
+    for attempt, delay in enumerate(expected):
+        base = 32 << attempt
+        assert base // 2 <= delay <= base
+    assert len(set(expected)) > 1  # jitter actually varies the draws
+
+    net = InMemoryNetwork(clock)
+    group.attach(solo_session(net, "a"))
+    group.attach(solo_session(net, "b"))
+    t0 = clock.now_ms()
+    marks = []
+    real_backoff = group._backoff
+
+    def spying_backoff(attempt):
+        real_backoff(attempt)
+        marks.append(clock.now_ms() - t0)
+
+    group._backoff = spying_backoff
+    with pytest.raises(GroupSaturated):
+        group.attach(solo_session(net, "overflow"))
+    # the observed retry instants are exactly the seeded schedule's
+    # cumulative sums — pinned, not merely bounded
+    assert marks == [sum(expected[: i + 1]) for i in range(len(expected))]
+
+    # a different seed decorrelates the schedule
+    other = HostGroup.build(
+        game, 1, clock=FakeClock(), max_prediction=8, num_players=2,
+        max_sessions=2, idle_timeout_ms=0, max_attempts=4, backoff_ms=32,
+        backoff_seed=10,
+    )
+    assert [other.backoff_delay_ms(a) for a in range(3)] != expected
