@@ -1438,7 +1438,40 @@ def bench_tunnel_floor():
         core.tick_multi(rows)
     true_barrier(core.state)
     fused16_per_tick = (time.perf_counter() - t0) / (10 * 16) * 1000.0
-    return {
+
+    # ...and the while_loop K-VIRTUAL-TICK DRIVER arm (the resident
+    # serving loop's dispatch-amortization ceiling, measured with the
+    # REAL driver machinery — mailbox stage + commit + one lax.while_loop
+    # dispatch per K ticks — but independent of the serving
+    # integration): a capacity-1 MultiSessionDeviceCore, one fast
+    # (one-advance, trailing-save) row per virtual tick, the shape the
+    # request path's steady state stages. Compare while_loop_k1 against
+    # while_loop_k64 for the pure amortization factor; compare k16
+    # against fused16_ms_per_tick for while_loop-vs-scan overhead.
+    from ggrs_tpu.tpu.backend import MultiSessionDeviceCore
+
+    mdev = MultiSessionDeviceCore(
+        ExGame(4, ENTITIES), max_prediction=13, num_players=4, capacity=1
+    )
+    mdev.attach_mailbox(64)
+    mdev.warmup()
+    wl_row = core.pack_tick_row(False, 0, z_in, z_st, slots1, 1)
+    wl = {}
+    for K in (1, 4, 16, 64):
+        reps = max(64 // K, 4)
+        for warm in (True, False):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                for _k in range(K):
+                    mdev.stage_mailbox_row(
+                        0, wl_row, last_active=2, fast=True
+                    )
+                mdev.commit_mailbox()
+                mdev.drive_mailbox()
+            true_barrier(mdev.states["frame"])
+            if not warm:
+                wl[K] = (time.perf_counter() - t0) / (reps * K) * 1000.0
+    out = {
         "empty_dispatch_ms": round(per_dispatch, 4),
         "dispatch_readback_roundtrip_ms": round(roundtrip, 4),
         "tick_program_ms": round(tick_program, 4),
@@ -1450,6 +1483,12 @@ def bench_tunnel_floor():
         "tick_program_cond_ms": round(tick_program_cond, 4),
         "fused16_ms_per_tick": round(fused16_per_tick, 4),
     }
+    for K, ms in wl.items():
+        out[f"while_loop_k{K}_ms_per_tick"] = round(ms, 4)
+    out["while_loop_amortization"] = round(
+        wl[1] / max(wl[64], 1e-9), 2
+    )
+    return out
 
 
 def bench_p2p4_rollback(rounds=12, burst=12, lazy_ticks=0, mesh_devices=0,
@@ -2005,6 +2044,136 @@ def bench_spec_bubble(sessions=16, ticks=240, entities=1024,
     }
 
 
+def bench_resident_loop(sessions=16, ticks=240, entities=256,
+                        resident_ticks=16, reps=3, seed=11):
+    """THE same-run A/B for the device-resident serving loop: identical
+    seeded lossy traffic through a `resident=True` SessionHost (device
+    mailbox + lax.while_loop virtual-tick driver, one driver dispatch
+    per ~K ticks) and its dispatch-per-tick twin. Reports:
+
+    - session_ticks_per_sec both arms (ABBA-interleaved medians — this
+      box's serving arms carry large contention spread) and the ratio;
+    - dispatches_per_tick both arms: TICK-program dispatches (megabatch
+      + driver + adopt) per host tick — the resident arm's acceptance
+      bar is < 0.25 (mailbox commits are data transfers, reported
+      separately as commits_per_tick);
+    - vticks_per_dispatch and mailbox overflows (must be 0: overflow
+      degrades to an extra dispatch, never a dropped input);
+    - a bitwise parity check (checksum histories + canonical stacked
+      state/ring bytes) on the final rep pair — the A and the B really
+      computed the same fleet."""
+    import jax
+
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.network.sockets import InMemoryNetwork
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.serve.loadgen import (
+        build_matches,
+        drive_scripted,
+        make_scripts,
+        sync_fleet,
+    )
+    from ggrs_tpu.utils.clock import FakeClock
+
+    def run(resident):
+        clock = FakeClock()
+        net = InMemoryNetwork(
+            clock, latency_ms=20, jitter_ms=5, loss=0.02, seed=seed
+        )
+        host = SessionHost(
+            ExGame(num_players=4, num_entities=entities),
+            max_prediction=8,
+            num_players=4,
+            max_sessions=sessions + 4,
+            clock=clock,
+            idle_timeout_ms=0,
+            warmup=True,
+            resident=resident,
+            resident_ticks=resident_ticks,
+            # ample device window: the twin must never throttle on the
+            # inflight budget (the resident arm has no dispatch queue),
+            # or the two arms' traffic timing drifts apart and the
+            # bitwise-parity check below is comparing different fleets —
+            # the bench_spec_bubble discipline
+            max_inflight_rows=4 * (sessions + 4),
+        )
+        matches = build_matches(host, net, clock, sessions=sessions,
+                                seed=seed)
+        n_sessions = sum(len(keys) for keys in matches)
+        sync_fleet(host, matches, clock)
+        scripts = make_scripts(matches, ticks, seed=seed)
+        dev = host.device
+        base_mega = dev.megabatches
+        base_driver = dev.driver_dispatches
+        host.device.block_until_ready()
+        t0 = time.perf_counter()
+        desyncs = drive_scripted(host, matches, clock, scripts, ticks)
+        host.device.block_until_ready()
+        dt = time.perf_counter() - t0
+        assert not desyncs, f"resident bench desynced: {desyncs[:3]}"
+        tick_dispatches = (
+            dev.megabatches - base_mega
+            + dev.driver_dispatches - base_driver
+        )
+        res = {
+            "session_ticks_per_sec": round(n_sessions * ticks / dt, 1),
+            "dispatches_per_tick": round(tick_dispatches / ticks, 3),
+        }
+        if resident:
+            res["vticks_per_dispatch"] = round(
+                dev.vticks_executed / max(dev.driver_dispatches, 1), 2
+            )
+            res["mailbox_overflows"] = dev.mailbox.overflows
+        keys = [k for ks in matches for k in ks]
+        return res, host, keys
+
+    samples_res, samples_twin = [], []
+    last = {}
+    for k in range(max(reps, 1)):
+        for resident in ((True, False) if k % 2 == 0 else (False, True)):
+            res, host, keys = run(resident)
+            last[resident] = (res, host, keys)
+            (samples_res if resident else samples_twin).append(
+                res["session_ticks_per_sec"]
+            )
+    # bitwise parity on the final pair: checksum histories + canonical
+    # stacked worlds — the resident arm must be computing the twin's
+    # exact fleet, or the throughput comparison is meaningless
+    (_, host_r, keys_r), (_, host_t, keys_t) = last[True], last[False]
+    for ka, kb in zip(keys_r, keys_t):
+        sa, sb = host_r.session(ka), host_t.session(kb)
+        assert sa.current_frame == sb.current_frame > 0
+        assert sa.local_checksum_history == sb.local_checksum_history
+    for ta, tb in zip(
+        jax.tree.leaves(host_r.device.stacked_canonical()),
+        jax.tree.leaves(host_t.device.stacked_canonical()),
+    ):
+        assert np.array_equal(np.asarray(ta), np.asarray(tb)), (
+            "resident arm diverged from the dispatch-per-tick twin"
+        )
+    p50 = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    res_info = last[True][0]
+    return {
+        "sessions": sessions,
+        "ticks": ticks,
+        "entities": entities,
+        "resident_ticks": resident_ticks,
+        "reps": max(reps, 1),
+        "session_ticks_per_sec_resident_p50": p50(samples_res),
+        "session_ticks_per_sec_twin_p50": p50(samples_twin),
+        "resident_speedup": round(
+            p50(samples_res) / max(p50(samples_twin), 1e-9), 3
+        ),
+        "dispatches_per_tick_resident": res_info["dispatches_per_tick"],
+        "dispatches_per_tick_twin": last[False][0]["dispatches_per_tick"],
+        "vticks_per_dispatch": res_info["vticks_per_dispatch"],
+        "mailbox_overflows": res_info["mailbox_overflows"],
+        "bitwise_parity": True,
+        "samples_resident": samples_res,
+        "samples_twin": samples_twin,
+    }
+
+
 def bench_env_rollout(num_envs=256, steps=200, entities=256, episode_len=64,
                       mesh_devices=0):
     """The RL-environment workload (ggrs_tpu/env/): env steps/sec through
@@ -2266,7 +2435,9 @@ def main():
         "serve_fast_dispatch_rate", "env_steps_per_sec",
         "sharded_vs_single_device_speedup",
         "chaos_fps_retained", "frames_served_from_speculation",
-        "spec_hit_rate", "spec_fps_lift", "headline_source",
+        "spec_hit_rate", "spec_fps_lift",
+        "resident_speedup", "resident_dispatches_per_tick",
+        "headline_source",
     )
 
     def _short_line(partial=False, error=None):
@@ -2563,6 +2734,19 @@ def main():
     ]
     full["spec_hit_rate"] = spec["spec_hit_rate"]
     full["spec_fps_lift"] = spec["spec_fps_lift"]
+    # the device-resident serving loop: resident host vs its
+    # dispatch-per-tick twin on identical seeded traffic (same-run A/B,
+    # ABBA-interleaved, bitwise parity asserted inside the arm)
+    resident = phase(
+        "resident_loop",
+        f"bench_resident_loop(ticks={60 if SMOKE else 240}, "
+        f"reps={1 if SMOKE else 3})",
+        timeout_s=1800,
+    )
+    full["resident_speedup"] = resident["resident_speedup"]
+    full["resident_dispatches_per_tick"] = resident[
+        "dispatches_per_tick_resident"
+    ]
     beam_exec = phase("_beam_exec", "bench_beam_exec()")
     beam_live = phase(
         "_beam_live",

@@ -43,6 +43,20 @@ CORE_STATE: FrozenSet[str] = frozenset({
     "plan_cache", "dispatch_signatures",
     "rings", "states",
     "_draft_stage_pools",
+    # the device-resident input mailbox (tpu/mailbox.py) and its row
+    # ring: the mailbox's INTERNAL staging has its own policy below;
+    # from the core's side, the mailbox binding and the ring may only
+    # be rebound by the attach/commit/drive/warmup entry points
+    "mailbox", "rows_dev",
+})
+
+# the mailbox's own shared state: the host-side fill-cycle image (counts,
+# staged rows, the open cycle's future checksum batch) and the pooled
+# commit staging — reused across commits only under the core's fence
+# guarantee, so only the mailbox's own entry points may write them
+MAILBOX_STATE: FrozenSet[str] = frozenset({
+    "rows_dev", "_counts", "_staged", "pending_rows", "_future",
+    "_pools", "_cycle_max_last_active", "_cycle_all_fast", "_vt_fast",
 })
 
 
@@ -95,6 +109,13 @@ POLICIES: Dict[str, FencePolicy] = {
             ("MultiSessionDeviceCore", "draft"),
             ("MultiSessionDeviceCore", "adopt_slot"),
             ("MultiSessionDeviceCore", "_acquire_draft_stage"),
+            # the device-resident loop's write/harvest entry points: the
+            # mailbox attaches once, commits admit the scatter to the
+            # fence, and the driver dispatch rebinds the stacked worlds
+            # under the same discipline as dispatch
+            ("MultiSessionDeviceCore", "attach_mailbox"),
+            ("MultiSessionDeviceCore", "commit_mailbox"),
+            ("MultiSessionDeviceCore", "drive_mailbox"),
             # the session-mesh serving core's fence-dispatch entry
             # points: overrides of the SAME protocol methods (GSPMD row
             # constraints + per-shard instruments wrapped around the
@@ -130,6 +151,22 @@ POLICIES: Dict[str, FencePolicy] = {
     "ggrs_tpu/fleet/island.py": FencePolicy(
         protected=CORE_STATE,
         allowed=frozenset(),
+    ),
+    # the device-resident input mailbox: the donated row ring, the
+    # host-side fill-cycle image and the pooled commit staging are the
+    # resident loop's correctness protocol — a write outside the
+    # stage/commit/cycle entry points breaks the fence-reuse proof or
+    # desynchronizes the watermarks from the rows the device will read
+    "ggrs_tpu/tpu/mailbox.py": FencePolicy(
+        protected=MAILBOX_STATE,
+        allowed=frozenset({
+            ("DeviceMailbox", "__init__"),
+            ("DeviceMailbox", "stage"),
+            ("DeviceMailbox", "commit"),
+            ("DeviceMailbox", "_acquire_commit_stage"),
+            ("DeviceMailbox", "take_cycle"),
+            ("DeviceMailbox", "warmup"),
+        }),
     ),
     # the batched wire pump's pooled decode staging (network/pump.py):
     # the offset/length scratch is reused across pump passes — only the

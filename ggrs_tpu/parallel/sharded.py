@@ -217,6 +217,22 @@ def shard_stacked_ring(stacked_ring, mesh: Mesh):
     )
 
 
+def mailbox_spec() -> P:
+    """Partition spec for the device-resident input mailbox's [S, K, L]
+    row ring (and its [S] watermark vector): the slot axis splits over
+    the mesh's `session` axis, virtual-tick and control-word axes stay
+    local — a lane's whole fill cycle lives with the shard that owns its
+    world, so the resident driver's per-vtick row reads never cross
+    ICI."""
+    return P("session")
+
+
+def shard_mailbox(rows, mesh: Mesh):
+    """Place a mailbox row ring (or watermark vector) on the mesh per
+    `mailbox_spec` — the resident-loop twin of `shard_stacked_state`."""
+    return jax.device_put(rows, NamedSharding(mesh, mailbox_spec()))
+
+
 def stacked_sharded_checksum(stacked_state, mesh: Mesh, keys=None):
     """Per-slot order-invariant checksums of a session-stacked (and
     optionally entity-sharded) state pytree, with the cross-shard word

@@ -31,6 +31,14 @@ Telemetry rides the PR 2 obs registry: sessions active/evicted/rejected,
 megabatch-size histogram, cross-session occupancy, admission-queue wait
 histogram — one `host.telemetry()` snapshot folds them in with every
 hosted session's own section.
+
+`resident=True` retires even the one-dispatch-per-tick cadence: staged
+rows feed a device-resident input mailbox (tpu/mailbox.py) and a jitted
+`lax.while_loop` virtual-tick driver consumes up to `resident_ticks` of
+them per single dispatch — the host demoted to an async feeder
+(pump → mailbox write → driver dispatch → lazy harvest), bit-identical
+to the dispatch-per-tick twin (docs/DESIGN.md "Device-resident serving
+loop").
 """
 
 from __future__ import annotations
@@ -183,7 +191,8 @@ class SessionHost:
                  async_inflight: int = 4, warmup: bool = False,
                  depth_routing: bool = True, batched_pump: bool = True,
                  mesh=None, speculation: bool = False,
-                 speculation_seed: int = 0):
+                 speculation_seed: int = 0, resident: bool = False,
+                 resident_ticks: int = 16):
         """`max_inflight_rows`: the device-window budget — session tick
         rows admitted past the fence before ready sessions start queuing
         (default: 2 full megabatches' worth). `idle_timeout_ms`: sessions
@@ -229,7 +238,29 @@ class SessionHost:
         of all-to-all. Everything else (sessions, envs, migration,
         checkpoints — which stay canonical and restore across layouts)
         is unchanged, and the sharded host is bit-identical to a
-        single-device twin fed the same traffic."""
+        single-device twin fed the same traffic.
+
+        `resident=True` is the DEVICE-RESIDENT SERVING LOOP: the host
+        becomes feed-and-harvest only. Staged session rows stop
+        dispatching one megabatch per host tick; instead they append to
+        a donated device-resident input mailbox (tpu/mailbox.py — one
+        batched scatter per host tick), and every `resident_ticks` host
+        ticks ONE jitted `lax.while_loop` virtual-tick driver dispatch
+        ticks the whole fleet through its staged rows — rollbacks
+        resimulating in-loop, lanes at different fill depths walking
+        their own watermarks — with checksums accumulating into
+        device-side [K, S, W] output rings harvested lazily behind the
+        async fence. Dispatch cadence drops from >= 1 megabatch per host
+        tick to ~1/K driver dispatches per tick. A lane outrunning K
+        degrades to an extra dispatch (ggrs_mailbox_overflow_total),
+        never a dropped input; adopts, draft launches, slot lifecycle,
+        migration export, checkpoint and drain all drain the mailbox
+        back to canonical form first, so every export/import,
+        kill→restore and sharded↔unsharded contract survives unchanged.
+        Bit-identical to a resident=False twin fed the same traffic
+        (tests/test_resident_loop.py pins state, ring bytes and checksum
+        histories); the dispatch-per-tick path is kept as that parity
+        twin."""
         from ..network.pump import WirePump, host_tax_histogram
         from ..tpu.backend import MultiSessionDeviceCore
 
@@ -336,6 +367,20 @@ class SessionHost:
             self._spec = None
         # pooled draft-row buffers, grown to device capacity on first use
         self._draft_row_pool: List[np.ndarray] = []
+        # device-resident serving loop: attach the input mailbox BEFORE
+        # warmup so the driver variants compile with the megabatch grid
+        self.resident = resident
+        self.resident_ticks = resident_ticks
+        self._mbox_ticks = 0  # host ticks since the last driver dispatch
+        # effective drive cadence: starts at resident_ticks and tightens
+        # as lanes with desync detection attach (_commit_lane) — a drive
+        # must land BEFORE each lane's interval-forced checksum flush, or
+        # the flush forces a synchronous mid-advance drive and the
+        # harvest stops overlapping host work
+        self._resident_cadence = resident_ticks
+        if resident:
+            assert resident_ticks >= 1
+            self.device.attach_mailbox(resident_ticks)
         if warmup:
             self.device.warmup()
 
@@ -482,6 +527,26 @@ class SessionHost:
             # must pump per-message too, or the "pre-batched" arm would
             # still ride the batched single-session pump underneath
             session.batched_pump = False
+        if kind == "p2p" and self.resident:
+            # keep the drive cadence two ticks inside the lane's desync
+            # interval: the interval-forced flush then always finds its
+            # values already driven and pump-harvested, instead of
+            # forcing a synchronous drive on the advance path
+            det = getattr(session, "desync_detection", None)
+            if det is not None and getattr(det, "enabled", False):
+                self._resident_cadence = max(
+                    1,
+                    min(self._resident_cadence, det.interval - 2),
+                )
+        if kind == "p2p":
+            # hosted lanes publish checksum reports at the interval-
+            # forced flush ONLY (resolution still rides the pump pass):
+            # publish timing is then a pure function of the frame
+            # counter, not of when device values became host-ready — a
+            # resident host's lazier harvest cadence would otherwise
+            # shift report datagrams on the seeded wire and fork the
+            # fault stream away from its dispatch-per-tick twin's
+            session.checksum_publish = "interval"
         lane = _Lane(
             key, session, slot, kind, n_players, local_handles,
             max_prediction, self.clock.now_ms(),
@@ -792,7 +857,11 @@ class SessionHost:
                             stage="parse",
                         )
                     continue
-                if lane.rows and lane.queued_since_tick is None:
+                if self.resident:
+                    # feed-and-harvest: rows move straight into the
+                    # mailbox fill cycle instead of the dispatch queue
+                    self._stage_resident(lane)
+                elif lane.rows and lane.queued_since_tick is None:
                     lane.queued_since_tick = self._tick_index
                     self._ready.append(lane.key)
         if tel.enabled:
@@ -800,8 +869,12 @@ class SessionHost:
                 (_time.perf_counter() - t_parse) * 1000.0
             )
 
-        # 3. dispatch megabatches under the device-window budget
+        # 3. dispatch megabatches under the device-window budget (env
+        # blocks still dispatch synchronously; in resident mode session
+        # lanes never enter the ready queue, so this is env-only there)
         self._pump_device()
+        if self.resident:
+            self._resident_pump()
 
         # 3b. speculative bubble-filling: draft the input-starved lanes'
         # futures into the device (one vmapped rollout batch riding the
@@ -816,6 +889,60 @@ class SessionHost:
         # 4. lifecycle: disconnect GC, then idle eviction
         self._run_gc(events)
         return events
+
+    def _stage_resident(self, lane: _Lane) -> None:
+        """Move a lane's freshly parsed rows into the device mailbox's
+        fill cycle (the resident twin of queueing for _pump_device):
+        saves bind lazy checksums against the cycle's future batch at
+        their [K, S, W] harvest index, so nothing blocks. Adopt rows —
+        a standing speculative draft matched this segment — force a
+        driver dispatch first (the lane's earlier rows must land before
+        the adopt serves its prefix), then dispatch through adopt_slot
+        exactly as the twin does."""
+        SnapshotRef, _LazyChecksum = _backend_refs()
+        dev = self.device
+        ring_len = dev.core.ring_len
+        while lane.rows:
+            staged = lane.rows.popleft()
+            if staged.adopt is not None:
+                dev.drive_mailbox()
+                draft_batch, packed = staged.adopt
+                batch = dev.adopt_slot(lane.slot, draft_batch, packed)
+                base = 0
+            else:
+                batch, base = dev.stage_mailbox_row(
+                    lane.slot, staged.row,
+                    last_active=staged.last_active, fast=staged.fast,
+                )
+            for slot_i, save in staged.saves:
+                save.cell.save_lazy(
+                    save.frame,
+                    SnapshotRef(save.frame, save.frame % ring_len),
+                    _LazyChecksum(batch, base + slot_i),
+                )
+
+    def _resident_pump(self) -> None:
+        """The resident scheduler's per-tick tail: land this tick's
+        staged rows on the device in ONE batched mailbox transfer, then
+        decide whether this tick drives. Drives fire every
+        `resident_ticks` host ticks, or early when any lane is within
+        two rows of the mailbox depth — the early drive keeps a
+        double-row tick (misprediction rollback + keepalive segment)
+        from ever overflowing in steady state, so
+        ggrs_mailbox_overflow_total stays a true anomaly counter."""
+        dev = self.device
+        mbox = dev.mailbox
+        dev.commit_mailbox()
+        if not mbox.pending_rows:
+            self._mbox_ticks = 0
+            return
+        self._mbox_ticks += 1
+        if (
+            self._mbox_ticks >= self._resident_cadence
+            or mbox.max_fill() >= mbox.depth - 2
+        ):
+            dev.drive_mailbox()
+            self._mbox_ticks = 0
 
     def _launch_drafts(self) -> None:
         """Collect every starved p2p lane that can be drafted this tick
@@ -928,6 +1055,10 @@ class SessionHost:
                 (lane, anchor, scripts[: len(members)], members,
                  fingerprint)
             )
+        if self.resident:
+            # drafts anchor on ring snapshots: rows the mailbox still
+            # owes must land before the rollout reads the rings
+            device.drive_mailbox()
         batch = device.draft(entries)
         for lane, anchor, scripts, members, fingerprint in packed_metas:
             self._spec.install_draft(
@@ -1443,6 +1574,30 @@ class SessionHost:
             **(
                 {"speculation": self._spec.section()}
                 if self._spec is not None
+                else {}
+            ),
+            # device-resident loop section (absent on dispatch-per-tick
+            # hosts, so old readers stay compatible)
+            **(
+                {
+                    "resident": {
+                        "depth": self.resident_ticks,
+                        "driver_dispatches": dev.driver_dispatches,
+                        "vticks_executed": dev.vticks_executed,
+                        "vticks_per_dispatch": (
+                            round(
+                                dev.vticks_executed
+                                / dev.driver_dispatches,
+                                3,
+                            )
+                            if dev.driver_dispatches
+                            else None
+                        ),
+                        "mailbox_pending": dev.mailbox.pending_rows,
+                        "mailbox_overflows": dev.mailbox.overflows,
+                    }
+                }
+                if self.resident
                 else {}
             ),
         }

@@ -34,6 +34,17 @@ arrival patterns against a non-speculating twin.
 This module is pure host-side numpy bookkeeping — it never touches the
 device core's fenced state (FEN001 keeps serve/ at zero allowances);
 dispatches go through the owning `MultiSessionDeviceCore` methods.
+
+Resident-loop interplay (serve/host.py `resident=True`): drafts anchor
+on ring snapshots and adopts serve a lane's NEXT row, so both are
+ordering barriers against the device mailbox — the host drives the
+pending fill cycle before `device.draft(...)` (the rollout must read
+rings that include every staged save) and before `device.adopt_slot`
+(the lane's earlier rows must land first). Nothing in this module
+changes: the planner's record/verify streams are host-side and see the
+same segments in the same order either way, which is why a resident
+speculating host adopts the exact frames its dispatch-per-tick twin
+does (tests/test_resident_loop.py pins it).
 """
 
 from __future__ import annotations

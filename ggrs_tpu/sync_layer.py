@@ -207,6 +207,55 @@ class PendingChecksumReport:
         while len(self._pending) > self.MAX_PENDING:
             self._pending.popleft()
 
+    def _bind(self, max_serial: Optional[int]) -> None:
+        """Bind a getter for EVERY queued old-enough report (not just the
+        head: binding is cheap and non-blocking, getters are stable
+        across later ring-slot reuse, and a younger report's slot can be
+        overwritten while an older value is still in flight), dropping
+        entries whose ring slot was reused before the first read. THE
+        one binding walk — flush() and bind_and_prefetch() both route
+        through it, so the serial guard and the reuse drop can never
+        diverge between the emitting and the resolve-only paths."""
+        from collections import deque
+
+        bound = deque()
+        for entry in self._pending:
+            frame, cell, getter, serial = entry
+            if getter is None:
+                if max_serial is not None and serial > max_serial:
+                    bound.append(entry)  # too fresh to bind yet
+                    continue
+                if cell.frame != frame:  # ring slot reused before read
+                    continue
+                entry[2] = cell.checksum_getter()
+            bound.append(entry)
+        self._pending = bound
+
+    def bind_and_prefetch(self, max_serial: Optional[int] = None) -> None:
+        """The resolve-only half of flush() — DETERMINISTIC-PUBLISH mode
+        (hosted sessions, `checksum_publish == "interval"`): bind getters
+        for every old-enough entry and start a background prefetch on the
+        head, so the interval-forced flush later finds the bytes already
+        moved — but emit NOTHING. Report emission then happens at fixed
+        interval ticks regardless of when device values became
+        host-ready, which keeps the wire byte-stream independent of
+        dispatch cadence — the property that lets a resident
+        (mailbox-driven) host put bit-identical traffic on a seeded
+        lossy network as its dispatch-per-tick twin. Getters still
+        waiting on an UNDISPATCHED batch (a resident fill cycle's
+        future) are left alone: prefetching those would force the very
+        driver dispatch the mailbox exists to defer."""
+        self._bind(max_serial)
+        for _frame, _cell, getter, _serial in self._pending:
+            if getter is None:
+                return
+            if not getattr(getter, "ready", True):
+                if not getattr(getter, "dispatch_pending", False):
+                    prefetch = getattr(getter, "prefetch", None)
+                    if callable(prefetch):
+                        prefetch()
+                return
+
     def flush(self, force: bool, emit, max_serial: Optional[int] = None) -> int:
         """emit(frame, checksum) is called at most once per captured report,
         in capture order. Returns the number of reports that were resolved
@@ -221,26 +270,7 @@ class PendingChecksumReport:
         so an opportunistic mid-run flush must stay a couple of advances
         behind the capture frontier; the interval-forced flush passes
         None and drains everything, exactly as before."""
-        from collections import deque
-
-        # bind a getter for EVERY queued (old-enough) report first, not
-        # just the head: binding is cheap and non-blocking, getters are
-        # stable across later ring-slot reuse, and a younger report's
-        # slot can be overwritten while an older value is still in
-        # flight — binding lazily at the head would drop reports that
-        # were perfectly capturable when they queued
-        bound = deque()
-        for entry in self._pending:
-            frame, cell, getter, serial = entry
-            if getter is None:
-                if max_serial is not None and serial > max_serial:
-                    bound.append(entry)  # too fresh to bind yet
-                    continue
-                if cell.frame != frame:  # ring slot reused before first read
-                    continue
-                entry[2] = cell.checksum_getter()
-            bound.append(entry)
-        self._pending = bound
+        self._bind(max_serial)
         blocked = 0
         while self._pending:
             frame, _cell, getter, serial = self._pending[0]

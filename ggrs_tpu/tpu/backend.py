@@ -222,6 +222,14 @@ class _LazyChecksum:
     def ready(self) -> bool:
         return self._batch.ready
 
+    @property
+    def dispatch_pending(self) -> bool:
+        """True while the owning batch's dispatch hasn't happened yet (a
+        resident fill cycle's future): prefetching such a getter would
+        FORCE the dispatch — deterministic-publish binding skips those
+        (sync_layer.PendingChecksumReport.bind_and_prefetch)."""
+        return getattr(self._batch, "dispatch_pending", False)
+
 
 class _FutureChecksumBatch:
     """Checksum-batch stand-in for ticks still sitting in the lazy tick
@@ -253,6 +261,10 @@ class _FutureChecksumBatch:
     @property
     def ready(self) -> bool:
         return self.batch is not None and self.batch.ready
+
+    @property
+    def dispatch_pending(self) -> bool:
+        return self.batch is None
 
 
 class DispatchPlanCache:
@@ -1915,6 +1927,15 @@ class MultiSessionDeviceCore:
             )
             self._draft_pad_row = np.zeros((self._draft_len,), np.int32)
             self._draft_stage_pools: dict = {}
+        # device-resident serving loop (attach_mailbox builds all three):
+        # the donated [S, K, L] input mailbox and the jitted
+        # lax.while_loop virtual-tick driver that consumes it — one host
+        # dispatch ticks the whole fleet for up to K virtual ticks
+        self.mailbox = None
+        self._driver_fn = None
+        self._driver_fast_fn = None
+        self.driver_dispatches = 0
+        self.vticks_executed = 0
         # per-row-bucket pooled (idx, rows) staging, async_inflight + 1
         # deep — the dispatch compaction packs straight into these
         # instead of allocating + re-tiling pad rows every megabatch
@@ -1975,6 +1996,12 @@ class MultiSessionDeviceCore:
     def _place_rings(self, tree):
         """Placement hook for the stacked rings — see `_place_states`."""
         return tree
+
+    def _place_mailbox(self, rows):
+        """Placement hook for the [S, K, L] mailbox row ring (identity on
+        one device; the sharded subclass splits the slot axis over the
+        session mesh via parallel/sharded.shard_mailbox)."""
+        return rows
 
     def _init_slot_layout(self) -> None:
         """Build the logical-slot -> physical-stack-index map. One
@@ -2127,6 +2154,12 @@ class MultiSessionDeviceCore:
         base = len(self.buckets) * (len(self.depth_buckets) + 1)
         if self.speculation:
             base += 2 * len(self.buckets)
+        if self.mailbox is not None:
+            # resident driver: one windowed variant per depth bucket
+            # plus the all-fast variant, plus one commit scatter per
+            # pow2 commit bucket
+            base += len(self.depth_buckets) + 1
+            base += len(self.mailbox.commit_buckets)
         return base
 
     def megabatch_programs(self) -> List[Tuple[int, Optional[int], int]]:
@@ -2355,6 +2388,10 @@ class MultiSessionDeviceCore:
         if self.speculation:
             fns["_draft_impl"] = self._draft_fn
             fns["_adopt_slot_impl"] = self._adopt_slot_fn
+        if self.mailbox is not None:
+            fns["_driver_impl"] = self._driver_fn
+            fns["_driver_fast_impl"] = self._driver_fast_fn
+            fns["mailbox._commit_impl"] = self.mailbox._commit_fn
         return fns
 
     def _draft_impl(self, rings, idx, rows):
@@ -2529,6 +2566,219 @@ class MultiSessionDeviceCore:
         return _ChecksumBatch(his, los, self.ledger)
 
     # ------------------------------------------------------------------
+    # device-resident serving loop (serve/host.py's resident=True mode
+    # drives this): a donated input mailbox the host feeds, and a jitted
+    # lax.while_loop virtual-tick driver that consumes it — dispatch
+    # cadence drops from one megabatch per host tick to one driver
+    # dispatch per K virtual ticks, with checksums accumulating into
+    # [K, S, W] output rings harvested lazily behind the async fence
+    # ------------------------------------------------------------------
+
+    def attach_mailbox(self, depth: int):
+        """Build the device-resident input mailbox (tpu/mailbox.py) and
+        the virtual-tick driver programs. `depth` = K, the maximum
+        virtual ticks one driver dispatch executes per lane. Call before
+        warmup() so the driver variants compile with the megabatch
+        grid."""
+        import jax
+
+        from .mailbox import DeviceMailbox
+
+        assert self.mailbox is None, "mailbox already attached"
+        self.mailbox = DeviceMailbox(self, depth)
+        self._driver_fn = jax.jit(
+            self._driver_impl, static_argnums=(5,), donate_argnums=(0, 1)
+        )
+        self._driver_fast_fn = jax.jit(
+            self._driver_fast_impl, donate_argnums=(0, 1)
+        )
+        return self.mailbox
+
+    def _driver_impl(self, rings, states, mbox_rows, marks, vt_fast,
+                     nslots):
+        """The virtual-tick driver: a lax.while_loop over the mailbox's
+        vtick axis, each iteration ticking the WHOLE stack — rollback
+        rows load and resimulate in-loop, exactly the single-session
+        tick body, without returning to Python between virtual ticks.
+        Lane s consumes rows for vticks [0, marks[s]); rows above a
+        lane's watermark (and every pad slot's rows) mask to the inert
+        pad row, so lanes at different fill depths ride one program. The
+        loop exits at the deepest watermark: a half-full mailbox pays
+        for the vticks it actually has, not for K.
+
+        Per-vtick depth routing rides INSIDE the loop: `vt_fast[t]`
+        (host-computed: every row staged at vtick t was fast-eligible)
+        conds each iteration between the vmapped zero-rollback fast step
+        and the vmapped windowed scan at the STATIC depth bucket
+        `nslots` — XLA executes only the taken branch, so one rollback
+        row costs its own vtick the windowed scan, not the whole cycle.
+        Bit-identical either way (the fast/windowed contract the
+        megabatch depth routing already pins). Checksums land in
+        [K, S, W] output rings (flat index j * S * W + s * W + i),
+        harvested lazily by the host."""
+        import jax.numpy as jnp
+
+        K, S = mbox_rows.shape[1], mbox_rows.shape[0]
+        W = self.core.window
+        pad = jnp.asarray(self._pad_row)
+        limit = jnp.max(marks)
+
+        def one(ring, state, row):
+            ring, state, _, hi, lo = self.core._tick_windowed_impl(
+                ring, state, row, {}, nslots
+            )
+            return ring, state, hi, lo
+
+        def cond(carry):
+            return carry[0] < limit
+
+        def body(carry):
+            t, rings, states, his, los = carry
+            rows_t = jax.lax.dynamic_index_in_dim(
+                mbox_rows, t, 1, keepdims=False
+            )
+            valid = t < marks
+            rows_t = jnp.where(valid[:, None], rows_t, pad[None, :])
+
+            def fast_branch(args):
+                rings, states = args
+                return jax.vmap(self.core._tick_fast_impl)(
+                    rings, states, rows_t
+                )
+
+            def windowed_branch(args):
+                rings, states = args
+                return jax.vmap(one)(rings, states, rows_t)
+
+            rings, states, hi, lo = jax.lax.cond(
+                vt_fast[t], fast_branch, windowed_branch, (rings, states)
+            )
+            his = jax.lax.dynamic_update_index_in_dim(his, hi, t, 0)
+            los = jax.lax.dynamic_update_index_in_dim(los, lo, t, 0)
+            return t + 1, rings, states, his, los
+
+        his = jnp.zeros((K, S, W), dtype=jnp.uint32)
+        los = jnp.zeros((K, S, W), dtype=jnp.uint32)
+        _, rings, states, his, los = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), rings, states, his, los)
+        )
+        return rings, states, his, los
+
+    def _driver_fast_impl(self, rings, states, mbox_rows, marks):
+        """The driver's zero-rollback variant: when EVERY row of the fill
+        cycle is fast-eligible (no load, one advance, no save past
+        window slot 1 — the dominant live traffic), each iteration
+        vmaps the per-slot zero-rollback fast tick
+        (ResimCore._tick_fast_impl, the in-loop twin of the megabatch
+        fast program) instead of the windowed scan body. Bit-identical
+        to the windowed driver on eligible rows — masked saves write the
+        old ring value back, pad rows are inert — by the same contract
+        the megabatch fast path pins."""
+        import jax.numpy as jnp
+
+        K, S = mbox_rows.shape[1], mbox_rows.shape[0]
+        W = self.core.window
+        pad = jnp.asarray(self._pad_row)
+        limit = jnp.max(marks)
+
+        def cond(carry):
+            return carry[0] < limit
+
+        def body(carry):
+            t, rings, states, his, los = carry
+            rows_t = jax.lax.dynamic_index_in_dim(
+                mbox_rows, t, 1, keepdims=False
+            )
+            valid = t < marks
+            rows_t = jnp.where(valid[:, None], rows_t, pad[None, :])
+            rings, states, hi, lo = jax.vmap(self.core._tick_fast_impl)(
+                rings, states, rows_t
+            )
+            his = jax.lax.dynamic_update_index_in_dim(his, hi, t, 0)
+            los = jax.lax.dynamic_update_index_in_dim(los, lo, t, 0)
+            return t + 1, rings, states, his, los
+
+        his = jnp.zeros((K, S, W), dtype=jnp.uint32)
+        los = jnp.zeros((K, S, W), dtype=jnp.uint32)
+        _, rings, states, his, los = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), rings, states, his, los)
+        )
+        return rings, states, his, los
+
+    def stage_mailbox_row(self, slot: int, row: np.ndarray, *,
+                          last_active: int, fast: bool):
+        """Append one LOGICAL slot's packed tick row to the mailbox fill
+        cycle; returns (checksum batch, base index) for the row's save
+        bindings. A full lane — the host outran the virtual-tick depth —
+        degrades to an EXTRA driver dispatch (counted in
+        ggrs_mailbox_overflow_total), never a dropped input."""
+        mbox = self.mailbox
+        phys = int(self._phys[slot])
+        if mbox.lane_full(phys):
+            mbox.note_overflow()
+            self.drive_mailbox()
+        return mbox.stage(phys, row, last_active, fast)
+
+    def commit_mailbox(self) -> None:
+        """Land every row staged since the last commit on the device in
+        ONE batched scatter (the host's one mailbox transfer per host
+        tick); admits the write to the async fence so the pooled commit
+        staging is provably reusable."""
+        mbox = self.mailbox
+        if mbox is None or mbox.staged_count == 0:
+            return
+        handle = mbox.commit()
+        self._note_inflight(handle, 0)
+
+    def drive_mailbox(self):
+        """Consume the mailbox with ONE virtual-tick driver dispatch:
+        commit any uncommitted rows, route the cycle to the fast or the
+        depth-bucketed windowed driver variant, and fulfill the cycle's
+        future checksum batch from the [K, S, W] output rings. Returns
+        the batch (None when the mailbox is empty). Non-blocking beyond
+        the async fence — the harvest stays lazy."""
+        mbox = self.mailbox
+        if mbox is None or (mbox.pending_rows == 0 and mbox.staged_count == 0):
+            return None
+        self.commit_mailbox()
+        marks, n_rows, max_la, all_fast, vt_fast, future = mbox.take_cycle()
+        if all_fast:
+            nslots = 1
+            self.plan_cache.note(("resident_drive", 0), metrics=False)
+            self.rings, self.states, his, los = self._driver_fast_fn(
+                self.rings, self.states, mbox.rows_dev, marks
+            )
+        else:
+            nslots = self.depth_bucket_for(max_la)
+            self.plan_cache.note(
+                ("resident_drive", nslots), metrics=False
+            )
+            self.rings, self.states, his, los = self._driver_fn(
+                self.rings, self.states, mbox.rows_dev, marks, vt_fast,
+                nslots,
+            )
+        san = active_sanitizer()
+        if san is not None:
+            san.check_dispatch_budget(
+                self._budget_fns(),
+                self.dispatch_bucket_budget(),
+                context="MultiSessionDeviceCore.drive_mailbox",
+            )
+        vticks = int(marks.max())
+        self.driver_dispatches += 1
+        self.vticks_executed += vticks
+        self.rows_dispatched += n_rows
+        if GLOBAL_TELEMETRY.enabled:
+            mbox.observe_drive(n_rows, vticks)
+            self.core._m_depth.observe(nslots)
+            self.core._m_waste.inc((self.core.window - nslots) * n_rows)
+        self._note_inflight(his, n_rows)
+        batch = _ChecksumBatch(his, los, self.ledger)
+        if future is not None:
+            future.batch = batch
+        return batch
+
+    # ------------------------------------------------------------------
     # slot lifecycle
     # ------------------------------------------------------------------
 
@@ -2539,6 +2789,9 @@ class MultiSessionDeviceCore:
         import jax.numpy as jnp
 
         assert 0 <= slot < self.capacity
+        # staged mailbox rows execute BEFORE any slot lifecycle event:
+        # a reset must never race rows the ring still owes
+        self.drive_mailbox()
         phys = int(self._phys[slot])
         init = self.core.game.init_state()
         self.states = jax.tree.map(
@@ -2582,6 +2835,7 @@ class MultiSessionDeviceCore:
         so the program compiles once (warmup covers it) no matter which
         slots finish."""
         assert mask.shape == (self.capacity,)
+        self.drive_mailbox()  # lifecycle events drain the mailbox first
         m = np.zeros((self.stack_slots,), dtype=bool)
         m[self._phys[np.asarray(mask, dtype=bool)]] = True
         self.rings, self.states = self._reset_mask_fn(
@@ -2731,6 +2985,24 @@ class MultiSessionDeviceCore:
             self.states = jax.tree.map(
                 lambda a, x: a.at[self.pad_slot].set(x), self.states, init
             )
+        if self.mailbox is not None:
+            # resident driver variants: compile the commit-bucket
+            # scatters plus every driver program the live cycle router
+            # can pick (fast + one windowed variant per depth bucket).
+            # All-zero watermarks make each a true no-op — the
+            # while_loop exits before its first virtual tick — so only
+            # the compile happens, never a state change.
+            self.mailbox.warmup()
+            marks = np.zeros((self.stack_slots,), dtype=np.int32)
+            vt_fast = np.ones((self.mailbox.depth,), dtype=bool)
+            rows_dev = self.mailbox.rows_dev
+            self.rings, self.states, _, _ = self._driver_fast_fn(
+                self.rings, self.states, rows_dev, marks
+            )
+            for d in self.depth_buckets:
+                self.rings, self.states, _, _ = self._driver_fn(
+                    self.rings, self.states, rows_dev, marks, vt_fast, d
+                )
         # the masked batch reset (env auto-reset) with an all-False mask:
         # a true no-op on the stacked worlds, but the program exists
         # before the first episode ever finishes mid-serve
@@ -2748,6 +3020,10 @@ class MultiSessionDeviceCore:
         self.block_until_ready()
 
     def block_until_ready(self) -> None:
+        # "device state is current" includes the mailbox: rows the ring
+        # still owes execute first, so exports/checkpoints/parity reads
+        # always observe the canonical (fully ticked) worlds
+        self.drive_mailbox()
         jax.block_until_ready(self.states)
         self._inflight.clear()
         self.inflight_rows = 0
@@ -3001,6 +3277,33 @@ class ShardedMultiSessionDeviceCore(MultiSessionDeviceCore):
         idx = jax.lax.with_sharding_constraint(idx, self._row_sharding)
         rows = jax.lax.with_sharding_constraint(rows, self._row_sharding)
         return super()._draft_impl(rings, idx, rows)
+
+    def _place_mailbox(self, rows):
+        from ..parallel.sharded import shard_mailbox
+
+        return shard_mailbox(rows, self.mesh)
+
+    def _driver_impl(self, rings, states, mbox_rows, marks, vt_fast,
+                     nslots):
+        # the mailbox's slot axis is placed on the session mesh
+        # (shard_mailbox); constrain it (and the watermarks) in-program
+        # too so the vmapped vtick body partitions like every other
+        # stacked computation — each shard walks its own lanes' rows
+        # (vt_fast is a tiny replicated [K] routing vector)
+        mbox_rows = jax.lax.with_sharding_constraint(
+            mbox_rows, self._row_sharding
+        )
+        marks = jax.lax.with_sharding_constraint(marks, self._row_sharding)
+        return super()._driver_impl(
+            rings, states, mbox_rows, marks, vt_fast, nslots
+        )
+
+    def _driver_fast_impl(self, rings, states, mbox_rows, marks):
+        mbox_rows = jax.lax.with_sharding_constraint(
+            mbox_rows, self._row_sharding
+        )
+        marks = jax.lax.with_sharding_constraint(marks, self._row_sharding)
+        return super()._driver_fast_impl(rings, states, mbox_rows, marks)
 
     def _dispatch_staged(self, staged, n, bucket, *, last_active, fast):
         if GLOBAL_TELEMETRY.enabled:

@@ -541,6 +541,65 @@ class ResimCore:
         )
         return ring, state, verify, his, los
 
+    def _tick_fast_impl(self, ring, state, row):
+        """The per-slot ZERO-ROLLBACK fast tick: the single-session body
+        the resident virtual-tick driver vmaps in-loop
+        (MultiSessionDeviceCore._driver_fast_impl) when every row of a
+        mailbox fill cycle is fast-eligible — no load, at most one
+        advance, no active slot past window slot 1. The math is the
+        megabatch fast program's (_dispatch_fast_impl) per slot: no ring
+        gather/scatter beyond the two masked single-slot writes, no
+        resim scan — one step, two checksums. Masked saves write the
+        slot's OLD ring value back (the branchless trick), so even the
+        ring's bytes stay bit-identical to the cond program; pad rows
+        (advance 0, scratch saves) are inert. Checksums land at window
+        slots 0/1 of a zero [W] batch, keeping the flat indexing."""
+        W, P, I = self.window, self.num_players, self.game.input_size
+        advance = row[2]
+        s0 = row[self._off_save]
+        s1 = row[self._off_save + 1]
+        statuses0 = row[self._off_status : self._off_status + P]
+        inputs0 = (
+            row[self._off_input : self._off_input + P * I]
+            .astype(jnp.uint8)
+            .reshape(P, I)
+        )
+        zero = jnp.uint32(0)
+
+        def ring_write(ring, do, wslot, value):
+            old = jax.tree.map(
+                lambda r: jax.lax.dynamic_index_in_dim(
+                    r, wslot, 0, keepdims=False
+                ),
+                ring,
+            )
+            return jax.tree.map(
+                lambda r, s: jax.lax.dynamic_update_index_in_dim(
+                    r, s, wslot, 0
+                ),
+                ring,
+                _tree_where(do, value, old),
+            )
+
+        # slot 0: masked save of the pre-step state
+        hi0, lo0 = self.game.checksum(state)
+        do0 = s0 < self.ring_len
+        ring = ring_write(ring, do0, jnp.where(do0, s0, 0), state)
+        # the one advance (masked only so pad rows stay inert)
+        nxt = self.game.step(state, inputs0, statuses0)
+        state = _tree_where(advance > 0, nxt, state)
+        # slot 1: masked trailing save of the post-step state
+        hi1, lo1 = self.game.checksum(state)
+        do1 = s1 < self.ring_len
+        ring = ring_write(ring, do1, jnp.where(do1, s1, 0), state)
+        his = jnp.zeros((W,), dtype=hi0.dtype)
+        los = jnp.zeros((W,), dtype=lo0.dtype)
+        his = his.at[0].set(jnp.where(do0, hi0, zero))
+        his = his.at[1].set(jnp.where(do1, hi1, zero))
+        los = los.at[0].set(jnp.where(do0, lo0, zero))
+        los = los.at[1].set(jnp.where(do1, lo1, zero))
+        return ring, state, his, los
+
     def _branchless_nslots(
         self, row: np.ndarray, last_active: Optional[int] = None
     ) -> int:

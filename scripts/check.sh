@@ -64,6 +64,15 @@
 #   ggrs_fleet_* instruments through BOTH exporters
 #   (scripts/fleet_smoke.py, CPU jax, ~2-3 min). Also runs in the
 #   default flow (step 2d): the control plane is a correctness surface.
+#   --resident-smoke runs a lossy 16-session loadgen fleet on a
+#   SessionHost(resident=True) — device mailbox + lax.while_loop
+#   virtual-tick driver — under GGRS_SANITIZE=1, gated on
+#   vticks-per-dispatch p50 > 1, zero mailbox overflows, zero desyncs,
+#   zero post-warmup recompiles, the jit cache within
+#   dispatch_bucket_budget(), and the mailbox instruments through BOTH
+#   exporters (scripts/resident_smoke.py, CPU jax, <1 min). Also runs
+#   in the default flow (step 2e): the resident loop is a correctness
+#   surface, not an optional extra.
 #   --lint runs the determinism/trace/fence/wire static-analysis gate
 #   (python -m ggrs_tpu.analysis, pure AST, no jax, seconds) against
 #   analysis/baseline.toml, then the retrace-sanitizer smoke
@@ -150,6 +159,12 @@ if [ "${1:-}" = "--fleet-smoke" ]; then
   exit $?
 fi
 
+if [ "${1:-}" = "--resident-smoke" ]; then
+  echo "== resident smoke (device mailbox + while_loop virtual-tick driver) =="
+  GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/resident_smoke.py
+  exit $?
+fi
+
 if [ "${1:-}" = "--spec-smoke" ]; then
   echo "== spec smoke (speculative bubble-filling, single-device + sharded) =="
   GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
@@ -180,6 +195,9 @@ GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
 
 echo "== [2d/5] fleet smoke (multi-process control plane, real SIGKILL) =="
 JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+
+echo "== [2e/5] resident smoke (device mailbox + while_loop driver) =="
+GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/resident_smoke.py
 
 if [ "$FAST" = "0" ]; then
   echo "== [3/5] UBSAN build + native/wire tests =="

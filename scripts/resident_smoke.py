@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Device-resident serving loop smoke gate (scripts/check.sh
+--resident-smoke): a lossy 16-session loadgen fleet on a
+SessionHost(resident=True) — the donated device mailbox + lax.while_loop
+virtual-tick driver — under GGRS_SANITIZE=1:
+
+  1. AMORTIZATION ENGAGED: the ggrs_vticks_per_dispatch histogram's p50
+     is > 1 (one driver dispatch really covers multiple virtual ticks)
+     and tick-program dispatches per host tick stay well under the
+     dispatch-per-tick twin's >= 1;
+  2. NO DROPPED INPUTS: zero mailbox overflows (the cadence keeps up;
+     an overflow would only ever degrade to an extra dispatch, but the
+     steady state must not need one) and zero desyncs;
+  3. RECOMPILE-CLEAN: warmup compiles the driver variants and commit
+     buckets with the megabatch grid; the lossy serve afterwards
+     compiles NOTHING and every dispatch-function cache stays within
+     dispatch_bucket_budget() (which counts the driver + commit
+     programs);
+  4. the three mailbox instruments (ggrs_vticks_per_dispatch,
+     ggrs_mailbox_occupancy, ggrs_mailbox_overflow_total) export through
+     BOTH exporters and the host telemetry section carries the resident
+     block.
+
+Runs on CPU (JAX_PLATFORMS=cpu, self-applied) in under a minute. Exits
+nonzero with a reason on any failure.
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("GGRS_SANITIZE", "1")
+
+from ggrs_tpu import enable_global_telemetry  # noqa: E402
+from ggrs_tpu.obs import GLOBAL_TELEMETRY  # noqa: E402
+
+SESSIONS = 16
+TICKS = 80
+RESIDENT_TICKS = 8
+
+
+def fail(reason):
+    print(f"resident-smoke FAIL: {reason}")
+    sys.exit(1)
+
+
+def validate_prometheus(text):
+    sample = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(\{[a-zA-Z_][a-zA-Z0-9_:]*="(\\.|[^"\\])*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})?'
+        r" -?[0-9.eE+-]+$"
+    )
+    comment = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*( .*)?$")
+    for line in text.strip().splitlines():
+        ok = comment.match(line) if line.startswith("#") else sample.match(line)
+        if not ok:
+            fail(f"unparseable prometheus line: {line!r}")
+    return text
+
+
+def build_fleet(seed=7):
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.network.sockets import InMemoryNetwork
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.serve.loadgen import (
+        build_matches,
+        drive_scripted,
+        make_scripts,
+        sync_fleet,
+    )
+    from ggrs_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    net = InMemoryNetwork(
+        clock, latency_ms=20, jitter_ms=6, loss=0.02, seed=seed
+    )
+    host = SessionHost(
+        ExGame(num_players=4, num_entities=16),
+        max_prediction=8, num_players=4, max_sessions=SESSIONS + 4,
+        clock=clock, idle_timeout_ms=0, warmup=True,
+        resident=True, resident_ticks=RESIDENT_TICKS,
+        max_inflight_rows=4 * (SESSIONS + 4),
+    )
+    matches = build_matches(host, net, clock, sessions=SESSIONS, seed=seed)
+    sync_fleet(host, matches, clock)
+    # the measured window: count only post-sync dispatches
+    base_mega = host.device.megabatches
+    base_driver = host.device.driver_dispatches
+    GLOBAL_TELEMETRY.registry.reset()
+    scripts = make_scripts(matches, TICKS, seed=seed)
+    desyncs = drive_scripted(host, matches, clock, scripts, TICKS)
+    host.device.block_until_ready()
+    if desyncs:
+        fail(f"resident fleet desynced: {desyncs[:3]}")
+    if host.desyncs_observed:
+        fail("resident fleet observed desyncs")
+    return host, base_mega, base_driver
+
+
+def hist_p50(snap_entry):
+    vals = snap_entry["values"].get("", {})
+    count = vals.get("count", 0)
+    if not count:
+        return 0.0
+    cum = 0
+    for le, c in vals.get("buckets", {}).items():
+        cum += c
+        if cum * 2 >= count:
+            return float("inf") if le == "+Inf" else float(le)
+    return 0.0
+
+
+def main():
+    import jax  # noqa: F401
+
+    enable_global_telemetry()
+
+    import ggrs_tpu.tpu  # noqa: F401  (installs the GGRS_SANITIZE wrapper)
+    from ggrs_tpu.analysis.sanitize import active_sanitizer
+
+    san = active_sanitizer()
+    if san is None:
+        fail("sanitizer not installed (GGRS_SANITIZE=1 expected)")
+
+    base = len(san.recompiles)
+    host, base_mega, base_driver = build_fleet()
+    recompiles = san.recompiles[base:]
+    # bracket: warmup happens inside build_fleet BEFORE the drive — the
+    # sanitizer's warmup scope exempts those; anything recorded is a
+    # live-serve compile
+    if recompiles:
+        fail(
+            "post-warmup recompile on the resident host:\n"
+            + "\n".join(e.render() for e in recompiles)
+        )
+
+    dev = host.device
+    # --- 1. amortization engaged -------------------------------------
+    snap = host.telemetry()
+    m = snap["metrics"]
+    vt = m.get("ggrs_vticks_per_dispatch")
+    if vt is None:
+        fail("ggrs_vticks_per_dispatch missing from the snapshot exporter")
+    p50 = hist_p50(vt)
+    if not p50 > 1:
+        fail(f"vticks-per-dispatch p50 {p50} (expected > 1): {vt}")
+    tick_dispatches = (
+        dev.megabatches - base_mega + dev.driver_dispatches - base_driver
+    )
+    rate = tick_dispatches / TICKS
+    if rate >= 0.5:
+        fail(f"tick-program dispatches per host tick {rate} (expected < 0.5)")
+
+    # --- 2. no dropped inputs ----------------------------------------
+    if dev.mailbox.overflows:
+        fail(f"mailbox overflowed {dev.mailbox.overflows}x in steady state")
+    if dev.mailbox.pending_rows:
+        fail("mailbox left pending rows after block_until_ready")
+    frames = [lane.current_frame for lane in host._lanes.values()]
+    if min(frames) <= 0:
+        fail(f"a lane never advanced: {frames}")
+
+    # --- 3. jit cache within budget ----------------------------------
+    cache = sum(fn._cache_size() for fn in dev._budget_fns().values())
+    budget = dev.dispatch_bucket_budget()
+    if cache > budget:
+        fail(f"jit cache {cache} exceeds budget {budget}")
+
+    # --- 4. instruments through both exporters -----------------------
+    for name in (
+        "ggrs_vticks_per_dispatch",
+        "ggrs_mailbox_occupancy",
+        "ggrs_mailbox_overflow_total",
+    ):
+        if name not in m:
+            fail(f"{name} missing from the snapshot exporter")
+    resident = snap["host"].get("resident")
+    if not resident or resident["driver_dispatches"] < 1:
+        fail(f"host section resident block missing/empty: {resident}")
+    prom = validate_prometheus(GLOBAL_TELEMETRY.prometheus())
+    for name in (
+        "ggrs_vticks_per_dispatch_bucket",
+        "ggrs_mailbox_occupancy",
+        "ggrs_mailbox_overflow_total",
+    ):
+        if name not in prom:
+            fail(f"{name} missing from the prometheus exporter")
+
+    print(
+        f"resident-smoke OK: vticks_p50={p50} "
+        f"dispatches_per_tick={rate:.3f} "
+        f"driver_dispatches={dev.driver_dispatches} "
+        f"cache={cache}/{budget}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,334 @@
+"""Device-resident serving loop: the donated input mailbox
+(tpu/mailbox.py) + the lax.while_loop virtual-tick driver
+(MultiSessionDeviceCore._driver_impl) behind SessionHost(resident=True).
+
+The correctness contract is the repo's usual bitwise one: a resident
+host must be a BIT-EXACT replica of its dispatch-per-tick twin fed the
+same seeded traffic — every session's checksum history, the canonical
+stacked state AND ring bytes — across rollbacks (lossy network),
+disconnects, starved lanes (speculation drafting in the holes) and
+desync-report ordering, on the single-device core and the 8-shard
+session mesh; the jit cache freezes after warmup under GGRS_SANITIZE=1;
+and migration / checkpoint→restore drain the mailbox back to canonical
+form so a session leaves resident mode bit-exactly."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from ggrs_tpu import PlayerType, SessionBuilder, SessionState
+from ggrs_tpu.models.ex_game import ExGame
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.parallel.mesh import make_session_mesh
+from ggrs_tpu.serve import SessionHost, migrate_session
+from ggrs_tpu.tpu.backend import MultiSessionDeviceCore
+from ggrs_tpu.types import DesyncDetection
+from ggrs_tpu.utils.clock import FakeClock
+
+ENTITIES = 16
+FRAME_MS = 16
+
+
+def _assert_tree_equal(ta, tb, what):
+    la = jax.tree_util.tree_leaves_with_path(ta)
+    lb = jax.tree_util.tree_leaves(tb)
+    assert len(la) == len(lb)
+    for (path, a), b in zip(la, lb):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"{what}{jax.tree_util.keystr(path)}",
+        )
+
+
+def build_fleet(*, resident, mesh=None, seed=13, sessions=16, ticks=40,
+                loss=0.03, resident_ticks=8, on_tick=None,
+                scripts_fn=None, **host_kw):
+    """A seeded lossy loadgen fleet; `resident` picks the arm. Ample
+    inflight window so the twin never throttles on backpressure (the
+    resident arm has no dispatch queue — scheduling, and therefore
+    traffic, must be identical across the arms)."""
+    from ggrs_tpu.serve.loadgen import (
+        build_matches,
+        drive_scripted,
+        make_scripts,
+        sync_fleet,
+    )
+
+    clock = FakeClock()
+    net = InMemoryNetwork(
+        clock, latency_ms=20, jitter_ms=8, loss=loss, seed=seed
+    )
+    host = SessionHost(
+        ExGame(num_players=4, num_entities=ENTITIES),
+        max_prediction=8, num_players=4, max_sessions=sessions + 4,
+        clock=clock, idle_timeout_ms=0, mesh=mesh,
+        resident=resident, resident_ticks=resident_ticks,
+        max_inflight_rows=4 * (sessions + 4), **host_kw,
+    )
+    matches = build_matches(host, net, clock, sessions=sessions, seed=seed)
+    sync_fleet(host, matches, clock)
+    scripts = (
+        scripts_fn(matches, ticks, seed)
+        if scripts_fn is not None
+        else make_scripts(matches, ticks, seed=seed)
+    )
+    desyncs = drive_scripted(
+        host, matches, clock, scripts, ticks,
+        on_tick=on_tick(net, matches) if on_tick is not None else None,
+    )
+    assert not desyncs, f"fleet desynced (resident={resident})"
+    host.device.block_until_ready()
+    return host, [k for keys in matches for k in keys]
+
+
+def assert_bitwise_twins(host_r, keys_r, host_t, keys_t):
+    """The parity core: per-session frame counters + checksum histories,
+    then the canonical stacked worlds byte-for-byte."""
+    published = 0
+    for ka, kb in zip(keys_r, keys_t):
+        sa, sb = host_r.session(ka), host_t.session(kb)
+        assert sa.current_frame == sb.current_frame > 0
+        assert sa.local_checksum_history == sb.local_checksum_history
+        published += len(getattr(sa, "local_checksum_history", ()))
+    assert published > 0  # non-vacuous: desync detection really ran
+    rr, sr = host_r.device.stacked_canonical()
+    rt, st = host_t.device.stacked_canonical()
+    _assert_tree_equal(rr, rt, "rings")
+    _assert_tree_equal(sr, st, "states")
+    hi_r, lo_r = host_r.device.checksum_slots()
+    hi_t, lo_t = host_t.device.checksum_slots()
+    np.testing.assert_array_equal(hi_r, hi_t)
+    np.testing.assert_array_equal(lo_r, lo_t)
+
+
+# ----------------------------------------------------------------------
+# bitwise parity vs the dispatch-per-tick twin
+# ----------------------------------------------------------------------
+
+
+def test_resident_bitwise_parity_lossy_fleet():
+    """Lossy 16-session fleet (rollbacks every few ticks): the resident
+    host matches its dispatch-per-tick twin bit for bit, while actually
+    amortizing dispatches (driver engaged, megabatch path idle)."""
+    host_r, keys_r = build_fleet(resident=True)
+    host_t, keys_t = build_fleet(resident=False)
+    assert_bitwise_twins(host_r, keys_r, host_t, keys_t)
+    dev = host_r.device
+    assert dev.driver_dispatches > 0
+    assert dev.vticks_executed / dev.driver_dispatches > 1
+    assert dev.mailbox.overflows == 0
+    assert dev.mailbox.pending_rows == 0
+    # session rows never rode the megabatch queue path
+    assert dev.megabatches < host_t.device.megabatches
+
+
+def test_resident_parity_under_starvation_and_disconnect():
+    """The hostile arm: hold-shaped scripts, blackhole windows past the
+    prediction gate (starved lanes -> speculation drafts in-loop
+    bubbles), then a mid-run hard disconnect of one peer per match
+    (DISCONNECTED statuses in the staged rows). Still bit-identical,
+    still zero dropped inputs."""
+    from ggrs_tpu.serve.loadgen import held_scripts, starve_on_tick
+
+    def hostile(net, matches):
+        starve = starve_on_tick(net, matches, hole_every=20, hole_len=12)
+
+        def on_tick(t):
+            starve(t)
+            if t == 44:
+                # hard-disconnect peer 0 of every match: every session
+                # holding it as a REMOTE player marks it disconnected at
+                # the same tick in both arms
+                for m, keys in enumerate(matches):
+                    net.set_blackhole([(m, 0)], True)
+
+        return on_tick
+
+    kw = dict(
+        loss=0.01, ticks=60, speculation=True, warmup=False,
+        scripts_fn=held_scripts, on_tick=hostile, seed=7,
+    )
+    host_r, keys_r = build_fleet(resident=True, **kw)
+    host_t, keys_t = build_fleet(resident=False, **kw)
+    assert_bitwise_twins(host_r, keys_r, host_t, keys_t)
+    # the starved lanes really drafted, and both arms adopted the same
+    assert (
+        host_r.frames_served_from_speculation
+        == host_t.frames_served_from_speculation
+    )
+    assert host_r.device.mailbox.overflows == 0
+
+
+@pytest.mark.parametrize("resident_ticks", [1, 3, 16])
+def test_resident_parity_any_cadence(resident_ticks):
+    """The drive cadence is a pure performance knob: depth-1 (drive
+    every tick), an odd mid value and a depth past the desync interval
+    all produce identical bytes."""
+    host_r, keys_r = build_fleet(
+        resident=True, resident_ticks=resident_ticks, ticks=24, seed=29
+    )
+    host_t, keys_t = build_fleet(resident=False, ticks=24, seed=29)
+    assert_bitwise_twins(host_r, keys_r, host_t, keys_t)
+
+
+def test_resident_sharded_parity():
+    """The sharded resident host (mailbox slot axis on the 8-shard
+    session mesh, driver GSPMD-partitioned) vs the single-device
+    dispatch-per-tick twin: both dimensions cross-checked at once."""
+    mesh = make_session_mesh(8)
+    host_r, keys_r = build_fleet(resident=True, mesh=mesh, ticks=30)
+    host_t, keys_t = build_fleet(resident=False, ticks=30)
+    assert host_r.device.driver_dispatches > 0
+    assert_bitwise_twins(host_r, keys_r, host_t, keys_t)
+
+
+# ----------------------------------------------------------------------
+# GGRS_SANITIZE: frozen jit cache after warmup
+# ----------------------------------------------------------------------
+
+
+def test_resident_jit_cache_frozen_after_warmup():
+    """warmup() compiles the driver variants + commit buckets with the
+    megabatch grid; the lossy resident serve afterwards compiles
+    NOTHING, and every dispatch-function cache (driver + commit
+    included) stays within dispatch_bucket_budget()."""
+    from ggrs_tpu.analysis.sanitize import (
+        install_sanitizer,
+        uninstall_sanitizer,
+    )
+
+    san = install_sanitizer()
+    try:
+        host, keys = build_fleet(
+            resident=True, sessions=6, ticks=25, warmup=True
+        )
+        assert not san.recompiles, (
+            "post-warmup recompile on the resident host:\n"
+            + "\n".join(e.render() for e in san.recompiles)
+        )
+        dev = host.device
+        cache = sum(
+            fn._cache_size() for fn in dev._budget_fns().values()
+        )
+        assert cache <= dev.dispatch_bucket_budget()
+        assert dev.driver_dispatches > 0
+    finally:
+        uninstall_sanitizer()
+
+
+# ----------------------------------------------------------------------
+# leaving resident mode: migration + checkpoint/kill→restore
+# ----------------------------------------------------------------------
+
+
+def _peer(net, clock, addr, other, handle, seed):
+    return (
+        SessionBuilder(input_size=1)
+        .with_num_players(2)
+        .with_max_prediction_window(8)
+        .with_input_delay(1)
+        .with_desync_detection_mode(DesyncDetection.on(interval=10))
+        .with_clock(clock)
+        .with_rng(random.Random(seed * 131 + handle + 7))
+        .add_player(PlayerType.local(), handle)
+        .add_player(PlayerType.remote(other), 1 - handle)
+        .start_p2p_session(net.socket(addr))
+    )
+
+
+def test_migration_out_of_resident_host_bitwise():
+    """A peer migrates mid-match from a RESIDENT host to a
+    dispatch-per-tick host: the export drains the mailbox first, so the
+    handoff carries canonical bytes and the migrated session stays a
+    bit-exact replica of an unmigrated twin match on the same scripts."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=20, jitter_ms=0, loss=0.0)
+    h1 = SessionHost(
+        ExGame(num_players=2, num_entities=ENTITIES), max_prediction=8,
+        num_players=2, max_sessions=4, clock=clock, idle_timeout_ms=0,
+        resident=True, resident_ticks=8,
+    )
+    h2 = SessionHost(
+        ExGame(num_players=2, num_entities=ENTITIES), max_prediction=8,
+        num_players=2, max_sessions=4, clock=clock, idle_timeout_ms=0,
+    )
+    a0 = _peer(net, clock, "a0", "a1", 0, seed=1)
+    a1 = _peer(net, clock, "a1", "a0", 1, seed=2)
+    b0 = _peer(net, clock, "b0", "b1", 0, seed=3)
+    b1 = _peer(net, clock, "b1", "b0", 1, seed=4)
+    ka0 = h1.attach(a0)
+    h1.attach(a1)
+    kb0 = h1.attach(b0)
+    h1.attach(b1)
+    for _ in range(600):
+        h1.tick()
+        h2.tick()
+        clock.advance(FRAME_MS)
+        if all(
+            s.current_state() == SessionState.RUNNING
+            for s in (a0, a1, b0, b1)
+        ):
+            break
+    else:
+        raise AssertionError("matches failed to synchronize")
+
+    script = lambda h, t: (t * 3 + h * 5 + 1) % 16  # noqa: E731
+    desyncs = []
+    keymap = [(a0, h1, ka0, 0), (a1, h1, None, 1),
+              (b0, h1, kb0, 0), (b1, h1, None, 1)]
+    # recover the attach keys for a1/b1
+    keymap[1] = (a1, h1, a1.host_key, 1)
+    keymap[3] = (b1, h1, b1.host_key, 1)
+
+    def drive(t):
+        for sess, host, key, h in keymap:
+            host.submit_input(key, h, bytes([script(h, t)]))
+        for host in (h1, h2):
+            for _k, evs in host.tick().items():
+                desyncs.extend(
+                    e for e in evs if type(e).__name__ == "DesyncDetected"
+                )
+        clock.advance(FRAME_MS)
+
+    for t in range(24):
+        drive(t)
+    # the handoff happens with mailbox rows pending (mid fill cycle)
+    new_ka0 = migrate_session(h1, h2, ka0)
+    keymap[0] = (a0, h2, new_ka0, 0)
+    for t in range(24, 90):
+        drive(t)
+
+    assert not desyncs, f"migration out of resident mode desynced: {desyncs[:3]}"
+    assert a0.current_frame == b0.current_frame > 40
+    common = set(a0.local_checksum_history) & set(b0.local_checksum_history)
+    assert common
+    for f in common:
+        assert a0.local_checksum_history[f] == b0.local_checksum_history[f]
+    migrated = h2.device.state_numpy(h2._lanes[new_ka0].slot)
+    twin = h1.device.state_numpy(h1._lanes[kb0].slot)
+    for k in migrated:
+        np.testing.assert_array_equal(
+            np.asarray(migrated[k]), np.asarray(twin[k]),
+            err_msg=f"state[{k}]",
+        )
+
+
+def test_resident_checkpoint_restore_round_trip(tmp_path):
+    """kill→restore out of resident mode: a resident host's checkpoint
+    (mailbox drained to canonical form) restores onto a fresh
+    NON-resident core bit-exactly — and matches the canonical bytes of
+    the dispatch-per-tick twin fed the same traffic."""
+    host_r, _ = build_fleet(resident=True, ticks=30, seed=21)
+    host_t, _ = build_fleet(resident=False, ticks=30, seed=21)
+    path = str(tmp_path / "resident.npz")
+    host_r.checkpoint(path)
+    restored = MultiSessionDeviceCore.restore(
+        path, ExGame(num_players=4, num_entities=ENTITIES)
+    )
+    rr, sr = restored.stacked_canonical()
+    rt, st = host_t.device.stacked_canonical()
+    _assert_tree_equal(rr, rt, "rings")
+    _assert_tree_equal(sr, st, "states")
