@@ -2309,6 +2309,62 @@ def bench_chaos_soak(sessions=32, ticks=100, entities=256):
     }
 
 
+def bench_fault_storm(sessions=16, ticks=120, entities=256,
+                      faults_per_kind=3):
+    """Device-domain fault storm (ggrs_tpu/serve/faults.py): the same
+    seeded 2-host fleet on a clean single-region network, (a) unfaulted
+    vs (b) under a seeded FaultPlan of TRANSIENT device faults —
+    dispatch raises (retried), harvest timeouts (drain skipped a tick),
+    mailbox overflow storms (forced early drives) — `faults_per_kind`
+    of each, per host. fps_retained_under_device_faults = b/a: what the
+    recovery ladder costs while every session keeps serving. Both arms
+    must stay desync-free with zero quarantines (transient tier), or
+    this is a correctness failure, not a slow run."""
+    from ggrs_tpu.serve.chaos import WanProfile, run_chaos
+
+    def arm(device_faults):
+        report = run_chaos(
+            sessions=sessions, ticks=ticks, hosts=2, entities=entities,
+            seed=13, warmup=True, migrations=0, kill=False,
+            profile=WanProfile(
+                regions=1, intra_ms=20, jitter_ms=5, reorder=0.0,
+                loss_good=0.01, loss_bad=0.01, duplicate=0.0, seed=13,
+            ),
+            device_faults=device_faults,
+            faults_per_kind=faults_per_kind,
+        )
+        report.pop("_group")
+        return report
+
+    clean = arm(False)
+    storm = arm(True)
+    for name, rep in (("clean", clean), ("storm", storm)):
+        assert rep["desyncs"] == 0, f"{name} arm desynced: {rep}"
+    assert storm["quarantines"] == 0, (
+        f"transient fault tier must not quarantine: {storm}"
+    )
+    fired = {}
+    for section in storm["device_faults"] or []:
+        for kind, n in section["fired"].items():
+            fired[kind] = fired.get(kind, 0) + n
+    assert sum(fired.values()) > 0, "the fault plan never fired"
+    return {
+        "sessions": storm["sessions"],
+        "ticks": ticks,
+        "entities": entities,
+        "faults_fired": fired,
+        "device_faults_absorbed": storm["host_device_faults"],
+        "clean_session_ticks_per_sec": clean["session_ticks_per_sec"],
+        "storm_session_ticks_per_sec": storm["session_ticks_per_sec"],
+        "fps_retained_under_device_faults": round(
+            storm["session_ticks_per_sec"]
+            / max(clean["session_ticks_per_sec"], 1e-9),
+            3,
+        ),
+        "p99_queue_wait_ticks": storm["p99_queue_wait_ticks"],
+    }
+
+
 def _obs_enable():
     """Called inside a phase subprocess (see _run_phase)."""
     from ggrs_tpu.obs import enable_global_telemetry
@@ -2434,7 +2490,8 @@ def main():
         "serve_sessions_per_sec", "serve_occupancy",
         "serve_fast_dispatch_rate", "env_steps_per_sec",
         "sharded_vs_single_device_speedup",
-        "chaos_fps_retained", "frames_served_from_speculation",
+        "chaos_fps_retained", "fps_retained_under_device_faults",
+        "frames_served_from_speculation",
         "spec_hit_rate", "spec_fps_lift",
         "resident_speedup", "resident_dispatches_per_tick",
         "headline_source",
@@ -2720,6 +2777,18 @@ def main():
         timeout_s=900,
     )
     full["chaos_fps_retained"] = chaos["fps_retained"]
+    # device fault domains: the same fleet under a seeded transient
+    # device-fault storm (dispatch raises, harvest timeouts, mailbox
+    # storms) vs its unfaulted twin — the recovery ladder's price
+    fault_storm = phase(
+        "fault_storm",
+        f"bench_fault_storm(sessions={8 if SMOKE else 16}, "
+        f"ticks={30 if SMOKE else 120})",
+        timeout_s=900,
+    )
+    full["fps_retained_under_device_faults"] = fault_storm[
+        "fps_retained_under_device_faults"
+    ]
     # speculative bubble-filling: the gated live arm under realistic
     # input starvation — a speculation=True host vs its =False twin on
     # identical seeded traffic (ABBA-interleaved, medians)

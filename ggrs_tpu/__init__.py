@@ -12,11 +12,16 @@ Importing this package does NOT import jax; the device backend lives in
 """
 
 from .errors import (
+    DeviceDispatchFailed,
+    DeviceFault,
     GGRSError,
+    HarvestTimeout,
     InvalidRequest,
+    InvariantViolation,
     MismatchedChecksum,
     NotSynchronized,
     PredictionThreshold,
+    SlotPoisoned,
     SpectatorTooFarBehind,
     StatsWindowTooYoung,
 )
@@ -52,14 +57,18 @@ __all__ = [
     "ConnectionStatus",
     "DesyncDetected",
     "DesyncDetection",
+    "DeviceDispatchFailed",
+    "DeviceFault",
     "Disconnected",
     "Frame",
     "GGRSError",
     "GLOBAL_TELEMETRY",
     "GameState",
     "GameStateCell",
+    "HarvestTimeout",
     "InputStatus",
     "InvalidRequest",
+    "InvariantViolation",
     "LoadGameState",
     "MismatchedChecksum",
     "NetworkInterrupted",
@@ -72,6 +81,7 @@ __all__ = [
     "SaveGameState",
     "SessionBuilder",
     "SessionState",
+    "SlotPoisoned",
     "SpectatorTooFarBehind",
     "StatsWindowTooYoung",
     "Synchronized",

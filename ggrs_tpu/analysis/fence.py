@@ -116,6 +116,12 @@ POLICIES: Dict[str, FencePolicy] = {
             ("MultiSessionDeviceCore", "attach_mailbox"),
             ("MultiSessionDeviceCore", "commit_mailbox"),
             ("MultiSessionDeviceCore", "drive_mailbox"),
+            # device fault domains: the SDC bit-flip injector is the ONE
+            # sanctioned direct corruption of the stacked worlds (fault
+            # seam / tests only, eager per-slot writes behind a full
+            # fence flush — the reset_slot discipline); the quarantine
+            # rebuild path reuses reset_slot/import_slot above
+            ("MultiSessionDeviceCore", "inject_slot_bitflip"),
             # the session-mesh serving core's fence-dispatch entry
             # points: overrides of the SAME protocol methods (GSPMD row
             # constraints + per-shard instruments wrapped around the
@@ -166,6 +172,10 @@ POLICIES: Dict[str, FencePolicy] = {
             ("DeviceMailbox", "_acquire_commit_stage"),
             ("DeviceMailbox", "take_cycle"),
             ("DeviceMailbox", "warmup"),
+            # slot-quarantine containment: scrub one poisoned lane's
+            # staged rows + watermark so its committed rows mask to the
+            # pad row at the next drive (survivor lanes untouched)
+            ("DeviceMailbox", "drop_lane"),
         }),
     ),
     # the batched wire pump's pooled decode staging (network/pump.py):

@@ -188,6 +188,113 @@ class FleetSaturated(HostFull):
         self.per_host = dict(per_host or {})
 
 
+class DeviceFault(GGRSError):
+    """Base for device-domain failures (ggrs_tpu/serve/faults.py is the
+    deterministic injection seam; the real accelerator is the other
+    producer): a dispatch that raised, a readback that never returned,
+    corruption the audit lane caught. Every subclass carries enough
+    context for the quarantine forensics bundle to name the blast
+    radius without a debugger attached."""
+
+
+class DeviceDispatchFailed(DeviceFault):
+    """A device dispatch (megabatch, resident drive, draft, adopt)
+    raised — the simulated XLA runtime failure the fault seam fires, or
+    a real one caught at the same boundary. `slots` names the LOGICAL
+    session slots the producer could attribute the failure to (empty =
+    unattributed: the whole batch is suspect and the host's recovery is
+    retry-then-degrade, not targeted quarantine). Fired BEFORE the
+    program executes, so the stacked worlds are untouched and survivors
+    can re-dispatch bit-exactly."""
+
+    def __init__(self, info: str, *, op: str = "dispatch",
+                 slots=(), injected: bool = False):
+        slot_list = sorted(int(s) for s in slots)
+        super().__init__(
+            f"{info} (op={op!r}, slots={slot_list}, injected={injected})"
+        )
+        self.info = info
+        self.op = op
+        self.slots = tuple(slot_list)
+        self.injected = injected
+
+
+class HarvestTimeout(DeviceFault):
+    """A device->host readback (checksum harvest, ledger drain, export
+    copy) timed out. Transient by contract: the values still exist on
+    device, so the correct reaction is block-and-retry (the host's
+    drain pass skips a tick; checkpoint/export retry synchronously) —
+    never dropping the harvest, which would orphan lazy checksum
+    bindings."""
+
+    def __init__(self, info: str, *, op: str = "harvest",
+                 pending: int = 0):
+        super().__init__(f"{info} (op={op!r}, pending={pending})")
+        self.info = info
+        self.op = op
+        self.pending = pending
+
+
+class SlotPoisoned(DeviceFault):
+    """One session slot's device residue can no longer be trusted — a
+    persistent dispatch failure pinned on it, or the SDC audit lane
+    caught its bytes diverging from the reference recompute. The host
+    QUARANTINES the slot (drops its staged work, detaches the lane,
+    keeps ticking survivors bit-exactly) and surfaces this error with
+    the forensics bundle path; the fleet agent treats it as a
+    mini-failover (rebuild the match from its last clean checkpoint
+    ticket, or hand it to the director)."""
+
+    def __init__(self, info: str, *, slot: int = -1, key=None,
+                 reason: str = "", frame: int = -1,
+                 forensics=None):
+        super().__init__(
+            f"{info} (slot={slot}, key={key!r}, reason={reason!r}, "
+            f"frame={frame})"
+        )
+        self.info = info
+        self.slot = slot
+        self.key = key
+        self.reason = reason
+        self.frame = frame
+        self.forensics = forensics
+
+
+class InvariantViolation(GGRSError):
+    """An always-on cheap invariant monitor tripped: confirmed-frame
+    watermark regressed, a RUNNING lane wedged without progress past
+    its budget, mailbox accounting went inconsistent — the class of bug
+    the WAN chaos soak previously found only by accident. Carries the
+    invariant's name and a forensics bundle path so the trip is
+    diagnosable after the process is gone."""
+
+    def __init__(self, info: str, *, invariant: str = "", key=None,
+                 frame: int = -1, forensics=None):
+        super().__init__(
+            f"{info} (invariant={invariant!r}, key={key!r}, "
+            f"frame={frame})"
+        )
+        self.info = info
+        self.invariant = invariant
+        self.key = key
+        self.frame = frame
+        self.forensics = forensics
+
+
+class MailboxLaneFull(GGRSError):
+    """A mailbox lane was staged past its virtual-tick depth without an
+    intervening drive — the caller must drive first (the core's
+    stage_mailbox_row does; hitting this means a scheduler bypassed it).
+    Typed so the operator sees WHICH lane wedged at WHAT depth instead
+    of a bare AssertionError in the staging hot path."""
+
+    def __init__(self, info: str, *, lane: int = -1, depth: int = 0):
+        super().__init__(f"{info} (lane={lane}, depth={depth})")
+        self.info = info
+        self.lane = lane
+        self.depth = depth
+
+
 class RetraceBudgetExceeded(GGRSError):
     """The retrace sanitizer observed more compiled programs than the
     dispatch-bucket budget allows: a jit cache meant to be bounded by the

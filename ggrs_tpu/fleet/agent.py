@@ -37,7 +37,13 @@ from ..utils.checkpoint import atomic_write_bytes
 from ..utils.clock import Clock, FakeClock
 from .island import FRAME_MS, MatchIsland, MatchSpec, ReboundUdpSocket, step_islands
 from .rpc import RpcPeer
-from .ticket import dumps_ticket, export_islands, import_islands, loads_ticket
+from .ticket import (
+    dumps_ticket,
+    export_islands,
+    import_islands,
+    loads_ticket,
+    read_ticket_file,
+)
 from .wire import FRAME_CALL, FleetConn
 
 FENCED_EXIT_CODE = 86
@@ -56,7 +62,16 @@ class AgentCore:
                  max_sessions: int = 16, max_prediction: int = 8,
                  num_players: int = 4, hb_interval_ms: int = 150,
                  checkpoint_every: int = 32, warmup: bool = False,
-                 label: str = ""):
+                 label: str = "", resident: bool = False,
+                 resident_ticks: int = 8, sdc_audit_every: int = 0):
+        """`resident=True` runs the agent's SessionHost on the
+        device-resident serving loop (PR 13's mailbox + while_loop
+        driver) — bit-identical to the dispatch-per-tick agent by the
+        resident contract, and every fleet operation (checkpoint
+        tickets, SIGKILL-restore, cross-process migration) drains the
+        mailbox back to canonical form first, so tickets from a
+        resident agent import into a non-resident one and vice versa.
+        `sdc_audit_every` enables the host's sampled SDC audit lane."""
         from ..serve.host import SessionHost
 
         self.clock = clock or Clock()
@@ -72,6 +87,9 @@ class AgentCore:
             clock=FakeClock(),
             idle_timeout_ms=0,
             warmup=warmup,
+            resident=resident,
+            resident_ticks=resident_ticks,
+            sdc_audit_every=sdc_audit_every,
         )
         if warmup:
             # the failover/migration import path runs EAGER per-leaf
@@ -97,6 +115,10 @@ class AgentCore:
         self._last_hb = self.clock.now_ms()
         self._partition_until: Optional[int] = None
         self._draining = False
+        # slot quarantines the host surfaced, and what became of them:
+        # match_id -> "rebuilt" (mini-failover from the last checkpoint
+        # ticket) | "lost" (no clean ticket covered the match)
+        self.quarantines: Dict[int, str] = {}
 
     # ------------------------------------------------------------------
     # control-plane lifecycle
@@ -152,10 +174,22 @@ class AgentCore:
             return  # fenced mid-pump: no further advance, ever
         # data plane: islands tick regardless of director reachability
         active = [
-            i for i in self.islands.values() if i.keys and not i.done
+            i for i in self.islands.values()
+            if i.keys and not i.done and not i.failed
         ]
         if active:
+            # snapshot key->match ownership BEFORE stepping: the island
+            # loop's vanished-lane guard wipes a quarantined island's
+            # keys, and the verdicts drained after must still map back
+            # to the match they poisoned
+            owners = {
+                key: mid
+                for mid, isl in self.islands.items()
+                for key in isl.keys.values()
+            }
             step_islands(self.host, active)
+            for poisoned in self.host.take_quarantines():
+                self._on_quarantine(poisoned, owners.get(poisoned.key))
             self.host.clock.advance(FRAME_MS)
             self.tick_index += 1
             if (
@@ -198,6 +232,63 @@ class AgentCore:
                 self.registered = True
                 self._last_hb = now - self.hb_interval_ms  # hb soon
 
+    def _on_quarantine(self, poisoned, mid=None) -> None:
+        """A hosted slot was quarantined (typed SlotPoisoned from the
+        host's device-fault containment): treat it as a MINI-FAILOVER
+        of the owning match — the PR 11 seize/adopt machinery turned
+        inward. The island is torn down whole (a mem-plane match's
+        surviving peers can never confirm another frame against a dead
+        sibling) and rebuilt from the agent's last crash-checkpoint
+        ticket, every peer re-adopted at the checkpoint frame exactly
+        as a director failover would place it on a sibling host. No
+        clean ticket covering the match -> the match is lost: marked
+        failed, reported in the heartbeat, excluded from future
+        checkpoints."""
+        if mid is None:
+            for m, island in self.islands.items():
+                if poisoned.key in island.keys.values():
+                    mid = m
+                    break
+        if mid is None or mid not in self.islands:
+            return  # a non-island session (not spawned by the director)
+        island = self.islands[mid]
+        for key in list(island.keys.values()):
+            if key in self.host._lanes:
+                self.host.detach(key)
+        island.keys = {}
+        island.failed = True
+        outcome = "lost"
+        ckpt = self.last_checkpoint
+        if ckpt is not None and mid not in self._spread:
+            try:
+                entries, _meta = loads_ticket(
+                    read_ticket_file(ckpt["path"])
+                )
+                entries = [
+                    e for e in entries
+                    if e["island"].spec.match_id == mid
+                ]
+                if entries:
+                    restored = import_islands(self.host, entries)
+                    self.islands[mid] = restored[0]
+                    outcome = "rebuilt"
+            except Exception:  # noqa: BLE001 - a failed rebuild must
+                # degrade to "match lost", never take the agent (and
+                # its innocent matches) down with it
+                outcome = "lost"
+        self.quarantines[mid] = outcome
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "fleet_quarantine", match=mid,
+                host=self.host_id if self.host_id is not None else -1,
+                outcome=outcome, reason=poisoned.reason,
+                slot=poisoned.slot, frame=poisoned.frame,
+            )
+        # refresh crash cover NOW: a lost island must not resurrect
+        # from a stale ticket, and a rebuilt one needs cover at its
+        # rebuilt frame
+        self.write_checkpoint()
+
     def _send_heartbeat(self, now: int) -> None:
         self._last_hb = now
         rid = self.peer.next_rid()
@@ -216,6 +307,9 @@ class AgentCore:
             },
             "checkpoint": self.last_checkpoint,
             "desyncs": sum(i.desyncs for i in self.islands.values()),
+            "quarantines": {
+                str(m): outcome for m, outcome in self.quarantines.items()
+            },
         }, now_ms=now)
 
     # ------------------------------------------------------------------

@@ -95,6 +95,9 @@ class HostRecord:
         self.desyncs = 0
         self.islands: Dict[str, dict] = {}
         self.checkpoint: Optional[dict] = None
+        # match_id -> outcome ("rebuilt" | "lost"): slot quarantines the
+        # agent reported handling as mini-failovers
+        self.quarantines: Dict[str, str] = {}
         self.last_hb_ms = now_ms
         self.hb_misses = 0
         self.admissions_held = False
@@ -335,6 +338,24 @@ class Director:
             hr.islands = body.get("islands", hr.islands)
             hr.checkpoint = body.get("checkpoint", hr.checkpoint)
             hr.desyncs = int(body.get("desyncs", hr.desyncs))
+            for mid, outcome in body.get("quarantines", {}).items():
+                # dedup on (match, OUTCOME): a rebuilt match that is
+                # later quarantined again and lost must still take the
+                # lost-match branch
+                if hr.quarantines.get(mid) != outcome:
+                    if GLOBAL_TELEMETRY.enabled:
+                        GLOBAL_TELEMETRY.record(
+                            "fleet_quarantine_reported", host=hr.host_id,
+                            match=int(mid), outcome=outcome,
+                        )
+                    if outcome == "lost":
+                        # a lost match is a lost match wherever it died:
+                        # keep the table honest for the operator
+                        rec = self.matches.get(int(mid))
+                        if rec is not None and rec["state"] == "placed":
+                            rec["state"] = "lost"
+                            self.matches_lost.append(int(mid))
+                hr.quarantines[mid] = outcome
             # reconcile against the agent's island list — the ground
             # truth for what it actually hosts
             reported = {int(m) for m in hr.islands}
@@ -927,6 +948,7 @@ class Director:
                     "hb_misses": hr.hb_misses,
                     "fence_rejections": hr.fence_rejections,
                     "desyncs": hr.desyncs,
+                    "quarantines": dict(hr.quarantines),
                 }
                 for hid, hr in self.hosts.items()
             },

@@ -138,12 +138,32 @@ class Telemetry:
         and return the path (None when the per-process dump cap is hit)."""
         if self._dumps_written >= self.MAX_FORENSICS_DUMPS:
             return None
-        bundle = self.desync_forensics(**kwargs)
+        return self._write_bundle("desync", self.desync_forensics(**kwargs))
+
+    def write_forensics(self, kind: str, *, frame: int = -1,
+                        last_events: int = 64, **fields: Any) -> Optional[str]:
+        """Generic forensics bundle — the desync writer's machinery for
+        any device-domain verdict (slot quarantines, invariant trips):
+        the caller's fields plus the flight-recorder tail land in one
+        JSON dump under the same dir/cap discipline. Returns the path
+        (None when the per-process dump cap is hit)."""
+        if self._dumps_written >= self.MAX_FORENSICS_DUMPS:
+            return None
+        bundle = {
+            "kind": f"{kind}_forensics",
+            "written_at_ms": time.time() * 1000.0,
+            "frame": frame,
+            **{k: jsonable(v) for k, v in fields.items()},
+            "events": self.recorder.to_json(last_events),
+        }
+        return self._write_bundle(kind, bundle)
+
+    def _write_bundle(self, kind: str, bundle: dict) -> str:
         dump_dir = self.dump_dir or os.environ.get("GGRS_OBS_DUMP_DIR") or "."
         os.makedirs(dump_dir, exist_ok=True)
         path = os.path.join(
             dump_dir,
-            f"ggrs_desync_f{bundle['frame']}_{int(bundle['written_at_ms'])}"
+            f"ggrs_{kind}_f{bundle['frame']}_{int(bundle['written_at_ms'])}"
             f"_{self._dumps_written}.json",
         )
         with open(path, "w") as f:

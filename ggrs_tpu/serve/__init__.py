@@ -15,15 +15,31 @@ on the first SessionHost construction. The load-generator harness lives
 in ggrs_tpu.serve.loadgen (imported lazily for the same reason).
 """
 
-from ..errors import GroupSaturated, HostFull
+from ..errors import (
+    DeviceDispatchFailed,
+    GroupSaturated,
+    HarvestTimeout,
+    HostFull,
+    InvariantViolation,
+    SlotPoisoned,
+)
+from .faults import FAULT_KINDS, Fault, FaultInjector, FaultPlan
 from .host import SessionHost
 from .migrate import HostGroup, MigrationTicket, migrate_session
 
 __all__ = [
+    "DeviceDispatchFailed",
+    "FAULT_KINDS",
+    "Fault",
+    "FaultInjector",
+    "FaultPlan",
     "GroupSaturated",
+    "HarvestTimeout",
     "HostFull",
     "HostGroup",
+    "InvariantViolation",
     "MigrationTicket",
     "SessionHost",
+    "SlotPoisoned",
     "migrate_session",
 ]

@@ -38,8 +38,17 @@ from ..network.sockets import InMemoryNetwork
 from ..sessions.builder import SessionBuilder
 from ..types import DesyncDetection, PlayerType, SessionState
 from ..utils.clock import FakeClock
+from .faults import FaultInjector, FaultPlan
 from .loadgen import FRAME_MS, build_matches, make_scripts, sync_fleet
 from .migrate import HostGroup
+
+# the device-fault kinds a WAN chaos soak fires by default: the
+# TRANSIENT tier only — recovery is retry/skip/extra-drive, so the
+# zero-desync and service gates still hold. The destructive tier
+# (slot_bitflip, checkpoint_corrupt) needs the audit lane and
+# restore-failure assertions around it: scripts/fault_smoke.py and
+# tests/test_device_faults.py drive those deliberately.
+CHAOS_FAULT_KINDS = ("dispatch_raise", "harvest_timeout", "mailbox_storm")
 
 
 def _region_of(addr: Any, regions: int) -> int:
@@ -222,6 +231,9 @@ def run_chaos(
     warmup: bool = True,
     checkpoint_path: Optional[str] = None,
     game=None,
+    device_faults: bool = False,
+    fault_kinds=CHAOS_FAULT_KINDS,
+    faults_per_kind: int = 1,
 ) -> Dict[str, Any]:
     """Drive >= `sessions` scripted peers across a `hosts`-wide HostGroup
     under a seeded WAN fault profile and a chaos schedule; returns a
@@ -232,7 +244,14 @@ def run_chaos(
     `kill_pause_ticks`, then resume from the kill-time checkpoint), and
     optionally a flash crowd and a mass-disconnect storm. The soak's
     gates: zero desyncs (with real checksum comparisons) and a bounded
-    p99 admission-queue wait."""
+    p99 admission-queue wait.
+
+    `device_faults=True` additionally arms the DEVICE-DOMAIN fault seam
+    (serve/faults.py) on every host: a seeded FaultPlan of
+    `fault_kinds` (default: the transient tier — dispatch raises,
+    harvest timeouts, mailbox overflow storms) fires through the run,
+    and the same gates must still hold — the wire chaos and the device
+    chaos compose."""
     clock = FakeClock()
     if profile is None:
         profile = WanProfile(seed=seed)
@@ -298,6 +317,15 @@ def run_chaos(
         _os.close(fd)
 
     scripts = make_scripts(matches, ticks, seed)
+    injectors = []
+    if device_faults:
+        for i, host in enumerate(group.hosts):
+            plan = FaultPlan(
+                seed * 131 + i, ticks, kinds=fault_kinds,
+                events_per_kind=faults_per_kind,
+                persist_dispatch=False,
+            )
+            injectors.append(FaultInjector(host, plan).install())
     rng = random.Random(seed ^ 0xCA05)
     desyncs: List[Any] = []
     stormed: set = set()
@@ -437,6 +465,8 @@ def run_chaos(
 
     t_wall = _time.perf_counter()
     for t in range(ticks):
+        for inj in injectors:
+            inj.advance(t)
         for ev in by_tick.get(t, ()):
             handlers[ev.kind](ev, t)
         # scripted inputs: base matches from the pre-generated scripts,
@@ -526,6 +556,11 @@ def run_chaos(
         )),
         "profile": profile.section(),
         "group": group.group_section(),
+        "device_faults": (
+            [inj.section() for inj in injectors] if injectors else None
+        ),
+        "quarantines": sum(h.quarantines_total for h in group.hosts),
+        "host_device_faults": sum(h.device_faults for h in group.hosts),
     }
     report["_group"] = group  # live handle for callers; strip before JSON
     return report

@@ -50,11 +50,15 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..errors import (
+    DeviceDispatchFailed,
     DrainStalled,
     GGRSError,
+    HarvestTimeout,
     HostFull,
     InvalidRequest,
+    InvariantViolation,
     PredictionThreshold,
+    SlotPoisoned,
 )
 from ..obs import GLOBAL_TELEMETRY, SESSION_COUNT_BUCKETS
 from ..types import (
@@ -70,6 +74,11 @@ from ..utils.tracing import GLOBAL_TRACER
 
 DEFAULT_IDLE_TIMEOUT_MS = 30_000
 
+# _drive_resident's "the drive raised and the recovery ladder ran"
+# sentinel — distinct from None, which drive_mailbox legitimately
+# returns for an empty mailbox
+_DRIVE_FAILED = object()
+
 # lazily-resolved backend types (importing ggrs_tpu.serve must not pull
 # jax; the per-row retire path must not re-run import machinery either)
 _BACKEND_REFS = None
@@ -82,6 +91,18 @@ def _backend_refs():
 
         _BACKEND_REFS = (SnapshotRef, _LazyChecksum)
     return _BACKEND_REFS
+
+
+def _array_is_ready(arr) -> bool:
+    global _ARRAY_IS_READY
+    if _ARRAY_IS_READY is None:
+        from ..tpu.backend import _array_is_ready as impl
+
+        _ARRAY_IS_READY = impl
+    return _ARRAY_IS_READY(arr)
+
+
+_ARRAY_IS_READY = None
 
 
 class _StagedRow:
@@ -127,6 +148,17 @@ class _Lane:
         "pending_inputs", "queued_since_tick", "ticks_advanced",
         "throttled_ticks", "last_error", "failed", "row_pool", "row_flip",
         "starved", "confirmed_watermark",
+        # invariant monitors (always-on, cheap)
+        "max_confirmed_seen", "last_progress_seen", "last_progress_tick",
+        "wedge_reported",
+        # SDC audit lane (maintained only when the host samples audits):
+        # frame -> (played inputs u8[P,I], statuses i32[P]) — rollback
+        # segments overwrite predicted values with the corrected truth,
+        # so the record is always what the device actually played last —
+        # plus the saved frames whose ring rows can anchor a replay and
+        # each save's recorded (lazy) checksum, the at-rest reference
+        # the audit sweep compares recomputed ring rows against
+        "audit_inputs", "saved_frames", "audit_saved_checksums",
     )
 
     def __init__(self, key, session, slot, kind, num_players,
@@ -152,6 +184,13 @@ class _Lane:
         # the speculative bubble-filling scheduler's draft keys
         self.starved = False
         self.confirmed_watermark: Optional[int] = None
+        self.max_confirmed_seen: Optional[int] = None
+        self.last_progress_seen = 0
+        self.last_progress_tick = 0
+        self.wedge_reported = False
+        self.audit_inputs: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self.saved_frames: set = set()
+        self.audit_saved_checksums: Dict[int, Any] = {}
         # pooled packed-row buffers (pack_tick_row_into targets): staging
         # a segment allocates nothing on the steady-state path
         self.row_pool = [
@@ -192,7 +231,11 @@ class SessionHost:
                  depth_routing: bool = True, batched_pump: bool = True,
                  mesh=None, speculation: bool = False,
                  speculation_seed: int = 0, resident: bool = False,
-                 resident_ticks: int = 16):
+                 resident_ticks: int = 16, sdc_audit_every: int = 0,
+                 wedge_limit_ticks: int = 256,
+                 drive_failure_limit: int = 3,
+                 shed_after_stall_ticks: int = 256,
+                 strict_invariants: bool = False):
         """`max_inflight_rows`: the device-window budget — session tick
         rows admitted past the fence before ready sessions start queuing
         (default: 2 full megabatches' worth). `idle_timeout_ms`: sessions
@@ -260,7 +303,29 @@ class SessionHost:
         Bit-identical to a resident=False twin fed the same traffic
         (tests/test_resident_loop.py pins state, ring bytes and checksum
         histories); the dispatch-per-tick path is kept as that parity
-        twin."""
+        twin.
+
+        DEVICE FAULT DOMAINS (docs/DESIGN.md "Device fault domains"):
+        `sdc_audit_every=N` (0 = off) samples the SDC AUDIT LANE every N
+        host ticks — each eligible lane's live world is double-computed
+        from its last ring anchor through the full-window parity
+        program and compared checksum-for-checksum; a mismatch
+        quarantines the slot (typed SlotPoisoned + forensics bundle)
+        within the sampling bound. A dispatch/drive raise
+        (DeviceDispatchFailed — the fault seam's simulated XLA runtime
+        failure, or a real one) retries once as a transient, then
+        quarantines the culprit slots and re-dispatches survivors
+        bit-exactly; `drive_failure_limit` LIFETIME resident-drive
+        failures DEGRADE the host to its dispatch-per-tick twin instead
+        of crashing (bit-identical, slower — a device whose runtime
+        keeps failing is hardware-suspect, so the fallback is sticky). `shed_after_stall_ticks`
+        of a wedged fence (ready queue pinned at a full device window)
+        sheds admission — attach raises HostFull — until the stall
+        clears. `wedge_limit_ticks` bounds the always-on invariant
+        monitors (lane progress, confirmed-watermark monotonicity,
+        mailbox accounting), which record typed InvariantViolations
+        with forensics (`strict_invariants=True` raises them
+        instead)."""
         from ..network.pump import WirePump, host_tax_histogram
         from ..tpu.backend import MultiSessionDeviceCore
 
@@ -282,6 +347,7 @@ class SessionHost:
             game, max_prediction, num_players, max_sessions,
             async_inflight=async_inflight, depth_routing=depth_routing,
             mesh=mesh, speculation=speculation,
+            sdc_audit=sdc_audit_every > 0,
         )
         self.depth_routing = depth_routing
         self.game = game
@@ -293,7 +359,11 @@ class SessionHost:
             if max_inflight_rows is not None
             else 2 * max_sessions
         )
-        assert self.max_inflight_rows >= 1
+        if self.max_inflight_rows < 1:
+            raise InvalidRequest(
+                f"max_inflight_rows must be >= 1 "
+                f"(got {self.max_inflight_rows})"
+            )
         self.clock = clock or Clock()
         self.idle_timeout_ms = idle_timeout_ms
         self._lanes: Dict[Any, _Lane] = {}
@@ -335,6 +405,55 @@ class SessionHost:
             "ggrs_host_queue_wait_ticks",
             "host ticks a session's staged rows waited before dispatch",
             buckets=SESSION_COUNT_BUCKETS,
+        )
+        # device fault domains: quarantine machinery, the sampled SDC
+        # audit lane, always-on invariant monitors and the degradation
+        # ladder (docs/DESIGN.md "Device fault domains")
+        self.fault_seam = None  # serve/faults.py FaultInjector installs
+        self._audit_every = sdc_audit_every
+        self.wedge_limit_ticks = wedge_limit_ticks
+        self.drive_failure_limit = drive_failure_limit
+        self.shed_after_stall_ticks = shed_after_stall_ticks
+        self.strict_invariants = strict_invariants
+        self._quarantines: List[SlotPoisoned] = []
+        self.quarantines_total = 0
+        self.device_faults = 0
+        self.harvest_timeouts = 0
+        self.invariant_trips: List[InvariantViolation] = []
+        self._pending_audits: List[Tuple[Any, List[Tuple]]] = []
+        self.audits_sampled = 0
+        self.audit_mismatches = 0
+        self._resident_degraded = False
+        self._drive_failures = 0
+        self._shed_admission = False
+        self._stall_ticks = 0
+        self.degrades = 0
+        self._m_quarantines = _reg.counter(
+            "ggrs_slot_quarantines_total",
+            "session slots quarantined out of the shared device stack "
+            "(typed SlotPoisoned + forensics bundle each)",
+            ("reason",),
+        )
+        self._m_sdc_audits = _reg.counter(
+            "ggrs_sdc_audits_total",
+            "lanes double-computed by the sampled SDC audit lane",
+        )
+        self._m_sdc_mismatches = _reg.counter(
+            "ggrs_sdc_mismatches_total",
+            "SDC audit mismatches (silent corruption caught: live world "
+            "vs full-window reference replay from the ring anchor)",
+        )
+        self._m_degraded = _reg.counter(
+            "ggrs_degraded_mode_total",
+            "degradation-ladder steps taken (resident loop falling back "
+            "to dispatch-per-tick, admission shed under a fence stall)",
+            ("mode",),
+        )
+        self._m_invariants = _reg.counter(
+            "ggrs_invariant_trips_total",
+            "always-on invariant monitor trips (typed InvariantViolation "
+            "+ forensics bundle each)",
+            ("invariant",),
         )
         # fleet-wide batched wire pump + host-tax attribution (the pump
         # phase's own child is observed inside WirePump.pump; the shared
@@ -379,7 +498,10 @@ class SessionHost:
         # harvest stops overlapping host work
         self._resident_cadence = resident_ticks
         if resident:
-            assert resident_ticks >= 1
+            if resident_ticks < 1:
+                raise InvalidRequest(
+                    f"resident_ticks must be >= 1 (got {resident_ticks})"
+                )
             self.device.attach_mailbox(resident_ticks)
         if warmup:
             self.device.warmup()
@@ -440,6 +562,14 @@ class SessionHost:
         if self._draining:
             self._reject()
             raise HostFull("host is draining: not admitting sessions")
+        if self._shed_admission:
+            # degradation ladder: a wedged fence sheds new admissions
+            # BEFORE the backlog wedges the hosted fleet
+            self._reject()
+            raise HostFull(
+                "host is shedding admission: device fence stalled for "
+                f"{self._stall_ticks} ticks at a full inflight window"
+            )
         if not self._free_slots:
             self._reject()
             raise HostFull(
@@ -553,6 +683,10 @@ class SessionHost:
             self.device.core._packed_len,
         )
         lane.current_frame = current_frame
+        # the wedge monitor's baseline is the ATTACH tick: a session
+        # admitted late into a long-lived host starts its progress
+        # clock here, not at host tick 0
+        lane.last_progress_tick = self._tick_index
         self._lanes[key] = lane
         self.sessions_admitted += 1
         if self._spec is not None and kind == "p2p":
@@ -689,6 +823,11 @@ class SessionHost:
         if self._draining:
             self._reject()
             raise HostFull("host is draining: not admitting env blocks")
+        if self._shed_admission:
+            self._reject()
+            raise HostFull(
+                "host is shedding admission: device fence stalled"
+            )
         if num_envs < 1 or num_envs > len(self._free_slots):
             self._reject()
             raise HostFull(
@@ -802,10 +941,21 @@ class SessionHost:
         # 1b. drain pass: retire ready fence entries and resolve every
         # host-ready checksum batch OFF the tick path — with the batched
         # checksum pump in the sessions, the steady-state tick never
-        # blocks on a device->host transfer (drain_blocked_ticks == 0)
+        # blocks on a device->host transfer (drain_blocked_ticks == 0).
+        # A HarvestTimeout (fault seam / real readback stall) is
+        # transient by contract: the values still exist on device, so
+        # this tick's drain is skipped and the next pass resolves them.
         t_drain = _time.perf_counter() if tel.enabled else 0.0
-        self.device.ledger.drain_ready()
-        self.device.poll_retired()
+        try:
+            if self.fault_seam is not None:
+                self.fault_seam.before_harvest("drain")
+            self.device.ledger.drain_ready()
+            self.device.poll_retired()
+        except HarvestTimeout:
+            self.harvest_timeouts += 1
+            if tel.enabled:
+                tel.record("harvest_timeout", op="drain")
+        self._resolve_audits()
         if tel.enabled:
             self._m_tax_drain.observe(
                 (_time.perf_counter() - t_drain) * 1000.0
@@ -857,11 +1007,20 @@ class SessionHost:
                             stage="parse",
                         )
                     continue
-                if self.resident:
+                if self.resident_active:
                     # feed-and-harvest: rows move straight into the
                     # mailbox fill cycle instead of the dispatch queue
                     self._stage_resident(lane)
-                elif lane.rows and lane.queued_since_tick is None:
+                if (
+                    not self.resident_active
+                    and lane.rows
+                    and not lane.failed
+                    and lane.queued_since_tick is None
+                ):
+                    # dispatch-per-tick scheduling — also the DEGRADED
+                    # resident host's path (and _stage_resident hands
+                    # rows back here when a drive failure degrades the
+                    # host mid-stage)
                     lane.queued_since_tick = self._tick_index
                     self._ready.append(lane.key)
         if tel.enabled:
@@ -873,7 +1032,7 @@ class SessionHost:
         # blocks still dispatch synchronously; in resident mode session
         # lanes never enter the ready queue, so this is env-only there)
         self._pump_device()
-        if self.resident:
+        if self.resident_active:
             self._resident_pump()
 
         # 3b. speculative bubble-filling: draft the input-starved lanes'
@@ -886,9 +1045,51 @@ class SessionHost:
         if self._spec is not None and not self._draining:
             self._launch_drafts()
 
+        # 3c. the sampled SDC audit lane: double-compute eligible lanes
+        # from their ring anchors through the full-window reference
+        # program, resolved lazily by the next drain passes
+        if self._audit_every:
+            self._maybe_audit()
+
+        # 3d. degradation ladder, fence-stall arm: a ready queue pinned
+        # at a full device window for `shed_after_stall_ticks` sheds
+        # admission until the stall clears
+        if self._ready and self.device.inflight_rows >= self.max_inflight_rows:
+            self._stall_ticks += 1
+            if (
+                self.shed_after_stall_ticks
+                and not self._shed_admission
+                and self._stall_ticks >= self.shed_after_stall_ticks
+            ):
+                self._shed_admission = True
+                self.degrades += 1
+                if tel.enabled:
+                    self._m_degraded.labels("shed_admission").inc()
+                    tel.record(
+                        "host_degraded", mode="shed_admission",
+                        stall_ticks=self._stall_ticks,
+                    )
+        else:
+            self._stall_ticks = 0
+            if self._shed_admission:
+                self._shed_admission = False
+                if tel.enabled:
+                    tel.record("host_admission_restored")
+
+        # 3e. always-on invariant monitors (cheap: a handful of integer
+        # compares per lane)
+        self._check_invariants()
+
         # 4. lifecycle: disconnect GC, then idle eviction
         self._run_gc(events)
         return events
+
+    @property
+    def resident_active(self) -> bool:
+        """True while the resident loop is the serving path — False on
+        dispatch-per-tick hosts AND on a resident host the degradation
+        ladder dropped back to its dispatch-per-tick twin."""
+        return self.resident and not self._resident_degraded
 
     def _stage_resident(self, lane: _Lane) -> None:
         """Move a lane's freshly parsed rows into the device mailbox's
@@ -898,28 +1099,49 @@ class SessionHost:
         a standing speculative draft matched this segment — force a
         driver dispatch first (the lane's earlier rows must land before
         the adopt serves its prefix), then dispatch through adopt_slot
-        exactly as the twin does."""
+        exactly as the twin does.
+
+        A DeviceDispatchFailed from the forced drive inside staging runs
+        the recovery ladder (_recover_drive_failure) and retries the
+        row; if the ladder quarantined THIS lane its rows are gone, and
+        if it degraded the host the remaining rows fall through to the
+        caller's queue path."""
         SnapshotRef, _LazyChecksum = _backend_refs()
         dev = self.device
         ring_len = dev.core.ring_len
-        while lane.rows:
-            staged = lane.rows.popleft()
+        while lane.rows and not lane.failed:
+            if not self.resident_active:
+                return  # degraded mid-stage: caller queues the rest
+            staged = lane.rows[0]
             if staged.adopt is not None:
-                dev.drive_mailbox()
+                if self._drive_resident() is _DRIVE_FAILED:
+                    continue  # ladder ran; re-check lane/mode and retry
+                if lane.failed or not self.resident_active:
+                    continue
                 draft_batch, packed = staged.adopt
                 batch = dev.adopt_slot(lane.slot, draft_batch, packed)
                 base = 0
             else:
-                batch, base = dev.stage_mailbox_row(
-                    lane.slot, staged.row,
-                    last_active=staged.last_active, fast=staged.fast,
-                )
+                try:
+                    batch, base = dev.stage_mailbox_row(
+                        lane.slot, staged.row,
+                        last_active=staged.last_active, fast=staged.fast,
+                    )
+                except DeviceDispatchFailed as exc:
+                    # the row was NOT staged (the raise fires before any
+                    # mailbox state changes): recover, then retry it
+                    self._recover_drive_failure(exc)
+                    continue
+            lane.rows.popleft()
             for slot_i, save in staged.saves:
+                lazy = _LazyChecksum(batch, base + slot_i)
                 save.cell.save_lazy(
                     save.frame,
                     SnapshotRef(save.frame, save.frame % ring_len),
-                    _LazyChecksum(batch, base + slot_i),
+                    lazy,
                 )
+                if self._audit_every and lane.kind == "p2p":
+                    lane.audit_saved_checksums[save.frame] = lazy
 
     def _resident_pump(self) -> None:
         """The resident scheduler's per-tick tail: land this tick's
@@ -941,8 +1163,154 @@ class SessionHost:
             self._mbox_ticks >= self._resident_cadence
             or mbox.max_fill() >= mbox.depth - 2
         ):
-            dev.drive_mailbox()
+            self._drive_resident()
             self._mbox_ticks = 0
+
+    # ------------------------------------------------------------------
+    # device-fault recovery ladder (docs/DESIGN.md "Device fault
+    # domains"): transient retry -> culprit quarantine -> degrade to the
+    # dispatch-per-tick twin. Survivors keep ticking bit-exactly at
+    # every rung (retries re-execute identical rows; quarantined lanes'
+    # pending mailbox rows are masked off before the next drive; the
+    # degraded twin is the parity reference by construction).
+    # ------------------------------------------------------------------
+
+    def _on_device_fault(self, exc: DeviceDispatchFailed) -> None:
+        self.device_faults += 1
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "device_dispatch_failed", op=exc.op,
+                slots=list(exc.slots), injected=exc.injected,
+            )
+
+    def _drive_resident(self):
+        """drive_mailbox behind the recovery ladder. Returns the drive's
+        checksum batch (None for an empty mailbox), or _DRIVE_FAILED
+        after a raise was contained — by then the ladder has retried,
+        quarantined culprits and/or degraded, and the caller re-checks
+        its lane/mode state and tries again."""
+        try:
+            return self.device.drive_mailbox()
+        except DeviceDispatchFailed as exc:
+            self._recover_drive_failure(exc)
+            return _DRIVE_FAILED
+
+    def _recover_drive_failure(self, exc: DeviceDispatchFailed) -> None:
+        """A resident drive raised (worlds untouched by contract): retry
+        once as a transient, then quarantine the culprit slots the
+        failure names and drive the survivors; `drive_failure_limit`
+        lifetime failures degrade the host to its dispatch-per-tick
+        twin. An unattributed persistent failure re-raises — the whole
+        device is suspect, and pretending otherwise would serve
+        corrupt frames."""
+        self._on_device_fault(exc)
+        self._drive_failures += 1
+        for attempt in (0, 1):
+            try:
+                self.device.drive_mailbox()
+                break
+            except DeviceDispatchFailed as exc2:
+                self._on_device_fault(exc2)
+                self._drive_failures += 1
+                culprits = [
+                    key for key, lane in self._lanes.items()
+                    if lane.slot in set(exc2.slots) and not lane.failed
+                ]
+                if not culprits or attempt > 0:
+                    raise
+                for key in culprits:
+                    self.quarantine(key, "drive_failed", error=exc2)
+        if (
+            self._drive_failures >= self.drive_failure_limit
+            and not self._resident_degraded
+            and self.resident
+        ):
+            self._degrade_resident()
+
+    def _degrade_resident(self) -> None:
+        """Drop from the resident loop to the dispatch-per-tick twin —
+        bit-identical scheduling-wise (the cadence is a pure perf knob,
+        pinned by test_resident_parity_any_cadence), so a host that
+        keeps tripping over its driver serves slower instead of
+        crashing 64 sessions. The mailbox is empty here (the recovery
+        drive that brought failures past the limit just drained it)."""
+        mbox = self.device.mailbox
+        if mbox is not None and (mbox.pending_rows or mbox.staged_count):
+            # degrading while the ring still owes rows would strand
+            # them forever: surface the accounting bug typed
+            raise InvariantViolation(
+                f"degrade with {mbox.pending_rows} mailbox rows pending",
+                invariant="degrade_with_pending_rows",
+            )
+        self._resident_degraded = True
+        self.degrades += 1
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_degraded.labels("dispatch_per_tick").inc()
+            GLOBAL_TELEMETRY.record(
+                "host_degraded", mode="dispatch_per_tick",
+                drive_failures=self._drive_failures,
+            )
+
+    # ------------------------------------------------------------------
+    # slot quarantine: contain a poisoned slot, keep survivors serving
+    # ------------------------------------------------------------------
+
+    def quarantine(self, key: Any, reason: str, *, error=None,
+                   frame: Optional[int] = None) -> Optional[SlotPoisoned]:
+        """Quarantine one hosted session's device slot: its staged rows
+        and any rows the mailbox still owes it are discarded (masked off
+        before the next drive — survivors' rows are untouched), the
+        lane detaches, the slot's residue is scrubbed before reuse, and
+        the verdict is surfaced as a typed SlotPoisoned (take_quarantines
+        drains them — the fleet agent treats each like a mini-failover)
+        with a forensics bundle. Returns the SlotPoisoned (None for an
+        unknown key)."""
+        lane = self._lanes.get(key)
+        if lane is None:
+            return None
+        q_frame = frame if frame is not None else lane.current_frame
+        lane.failed = True
+        lane.last_error = reason
+        lane.rows.clear()
+        dropped = 0
+        if self.resident and self.device.mailbox is not None:
+            dropped = self.device.drop_mailbox_lane(lane.slot)
+        # faults pinned on this slot stop firing: the slot is dead
+        seam = self.fault_seam
+        if seam is not None and hasattr(seam, "dispatch_cleared"):
+            seam.dispatch_cleared(lane.slot)
+        self.quarantines_total += 1
+        tel = GLOBAL_TELEMETRY
+        forensics = None
+        if tel.enabled:
+            self._m_quarantines.labels(reason).inc()
+            tel.record(
+                "slot_quarantined", frame=q_frame, key=str(key),
+                slot=lane.slot, reason=reason, dropped_rows=dropped,
+            )
+            forensics = tel.write_forensics(
+                "quarantine", frame=q_frame, key=str(key),
+                slot=lane.slot, reason=reason,
+                error=repr(error) if error is not None else None,
+                dropped_rows=dropped, tick=self._tick_index,
+                sessions_active=len(self._lanes),
+            )
+        err = SlotPoisoned(
+            f"hosted session {key!r} quarantined",
+            slot=lane.slot, key=key, reason=reason, frame=q_frame,
+            forensics=forensics,
+        )
+        self._quarantines.append(err)
+        slot = lane.slot
+        self.detach(key)
+        self.device.reset_slot(slot)
+        return err
+
+    def take_quarantines(self) -> List[SlotPoisoned]:
+        """Drain the typed quarantine verdicts surfaced since the last
+        call (the fleet agent polls this every step)."""
+        out, self._quarantines = self._quarantines, []
+        return out
 
     def _launch_drafts(self) -> None:
         """Collect every starved p2p lane that can be drafted this tick
@@ -1055,10 +1423,11 @@ class SessionHost:
                 (lane, anchor, scripts[: len(members)], members,
                  fingerprint)
             )
-        if self.resident:
+        if self.resident_active:
             # drafts anchor on ring snapshots: rows the mailbox still
             # owes must land before the rollout reads the rings
-            device.drive_mailbox()
+            if self._drive_resident() is _DRIVE_FAILED:
+                return  # ladder ran; draft again next tick
         batch = device.draft(entries)
         for lane, anchor, scripts, members, fingerprint in packed_metas:
             self._spec.install_draft(
@@ -1071,6 +1440,238 @@ class SessionHost:
                 "spec_draft_launched", lanes=len(packed_metas),
                 rows=len(entries),
             )
+
+    # ------------------------------------------------------------------
+    # SDC audit lane: sampled double-compute vs the full-window
+    # reference program (docs/DESIGN.md "Device fault domains")
+    # ------------------------------------------------------------------
+
+    def _build_audit_row(self, lane: _Lane):
+        """One lane's audit row: load at the OLDEST ring anchor whose
+        replay the record still covers, re-advance every played frame
+        up to the live one, saves all scratch. The oldest anchor
+        maximizes the lookback window — corruption that struck within
+        the last ~max_prediction frames is caught before a post-fault
+        save 'heals' the ring into consistency with the corrupt world.
+        Returns (row, anchor, count) or None when the lane has no
+        coverage (fresh, mid-rollback backlog, or saves out of
+        range)."""
+        core = self.device.core
+        cur = lane.current_frame
+        rec = lane.audit_inputs
+        lo = max(cur - (core.ring_len - 1), 0)
+        anchor = None
+        for f in sorted(lane.saved_frames):
+            if f < lo or f > cur:
+                continue
+            if cur - f > core.max_prediction + 1:
+                continue  # replay must fit one packed row
+            if all(g in rec for g in range(f, cur)):
+                anchor = f
+                break
+        if anchor is None:
+            return None
+        count = cur - anchor
+        W, P, I = core.window, self.num_players, self.game.input_size
+        inputs = np.zeros((W, P, I), dtype=np.uint8)
+        statuses = np.zeros((W, P), dtype=np.int32)
+        save_slots = np.full((W,), core.scratch_slot, dtype=np.int32)
+        for k in range(count):
+            inp, st = rec[anchor + k]
+            inputs[k] = inp
+            statuses[k] = st
+        row = core.pack_tick_row_into(
+            np.empty((core._packed_len,), dtype=np.int32),
+            do_load=True,
+            load_slot=anchor % core.ring_len,
+            inputs=inputs,
+            statuses=statuses,
+            save_slots=save_slots,
+            advance_count=count,
+            start_frame=anchor,
+        )
+        # the at-rest sweep's expectations: every LIVE ring row whose
+        # save checksum the host recorded — (ring slot, frame, recorded
+        # lazy checksum), captured by reference NOW so later saves
+        # can't retroactively change what this audit compares against
+        expect = [
+            (f % core.ring_len, f, lane.audit_saved_checksums[f])
+            for f in sorted(lane.saved_frames)
+            if cur - core.ring_len < f <= cur
+            and f in lane.audit_saved_checksums
+        ]
+        return row, anchor, count, expect
+
+    def _maybe_audit(self) -> None:
+        """Every `sdc_audit_every` host ticks, double-compute EVERY
+        eligible lane (one vmapped batch on the shared bucket grid):
+        detection of a flipped bit is then guaranteed within
+        sdc_audit_every + the anchor lookback (~max_prediction frames)
+        ticks — the sampling bound the acceptance soak pins. Results
+        resolve lazily off the drain pass; a mismatch quarantines the
+        slot."""
+        if self._tick_index % self._audit_every:
+            return
+        entries: List[Tuple[int, np.ndarray]] = []
+        metas = []
+        for lane in self._lanes.values():
+            if (
+                lane.failed
+                or lane.kind != "p2p"
+                or lane.rows  # staged rows not yet on device: stale view
+                or lane.queued_since_tick is not None
+            ):
+                continue
+            built = self._build_audit_row(lane)
+            if built is None:
+                continue
+            row, anchor, count, expect = built
+            entries.append((lane.slot, row))
+            metas.append(
+                (lane.key, anchor, count, lane.current_frame, expect)
+            )
+            if len(entries) >= self.device.capacity:
+                break
+        if not entries:
+            return
+        if self.resident_active and self.device.mailbox.pending_rows:
+            # the audit reads rings/states: rows the mailbox still owes
+            # must land first (an extra drive is a pure cadence change)
+            if self._drive_resident() is _DRIVE_FAILED:
+                return  # ladder ran; audit again next cycle
+        out = self.device.audit_rows(entries)
+        self._pending_audits.append((out, metas))
+        self.audits_sampled += len(entries)
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_sdc_audits.inc(len(entries))
+
+    def _resolve_audits(self, block: bool = False) -> None:
+        """Resolve host-ready audit batches (all of them when `block`):
+        a (reference replay, live world) checksum mismatch is silent
+        data corruption — quarantine the slot with reason sdc_audit."""
+        if not self._pending_audits:
+            return
+        from ..ops.fixed_point import combine_checksum
+
+        remaining = []
+        for pending in self._pending_audits:
+            out, metas = pending
+            ref_hi, ref_lo, live_hi, live_lo, ring_hi, ring_lo = out
+            if not block and not _array_is_ready(ref_hi):
+                remaining.append(pending)
+                continue
+            rh, rl = np.asarray(ref_hi), np.asarray(ref_lo)
+            lh, ll = np.asarray(live_hi), np.asarray(live_lo)
+            qh, ql = np.asarray(ring_hi), np.asarray(ring_lo)
+            for k, (key, anchor, count, frame, expect) in enumerate(metas):
+                verdicts = []
+                if rh[k] != lh[k] or rl[k] != ll[k]:
+                    # the replayed lineage and the live world disagree:
+                    # one of them (or the anchor row) flipped
+                    verdicts.append({
+                        "check": "replay",
+                        "ref": [int(rh[k]), int(rl[k])],
+                        "live": [int(lh[k]), int(ll[k])],
+                    })
+                for rs, f, lazy in expect:
+                    recomputed = combine_checksum(qh[k][rs], ql[k][rs])
+                    if recomputed != lazy():
+                        # a stored snapshot's bytes no longer hash to
+                        # what the device computed when it SAVED them:
+                        # at-rest corruption a future rollback would
+                        # load and serve
+                        verdicts.append({
+                            "check": "ring_row", "frame": f,
+                            "ring_slot": rs,
+                            "recomputed": int(recomputed),
+                            "recorded": int(lazy()),
+                        })
+                if not verdicts:
+                    continue
+                self.audit_mismatches += 1
+                if GLOBAL_TELEMETRY.enabled:
+                    self._m_sdc_mismatches.inc()
+                    GLOBAL_TELEMETRY.record(
+                        "sdc_mismatch", frame=frame, key=str(key),
+                        anchor=anchor, replayed=count,
+                        verdicts=verdicts,
+                    )
+                self.quarantine(key, "sdc_audit", frame=frame)
+        self._pending_audits = remaining
+
+    # ------------------------------------------------------------------
+    # always-on invariant monitors
+    # ------------------------------------------------------------------
+
+    def _trip_invariant(self, invariant: str, *, key: Any = None,
+                        frame: int = -1, info: str = "") -> None:
+        tel = GLOBAL_TELEMETRY
+        forensics = None
+        if tel.enabled:
+            self._m_invariants.labels(invariant).inc()
+            tel.record(
+                "invariant_trip", frame=frame, invariant=invariant,
+                key=str(key), info=info,
+            )
+            forensics = tel.write_forensics(
+                "invariant", frame=frame, invariant=invariant,
+                key=str(key), info=info, tick=self._tick_index,
+            )
+        err = InvariantViolation(
+            info or f"invariant {invariant} violated",
+            invariant=invariant, key=key, frame=frame,
+            forensics=forensics,
+        )
+        if len(self.invariant_trips) < 256:
+            self.invariant_trips.append(err)
+        if self.strict_invariants:
+            raise err
+
+    def _check_invariants(self) -> None:
+        """The cheap always-on monitors — the bug class the WAN soak
+        found by accident (a stale watermark permanently wedging a
+        session), watched deliberately: per-lane confirmed-frame
+        progress (no RUNNING lane silent past wedge_limit_ticks,
+        latched until progress resumes) and resident mailbox
+        accounting (staged-row count vs watermark image)."""
+        tick = self._tick_index
+        if self.wedge_limit_ticks:
+            for lane in self._lanes.values():
+                if lane.failed:
+                    continue
+                if lane.ticks_advanced != lane.last_progress_seen:
+                    lane.last_progress_seen = lane.ticks_advanced
+                    lane.last_progress_tick = tick
+                    lane.wedge_reported = False
+                elif (
+                    not lane.wedge_reported
+                    and tick - lane.last_progress_tick
+                    > self.wedge_limit_ticks
+                    and lane.session.current_state()
+                    == SessionState.RUNNING
+                ):
+                    lane.wedge_reported = True
+                    self._trip_invariant(
+                        "lane_wedged", key=lane.key,
+                        frame=lane.current_frame,
+                        info=(
+                            f"RUNNING lane {lane.key!r} advanced no "
+                            f"frame for {tick - lane.last_progress_tick}"
+                            " ticks"
+                        ),
+                    )
+        if self.resident_active and self.device.mailbox is not None:
+            mbox = self.device.mailbox
+            counted = int(mbox._counts.sum())
+            if mbox.pending_rows != counted or mbox.max_fill() > mbox.depth:
+                self._trip_invariant(
+                    "mailbox_accounting",
+                    info=(
+                        f"mailbox pending_rows={mbox.pending_rows} vs "
+                        f"watermark image {counted} "
+                        f"(max_fill={mbox.max_fill()}/{mbox.depth})"
+                    ),
+                )
 
     def _lane_ready(self, lane: _Lane) -> bool:
         lane.starved = False
@@ -1113,6 +1714,22 @@ class SessionHost:
                 ),
                 default=None,
             )
+            if confirmed is not None:
+                # invariant monitor: the confirmed watermark is
+                # monotone by protocol — a regression means a peer's
+                # frame accounting (or ours) corrupted
+                prev = lane.max_confirmed_seen
+                if prev is not None and confirmed < prev:
+                    self._trip_invariant(
+                        "confirmed_regressed", key=lane.key,
+                        frame=confirmed,
+                        info=(
+                            f"confirmed watermark regressed "
+                            f"{prev} -> {confirmed} on lane {lane.key!r}"
+                        ),
+                    )
+                else:
+                    lane.max_confirmed_seen = confirmed
             if (
                 confirmed is None
                 or sl.current_frame - confirmed >= lane.max_prediction
@@ -1189,6 +1806,30 @@ class SessionHost:
             (load is not None, count, last_active, trailing is not None),
             frame=start_frame,
         )
+        if self._audit_every and lane.kind == "p2p":
+            # SDC audit record: what the device is about to PLAY for
+            # each advanced frame (rollback segments overwrite earlier
+            # predicted values with the corrected truth, keeping the
+            # record equal to the lineage the live bytes derive from),
+            # plus the frames whose ring rows can anchor a replay
+            rec = lane.audit_inputs
+            for k in range(count):
+                rec[start_frame + k] = (
+                    inputs[k].copy(), statuses[k].copy()
+                )
+            for _slot_i, save in saves:
+                lane.saved_frames.add(save.frame)
+            floor = start_frame + count - (core.ring_len - 1)
+            if len(rec) > 2 * core.window:
+                for f in [f for f in rec if f < floor]:
+                    del rec[f]
+                lane.saved_frames = {
+                    f for f in lane.saved_frames if f >= floor
+                }
+                for f in [
+                    f for f in lane.audit_saved_checksums if f < floor
+                ]:
+                    del lane.audit_saved_checksums[f]
         # speculative bubble-filling: record what this lane actually
         # played (the verify pass's ground truth + the input model's
         # training stream), then check the segment against any standing
@@ -1352,15 +1993,39 @@ class SessionHost:
                     env_entries.sort(
                         key=lambda e: self.device.shard_of(e[0])
                     )
-                # session entries FIRST: save bindings index the batch by
-                # position, and env rows need no post-dispatch binding
-                entries = [
-                    (lane.slot, staged.row) for lane, staged in group
-                ] + env_entries
-                if not entries:
-                    continue
+                batch, group = self._dispatch_group(
+                    gkey, group, env_entries, env_la
+                )
+                for k, (lane, staged) in enumerate(group):
+                    self._retire_row(lane, staged, batch, k * core.window)
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_queue_depth.set(len(self._ready))
+
+    def _dispatch_group(self, gkey, group, env_entries, env_la):
+        """Dispatch one depth group behind the fault-containment ladder:
+        a DeviceDispatchFailed (raised BEFORE the program runs — worlds
+        untouched) retries once as a transient; a second raise naming
+        culprit slots quarantines them and re-dispatches the survivors
+        bit-exactly (identical rows, identical program); persistent AND
+        unattributed re-raises — the whole device is suspect. Returns
+        (checksum batch | None, surviving group) with save-binding
+        positions matching the surviving entries."""
+        for attempt in range(3):
+            group = [
+                (lane, staged) for lane, staged in group if not lane.failed
+            ]
+            # session entries FIRST: save bindings index the batch by
+            # position, and env rows need no post-dispatch binding
+            entries = [
+                (lane.slot, staged.row) for lane, staged in group
+            ] + env_entries
+            if not entries:
+                return None, group
+            try:
                 if gkey == "fast":
-                    batch, _bucket = self.device.dispatch(entries, fast=True)
+                    batch, _bucket = self.device.dispatch(
+                        entries, fast=True
+                    )
                 elif gkey is None:
                     batch, _bucket = self.device.dispatch(entries)
                 else:
@@ -1371,10 +2036,25 @@ class SessionHost:
                     batch, _bucket = self.device.dispatch(
                         entries, last_active=la
                     )
-                for k, (lane, staged) in enumerate(group):
-                    self._retire_row(lane, staged, batch, k * core.window)
-        if GLOBAL_TELEMETRY.enabled:
-            self._m_queue_depth.set(len(self._ready))
+                return batch, group
+            except DeviceDispatchFailed as exc:
+                self._on_device_fault(exc)
+                if attempt == 0:
+                    continue  # transient: the retry re-runs identically
+                culprits = [
+                    lane for lane, _ in group
+                    if lane.slot in set(exc.slots)
+                ]
+                if not culprits:
+                    raise
+                for lane in culprits:
+                    self.quarantine(
+                        lane.key, "dispatch_failed", error=exc
+                    )
+        raise DeviceDispatchFailed(
+            "megabatch dispatch still failing after quarantine",
+            op="megabatch",
+        )
 
     def _retire_row(self, lane: _Lane, staged: _StagedRow, batch,
                     base: int) -> None:
@@ -1386,11 +2066,14 @@ class SessionHost:
         ring_len = self.device.core.ring_len
         lane.rows.popleft()
         for slot_i, save in staged.saves:
+            lazy = _LazyChecksum(batch, base + slot_i)
             save.cell.save_lazy(
                 save.frame,
                 SnapshotRef(save.frame, save.frame % ring_len),
-                _LazyChecksum(batch, base + slot_i),
+                lazy,
             )
+            if self._audit_every and lane.kind == "p2p":
+                lane.audit_saved_checksums[save.frame] = lazy
         if not lane.rows:
             self._ready.remove(lane.key)
             waited = self._tick_index - lane.queued_since_tick
@@ -1463,8 +2146,15 @@ class SessionHost:
         passes = 0
         while self._ready:
             # retire the whole fence first so the budget can never pin the
-            # queue: each pass then dispatches at least one megabatch
-            self.device.block_until_ready()
+            # queue: each pass then dispatches at least one megabatch.
+            # block_until_ready drains the mailbox, so an armed/real
+            # drive fault can surface HERE — route it through the same
+            # recovery ladder as the tick path instead of letting a
+            # checkpoint/migration flush crash the host
+            try:
+                self.device.block_until_ready()
+            except DeviceDispatchFailed as exc:
+                self._recover_drive_failure(exc)
             self._pump_device()
             passes += 1
             if passes >= max_passes and self._ready:
@@ -1481,7 +2171,37 @@ class SessionHost:
                     queue_depth=depth, inflight_rows=inflight,
                     passes=passes,
                 )
-        self.device.block_until_ready()
+        try:
+            self.device.block_until_ready()
+        except DeviceDispatchFailed as exc:
+            self._recover_drive_failure(exc)
+            self.device.block_until_ready()
+        self._resolve_audits(block=True)
+
+    def _save_checkpoint(self, path: str) -> None:
+        """device.save behind the harvest-timeout recovery contract: a
+        readback timeout mid-checkpoint (the kill-mid-harvest race — an
+        export racing an in-flight checksum batch) blocks the fence and
+        retries ONCE, so the checkpoint either completes whole or the
+        typed HarvestTimeout surfaces — never a torn file (the write
+        itself is atomic) and never a silently skipped save."""
+        for attempt in (0, 1):
+            try:
+                if self.fault_seam is not None:
+                    self.fault_seam.before_harvest("checkpoint")
+                self.device.save(path)
+                break
+            except HarvestTimeout:
+                self.harvest_timeouts += 1
+                if GLOBAL_TELEMETRY.enabled:
+                    GLOBAL_TELEMETRY.record(
+                        "harvest_timeout", op="checkpoint"
+                    )
+                if attempt:
+                    raise
+                self.device.block_until_ready()
+        if self.fault_seam is not None:
+            self.fault_seam.after_checkpoint(path)
 
     def checkpoint(self, path: str) -> None:
         """Durably checkpoint the stacked device worlds WITHOUT draining:
@@ -1489,7 +2209,7 @@ class SessionHost:
         The periodic crash-recovery story — a kill→restore rebuilds a
         host from the latest checkpoint (serve/migrate.HostGroup)."""
         self._flush_ready("checkpoint")
-        self.device.save(path)
+        self._save_checkpoint(path)
         if GLOBAL_TELEMETRY.enabled:
             GLOBAL_TELEMETRY.record(
                 "host_checkpointed", path=str(path),
@@ -1506,7 +2226,7 @@ class SessionHost:
         self._draining = True
         self._flush_ready("drain")
         if checkpoint_path is not None:
-            self.device.save(checkpoint_path)
+            self._save_checkpoint(checkpoint_path)
         self._drained = True
         summary = self._host_section()
         summary["checkpoint"] = checkpoint_path
@@ -1567,6 +2287,24 @@ class SessionHost:
             "plan_signatures": len(dev.plan_cache.signatures),
             "buckets": list(dev.buckets),
             "session_shards": dev.session_shards,
+            # device fault domains: quarantine/degrade/audit health
+            "quarantines": self.quarantines_total,
+            "device_faults": self.device_faults,
+            "harvest_timeouts": self.harvest_timeouts,
+            "invariant_trips": len(self.invariant_trips),
+            "shedding_admission": self._shed_admission,
+            **(
+                {
+                    "sdc_audit": {
+                        "every": self._audit_every,
+                        "sampled": self.audits_sampled,
+                        "mismatches": self.audit_mismatches,
+                        "pending": len(self._pending_audits),
+                    }
+                }
+                if self._audit_every
+                else {}
+            ),
             "sessions": sessions,
             "envs": [env._env_section() for env in self._envs],
             # speculative bubble-filling hit rate and volume (absent on
@@ -1595,6 +2333,8 @@ class SessionHost:
                         ),
                         "mailbox_pending": dev.mailbox.pending_rows,
                         "mailbox_overflows": dev.mailbox.overflows,
+                        "degraded": self._resident_degraded,
+                        "drive_failures": self._drive_failures,
                     }
                 }
                 if self.resident

@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..errors import (
     CheckpointIncompatible,
     GroupSaturated,
+    HarvestTimeout,
     HostFull,
     InvalidRequest,
 )
@@ -127,7 +128,22 @@ def export_session(host: SessionHost, key: Any) -> MigrationTicket:
         # the staged rows must land on device BEFORE the export reads the
         # slot, or the exported world is behind lane.current_frame
         host._flush_ready(f"migration export of {key!r}")
-    slot_state = host.device.export_slot(lane.slot)
+    seam = getattr(host, "fault_seam", None)
+    for attempt in (0, 1):
+        try:
+            if seam is not None:
+                seam.before_harvest("migration_export")
+            slot_state = host.device.export_slot(lane.slot)
+            break
+        except HarvestTimeout:
+            # transient readback stall: the residue still exists on
+            # device — block the fence and retry once, so the export
+            # either completes whole or surfaces typed (never a
+            # half-copied slot riding a ticket)
+            host.harvest_timeouts += 1
+            if attempt:
+                raise
+            host.device.block_until_ready()
     ticket = MigrationTicket(
         lane.session, key, lane.slot, lane.current_frame,
         set(lane.pending_inputs), slot_state,
@@ -220,7 +236,8 @@ class HostGroup:
                  clock=None, host_factory=None,
                  max_attempts: int = 3, backoff_ms: int = 32,
                  backoff_seed: int = 0):
-        assert hosts, "a HostGroup needs at least one host"
+        if not hosts:
+            raise InvalidRequest("a HostGroup needs at least one host")
         self.hosts = list(hosts)
         self.clock = clock or hosts[0].clock
         self._host_factory = host_factory
@@ -496,7 +513,10 @@ class HostGroup:
         suspended — not pumped, not advanced, their inputs dropped —
         until restore_host() brings the host back. Returns the number of
         suspended sessions."""
-        assert host_idx not in self.dead
+        if host_idx in self.dead:
+            raise InvalidRequest(
+                f"kill_host({host_idx}): host is already dead"
+            )
         host = self.hosts[host_idx]
         host.drain(checkpoint_path)
         tickets: List[MigrationTicket] = []
@@ -529,7 +549,10 @@ class HostGroup:
         Returns the number of resumed sessions."""
         from ..utils.checkpoint import load_device_checkpoint
 
-        assert host_idx in self.dead
+        if host_idx not in self.dead:
+            raise InvalidRequest(
+                f"restore_host({host_idx}): host was never killed"
+            )
         if self._host_factory is None:
             raise InvalidRequest(
                 "restore_host needs a host_factory (build the group via "

@@ -1783,7 +1783,8 @@ class MultiSessionDeviceCore:
                  plan_cache: Optional[DispatchPlanCache] = None,
                  buckets: Optional[Sequence[int]] = None,
                  depth_buckets: Optional[Sequence[int]] = None,
-                 depth_routing: bool = True, speculation: bool = False):
+                 depth_routing: bool = True, speculation: bool = False,
+                 sdc_audit: bool = False):
         """`num_players` is the HOST-WIDE player layout (the widest
         session the host admits): every hosted session's rows are packed
         at this width, with absent players padded as DISCONNECTED so the
@@ -1927,6 +1928,23 @@ class MultiSessionDeviceCore:
             )
             self._draft_pad_row = np.zeros((self._draft_len,), np.int32)
             self._draft_stage_pools: dict = {}
+        # SDC audit lane (serve/host.py's sampled double-compute): ONE
+        # read-only reference program per row bucket — gather sampled
+        # slots, replay each from its ring anchor through the
+        # full-window parity tick (the depth_routing=False reference),
+        # and return the recomputed final-state checksum beside the live
+        # world's, so silent corruption in either is a host-visible
+        # mismatch. Compiled at warmup, counted in the bucket budget.
+        self.sdc_audit = sdc_audit
+        if sdc_audit:
+            # NO donation: the audit must never touch the worlds it
+            # checks — rings/states flow through untouched
+            self._audit_fn = jax.jit(self._audit_impl)
+        self.audit_dispatches = 0
+        # deterministic fault-injection seam (serve/faults.py): consulted
+        # at every dispatch/drive entry point BEFORE the program runs and
+        # at mailbox staging. None (the default) costs one attribute read.
+        self.fault_seam = None
         # device-resident serving loop (attach_mailbox builds all three):
         # the donated [S, K, L] input mailbox and the jitted
         # lax.while_loop virtual-tick driver that consumes it — one host
@@ -2154,6 +2172,9 @@ class MultiSessionDeviceCore:
         base = len(self.buckets) * (len(self.depth_buckets) + 1)
         if self.speculation:
             base += 2 * len(self.buckets)
+        if self.sdc_audit:
+            # one read-only reference-recompute program per row bucket
+            base += len(self.buckets)
         if self.mailbox is not None:
             # resident driver: one windowed variant per depth bucket
             # plus the all-fast variant, plus one commit scatter per
@@ -2236,6 +2257,13 @@ class MultiSessionDeviceCore:
         assert len({slot for slot, _ in entries}) == n, (
             "one row per session slot per megabatch"
         )
+        if self.fault_seam is not None:
+            # BEFORE any state or staging changes: a raise here leaves
+            # the stacked worlds untouched, so the host can retry or
+            # re-dispatch survivors bit-exactly
+            self.fault_seam.before_dispatch(
+                "megabatch", [slot for slot, _ in entries]
+            )
         bucket = self.bucket_for(n)
         staged = self._acquire_stage(bucket)
         idx, rows, used = staged
@@ -2271,6 +2299,10 @@ class MultiSessionDeviceCore:
         n = int(idx_block.shape[0])
         assert 0 < n <= self.capacity
         assert rows_block.shape[0] == n
+        if self.fault_seam is not None:
+            self.fault_seam.before_dispatch(
+                "megabatch_rows", [int(s) for s in idx_block]
+            )
         bucket = self.bucket_for(n)
         staged = self._acquire_stage(bucket)
         idx, rows, used = staged
@@ -2388,6 +2420,8 @@ class MultiSessionDeviceCore:
         if self.speculation:
             fns["_draft_impl"] = self._draft_fn
             fns["_adopt_slot_impl"] = self._adopt_slot_fn
+        if self.sdc_audit:
+            fns["_audit_impl"] = self._audit_fn
         if self.mailbox is not None:
             fns["_driver_impl"] = self._driver_fn
             fns["_driver_fast_impl"] = self._driver_fast_fn
@@ -2566,6 +2600,78 @@ class MultiSessionDeviceCore:
         return _ChecksumBatch(his, los, self.ledger)
 
     # ------------------------------------------------------------------
+    # SDC audit lane (serve/host.py's sampled double-compute drives it)
+    # ------------------------------------------------------------------
+
+    def _audit_impl(self, rings, states, idx, rows):
+        """Reference recompute over [B] sampled slots, READ-ONLY: gather
+        each slot's (ring, state), replay its audit row — load at the
+        ring anchor, re-advance the recorded played inputs — through the
+        FULL-WINDOW parity tick (the depth_routing=False reference
+        program, deliberately a different compiled artifact from the
+        fast/driver paths that produced the live bytes), and return the
+        replayed final state's checksum beside the live world's. On an
+        uncorrupted slot the two agree bitwise by the rollback
+        contract; a flipped bit in the live world OR in the anchor ring
+        row makes them diverge — either way a host-visible SDC verdict
+        within the sampling cadence. Nothing is donated and nothing is
+        scattered back: an audit can never perturb the worlds it
+        checks."""
+        g_ring = jax.tree.map(lambda a: a[idx], rings)
+        g_state = jax.tree.map(lambda a: a[idx], states)
+
+        def one(ring, state, row):
+            _, replayed, _, _, _ = self.core._tick_windowed_impl(
+                ring, state, row, {}, self.core.window
+            )
+            ref_hi, ref_lo = self.core.game.checksum(replayed)
+            live_hi, live_lo = self.core.game.checksum(state)
+            # every ring row's checksum recomputed at rest: the host
+            # compares them against the values recorded when each row
+            # was SAVED, so a bit that flipped in a stored snapshot is
+            # caught before a future rollback can load and serve it
+            ring_hi, ring_lo = jax.vmap(self.core.game.checksum)(ring)
+            return ref_hi, ref_lo, live_hi, live_lo, ring_hi, ring_lo
+
+        return jax.vmap(one)(g_ring, g_state, rows)
+
+    def audit_rows(self, entries):
+        """Launch one sampled SDC audit batch: `entries` is a list of
+        (slot, packed audit row) — a row whose load slot is the lane's
+        last ring anchor and whose advances replay the recorded played
+        inputs up to the live frame, saves all scratch. Returns the
+        device handles (ref_hi, ref_lo, live_hi, live_lo, ring_hi[R],
+        ring_lo[R]), entry k at index k — the host resolves them lazily
+        and quarantines any slot whose replay/live pair or recorded
+        ring-row checksums mismatch. Pads to the megabatch row buckets;
+        non-blocking (no fence admission needed: the audit allocates
+        its own staging and touches no donated state)."""
+        assert self.sdc_audit, "core built without sdc_audit=True"
+        n = len(entries)
+        assert 0 < n <= self.capacity
+        bucket = self.bucket_for(n)
+        # fresh staging per audit: audits are sampled (default one in
+        # `sdc_audit_every` host ticks), so this is not a hot path and
+        # pooling it would only grow the fence-protected surface
+        idx = np.full((bucket,), self.pad_slot, dtype=np.int32)
+        rows = np.tile(self._pad_row, (bucket, 1))
+        for k, (slot, row) in enumerate(entries):
+            assert 0 <= slot < self.capacity
+            idx[k] = self._phys[slot]
+            rows[k] = row
+        self.plan_cache.note(("sdc_audit", bucket), metrics=False)
+        out = self._audit_fn(self.rings, self.states, idx, rows)
+        san = active_sanitizer()
+        if san is not None:
+            san.check_dispatch_budget(
+                self._budget_fns(),
+                self.dispatch_bucket_budget(),
+                context="MultiSessionDeviceCore.audit_rows",
+            )
+        self.audit_dispatches += 1
+        return out
+
+    # ------------------------------------------------------------------
     # device-resident serving loop (serve/host.py's resident=True mode
     # drives this): a donated input mailbox the host feeds, and a jitted
     # lax.while_loop virtual-tick driver that consumes it — dispatch
@@ -2714,7 +2820,12 @@ class MultiSessionDeviceCore:
         ggrs_mailbox_overflow_total), never a dropped input."""
         mbox = self.mailbox
         phys = int(self._phys[slot])
-        if mbox.lane_full(phys):
+        storm = (
+            self.fault_seam is not None and self.fault_seam.on_stage(phys)
+        )
+        if mbox.lane_full(phys) or storm:
+            # a real full lane and an injected overflow storm take the
+            # same path: degrade to an extra drive, never drop the row
             mbox.note_overflow()
             self.drive_mailbox()
         return mbox.stage(phys, row, last_active, fast)
@@ -2740,6 +2851,18 @@ class MultiSessionDeviceCore:
         mbox = self.mailbox
         if mbox is None or (mbox.pending_rows == 0 and mbox.staged_count == 0):
             return None
+        if self.fault_seam is not None:
+            # every lane with rows this drive would execute, as LOGICAL
+            # slots — consulted before commit/take so a raise leaves the
+            # cycle intact for the host's retry/containment ladder
+            phys_live = set(np.nonzero(mbox._counts)[0].tolist())
+            phys_live.update(p for p, _, _ in mbox._staged)
+            slots = sorted(
+                int(self._phys_inverse[p])
+                for p in phys_live
+                if int(self._phys_inverse[p]) < self.capacity
+            )
+            self.fault_seam.before_dispatch("resident_drive", slots)
         self.commit_mailbox()
         marks, n_rows, max_la, all_fast, vt_fast, future = mbox.take_cycle()
         if all_fast:
@@ -2801,6 +2924,80 @@ class MultiSessionDeviceCore:
             lambda a: a.at[phys].set(jnp.zeros(a.shape[1:], a.dtype)),
             self.rings,
         )
+
+    def drop_mailbox_lane(self, slot: int) -> int:
+        """QUARANTINE containment (resident mode): discard every row
+        LOGICAL slot `slot` still owes the mailbox — its watermark drops
+        to zero, so rows already committed to the device ring mask to
+        the inert pad row and never execute, and its staged rows never
+        commit. Survivor lanes' rows, watermarks and routing are
+        untouched (a conservatively-wide depth bucket is bit-identical
+        by the windowed contract). Returns the rows dropped."""
+        if self.mailbox is None:
+            return 0
+        return self.mailbox.drop_lane(int(self._phys[slot]))
+
+    def inject_slot_bitflip(self, slot: int, *, seed: int,
+                            target: str = "ring",
+                            ring_slot: Optional[int] = None) -> dict:
+        """FAULT-INJECTION entry point (serve/faults.py's SDC arm; never
+        called on a production path): flip ONE seeded bit of logical
+        slot `slot`'s device residue — a snapshot-ring row
+        (`target='ring'`, the at-rest corruption a future rollback
+        would load and serve; `ring_slot` pins which row, default
+        seeded over the real rows) or its live world
+        (`target='state'`). Flushes the fence and the mailbox first so
+        the flip lands on canonical bytes, then writes the flipped
+        leaf back through an eager per-slot update, the reset_slot
+        discipline. Survivors' slots are untouched. Returns a
+        descriptor of what flipped, for the forensics bundle."""
+        import jax.numpy as jnp
+        from random import Random
+
+        assert 0 <= slot < self.capacity
+        assert target in ("state", "ring")
+        self.block_until_ready()
+        phys = int(self._phys[slot])
+        tree = self.states if target == "state" else self.rings
+        leaves = jax.tree_util.tree_leaves_with_path(tree)
+        rng = Random(seed)
+        path, leaf = leaves[rng.randrange(len(leaves))]
+        if target == "ring":
+            # confine the flip to ONE real ring row (never the scratch
+            # row, which masked saves target and nothing ever loads)
+            r = (
+                int(ring_slot) % self.core.ring_len
+                if ring_slot is not None
+                else rng.randrange(self.core.ring_len)
+            )
+            row = np.array(jax.device_get(leaf[phys, r]), copy=True)
+        else:
+            r = None
+            row = np.array(jax.device_get(leaf[phys]), copy=True)
+        flat = row.reshape(-1).view(np.uint8)
+        bit = rng.randrange(flat.size * 8)
+        flat[bit // 8] ^= np.uint8(1 << (bit % 8))
+
+        def patch(p, a):
+            if p != path:
+                return a
+            if r is None:
+                return a.at[phys].set(jnp.asarray(row))
+            return a.at[phys, r].set(jnp.asarray(row))
+
+        patched = jax.tree_util.tree_map_with_path(patch, tree)
+        if target == "state":
+            self.states = patched
+        else:
+            self.rings = patched
+        return {
+            "slot": slot,
+            "target": target,
+            "ring_slot": r,
+            "leaf": jax.tree_util.keystr(path),
+            "byte": bit // 8,
+            "bit": bit % 8,
+        }
 
     def _reset_masked_impl(self, rings, states, mask, init):
         """Masked batch reset over the stacked pytrees: every slot with
@@ -2985,6 +3182,19 @@ class MultiSessionDeviceCore:
             self.states = jax.tree.map(
                 lambda a, x: a.at[self.pad_slot].set(x), self.states, init
             )
+        if self.sdc_audit:
+            # the audit lane's reference-recompute program per row
+            # bucket: all-pad batches read the dummy slot only and
+            # return discarded checksums — a pure compile, and the
+            # worlds are untouched by construction (nothing is donated
+            # or scattered)
+            for b in self.buckets:
+                self._audit_fn(
+                    self.rings,
+                    self.states,
+                    np.full((b,), self.pad_slot, dtype=np.int32),
+                    np.tile(self._pad_row, (b, 1)),
+                )
         if self.mailbox is not None:
             # resident driver variants: compile the commit-bucket
             # scatters plus every driver program the live cycle router
@@ -3277,6 +3487,14 @@ class ShardedMultiSessionDeviceCore(MultiSessionDeviceCore):
         idx = jax.lax.with_sharding_constraint(idx, self._row_sharding)
         rows = jax.lax.with_sharding_constraint(rows, self._row_sharding)
         return super()._draft_impl(rings, idx, rows)
+
+    def _audit_impl(self, rings, states, idx, rows):
+        # the sampled audit batch partitions across the session shards
+        # like any other staged row block; the replay itself is per-slot
+        # local, so the constraint keeps the gathers shard-local
+        idx = jax.lax.with_sharding_constraint(idx, self._row_sharding)
+        rows = jax.lax.with_sharding_constraint(rows, self._row_sharding)
+        return super()._audit_impl(rings, states, idx, rows)
 
     def _place_mailbox(self, rows):
         from ..parallel.sharded import shard_mailbox

@@ -43,6 +43,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..errors import InvariantViolation, MailboxLaneFull
 from ..obs import GLOBAL_TELEMETRY, LOG2_BUCKETS
 
 
@@ -57,7 +58,11 @@ class DeviceMailbox:
         import jax
         import jax.numpy as jnp
 
-        assert depth >= 1
+        if depth < 1:
+            raise InvariantViolation(
+                f"mailbox depth must be >= 1 (got {depth})",
+                invariant="mailbox_depth",
+            )
         self.core = core
         self.depth = depth
         self.stack_slots = core.stack_slots
@@ -137,7 +142,14 @@ class DeviceMailbox:
         The row reference must stay valid until the next `commit` (the
         lane row pools guarantee it: commits happen within the tick)."""
         j = int(self._counts[phys])
-        assert j < self.depth, "stage() on a full lane (caller must drive)"
+        if j >= self.depth:
+            # a runtime scheduling bug, not an API misuse: typed so the
+            # operator sees which lane wedged at what depth (the core's
+            # stage_mailbox_row drives first and can never hit this)
+            raise MailboxLaneFull(
+                "stage() on a full mailbox lane (caller must drive)",
+                lane=phys, depth=self.depth,
+            )
         self._staged.append((phys, j, row))
         self._counts[phys] = j + 1
         self.pending_rows += 1
@@ -272,7 +284,14 @@ class DeviceMailbox:
         bool[K], future) and resets the staging bookkeeping for the next
         cycle. `commit` must have landed every staged row first
         (drive_mailbox guarantees it)."""
-        assert not self._staged, "take_cycle() with uncommitted rows"
+        if self._staged:
+            # a drive that would execute rows the device never received:
+            # the watermark/row-ring invariant the resident loop's
+            # correctness rests on, surfaced typed instead of asserted
+            raise InvariantViolation(
+                "take_cycle() with uncommitted staged rows",
+                invariant="mailbox_uncommitted_rows",
+            )
         marks = self._counts.copy()
         n = self.pending_rows
         max_la = self._cycle_max_last_active
@@ -286,6 +305,29 @@ class DeviceMailbox:
         self._vt_fast.fill(True)
         self._future = None
         return marks, n, max_la, all_fast, vt_fast, future
+
+    def drop_lane(self, phys: int) -> int:
+        """QUARANTINE containment: discard every row PHYSICAL lane
+        `phys` still owes this fill cycle — staged entries are scrubbed
+        before they can commit, and the lane's watermark drops to zero
+        so rows already committed to the device ring mask to the inert
+        pad row at the next drive. Other lanes' rows, watermarks and
+        the cycle's routing flags are untouched (leftover conservative
+        routing — a wider depth bucket, a windowed instead of fast
+        drive — is bit-identical by the driver contract). Returns the
+        rows dropped. Lazy checksums already bound against the cycle's
+        future for the dropped rows resolve to pad values; the caller
+        quarantined the owning session, so no live cell reads them."""
+        n = int(self._counts[phys])
+        if n == 0:
+            return 0
+        if self._staged:
+            self._staged = [
+                (p, j, row) for (p, j, row) in self._staged if p != phys
+            ]
+        self._counts[phys] = 0
+        self.pending_rows -= n
+        return n
 
     def observe_drive(self, n_rows: int, vticks: int) -> None:
         """Telemetry for one driver dispatch (behind the enabled check at

@@ -73,6 +73,17 @@
 #   exporters (scripts/resident_smoke.py, CPU jax, <1 min). Also runs
 #   in the default flow (step 2e): the resident loop is a correctness
 #   surface, not an optional extra.
+#   --fault-smoke runs a seeded FaultPlan firing >= 1 of EVERY
+#   device-domain fault kind (dispatch raise, harvest timeout, mailbox
+#   overflow storm, checkpoint corruption, injected slot bit-flip)
+#   against a lossy 16-session resident fleet under GGRS_SANITIZE=1,
+#   gated on survivors serving with zero desyncs, every quarantine a
+#   typed SlotPoisoned + forensics bundle, the injected SDC caught by
+#   the audit lane, the corrupted checkpoint detected typed, zero
+#   post-warmup recompiles, and the fault instruments through BOTH
+#   exporters (scripts/fault_smoke.py, CPU jax, <1 min). Also runs in
+#   the default flow (step 2f): device fault domains are a correctness
+#   surface, not an optional extra.
 #   --lint runs the determinism/trace/fence/wire static-analysis gate
 #   (python -m ggrs_tpu.analysis, pure AST, no jax, seconds) against
 #   analysis/baseline.toml, then the retrace-sanitizer smoke
@@ -165,6 +176,12 @@ if [ "${1:-}" = "--resident-smoke" ]; then
   exit $?
 fi
 
+if [ "${1:-}" = "--fault-smoke" ]; then
+  echo "== fault smoke (device fault seam: quarantine + SDC audit + degrade) =="
+  GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/fault_smoke.py
+  exit $?
+fi
+
 if [ "${1:-}" = "--spec-smoke" ]; then
   echo "== spec smoke (speculative bubble-filling, single-device + sharded) =="
   GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
@@ -198,6 +215,9 @@ JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 
 echo "== [2e/5] resident smoke (device mailbox + while_loop driver) =="
 GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/resident_smoke.py
+
+echo "== [2f/5] fault smoke (device fault domains end to end) =="
+GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/fault_smoke.py
 
 if [ "$FAST" = "0" ]; then
   echo "== [3/5] UBSAN build + native/wire tests =="
