@@ -2365,6 +2365,154 @@ def bench_fault_storm(sessions=16, ticks=120, entities=256,
     }
 
 
+def bench_journal_overhead(sessions=16, ticks=160, entities=256):
+    """Durable-journal write tax (ggrs_tpu/journal): the bench_serve_host
+    hosted-fleet drive with per-lane confirmed-input journaling OFF vs
+    ON across the fsync-cadence sweep (0 = rotation/close only, 8 =
+    every 8 record appends, 1 = every append). The tap is a host-side
+    pure observer, so the arms are bit-identical traffic; the figure is
+    purely the host-tax of encode+write(+fsync). journal_fps_ratio_* =
+    arm/baseline session-ticks/sec (1.0 = free)."""
+    import shutil
+    import tempfile
+
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.network.sockets import InMemoryNetwork
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.serve.loadgen import (
+        build_matches,
+        drive_scripted,
+        make_scripts,
+        sync_fleet,
+    )
+    from ggrs_tpu.utils.clock import FakeClock
+
+    def arm(journal_dir, fsync):
+        clock = FakeClock()
+        net = InMemoryNetwork(
+            clock, latency_ms=20, jitter_ms=5, loss=0.01, seed=7
+        )
+        game = ExGame(num_players=4, num_entities=entities)
+        host = SessionHost(
+            game, max_prediction=8, num_players=4,
+            max_sessions=sessions + 4, clock=clock, idle_timeout_ms=0,
+            warmup=True, journal_dir=journal_dir,
+            journal_fsync_every=fsync,
+        )
+        matches = build_matches(host, net, clock, sessions=sessions, seed=7)
+        n_sessions = sum(len(keys) for keys in matches)
+        sync_fleet(host, matches, clock)
+        scripts = make_scripts(matches, ticks, seed=7)
+        host.device.block_until_ready()
+        t0 = time.perf_counter()
+        desyncs = drive_scripted(host, matches, clock, scripts, ticks)
+        host.device.block_until_ready()
+        host.flush_journals()
+        dt = time.perf_counter() - t0
+        assert not desyncs, f"journal bench arm desynced: {desyncs[:3]}"
+        section = host._host_section().get("journal", {})
+        return n_sessions * ticks / dt, section
+
+    base_a, _ = arm(None, 0)
+    arms = {}
+    rows = bytes_written = 0
+    for fsync in (0, 8, 1):
+        d = tempfile.mkdtemp(prefix=f"ggrs_jbench_f{fsync}_")
+        try:
+            fps, section = arm(d, fsync)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+        arms[f"fsync{fsync}"] = fps
+        if fsync == 0:
+            rows = section.get("frames_journaled", 0)
+            bytes_written = section.get("bytes_written", 0)
+            assert rows > 0, "journal arm journaled nothing"
+    base_b, _ = arm(None, 0)  # AB..A: bracket drift on a noisy box
+    base = (base_a + base_b) / 2
+    return {
+        "sessions": sessions,
+        "ticks": ticks,
+        "entities": entities,
+        "frames_journaled": rows,
+        "journal_bytes": bytes_written,
+        "baseline_session_ticks_per_sec": round(base, 1),
+        **{
+            f"journal_session_ticks_per_sec_{k}": round(v, 1)
+            for k, v in arms.items()
+        },
+        **{
+            f"journal_fps_ratio_{k}": round(v / max(base, 1e-9), 3)
+            for k, v in arms.items()
+        },
+    }
+
+
+def bench_recovery_time_objective(matches=8, ticks=120, entities=8):
+    """Recovery-time objective of journal-only point-in-time recovery:
+    run `matches` seeded twin matches with journaling on, then rebuild
+    every match's world from its on-disk journal ALONE as ONE batched
+    megabatch grid (journal.recover.batch_resim_journals — slot per
+    match, a full window of confirmed frames per dispatch per match).
+    Reports matches/sec and confirmed-frames/sec rebuilt; per-frame
+    checksums of the rebuilt lineage are verified against the live
+    runs' desync-detection histories, so a fast-but-wrong resim fails
+    the bench instead of flattering it."""
+    import shutil
+    import tempfile
+
+    from ggrs_tpu.fleet.island import MatchSpec, make_game, run_twin
+    from ggrs_tpu.journal import resimulate_journal_dirs
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.utils.clock import FakeClock
+
+    d = tempfile.mkdtemp(prefix="ggrs_rto_")
+    try:
+        specs = [
+            MatchSpec(match_id=m, players=2, ticks=ticks,
+                      seed=4000 + m, entities=entities)
+            for m in range(matches)
+        ]
+        game = make_game(players=2, entities=entities)
+        host = SessionHost(
+            game, max_prediction=8, num_players=2,
+            max_sessions=2 * matches, clock=FakeClock(),
+            idle_timeout_ms=0, warmup=True, journal_dir=d,
+        )
+        islands = run_twin(specs, host=host, game=game)
+        # one journal per match: peer 0's lane (attach order is
+        # match-major, so lanes 2m / 2m+1 are match m's peers)
+        paths = [
+            os.path.join(d, f"lane{islands[s.match_id].keys[0]}")
+            for s in specs
+        ]
+        t0 = time.perf_counter()
+        results = resimulate_journal_dirs(game, paths)
+        wall = time.perf_counter() - t0
+        frames = sum(r["frames"] for r in results)
+        verified = 0
+        for spec, res in zip(specs, results):
+            for hist in islands[spec.match_id].histories().values():
+                for f, c in hist.items():
+                    if f < res["frames"]:
+                        assert res["checksums"][f] == c, (
+                            spec.match_id, f
+                        )
+                        verified += 1
+        assert verified > 0, "no checksums overlapped the rebuild"
+        return {
+            "matches": matches,
+            "ticks": ticks,
+            "entities": entities,
+            "frames_rebuilt": frames,
+            "checksums_verified": verified,
+            "resim_wall_s": round(wall, 4),
+            "rto_matches_per_sec": round(matches / wall, 2),
+            "rto_frames_per_sec": round(frames / wall, 1),
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _obs_enable():
     """Called inside a phase subprocess (see _run_phase)."""
     from ggrs_tpu.obs import enable_global_telemetry
@@ -2494,6 +2642,7 @@ def main():
         "frames_served_from_speculation",
         "spec_hit_rate", "spec_fps_lift",
         "resident_speedup", "resident_dispatches_per_tick",
+        "journal_fps_ratio", "rto_matches_per_sec",
         "headline_source",
     )
 
@@ -2816,6 +2965,24 @@ def main():
     full["resident_dispatches_per_tick"] = resident[
         "dispatches_per_tick_resident"
     ]
+    # durable input journal: the write tax (fsync-cadence sweep) and
+    # the recovery-time objective (journal-only batched resim)
+    journal = phase(
+        "journal_overhead",
+        f"bench_journal_overhead(sessions={8 if SMOKE else 16}, "
+        f"ticks={40 if SMOKE else 160})",
+        timeout_s=900,
+    )
+    full["journal_fps_ratio"] = journal["journal_fps_ratio_fsync0"]
+    full["journal_fps_ratio_fsync1"] = journal["journal_fps_ratio_fsync1"]
+    rto = phase(
+        "recovery_time_objective",
+        f"bench_recovery_time_objective(matches={4 if SMOKE else 8}, "
+        f"ticks={40 if SMOKE else 120})",
+        timeout_s=900,
+    )
+    full["rto_matches_per_sec"] = rto["rto_matches_per_sec"]
+    full["rto_frames_per_sec"] = rto["rto_frames_per_sec"]
     beam_exec = phase("_beam_exec", "bench_beam_exec()")
     beam_live = phase(
         "_beam_live",

@@ -34,6 +34,11 @@ SCOPE_PREFIXES = (
     # replayability DET enforces (opponents draw counter-based uniforms,
     # never wall clocks or stateful RNG streams)
     "ggrs_tpu/env/",
+    # the durable input journal feeds recovery resimulation: a
+    # wall-clock value, stateful RNG draw or unordered iteration in its
+    # encode/decode/replay path would make "recovery is a pure function
+    # of (spec, journal)" silently false
+    "ggrs_tpu/journal/",
     "ggrs_tpu/sync_layer.py",
     "ggrs_tpu/input_queue.py",
 )

@@ -178,6 +178,35 @@ POLICIES: Dict[str, FencePolicy] = {
             ("DeviceMailbox", "drop_lane"),
         }),
     ),
+    # the durable input journal's writer protocol (journal/wal.py): the
+    # active segment fd, the rotation indices and the resume-verify set
+    # are the crash-consistency proof — an append or rotation routed
+    # around the entry points could tear a record the open-time scan
+    # would then misread as the OLD format's torn tail, or leave the
+    # verify set claiming rows the disk never saw
+    "ggrs_tpu/journal/wal.py": FencePolicy(
+        protected=frozenset({
+            "_fd", "_seg_index", "_seg_size", "_since_fsync", "_verify",
+        }),
+        allowed=frozenset({
+            ("JournalWriter", "__init__"),
+            ("JournalWriter", "_rotate"),
+            ("JournalWriter", "_rebase_segment"),
+            ("JournalWriter", "append_rows"),
+            ("JournalWriter", "verify_row"),
+            ("JournalWriter", "sync"),
+            ("JournalWriter", "close"),
+        }),
+    ),
+    # the host-side journal tap and the fleet recovery path drive the
+    # writer ONLY through its entry points (and the device cores only
+    # through theirs) — zero allowances, the serve/host.py discipline
+    "ggrs_tpu/journal/recover.py": FencePolicy(
+        protected=CORE_STATE | frozenset({
+            "_fd", "_seg_index", "_seg_size", "_since_fsync", "_verify",
+        }),
+        allowed=frozenset(),
+    ),
     # the batched wire pump's pooled decode staging (network/pump.py):
     # the offset/length scratch is reused across pump passes — only the
     # staging's own grow path may rebind the arrays (the byte pool is

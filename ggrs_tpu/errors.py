@@ -295,6 +295,50 @@ class MailboxLaneFull(GGRSError):
         self.depth = depth
 
 
+class JournalError(GGRSError):
+    """Base for durable input-journal failures (ggrs_tpu/journal): the
+    crash-consistent write-ahead log of confirmed tick rows that makes
+    total host loss recoverable by deterministic resimulation."""
+
+
+class JournalCorrupt(JournalError):
+    """A journal segment failed its open-time scan — a CRC32 mismatch or
+    framing violation in a NON-final segment (a torn tail on the final
+    segment is expected crash residue and is truncated, never an error)
+    — or a resumed redrive re-confirmed a row whose bytes disagree with
+    what the journal durably recorded. The scan QUARANTINES the segment
+    (renamed aside) and recovery falls back to the next failover-ladder
+    tier; this error carries the segment and offset so the operator can
+    autopsy the quarantined bytes."""
+
+    def __init__(self, info: str, *, path: str = "", segment: str = "",
+                 offset: int = -1, frame: int = -1):
+        detail = f" (segment={segment!r}, offset={offset}"
+        if frame >= 0:
+            detail += f", frame={frame}"
+        super().__init__(info + detail + ")")
+        self.info = info
+        self.path = path
+        self.segment = segment
+        self.offset = offset
+        self.frame = frame
+
+
+class JournalStalled(JournalError):
+    """A journal append/fsync could not complete — ENOSPC, EIO, a dying
+    disk. The journal is a durability feature, never a liveness
+    dependency: the host's reaction is DEGRADE-TO-UNJOURNALED (typed
+    invariant trip, serving continues without the durability guarantee),
+    never a wedged or crashed host. Carries the errno so the operator
+    sees disk-full vs device-error without a debugger."""
+
+    def __init__(self, info: str, *, path: str = "", errno: int = 0):
+        super().__init__(f"{info} (path={path!r}, errno={errno})")
+        self.info = info
+        self.path = path
+        self.errno = errno
+
+
 class RetraceBudgetExceeded(GGRSError):
     """The retrace sanitizer observed more compiled programs than the
     dispatch-bucket budget allows: a jit cache meant to be bounded by the

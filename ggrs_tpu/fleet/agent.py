@@ -24,6 +24,19 @@ serializes its co-located islands into one fleet ticket
 `<base_dir>/host<id>.ckpt`. Serialization is observationally neutral
 (see ticket.py), so the checkpointed run and an unfaulted run are the
 same run.
+
+Durable input journal (docs/DESIGN.md "Durable recovery"): on top of
+the in-RAM ticket the agent journals each co-located mem-plane island's
+CONFIRMED input rows to a crash-consistent segment WAL under
+`<base_dir>/journal_h<id>/m<match>` — one journal per MATCH (every peer
+of an island confirms bit-identical rows, so peer 0's lane taps for the
+whole island). Tickets taken at export/drain carry the journal bytes by
+value, so a migrated match's durable history moves with it; the
+director SEIZES journal files at fence time exactly like ticket bytes,
+and its failover ladder falls back ticket → ticket+journal-tail-verify
+→ journal-only resimulation from genesis (`journal_rebuild` below) —
+the tier that makes TOTAL host loss (ticket destroyed, process gone)
+recoverable with zero confirmed-frame loss.
 """
 
 from __future__ import annotations
@@ -63,7 +76,9 @@ class AgentCore:
                  num_players: int = 4, hb_interval_ms: int = 150,
                  checkpoint_every: int = 32, warmup: bool = False,
                  label: str = "", resident: bool = False,
-                 resident_ticks: int = 8, sdc_audit_every: int = 0):
+                 resident_ticks: int = 8, sdc_audit_every: int = 0,
+                 journal: bool = True, journal_fsync_every: int = 0,
+                 journal_segment_bytes: int = 1 << 18):
         """`resident=True` runs the agent's SessionHost on the
         device-resident serving loop (PR 13's mailbox + while_loop
         driver) — bit-identical to the dispatch-per-tick agent by the
@@ -71,7 +86,12 @@ class AgentCore:
         tickets, SIGKILL-restore, cross-process migration) drains the
         mailbox back to canonical form first, so tickets from a
         resident agent import into a non-resident one and vice versa.
-        `sdc_audit_every` enables the host's sampled SDC audit lane."""
+        `sdc_audit_every` enables the host's sampled SDC audit lane.
+        `journal=True` (the default) journals every co-located
+        mem-plane island's confirmed inputs per match under
+        `<base_dir>/journal_h<host_id>` — observationally neutral to
+        the data plane (a host-side tap), `journal_fsync_every` sets
+        the writer's fsync cadence."""
         from ..serve.host import SessionHost
 
         self.clock = clock or Clock()
@@ -119,6 +139,15 @@ class AgentCore:
         # match_id -> "rebuilt" (mini-failover from the last checkpoint
         # ticket) | "lost" (no clean ticket covered the match)
         self.quarantines: Dict[int, str] = {}
+        # durable per-match input journals: match_id -> tapped host key
+        # (peer 0's lane); the directory is fixed at registration when
+        # the host_id lands
+        self.journal_enabled = journal
+        self.journal_fsync_every = journal_fsync_every
+        self.journal_segment_bytes = journal_segment_bytes
+        self.journal_dir: Optional[str] = None
+        self._island_journal: Dict[int, Any] = {}
+        self.journal_frames_replayed = 0
 
     # ------------------------------------------------------------------
     # control-plane lifecycle
@@ -230,6 +259,13 @@ class AgentCore:
                 self.host_id = body["host_id"]
                 self.epoch = body["epoch"]
                 self.registered = True
+                if self.journal_enabled:
+                    # per-incarnation directory: a respawned replacement
+                    # gets a fresh host_id, so a predecessor's files can
+                    # never masquerade as this incarnation's history
+                    self.journal_dir = os.path.join(
+                        self.base_dir, f"journal_h{self.host_id}"
+                    )
                 self._last_hb = now - self.hb_interval_ms  # hb soon
 
     def _on_quarantine(self, poisoned, mid=None) -> None:
@@ -269,8 +305,16 @@ class AgentCore:
                     if e["island"].spec.match_id == mid
                 ]
                 if entries:
+                    for e in entries:
+                        e.pop("journal", None)  # periodic tickets carry
+                        # none, but be robust to drained-ticket reuse
                     restored = import_islands(self.host, entries)
                     self.islands[mid] = restored[0]
+                    # resume the match's journal on the rebuilt lane:
+                    # the on-disk history is intact (the quarantine was
+                    # a device fault, not a disk fault) and the redrive
+                    # verifies against it
+                    self._attach_island_journal(restored[0])
                     outcome = "rebuilt"
             except Exception:  # noqa: BLE001 - a failed rebuild must
                 # degrade to "match lost", never take the agent (and
@@ -288,6 +332,80 @@ class AgentCore:
         # from a stale ticket, and a rebuilt one needs cover at its
         # rebuilt frame
         self.write_checkpoint()
+
+    # ------------------------------------------------------------------
+    # durable per-match input journals
+    # ------------------------------------------------------------------
+
+    def _journal_path(self, match_id: int) -> Optional[str]:
+        if self.journal_dir is None:
+            return None
+        return os.path.join(self.journal_dir, f"m{match_id}")
+
+    def _attach_island_journal(self, island, files=None,
+                               tail=None) -> None:
+        """Tap peer 0's lane of a co-located mem-plane island into the
+        match's journal (`files` seeds it first — seized/migrated
+        bytes, so the history stays contiguous from genesis; `tail`
+        pre-observes the source recorder's not-yet-durable rows so the
+        adoption hole journals too). Degradation-only failure mode: a
+        corrupt local journal leaves the match served but unjournaled,
+        never unserved."""
+        from ..errors import JournalError
+
+        if not self.journal_enabled or self.journal_dir is None:
+            return
+        spec = island.spec
+        if spec.data_plane != "mem" or not island.keys:
+            return
+        try:
+            path = self._journal_path(spec.match_id)
+            if files:
+                from ..journal.wal import seed_journal
+
+                seed_journal(path, files)
+            peer = min(island.keys)
+            attached = self.host.attach_journal(
+                island.keys[peer], path,
+                meta={
+                    "match_id": spec.match_id,
+                    "spec": spec.to_json(),
+                    "host_id": self.host_id,
+                    "epoch": self.epoch,
+                    "peer": peer,
+                    "input_delay": spec.input_delay,
+                },
+                fsync_every=self.journal_fsync_every,
+                segment_bytes=self.journal_segment_bytes,
+            )
+        except (JournalError, OSError) as exc:
+            # degradation-only, as documented: a disk that refuses the
+            # seed must not fail an IMPORT the islands already adopted
+            # under — the director's retry on a sibling would double-
+            # host the match. The match serves unjournaled instead.
+            attached = None
+            if GLOBAL_TELEMETRY.enabled:
+                GLOBAL_TELEMETRY.record(
+                    "fleet_journal_attach_degraded",
+                    match=spec.match_id, error=type(exc).__name__,
+                )
+        if attached is not None:
+            self._island_journal[spec.match_id] = island.keys[peer]
+            if tail:
+                self.host.seed_journal_tail(island.keys[peer], tail)
+
+    def _detach_island_journal(self, match_id: int) -> None:
+        self._island_journal.pop(match_id, None)
+
+    def _journal_section(self) -> Dict[str, Any]:
+        matches = {}
+        for mid, key in list(self._island_journal.items()):
+            if key not in self.host._lanes:
+                continue
+            frontier = self.host.journal_frontier(key)
+            if frontier is not None:
+                matches[str(mid)] = frontier
+        return {"dir": self.journal_dir, "matches": matches}
 
     def _send_heartbeat(self, now: int) -> None:
         self._last_hb = now
@@ -310,6 +428,11 @@ class AgentCore:
             "quarantines": {
                 str(m): outcome for m, outcome in self.quarantines.items()
             },
+            **(
+                {"journal": self._journal_section()}
+                if self.journal_enabled and self.journal_dir is not None
+                else {}
+            ),
         }, now_ms=now)
 
     # ------------------------------------------------------------------
@@ -373,6 +496,8 @@ class AgentCore:
             return *self._op_export(body), None
         if op == "import":
             return self._op_import(blob), b"", None
+        if op == "journal_rebuild":
+            return self._op_journal_rebuild(blob, now), b"", None
         if op == "report":
             return self._op_report(body), b"", None
         if op == "drain":
@@ -400,6 +525,7 @@ class AgentCore:
         island = MatchIsland.build(spec)
         island.attach(self.host)
         self.islands[spec.match_id] = island
+        self._attach_island_journal(island)
         # crash cover from the first tick: a match only a future periodic
         # checkpoint would capture is a match a kill can lose
         self.write_checkpoint()
@@ -438,6 +564,7 @@ class AgentCore:
         if island is None:
             raise InvalidRequest(f"unknown match {mid}")
         self._spread.discard(mid)
+        self._detach_island_journal(mid)
         for key in island.keys.values():
             if key in self.host._lanes:
                 self.host.detach(key)
@@ -461,8 +588,10 @@ class AgentCore:
                 f"match {mid} is spread across agents: a half cannot "
                 "migrate (its sibling's ack state would dangle)"
             )
+        tails = self._capture_journal_tails([island])
         entries = export_islands(self.host, [island], detach=True)
         self.islands.pop(mid)
+        self._attach_ticket_journals(entries, tails)
         blob = dumps_ticket(entries, self._ticket_meta())
         # refresh the crash checkpoint WITHOUT the exported match: were
         # this host killed later, a stale checkpoint would resurrect a
@@ -470,12 +599,69 @@ class AgentCore:
         self.write_checkpoint()
         return {"match": mid}, blob
 
+    def _capture_journal_tails(
+        self, islands: List[Any]
+    ) -> Dict[int, dict]:
+        """BEFORE a detaching export: final-drain each exported match's
+        tap and snapshot the rows not yet durable (played but
+        unconfirmed at the export instant) — the destination seeds its
+        recorder with them, covering the hole between the durable
+        frontier and the first frame it will observe itself."""
+        tails: Dict[int, dict] = {}
+        if not self.journal_enabled or self.journal_dir is None:
+            return tails
+        for island in islands:
+            mid = island.spec.match_id
+            key = self._island_journal.get(mid)
+            if key is None or key not in self.host._lanes:
+                continue
+            tail = self.host.journal_tail(key)
+            if tail:
+                tails[mid] = tail
+        return tails
+
+    def _attach_ticket_journals(
+        self, entries: List[dict], tails: Optional[Dict[int, dict]] = None
+    ) -> None:
+        """Fold each exported match's journal bytes (+ the captured
+        recorder tail) into its ticket entry (read AFTER export
+        detached+synced the tap, so the bytes are the complete
+        history): the durable lineage migrates with the match instead
+        of stranding on the source host."""
+        from ..journal.wal import journal_files
+
+        if not self.journal_enabled or self.journal_dir is None:
+            return
+        for entry in entries:
+            mid = entry["island"].spec.match_id
+            self._detach_island_journal(mid)
+            files = journal_files(self._journal_path(mid))
+            if files:
+                entry["journal"] = files
+                if tails and mid in tails:
+                    entry["journal_tail"] = tails[mid]
+
     def _op_import(self, blob: bytes) -> dict:
         entries, meta = loads_ticket(blob)
+        journal_seed = {
+            entry["island"].spec.match_id: entry.pop("journal")
+            for entry in entries
+            if entry.get("journal")
+        }
+        journal_tails = {
+            entry["island"].spec.match_id: entry.pop("journal_tail")
+            for entry in entries
+            if entry.get("journal_tail")
+        }
         adopted = import_islands(self.host, entries)
         out = {}
         for island in adopted:
             self.islands[island.spec.match_id] = island
+            self._attach_island_journal(
+                island,
+                files=journal_seed.get(island.spec.match_id),
+                tail=journal_tails.get(island.spec.match_id),
+            )
             out[str(island.spec.match_id)] = {
                 str(k): v for k, v in island.frames().items()
             }
@@ -484,6 +670,165 @@ class AgentCore:
         # sessions a failover/migration just moved here
         self.write_checkpoint()
         return {"adopted": out}
+
+    def _op_journal_rebuild(self, blob: bytes, now: int) -> dict:
+        """The failover ladder's THIRD tier: rebuild matches from their
+        seized journals ALONE — no ticket, no surviving process state.
+        Each match island is rebuilt from its spec with the journal's
+        confirmed rows mapped back to per-peer submit scripts, then the
+        whole batch redrives from genesis through the ONE megabatch
+        drive loop (`step_islands`) in a tight catch-up to the journal
+        frontier: N lost matches resimulate as one fleet, every
+        re-confirmed row VERIFIED bit-for-bit against the journaled
+        bytes by the resumed writer. Deterministic by the repo's one
+        contract — the rebuilt run is a pure function of (spec,
+        confirmed inputs) — so the recovered match is bitwise the match
+        that died."""
+        import pickle
+
+        from ..errors import InvalidRequest
+        from ..journal.metrics import journal_replayed_frames_total
+        from ..journal.recover import journal_coverage, scripts_from_journal
+        from ..journal.wal import read_journal_script, seed_journal
+
+        if self._draining:
+            raise HostFull("agent is draining: not rebuilding matches")
+        payload = pickle.loads(blob)
+        rebuilt: List[tuple] = []
+        failed: Dict[str, str] = {}
+        for mid_s, entry in sorted(payload.items(), key=lambda kv: int(kv[0])):
+            spec = MatchSpec.from_json(entry["spec"])
+            island = None
+            try:
+                if (
+                    self.host.active_sessions + spec.players
+                    > self.host.max_sessions
+                ):
+                    raise HostFull(
+                        f"journal rebuild of match {spec.match_id} "
+                        "exceeds the free session slots"
+                    )
+                path = self._journal_path(spec.match_id)
+                if path is None:
+                    raise InvalidRequest("agent has no journal directory")
+                seed_journal(path, entry["files"])
+                inputs, _statuses, jmeta = read_journal_script(path)
+                if int(jmeta.get("first_frame", 0)) != 0:
+                    # a journal whose first surviving segment starts
+                    # past genesis (leading segment lost/quarantined)
+                    # cannot seed a from-genesis resimulation: frames
+                    # would map to the wrong cursors silently — refuse
+                    # typed instead
+                    from ..errors import JournalCorrupt
+
+                    raise JournalCorrupt(
+                        "journal does not cover genesis "
+                        f"(first_frame={jmeta.get('first_frame')})",
+                        path=path,
+                        frame=int(jmeta.get("first_frame", 0)),
+                    )
+                island = MatchIsland.build(spec)
+                island.scripts = scripts_from_journal(
+                    inputs,
+                    input_delay=spec.input_delay,
+                    ticks=spec.ticks,
+                    # beyond the journaled frontier the match resumes
+                    # live traffic; the spec-derived script is the
+                    # harness's stand-in for it (and bit-equal to what
+                    # the journal pinned — the twin-parity gates verify)
+                    fallback=island.scripts,
+                )
+                island.attach(self.host)
+                self.islands[spec.match_id] = island
+                # resume-attach AFTER seeding: the writer retains the
+                # seized rows as its verify set, so the catch-up
+                # redrive below is checked row-for-row against the
+                # durable bytes
+                self._attach_island_journal(island)
+                rebuilt.append(
+                    (island, journal_coverage(
+                        inputs, input_delay=spec.input_delay
+                    ))
+                )
+            except Exception as exc:  # noqa: BLE001 - per-match
+                # isolation: ONE poison journal (corrupt from genesis,
+                # capacity miss) must not abort the sibling rebuilds or
+                # leave its own half-attached residue serving
+                if island is not None:
+                    for lkey in list(island.keys.values()):
+                        if lkey in self.host._lanes:
+                            self.host.detach(lkey)
+                    island.keys = {}
+                self.islands.pop(spec.match_id, None)
+                self._detach_island_journal(spec.match_id)
+                failed[mid_s] = f"{type(exc).__name__}: {exc}"
+                if GLOBAL_TELEMETRY.enabled:
+                    GLOBAL_TELEMETRY.record(
+                        "fleet_journal_rebuild_failed",
+                        match=spec.match_id,
+                        error=type(exc).__name__,
+                    )
+        # batched catch-up resimulation: drive ONLY the rebuilt islands
+        # (their private clocks advance; co-hosted live islands stay
+        # frozen) until each reaches its journal frontier. Heartbeats
+        # bracket the stretch — recovery must not read as death.
+        steps = 0
+        cap = 8 * max(
+            (i.spec.ticks + i.COOLDOWN_FACTOR * i.spec.max_prediction + 64
+             for i, _ in rebuilt),
+            default=0,
+        )
+        conn = self.peer.conn if self.peer is not None else None
+        frames_before = {
+            i.spec.match_id: min(i.frames().values(), default=0)
+            for i, _ in rebuilt
+        }
+        while steps < cap:
+            live = [
+                i for i, cov in rebuilt
+                if not i.done and not i.failed and i.cursor < cov
+            ]
+            if not live:
+                break
+            step_islands(
+                self.host,
+                [i for i, _ in rebuilt if not i.done and not i.failed],
+            )
+            self.host.clock.advance(FRAME_MS)
+            steps += 1
+            if conn is not None and self.registered and steps % 64 == 0:
+                self._send_heartbeat(self.clock.now_ms())
+        replayed = sum(
+            max(min(i.frames().values(), default=0)
+                - frames_before[i.spec.match_id], 0)
+            for i, _ in rebuilt
+        )
+        self.journal_frames_replayed += replayed
+        journal_replayed_frames_total().inc(replayed)
+        # the catch-up advanced host ticks no OTHER lane saw: re-anchor
+        # their wedge monitors so recovery can't read as a lane wedge
+        for lane in self.host._lanes.values():
+            lane.last_progress_tick = self.host._tick_index
+            lane.wedge_reported = False
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "fleet_journal_rebuild",
+                host=self.host_id if self.host_id is not None else -1,
+                matches=len(rebuilt), frames=replayed, steps=steps,
+            )
+        # crash cover at the recovered frame, from tick one
+        self.write_checkpoint()
+        return {
+            "rebuilt": {
+                str(i.spec.match_id): {
+                    str(k): v for k, v in i.frames().items()
+                }
+                for i, _ in rebuilt
+            },
+            "failed": failed,
+            "replayed_frames": replayed,
+            "steps": steps,
+        }
 
     def _op_report(self, body: dict) -> dict:
         digests = bool(body.get("digests", True))
@@ -514,7 +859,9 @@ class AgentCore:
             )
         self._draining = True
         islands = list(self.islands.values())
+        tails = self._capture_journal_tails(islands)
         entries = export_islands(self.host, islands, detach=True)
+        self._attach_ticket_journals(entries, tails)
         blob = dumps_ticket(entries, self._ticket_meta())
         self.islands.clear()
         return {"exported": len(islands)}, blob
@@ -590,6 +937,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--tick-interval-ms", type=float, default=4.0,
                         help="real-time pacing of the island frame loop")
     parser.add_argument("--warmup", action="store_true")
+    parser.add_argument("--no-journal", action="store_true",
+                        help="disable the durable per-match input journal")
+    parser.add_argument("--journal-fsync-every", type=int, default=0)
     parser.add_argument("--platform", default=None,
                         help="force a jax platform (the test image's "
                         "sitecustomize overrides JAX_PLATFORMS)")
@@ -615,6 +965,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         checkpoint_every=args.checkpoint_every,
         warmup=args.warmup,
         label=args.label,
+        journal=not args.no_journal,
+        journal_fsync_every=args.journal_fsync_every,
     )
     host, _, port = args.director.rpartition(":")
     core.attach_conn(connect((host or "127.0.0.1", int(port))))

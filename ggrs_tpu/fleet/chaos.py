@@ -9,7 +9,12 @@ process-level kinds) against them:
     sigkill    — SIGKILL a real agent process; the heartbeat detector
                  suspects, fences, seizes the checkpoint, restores on a
                  survivor (an auto-respawned replacement keeps the fleet
-                 at strength for the next kill)
+                 at strength for the next kill). With
+                 `destroy_tickets=True` the kill ALSO deletes the
+                 victim's checkpoint file — total host loss — so the
+                 failover MUST recover through the journal-only tier
+                 (batched resimulation from genesis), the storage
+                 tier's acceptance scenario
     partition  — the control socket goes dark both ways while the data
                  plane keeps ticking (the BubbleSpec discipline, proven
                  by cursor progress during the blackout)
@@ -181,6 +186,10 @@ def run_process_chaos(
     seed: int = 0,
     wan: bool = True,
     kills: int = 1,
+    # the storage tier's total-host-loss arm: every kill also deletes
+    # the victim's checkpoint ticket, so recovery MUST ride the
+    # journal-only failover tier (asserted via the failover records)
+    destroy_tickets: bool = False,
     # 0 = auto: comfortably SHORTER than the suspicion window, so the
     # partition proves control/data decoupling (the host keeps ticking,
     # heals, is never fenced). A partition LONGER than suspicion is a
@@ -348,9 +357,24 @@ def run_process_chaos(
                     ):
                         faulted.add(rec["spec"].match_id)
                 director.sigkill(victim)
+                destroyed = None
+                if ev.params.get("destroy_ticket") or destroy_tickets:
+                    # total host loss: the process is dead (no rewrite
+                    # race) AND its checkpoint is gone — only the
+                    # journal tier can recover these matches
+                    hr = director.hosts[victim]
+                    cp = hr.checkpoint or {}
+                    if cp.get("path"):
+                        try:
+                            os.remove(cp["path"])
+                            destroyed = cp["path"]
+                        except OSError:
+                            pass
+                    hr.checkpoint = None
                 kill_log.append({
                     "host": victim, "at_progress": placed_progress(),
                     "wall": _time.monotonic(),
+                    "ticket_destroyed": destroyed,
                 })
             elif ev.kind == "partition":
                 target = ev.params.get("host")
@@ -521,6 +545,16 @@ def run_process_chaos(
             if p.poll() is None:
                 p.kill()
         report["agent_exit_codes"] = [p.poll() for p in procs]
+        report["journal_recoveries"] = [
+            {
+                "host": fo["host"],
+                "tiers": fo.get("tiers", {}),
+                "journal_restored": sorted(
+                    fo.get("journal_restored", {})
+                ),
+            }
+            for fo in director.failovers
+        ]
         parity = (
             compare_with_twin(specs, reports, faulted)
             if twin else None

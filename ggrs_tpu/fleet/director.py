@@ -95,6 +95,11 @@ class HostRecord:
         self.desyncs = 0
         self.islands: Dict[str, dict] = {}
         self.checkpoint: Optional[dict] = None
+        # durable journal inventory from the last heartbeat: the dir
+        # plus match_id -> journaled frame count — what the failover
+        # ladder's journal tiers seize
+        self.journal: Dict[str, Any] = {}
+        self.journal_dir: Optional[str] = None
         # match_id -> outcome ("rebuilt" | "lost"): slot quarantines the
         # agent reported handling as mini-failovers
         self.quarantines: Dict[str, str] = {}
@@ -146,6 +151,13 @@ class Director:
         failover_ms_histogram()
         placements_total()
         fleet_saturated_total()
+        from ..journal.metrics import (
+            journal_recoveries_total,
+            journal_replayed_frames_total,
+        )
+
+        journal_recoveries_total()
+        journal_replayed_frames_total()
         self.hosts: Dict[int, HostRecord] = {}
         self._next_host_id = 0
         self._listen = None
@@ -337,6 +349,10 @@ class Director:
             hr.free_slots = int(body.get("free_slots", hr.free_slots))
             hr.islands = body.get("islands", hr.islands)
             hr.checkpoint = body.get("checkpoint", hr.checkpoint)
+            journal = body.get("journal")
+            if journal is not None:
+                hr.journal = journal.get("matches", {})
+                hr.journal_dir = journal.get("dir")
             hr.desyncs = int(body.get("desyncs", hr.desyncs))
             for mid, outcome in body.get("quarantines", {}).items():
                 # dedup on (match, OUTCOME): a rebuilt match that is
@@ -683,19 +699,80 @@ class Director:
             return None, {}
         return blob, meta
 
+    def _seize_journals(self, hr: HostRecord) -> Dict[int, Dict[str, bytes]]:
+        """Read the fenced host's journal files NOW — the ticket
+        seizure discipline applied to the durable input store: whatever
+        a zombie appends after this read recovers nothing, because
+        every journal tier runs from these bytes."""
+        from ..journal.wal import journal_files
+
+        if not hr.journal_dir:
+            return {}
+        out: Dict[int, Dict[str, bytes]] = {}
+        for mid_s in hr.journal:
+            files = journal_files(
+                os.path.join(hr.journal_dir, f"m{mid_s}")
+            )
+            if files:
+                out[int(mid_s)] = files
+        if out and GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "fleet_journal_seized", host=hr.host_id,
+                matches=sorted(out),
+                bytes=sum(len(b) for fs in out.values()
+                          for b in fs.values()),
+            )
+        return out
+
+    def _merge_journals_into_ticket(
+        self, blob: bytes, journals: Dict[int, Dict[str, bytes]]
+    ) -> Optional[bytes]:
+        """Fold seized journal bytes into the seized ticket's entries so
+        the importing survivor resumes each match WITH its durable
+        lineage (tier 2: the resumed redrive is then verified row-by-row
+        against the journal tail). Returns None when the ticket itself
+        is unreadable — which drops the failover to the journal-only
+        tier instead of feeding survivors a poison blob."""
+        from .ticket import dumps_ticket, loads_ticket
+
+        try:
+            entries, meta = loads_ticket(blob)
+        except CheckpointIncompatible as exc:
+            if GLOBAL_TELEMETRY.enabled:
+                GLOBAL_TELEMETRY.record(
+                    "fleet_checkpoint_unreadable",
+                    error=type(exc).__name__, stage="merge",
+                )
+            return None
+        for entry in entries:
+            files = journals.get(entry["island"].spec.match_id)
+            if files:
+                entry["journal"] = files
+        return dumps_ticket(entries, meta)
+
     def fail_over(self, host_id: int) -> dict:
-        """Fence the host, seize its checkpoint, restore its co-located
-        matches on the least-loaded survivor (falling through survivors
-        on failure), re-point the match table. Spread halves and
-        checkpoint-less matches are recorded lost."""
+        """Fence the host, seize its checkpoint AND journals, then walk
+        the three-tier recovery ladder per match: (1) checkpoint-ticket
+        import on the least-loaded survivor; (2) the same import with
+        the seized journal bytes folded in, so the survivor's resumed
+        redrive is verified row-by-row against the journal tail; (3)
+        for matches the ticket could not cover — destroyed, corrupt,
+        epoch-rejected — journal-only resimulation from genesis on a
+        survivor (`journal_rebuild`): the matches rebuild as one
+        batched megabatch redrive with zero confirmed-frame loss.
+        Spread halves and matches with neither ticket nor journal are
+        recorded lost."""
         with self._table_mutation():
             return self._fail_over_impl(host_id)
 
     def _fail_over_impl(self, host_id: int) -> dict:
+        from ..journal.metrics import journal_recoveries_total
+
         hr = self.hosts[host_id]
         t0 = self.clock.now_ms()
         fenced_epoch = self.fence(host_id)
         blob, meta = self._seize_checkpoint(hr, fenced_epoch)
+        journals = self._seize_journals(hr)
         owned = [
             mid for mid, rec in self.matches.items()
             if rec.get("host") == host_id and rec["state"] == "placed"
@@ -704,9 +781,16 @@ class Director:
             "host": host_id, "fenced_epoch": fenced_epoch,
             "matches": owned, "checkpoint_tick": meta.get("tick"),
             "checkpoint_frames": meta.get("frames", {}),
+            "journal_matches": sorted(journals),
             "restored_on": None, "restored": {}, "lost": [],
+            "tiers": {}, "journal_restored": {},
         }
         restored_ids: List[int] = []
+        if blob is not None and journals:
+            # tier 2 packaging: ticket + journal tails in one import; a
+            # ticket that fails the merge parse is corrupt — fall to
+            # the journal-only tier rather than ship poison
+            blob = self._merge_journals_into_ticket(blob, journals)
         if blob is not None:
             for survivor in self._placeable():
                 try:
@@ -719,10 +803,26 @@ class Director:
                 for mid in restored_ids:
                     if mid in self.matches:
                         self.matches[mid]["host"] = survivor.host_id
+                    tier = (
+                        "ticket+journal" if mid in journals else "ticket"
+                    )
+                    record["tiers"][str(mid)] = tier
+                    journal_recoveries_total().labels(tier).inc()
                 # occupancy refreshes from the survivor's next heartbeat
                 # (a manual bump here double-counts whenever an import-
                 # era heartbeat already landed during the call)
                 break
+        # tier 3: journal-only resimulation for every owned match the
+        # ticket path left behind, batched into ONE rebuild call
+        pending_rebuild = {
+            mid: journals[mid]
+            for mid in owned
+            if mid not in restored_ids and mid in journals
+        }
+        if pending_rebuild:
+            self._journal_rebuild_on_survivor(
+                pending_rebuild, record, restored_ids
+            )
         for mid in owned:
             if mid not in restored_ids:
                 self.matches[mid]["state"] = "lost"
@@ -750,6 +850,82 @@ class Director:
             )
         self.failovers.append(record)
         return record
+
+    def _journal_rebuild_on_survivor(
+        self, pending: Dict[int, Dict[str, bytes]], record: dict,
+        restored_ids: List[int],
+    ) -> None:
+        """Tier 3: hand every (spec, seized journal) pair to one
+        survivor in a single `journal_rebuild` call — the agent
+        rebuilds the islands from genesis and catches them up to their
+        journal frontiers as one batched megabatch redrive. A generous
+        per-attempt timeout: the catch-up resimulates whole match
+        histories (the agent heartbeats through it)."""
+        import pickle
+
+        from ..journal.metrics import journal_recoveries_total
+
+        policy = RetryPolicy(
+            attempts=2,
+            timeout_ms=max(8 * self.rpc_policy.timeout_ms, 4000),
+            seed=self.seed ^ 0x10A1,
+        )
+        remaining = dict(pending)
+        record["restored_on_journal"] = []
+        record["journal_replayed_frames"] = 0
+        for survivor in self._placeable():
+            if not remaining:
+                break
+            payload = pickle.dumps(
+                {
+                    str(mid): {
+                        "spec": self.matches[mid]["spec"].to_json(),
+                        "files": files,
+                    }
+                    for mid, files in remaining.items()
+                },
+                protocol=5,
+            )
+            try:
+                body, _ = self.call(
+                    survivor, "journal_rebuild", blob=payload,
+                    policy=policy,
+                )
+            except (RpcError, RpcTimeout, CircuitOpen):
+                continue
+            rebuilt = body.get("rebuilt", {})
+            for mid_s, frames in rebuilt.items():
+                mid = int(mid_s)
+                remaining.pop(mid, None)
+                if mid in self.matches:
+                    self.matches[mid]["host"] = survivor.host_id
+                    self.matches[mid]["state"] = "placed"
+                restored_ids.append(mid)
+                record["tiers"][mid_s] = "journal"
+                record["journal_restored"][mid_s] = frames
+                journal_recoveries_total().labels("journal").inc()
+            if rebuilt:
+                record["restored_on_journal"].append(survivor.host_id)
+            record["journal_replayed_frames"] += body.get(
+                "replayed_frames", 0
+            )
+            for mid_s, err in body.get("failed", {}).items():
+                # only capacity failures are survivor-dependent; a
+                # corrupt/no-genesis journal fails IDENTICALLY
+                # everywhere — don't re-ship megabytes of seized bytes
+                # to every survivor for a deterministic refusal
+                if not err.startswith("HostFull"):
+                    remaining.pop(int(mid_s), None)
+            if GLOBAL_TELEMETRY.enabled:
+                GLOBAL_TELEMETRY.record(
+                    "fleet_journal_failover",
+                    survivor=survivor.host_id,
+                    matches=sorted(int(m) for m in rebuilt),
+                    frames=body.get("replayed_frames", 0),
+                )
+            # per-match failures (capacity, corrupt-from-genesis) stay
+            # in `remaining`: the next survivor gets ONLY those — the
+            # ticket tier's fall-through, match-granular
 
     # ------------------------------------------------------------------
     # rolling upgrade

@@ -1,0 +1,48 @@
+"""Durable input journal + point-in-time recovery.
+
+A segment-rotating, CRC32-framed write-ahead log of confirmed tick rows
+(`journal.wal`) plus the batched deterministic resimulation that turns
+those rows back into bit-exact match state (`journal.recover`). The
+host tap lives in serve/host.py (`SessionHost(journal_dir=...)` /
+`attach_journal`); the fleet wires journals per match island and the
+director's failover ladder falls back through them (docs/DESIGN.md
+"Durable recovery"). Importing this package does not import jax.
+"""
+
+from .recover import (
+    batch_resim_journals,
+    journal_coverage,
+    resimulate_journal_dirs,
+    scripts_from_journal,
+    state_digest,
+)
+from .wal import (
+    JOURNAL_FORMAT_VERSION,
+    JournalScan,
+    JournalWriter,
+    corrupt_segment,
+    decode_rows,
+    encode_rows,
+    journal_files,
+    read_journal_script,
+    scan_journal,
+    seed_journal,
+)
+
+__all__ = [
+    "JOURNAL_FORMAT_VERSION",
+    "JournalScan",
+    "JournalWriter",
+    "batch_resim_journals",
+    "corrupt_segment",
+    "decode_rows",
+    "encode_rows",
+    "journal_coverage",
+    "journal_files",
+    "read_journal_script",
+    "resimulate_journal_dirs",
+    "scan_journal",
+    "scripts_from_journal",
+    "seed_journal",
+    "state_digest",
+]

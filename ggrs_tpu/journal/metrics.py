@@ -1,0 +1,69 @@
+"""Durable-journal instruments: get-or-create helpers, one definition
+each, shared by the writer, the host tap, the director's recovery
+ladder and the smoke/soak gates that assert on them (the fleet/metrics
+pattern). Registry-driven, so both exporters and telemetry snapshots
+carry them with no extra wiring.
+"""
+
+from __future__ import annotations
+
+from ..obs import GLOBAL_TELEMETRY
+
+
+def journal_rows_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_journal_rows_total",
+        "confirmed frames made durable in input journals",
+    )
+
+
+def journal_bytes_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_journal_bytes_total",
+        "bytes appended to input-journal segments (records incl. framing)",
+    )
+
+
+def journal_segments_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_journal_segments_total",
+        "journal segments opened (initial + rotations)",
+    )
+
+
+def journal_fsyncs_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_journal_fsyncs_total",
+        "fsyncs issued by journal writers (cadence + rotation + sync)",
+    )
+
+
+def journal_stalls_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_journal_stalls_total",
+        "journal appends the filesystem refused (ENOSPC/EIO) — each one "
+        "degrades that lane to unjournaled, never wedges the host",
+    )
+
+
+def journal_corrupt_segments_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_journal_corrupt_segments_total",
+        "journal segments quarantined by the open-time scan (CRC/framing)",
+    )
+
+
+def journal_recoveries_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_journal_recoveries_total",
+        "matches recovered per failover-ladder tier (ticket / "
+        "ticket+journal / journal-only resimulation)",
+        ("tier",),
+    )
+
+
+def journal_replayed_frames_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_journal_replayed_frames_total",
+        "confirmed frames resimulated from journals during recovery",
+    )

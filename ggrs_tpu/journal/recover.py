@@ -1,0 +1,226 @@
+"""Point-in-time recovery from input journals: deterministic
+resimulation riding the megabatch core.
+
+Two consumers, one substrate:
+
+  * `batch_resim_journals` — N lost matches' WORLDS rebuilt as one
+    batched grid: each match is one slot of a MultiSessionDeviceCore,
+    each dispatch advances every live match a full window of confirmed
+    frames (the replay-seek showcase from the ROADMAP, pointed at
+    disaster recovery first). Emits per-frame combined checksums so the
+    rebuilt lineage can be pinned bitwise against a live peer's
+    `local_checksum_history` — the same comparison desync detection
+    makes across peers, made across TIME.
+  * `scripts_from_journal` — the fleet tier-3 path: a journal's
+    confirmed frame rows mapped back through the input delay to the
+    per-peer SUBMIT scripts, so a match island rebuilt from its spec
+    redrives from genesis submitting exactly what its players confirmed
+    before the host died. The redrive itself rides `step_islands` (the
+    shared megabatch drive loop), so N rebuilt matches resimulate as
+    one fleet.
+
+Both paths consume the contiguous confirmed prefix `scan_journal`
+recovered; neither touches the wire — recovery is a pure function of
+(spec, journal), which is the whole durability contract.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .wal import read_journal_script
+
+
+def scripts_from_journal(
+    inputs: np.ndarray,
+    *,
+    input_delay: int,
+    ticks: int,
+    fallback: Optional[Dict[int, List[int]]] = None,
+) -> Dict[int, List[int]]:
+    """Confirmed FRAME rows -> per-peer SUBMIT scripts. A submit at
+    island cursor t lands at frame t + input_delay (the input queue's
+    delay shift; the first `input_delay` frames play the queue's blank
+    fill, which a fresh rebuild reproduces by construction), so the
+    journal pins cursors 0..F-delay-1 and `fallback` (the spec-derived
+    script — the harness's stand-in for live traffic resuming after
+    recovery) covers the unconfirmed tail. Only 1-byte inputs (the
+    island layout) are supported: wider games recover through
+    `batch_resim_journals` instead of an island redrive."""
+    frames, players, input_size = inputs.shape
+    assert input_size == 1, "island scripts are 1-byte inputs"
+    out: Dict[int, List[int]] = {}
+    for k in range(players):
+        script: List[int] = []
+        for t in range(ticks):
+            f = t + input_delay
+            if f < frames:
+                script.append(int(inputs[f, k, 0]))
+            elif fallback is not None and k in fallback:
+                script.append(fallback[k][t])
+            else:
+                break
+        out[k] = script
+    return out
+
+
+def journal_coverage(inputs: np.ndarray, *, input_delay: int) -> int:
+    """How many island CURSOR ticks the journal pins (the redrive's
+    guaranteed-identical prefix)."""
+    return max(int(inputs.shape[0]) - input_delay, 0)
+
+
+def state_digest(state: Any) -> str:
+    """sha256 over a state pytree's leaves in sorted key-path order —
+    the canonical world-bytes witness (the island digest's `state`
+    half, computable host-side on a resimulated tree)."""
+    import jax
+
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_leaves_with_path(state)
+    for path, leaf in sorted(
+        leaves, key=lambda pl: jax.tree_util.keystr(pl[0])
+    ):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def batch_resim_journals(
+    game,
+    scripts: Sequence[Tuple[np.ndarray, np.ndarray]],
+    *,
+    max_prediction: int = 8,
+    collect_checksums: bool = True,
+) -> List[dict]:
+    """Rebuild N matches' world states from their confirmed input
+    scripts in one batched megabatch grid: match i is slot i of a
+    MultiSessionDeviceCore, every dispatch carries one full-window row
+    per still-live match (frames-per-dispatch = live_matches x window),
+    and per-frame save checksums ride the same lazy checksum batches
+    the serving host binds — resolved once at the end so the fence
+    stays busy. Returns one dict per match:
+
+        {"frames": F, "state": <host pytree at frame F>,
+         "checksums": {frame: combined_checksum}}
+
+    `state` is the canonical world alone (no snapshot ring): recovery
+    saves every frame for the checksum lineage, which a live host's
+    sparse cadence would not, so ring bytes are NOT comparable across
+    the two — world bytes and the checksum history are."""
+    import jax
+
+    from ..errors import InvalidRequest
+    from ..ops.fixed_point import combine_checksum  # noqa: F401 (parity doc)
+    from ..tpu.backend import MultiSessionDeviceCore
+
+    n = len(scripts)
+    assert n > 0
+    players = scripts[0][1].shape[1]
+    for i, (inp, st) in enumerate(scripts):
+        if inp.shape[1:] != (players, game.input_size) or st.shape[1:] != (
+            players,
+        ):
+            # refuse the ONE mismatched journal typed instead of dying
+            # as a broadcast error mid-grid and failing every match
+            raise InvalidRequest(
+                f"journal script {i} has shape {inp.shape} — the batch "
+                f"is {players} players x input_size {game.input_size}"
+            )
+    device = MultiSessionDeviceCore.create(
+        game, max_prediction, players, n,
+    )
+    core = device.core
+    W, ring_len = core.window, core.ring_len
+    for slot in range(n):
+        device.reset_slot(slot)
+    totals = [int(inp.shape[0]) for inp, _ in scripts]
+    done = [0] * n
+    pending: List[Tuple[Any, List[Tuple[int, int, int]]]] = []
+    scratch = np.full((W,), core.scratch_slot, dtype=np.int32)
+    while True:
+        entries = []
+        binds: List[Tuple[int, int, int]] = []  # (match, base_k, count)
+        counts = []
+        for slot in range(n):
+            rem = totals[slot] - done[slot]
+            if rem <= 0:
+                continue
+            count = min(W, rem)
+            start = done[slot]
+            inp_arr, st_arr = scripts[slot]
+            inputs = np.zeros((W, players, game.input_size), np.uint8)
+            statuses = np.zeros((W, players), np.int32)
+            inputs[:count] = inp_arr[start : start + count]
+            statuses[:count] = st_arr[start : start + count]
+            if collect_checksums:
+                save_slots = scratch.copy()
+                for i in range(count):
+                    # slot-i save snapshots the PRE-advance state
+                    # (= frame start+i), exactly what desync detection
+                    # checksummed live (utils/replay._replay_core's rule)
+                    save_slots[i] = (start + i) % ring_len
+            else:
+                save_slots = scratch
+            row = core.pack_tick_row(
+                False, 0, inputs, statuses, save_slots, count,
+                start_frame=start,
+            )
+            entries.append((slot, row))
+            binds.append((slot, len(entries) - 1, count))
+            counts.append(count)
+            done[slot] = start + count
+        if not entries:
+            break
+        batch, _bucket = device.dispatch(
+            entries, last_active=max(counts)
+        )
+        if collect_checksums:
+            pending.append((batch, binds))
+    device.block_until_ready()
+    results: List[dict] = []
+    checksums: List[Dict[int, int]] = [dict() for _ in range(n)]
+    if collect_checksums:
+        rebuilt = [0] * n
+        for batch, binds in pending:
+            for slot, k, count in binds:
+                for i in range(count):
+                    checksums[slot][rebuilt[slot] + i] = batch.resolve(
+                        k * W + i
+                    )
+                rebuilt[slot] += count
+    for slot in range(n):
+        payload = device.export_slot(slot)
+        results.append({
+            "frames": totals[slot],
+            "state": jax.device_get(payload["state"]),
+            "checksums": checksums[slot],
+        })
+    return results
+
+
+def resimulate_journal_dirs(game, paths: Sequence[str], **kw) -> List[dict]:
+    """`batch_resim_journals` over on-disk journals: read each
+    directory's contiguous confirmed prefix, rebuild all of them as one
+    grid. The recovery-time-objective bench's entry point."""
+    from ..errors import JournalCorrupt
+
+    scripts = []
+    for path in paths:
+        inputs, statuses, meta = read_journal_script(path)
+        # a same-shape wrong-game journal would resimulate to typed-
+        # valid garbage: refuse on the identity the META exists for
+        for ident, want in (
+            ("game_cls", type(game).__name__),
+            ("input_size", game.input_size),
+        ):
+            if ident in meta and meta[ident] != want:
+                raise JournalCorrupt(
+                    f"journal was recorded on {ident}={meta[ident]!r}, "
+                    f"not {want!r}",
+                    path=path,
+                )
+        scripts.append((inputs, statuses))
+    return batch_resim_journals(game, scripts, **kw)

@@ -1,0 +1,661 @@
+"""Segment-rotating write-ahead log of confirmed tick rows.
+
+The durability gap this closes: every other redundancy tier keeps its
+state in RAM — wire chaos recovers from retransmission queues, process
+fencing from in-memory checkpoint tickets, device quarantine from ring
+snapshots — so a host that dies with a stale or corrupt ticket loses
+every confirmed frame since the last checkpoint. But the simulation is a
+pure function of (initial state, confirmed inputs): persist the confirmed
+input rows crash-consistently and TOTAL host loss becomes recoverable by
+deterministic resimulation. This module is that persistence layer; the
+resimulation half lives in journal/recover.py.
+
+Format — append-only segment files `seg-XXXXXXXX.wal`, each a stream of
+CRC32-framed records:
+
+    u8 magic (0xA7) | u8 type | u32le payload_len | payload | u32le crc32
+
+The CRC covers header + payload, so any torn or bit-flipped record fails
+closed. Record types:
+
+    META (1)  JSON: journal identity (game class, players, input size),
+              the writing host's (host_id, epoch), `first_frame` of the
+              segment. Every segment STARTS with one, so each file is
+              self-describing and a scan can validate continuity without
+              the others.
+    ROWS (2)  a batch of consecutive confirmed frames in the recorder's
+              packed row layout: `<IHBB` start_frame, count, players,
+              input_size, then count*P*I input bytes (u8), then count*P
+              statuses (i32le) — byte-identical to what
+              `InputRecorder.drain_confirmed` hands over, and what
+              `utils.replay.replay_to_state` consumes after decode.
+
+Crash consistency: appends go straight to the active segment (a torn
+tail is detected and truncated by the open-time scan — the atomic-write
+pattern would force a whole-file rewrite per append); ROTATION uses the
+`atomic_write_bytes` discipline — the new segment materializes complete
+with its META record or not at all, and the finished segment is fsynced
+before the writer moves on, so a SIGKILL mid-rotation leaves either the
+old tail-segment alone or both files whole. `fsync_every=N` bounds how
+many confirmed rows a power loss can cost (N record appends between
+fsyncs; 0 = fsync only at rotation/close — SIGKILL-safe either way,
+since the OS keeps dirty pages of a dead process).
+
+Failure typing: a scan that hits a bad record in a NON-final segment
+quarantines it (renamed `*.corrupt`, typed JournalCorrupt collected —
+never a crash); an append that the disk refuses (ENOSPC, EIO) raises
+typed JournalStalled so the host can degrade to unjournaled instead of
+wedging.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..errors import InvalidRequest, JournalCorrupt, JournalStalled
+from .metrics import (
+    journal_bytes_total,
+    journal_corrupt_segments_total,
+    journal_fsyncs_total,
+    journal_rows_total,
+    journal_segments_total,
+)
+
+_MAGIC = 0xA7
+REC_META = 1
+REC_ROWS = 2
+
+_HEADER = struct.Struct("<BBI")  # magic, type, payload_len
+_CRC = struct.Struct("<I")
+_ROWS_HEAD = struct.Struct("<IHBB")  # start_frame, count, players, input_size
+
+JOURNAL_FORMAT_VERSION = 1
+SEGMENT_PREFIX = "seg-"
+SEGMENT_SUFFIX = ".wal"
+
+
+def _segment_name(index: int) -> str:
+    return f"{SEGMENT_PREFIX}{index:08x}{SEGMENT_SUFFIX}"
+
+
+def _frame_record(rtype: int, payload: bytes) -> bytes:
+    head = _HEADER.pack(_MAGIC, rtype, len(payload))
+    return head + payload + _CRC.pack(zlib.crc32(head + payload) & 0xFFFFFFFF)
+
+
+_DISCONNECTED = 2  # types.InputStatus.DISCONNECTED (no jax-adjacent import)
+
+
+def canonical_statuses(statuses: np.ndarray) -> np.ndarray:
+    """Journal-canonical statuses: at the confirmed frontier a player's
+    input is either real (CONFIRMED) or the player is DISCONNECTED —
+    PREDICTED is a transient whose residue differs per PEER (a correct
+    prediction is never re-advanced, so the predicting peer's last
+    observation keeps the transient while the input's owner records
+    CONFIRMED). Canonicalizing makes every peer of a match journal
+    bit-identical rows, which is what lets recovery read ANY surviving
+    peer's journal and lets cross-peer journal comparison double as a
+    desync autopsy."""
+    statuses = np.asarray(statuses, dtype=np.int32)
+    return np.where(statuses == _DISCONNECTED, statuses, 0).astype(np.int32)
+
+
+def encode_rows(start_frame: int, inputs: np.ndarray,
+                statuses: np.ndarray) -> bytes:
+    """One ROWS record: `inputs` u8[F, P, I], `statuses` i32[F, P]."""
+    inputs = np.ascontiguousarray(inputs, dtype=np.uint8)
+    statuses = np.ascontiguousarray(statuses, dtype=np.int32)
+    count, players, input_size = inputs.shape
+    assert statuses.shape == (count, players), (inputs.shape, statuses.shape)
+    payload = (
+        _ROWS_HEAD.pack(start_frame, count, players, input_size)
+        + inputs.tobytes()
+        + statuses.astype("<i4").tobytes()
+    )
+    return _frame_record(REC_ROWS, payload)
+
+
+def decode_rows(payload: bytes) -> Tuple[int, np.ndarray, np.ndarray]:
+    start, count, players, input_size = _ROWS_HEAD.unpack_from(payload, 0)
+    off = _ROWS_HEAD.size
+    n_inp = count * players * input_size
+    n_st = count * players * 4
+    if len(payload) != off + n_inp + n_st:
+        raise ValueError(
+            f"ROWS payload length {len(payload)} != header-implied "
+            f"{off + n_inp + n_st}"
+        )
+    inputs = np.frombuffer(
+        payload, dtype=np.uint8, count=n_inp, offset=off
+    ).reshape(count, players, input_size)
+    statuses = np.frombuffer(
+        payload, dtype="<i4", count=count * players, offset=off + n_inp
+    ).astype(np.int32).reshape(count, players)
+    return start, inputs, statuses
+
+
+def _has_valid_record_after(data: bytes, off: int) -> bool:
+    """True when a complete, CRC-valid record exists anywhere past
+    `off` — the discriminator between a TORN TAIL (a crash can only
+    tear the very end: nothing valid follows) and MID-FILE CORRUPTION
+    (an SDC flip leaves the records after it intact). Header-plausible
+    positions are rare in random bytes, so the scan is effectively one
+    cheap pass."""
+    n = len(data)
+    for p in range(off + 1, n - _HEADER.size - _CRC.size + 1):
+        if data[p] != _MAGIC:
+            continue
+        magic, rtype, length = _HEADER.unpack_from(data, p)
+        if rtype not in (REC_META, REC_ROWS):
+            continue
+        end = p + _HEADER.size + length + _CRC.size
+        if end > n:
+            continue
+        body = data[p : p + _HEADER.size + length]
+        (crc,) = _CRC.unpack_from(data, p + _HEADER.size + length)
+        if crc == (zlib.crc32(body) & 0xFFFFFFFF):
+            return True
+    return False
+
+
+def _parse_segment(data: bytes):
+    """Walk one segment's records. Returns (records, good_bytes, error):
+    `records` is [(type, payload)], `good_bytes` the offset of the first
+    bad byte (== len(data) when clean), `error` a short reason or None.
+    Never raises — the CALLER decides torn-tail vs corrupt-segment."""
+    records: List[Tuple[int, bytes]] = []
+    off = 0
+    n = len(data)
+    while off < n:
+        if n - off < _HEADER.size + _CRC.size:
+            return records, off, "truncated header"
+        magic, rtype, length = _HEADER.unpack_from(data, off)
+        if magic != _MAGIC or rtype not in (REC_META, REC_ROWS):
+            return records, off, f"bad frame (magic={magic:#x}, type={rtype})"
+        end = off + _HEADER.size + length + _CRC.size
+        if end > n:
+            return records, off, "truncated record"
+        body = data[off : off + _HEADER.size + length]
+        (crc,) = _CRC.unpack_from(data, off + _HEADER.size + length)
+        if crc != (zlib.crc32(body) & 0xFFFFFFFF):
+            return records, off, "crc mismatch"
+        records.append((rtype, data[off + _HEADER.size : off + _HEADER.size + length]))
+        off = end
+    return records, off, None
+
+
+class JournalScan:
+    """The open-time scan's verdict: the contiguous confirmed row prefix
+    (base_frame..next_frame), the journal meta, and everything that went
+    wrong — torn tails truncated, corrupt segments quarantined as typed
+    JournalCorrupt entries (never raised from the scan itself)."""
+
+    def __init__(self) -> None:
+        self.meta: Dict[str, Any] = {}
+        self.base_frame = 0
+        self.next_frame = 0
+        self.rows: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self.corrupt: List[JournalCorrupt] = []
+        self.segments: List[dict] = []
+        self.torn_bytes = 0
+        self.gap = False  # a quarantined segment broke frame continuity
+
+    @property
+    def frames(self) -> int:
+        return self.next_frame - self.base_frame
+
+    def script(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(inputs u8[F, P, I], statuses i32[F, P]) for the contiguous
+        confirmed prefix — the exact arrays `replay_to_state` and the
+        recovery resim consume."""
+        if not self.frames:
+            raise JournalCorrupt(
+                "journal holds no contiguous confirmed rows",
+                path=self.meta.get("path", ""),
+            )
+        frames = range(self.base_frame, self.next_frame)
+        inputs = np.concatenate([self.rows[f][0][None] for f in frames])
+        statuses = np.concatenate([self.rows[f][1][None] for f in frames])
+        return inputs, statuses
+
+
+def _list_segments(path: str) -> List[str]:
+    try:
+        names = os.listdir(path)
+    except FileNotFoundError:
+        return []
+    return sorted(
+        n for n in names
+        if n.startswith(SEGMENT_PREFIX) and n.endswith(SEGMENT_SUFFIX)
+    )
+
+
+def scan_journal(path: str, *, repair: bool = False) -> JournalScan:
+    """Read every segment, collect the contiguous confirmed prefix.
+    `repair=True` additionally truncates the final segment's torn tail
+    in place and renames corrupt segments to `<name>.corrupt` (the
+    writer's open path); False leaves the files untouched (the
+    director's seize path reads bytes it does not own)."""
+    scan = JournalScan()
+    names = _list_segments(path)
+    first = True
+    for i, name in enumerate(names):
+        seg_path = os.path.join(path, name)
+        with open(seg_path, "rb") as f:
+            data = f.read()
+        records, good, err = _parse_segment(data)
+        last = i == len(names) - 1
+        entry = {"name": name, "bytes": len(data), "records": len(records)}
+        if err is not None and last and _has_valid_record_after(data, good):
+            # valid records FOLLOW the bad bytes: this is mid-file
+            # corruption of the active segment (SDC), not crash
+            # tearing — quarantine like a finished segment instead of
+            # silently truncating acknowledged durable rows. (A flip
+            # inside the very LAST record is indistinguishable from a
+            # tear and is treated as one — the one-record ambiguity a
+            # framing-only format cannot close.)
+            last = False
+        pending_quarantine = False
+        if err is not None and not last:
+            # corruption: the segment quarantines aside, typed — but
+            # its CRC-valid leading records are still acknowledged
+            # durable rows, so THIS scan (the recovery read) keeps them
+            # before declaring the gap
+            exc = JournalCorrupt(
+                f"journal segment failed its scan: {err}",
+                path=path, segment=name, offset=good,
+            )
+            scan.corrupt.append(exc)
+            journal_corrupt_segments_total().inc()
+            entry["corrupt"] = err
+            pending_quarantine = True
+            if repair:
+                os.replace(seg_path, seg_path + ".corrupt")
+        if err is not None and last:
+            # torn tail: the crash residue the framing exists to absorb
+            scan.torn_bytes = len(data) - good
+            entry["torn_bytes"] = scan.torn_bytes
+            if repair and scan.torn_bytes:
+                with open(seg_path, "r+b") as f:
+                    f.truncate(good)
+        scan.segments.append(entry)
+        for rtype, payload in records:
+            if rtype == REC_META:
+                meta = json.loads(payload.decode("utf-8"))
+                if first:
+                    scan.meta = meta
+                    scan.base_frame = int(meta.get("first_frame", 0))
+                    scan.next_frame = scan.base_frame
+                    first = False
+                continue
+            if scan.gap:
+                continue  # rows past a quarantined segment: not contiguous
+            start, inputs, statuses = decode_rows(payload)
+            for k in range(inputs.shape[0]):
+                f = start + k
+                if f < scan.next_frame:
+                    continue  # duplicate coverage (resumed writer overlap)
+                if f > scan.next_frame:
+                    scan.gap = True
+                    break
+                scan.rows[f] = (inputs[k], statuses[k])
+                scan.next_frame = f + 1
+        if pending_quarantine:
+            scan.gap = True  # nothing AFTER this segment is contiguous
+    return scan
+
+
+def read_journal_script(path: str):
+    """(inputs, statuses, meta) of the contiguous confirmed prefix —
+    the recovery entry point. Raises JournalCorrupt when the journal
+    holds no usable rows; a quarantinable segment does NOT raise (the
+    prefix before it still recovers)."""
+    scan = scan_journal(path, repair=False)
+    inputs, statuses = scan.script()
+    return inputs, statuses, scan.meta
+
+
+def journal_files(path: str) -> Dict[str, bytes]:
+    """Snapshot the journal's bytes NOW — the director's seize-at-fence
+    read (the ticket discipline): whatever a fenced zombie appends after
+    this read is void, because recovery runs from these bytes. Includes
+    already-quarantined segments for the autopsy trail."""
+    out: Dict[str, bytes] = {}
+    try:
+        names = sorted(os.listdir(path))
+    except FileNotFoundError:
+        return out
+    for name in names:
+        if not (name.startswith(SEGMENT_PREFIX)):
+            continue
+        try:
+            with open(os.path.join(path, name), "rb") as f:
+                out[name] = f.read()
+        except OSError:
+            continue
+    return out
+
+
+def seed_journal(path: str, files: Dict[str, bytes]) -> None:
+    """Materialize seized/migrated journal bytes into a fresh directory
+    (atomic per file): the receiving host's journal then CONTINUES the
+    match's history from genesis instead of starting at the adoption
+    frame — what keeps a second failover journal-recoverable."""
+    from ..utils.checkpoint import atomic_write_bytes
+
+    os.makedirs(path, exist_ok=True)
+    for stale in sorted(os.listdir(path)):
+        # a previous hosting of the same match may have left segments
+        # (or quarantined residue) here; a stale higher-index segment
+        # could splice into the seized history and pass the continuity
+        # scan as if it were this lineage's tail — the seized bytes are
+        # the WHOLE truth, so the directory starts empty
+        if stale.startswith(SEGMENT_PREFIX):
+            os.unlink(os.path.join(path, stale))
+    for name in sorted(files):
+        if "/" in name or name.startswith("."):
+            raise InvalidRequest(f"journal file name {name!r} escapes dir")
+        atomic_write_bytes(os.path.join(path, name), files[name])
+
+
+def corrupt_segment(path: str, *, segment: int = 0,
+                    offset: Optional[int] = None) -> str:
+    """Chaos helper: flip one byte of segment `segment` (by sorted
+    index). The next scan must quarantine it as typed JournalCorrupt —
+    the storage tier's injected-corruption arm."""
+    names = _list_segments(path)
+    name = names[segment]
+    seg_path = os.path.join(path, name)
+    with open(seg_path, "r+b") as f:
+        data = bytearray(f.read())
+        # default: corrupt past the header record so the META (and the
+        # framing up to it) stays parseable and the CRC is what catches it
+        at = offset if offset is not None else min(len(data) - 5, len(data) // 2)
+        data[at] ^= 0x40
+        f.seek(0)
+        f.write(data)
+    return name
+
+
+class JournalWriter:
+    """Append confirmed rows durably; resume across restarts.
+
+    Open-time behavior: scans the directory with `repair=True` (torn
+    tail truncated, corrupt segments quarantined aside). A quarantine
+    that broke frame continuity raises JournalCorrupt — the caller
+    (host tap / fleet agent) degrades or falls back a recovery tier
+    rather than appending rows no resimulation could ever reach. On a
+    clean resume the scanned rows are retained as the VERIFY set:
+    `verify_row` checks a redriven row bit-for-bit against what the
+    journal recorded (freed as they pass), which is the "journal tail
+    replay" witness — a restore-from-ticket that redrives the
+    pre-crash window must reproduce the journaled bytes exactly."""
+
+    def __init__(self, path: str, *, meta: Optional[Dict[str, Any]] = None,
+                 segment_bytes: int = 1 << 18, fsync_every: int = 0):
+        self.path = path
+        self.segment_bytes = segment_bytes
+        self.fsync_every = fsync_every
+        self.meta = dict(meta or {})
+        self.frames_journaled = 0
+        self.appends = 0
+        self.bytes_written = 0
+        self.rotations = 0
+        self.fsyncs = 0
+        self.verified_rows = 0
+        self._fd = None
+        os.makedirs(path, exist_ok=True)
+        scan = scan_journal(path, repair=True)
+        if scan.gap:
+            raise (
+                scan.corrupt[0]
+                if scan.corrupt
+                else JournalCorrupt(
+                    "journal frame continuity broken", path=path
+                )
+            )
+        names = _list_segments(path)
+        self.next_frame = scan.next_frame
+        self.base_frame = scan.base_frame
+        self._verify = dict(scan.rows)
+        self._empty = scan.frames == 0
+        if names:
+            # a resume must be the SAME lineage: a fresh process whose
+            # key allocation collided onto a dead incarnation's path
+            # would otherwise splice two matches into one "contiguous"
+            # journal (or spuriously fail verify) — the self-describing
+            # META exists to refuse that at the door
+            for ident in ("game_cls", "num_players", "input_size",
+                          "match_id"):
+                if (
+                    ident in scan.meta
+                    and ident in self.meta
+                    and scan.meta[ident] != self.meta[ident]
+                ):
+                    raise JournalCorrupt(
+                        f"journal identity mismatch on resume: "
+                        f"{ident} is {scan.meta[ident]!r} on disk, "
+                        f"{self.meta[ident]!r} attaching",
+                        path=path,
+                    )
+            self.meta = {**scan.meta, **self.meta}
+            self._seg_index = int(
+                names[-1][len(SEGMENT_PREFIX):-len(SEGMENT_SUFFIX)], 16
+            )
+            seg_path = os.path.join(path, names[-1])
+            self._seg_size = os.path.getsize(seg_path)
+            self._fd = open(seg_path, "ab")
+        else:
+            if "first_frame" in self.meta:
+                self.next_frame = int(self.meta["first_frame"])
+                self.base_frame = self.next_frame
+            self._seg_index = -1
+            self._seg_size = 0
+            self._rotate()
+        self._since_fsync = 0
+
+    # ------------------------------------------------------------------
+    # segment lifecycle
+    # ------------------------------------------------------------------
+
+    def _meta_record(self) -> bytes:
+        stamped = {
+            **self.meta,
+            "format": JOURNAL_FORMAT_VERSION,
+            "first_frame": self.base_frame if self._seg_index < 0
+            else self.next_frame,
+            "segment": self._seg_index + 1,
+        }
+        return _frame_record(
+            REC_META, json.dumps(stamped, sort_keys=True).encode("utf-8")
+        )
+
+    def _rebase_segment(self) -> None:
+        """Rewrite the (row-less) active segment with a META carrying
+        the rebased first_frame — atomic, so a crash mid-rebase leaves
+        either the old empty segment or the new one, both row-free."""
+        from ..utils.checkpoint import atomic_write_bytes
+
+        assert self._empty
+        if self._fd is not None:
+            self._fd.close()
+            self._fd = None
+        saved = self._seg_index
+        self._seg_index = -1  # _meta_record: first_frame = base_frame
+        record = self._meta_record()
+        self._seg_index = saved
+        seg_path = os.path.join(self.path, _segment_name(self._seg_index))
+        atomic_write_bytes(seg_path, record)
+        self._fd = open(seg_path, "ab")
+        self._seg_size = len(record)
+
+    def _rotate(self) -> None:
+        """Finish the active segment (fsync — rotation is a durability
+        point regardless of cadence) and start the next one with its
+        META record via the atomic-write discipline: the new file
+        appears whole or not at all, so a SIGKILL mid-rotation can
+        never leave a headerless segment."""
+        from ..utils.checkpoint import atomic_write_bytes
+
+        record = self._meta_record()
+        if self._fd is not None:
+            self._fd.flush()
+            os.fsync(self._fd.fileno())
+            self.fsyncs += 1
+            journal_fsyncs_total().inc()
+            self._fd.close()
+            self._fd = None
+        self._seg_index += 1
+        seg_path = os.path.join(self.path, _segment_name(self._seg_index))
+        atomic_write_bytes(seg_path, record)
+        self._fd = open(seg_path, "ab")
+        self._seg_size = len(record)
+        self.bytes_written += len(record)
+        self.rotations += 1
+        self._since_fsync = 0
+        journal_segments_total().inc()
+        journal_bytes_total().inc(len(record))
+
+    # ------------------------------------------------------------------
+    # the append path
+    # ------------------------------------------------------------------
+
+    def append_rows(self, start_frame: int, inputs: np.ndarray,
+                    statuses: np.ndarray) -> int:
+        """Append consecutive confirmed rows starting at `start_frame`.
+        Rows at frames already journaled are verified (when the resume
+        scan retained them) and skipped — the redrive-after-restore
+        overlap; a gap ABOVE next_frame is an InvalidRequest (the
+        journal's whole value is contiguity from genesis). Returns the
+        number of NEW frames made durable. Disk refusal raises typed
+        JournalStalled; the torn partial record it may leave is exactly
+        what the open-time scan truncates."""
+        count = int(inputs.shape[0])
+        if start_frame > self.next_frame and self._empty:
+            # an EMPTY journal re-bases onto its first append: a
+            # mid-match adopted lane starts its durable history at the
+            # adoption frame (the journal then records first_frame > 0,
+            # which the genesis-resim tier refuses by design — such a
+            # journal supports tail recovery only). The on-disk META is
+            # rewritten so a scan agrees with the rebased frames.
+            self.base_frame = start_frame
+            self.next_frame = start_frame
+            self._rebase_segment()
+        if start_frame > self.next_frame:
+            raise InvalidRequest(
+                f"journal append at frame {start_frame} would leave a "
+                f"gap above {self.next_frame}"
+            )
+        skip = min(self.next_frame - start_frame, count)
+        for k in range(skip):
+            self.verify_row(start_frame + k, inputs[k], statuses[k])
+        if skip >= count:
+            return 0
+        start = start_frame + skip
+        record = encode_rows(start, inputs[skip:], statuses[skip:])
+        try:
+            if self._fd is None:
+                raise OSError(0, "journal writer is closed")
+            self._fd.write(record)
+            self._fd.flush()
+            self._since_fsync += 1
+            if self.fsync_every and self._since_fsync >= self.fsync_every:
+                os.fsync(self._fd.fileno())
+                self.fsyncs += 1
+                self._since_fsync = 0
+                journal_fsyncs_total().inc()
+        except OSError as exc:
+            raise JournalStalled(
+                f"journal append refused by the filesystem: {exc}",
+                path=self.path, errno=exc.errno or 0,
+            ) from exc
+        new = count - skip
+        self.next_frame = start + new
+        self._empty = False
+        # once fresh rows append past the resume frontier, every stale
+        # overlap row has already come and gone (observation precedes
+        # confirmation): retained verify rows below the redrive floor
+        # can never be checked — free them instead of holding a whole
+        # seized history in RAM
+        if self._verify:
+            self._verify.clear()
+        self.frames_journaled += new
+        self.appends += 1
+        self._seg_size += len(record)
+        self.bytes_written += len(record)
+        journal_rows_total().inc(new)
+        journal_bytes_total().inc(len(record))
+        if self._seg_size >= self.segment_bytes:
+            try:
+                self._rotate()
+            except OSError as exc:
+                raise JournalStalled(
+                    f"journal rotation refused by the filesystem: {exc}",
+                    path=self.path, errno=exc.errno or 0,
+                ) from exc
+        return new
+
+    def verify_row(self, frame: int, inputs: np.ndarray,
+                   statuses: np.ndarray) -> bool:
+        """Check one re-confirmed row against the journaled bytes (the
+        resume scan's retained rows; rows outside that set pass
+        vacuously — already freed as verified). A mismatch is typed
+        JournalCorrupt: the redrive and the durable record disagree,
+        so one of them is wrong and recovery must not trust the pair."""
+        rec = self._verify.pop(frame, None)
+        if rec is None:
+            return False
+        j_inp, j_st = rec
+        if not (
+            np.array_equal(
+                np.asarray(inputs, dtype=np.uint8), j_inp
+            )
+            and np.array_equal(
+                np.asarray(statuses, dtype=np.int32), j_st
+            )
+        ):
+            raise JournalCorrupt(
+                "re-confirmed row disagrees with the journaled bytes "
+                "(redrive/journal divergence)",
+                path=self.path, frame=frame,
+            )
+        self.verified_rows += 1
+        return True
+
+    def sync(self) -> None:
+        """Flush + fsync the active segment — the checkpoint/drain
+        durability point, independent of the append cadence."""
+        if self._fd is None:
+            return
+        self._fd.flush()
+        os.fsync(self._fd.fileno())
+        self.fsyncs += 1
+        journal_fsyncs_total().inc()
+
+    def close(self) -> None:
+        if self._fd is None:
+            return
+        try:
+            self.sync()
+        finally:
+            self._fd.close()
+            self._fd = None
+
+    def section(self) -> dict:
+        return {
+            "path": self.path,
+            "next_frame": self.next_frame,
+            "frames_journaled": self.frames_journaled,
+            "appends": self.appends,
+            "bytes_written": self.bytes_written,
+            "segments": self._seg_index + 1,
+            "fsyncs": self.fsyncs,
+            "verified_rows": self.verified_rows,
+            "unverified_rows": len(self._verify),
+        }

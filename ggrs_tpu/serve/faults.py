@@ -66,6 +66,19 @@ FAULT_KINDS = (
     "slot_bitflip",
 )
 
+# opt-in extension kinds: not in the every-kind default schedule
+# (FaultPlan()/FaultPlan.smoke() fire each of FAULT_KINDS, whose blast
+# radii exist on every host), scheduled by passing `kinds=` explicitly:
+#
+#   journal_stall   the next N durable-journal appends are refused as
+#                   if the disk were full (typed JournalStalled at the
+#                   host tap) — the storage tier's ENOSPC arm; the host
+#                   must degrade the lane to unjournaled with an
+#                   invariant trip, never wedge. Vacuous on a host with
+#                   no journaled lanes, hence opt-in.
+EXTENSION_FAULT_KINDS = ("journal_stall",)
+ALL_FAULT_KINDS = FAULT_KINDS + EXTENSION_FAULT_KINDS
+
 
 class Fault:
     """One scheduled device fault: fire at `tick`, of `kind`, with
@@ -74,7 +87,7 @@ class Fault:
     __slots__ = ("tick", "kind", "params")
 
     def __init__(self, tick: int, kind: str, **params: Any):
-        assert kind in FAULT_KINDS, f"unknown fault kind {kind!r}"
+        assert kind in ALL_FAULT_KINDS, f"unknown fault kind {kind!r}"
         self.tick = tick
         self.kind = kind
         self.params = params
@@ -110,7 +123,7 @@ class FaultPlan:
         faults: List[Fault] = []
         span = max(ticks - start, 1)
         for kind in self.kinds:
-            assert kind in FAULT_KINDS, f"unknown fault kind {kind!r}"
+            assert kind in ALL_FAULT_KINDS, f"unknown fault kind {kind!r}"
             for i in range(events_per_kind):
                 # one fault per evenly-sized stripe, jittered inside it,
                 # so multiple events of a kind can't pile on one tick
@@ -179,8 +192,9 @@ class FaultInjector:
         self._harvest_armed = 0
         self._storm_remaining = 0
         self._checkpoint_armed = 0
+        self._journal_armed = 0
         # observability: everything fired, for blast-radius assertions
-        self.fired: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
+        self.fired: Dict[str, int] = {k: 0 for k in ALL_FAULT_KINDS}
         self.bitflips: List[dict] = []  # {tick, key, slot, frame}
         self.corrupted_checkpoints: List[str] = []
         self._m_fired = faults_injected_counter()
@@ -261,6 +275,9 @@ class FaultInjector:
     def _arm_checkpoint_corrupt(self, tick: int, fault: Fault) -> None:
         self._checkpoint_armed += 1
 
+    def _arm_journal_stall(self, tick: int, fault: Fault) -> None:
+        self._journal_armed += int(fault.params.get("appends", 1))
+
     def _arm_slot_bitflip(self, tick: int, fault: Fault) -> None:
         """SDC fires immediately: flip one seeded bit of the victim's
         device residue. Default target is a SETTLED snapshot-ring row —
@@ -326,6 +343,21 @@ class FaultInjector:
         self._dispatch_armed = [
             a for a in self._dispatch_armed if a["slot"] != slot
         ]
+
+    def before_journal_append(self, path: str) -> None:
+        """Host seam, consulted before each journal frontier drain:
+        raises the simulated disk refusal (the host tap degrades the
+        lane to unjournaled — typed, with an invariant trip — and
+        serving continues untouched)."""
+        if self._journal_armed > 0:
+            self._journal_armed -= 1
+            self._note("journal_stall")
+            from ..errors import JournalStalled
+
+            raise JournalStalled(
+                "injected filesystem refusal (ENOSPC)",
+                path=path, errno=28,
+            )
 
     def before_harvest(self, op: str, pending: int = 0) -> None:
         """Host seam, consulted before checksum readbacks resolve."""

@@ -43,12 +43,14 @@ loop").
 
 from __future__ import annotations
 
+import os
 import time as _time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..journal.wal import canonical_statuses
 from ..errors import (
     DeviceDispatchFailed,
     DrainStalled,
@@ -57,6 +59,8 @@ from ..errors import (
     HostFull,
     InvalidRequest,
     InvariantViolation,
+    JournalError,
+    JournalStalled,
     PredictionThreshold,
     SlotPoisoned,
 )
@@ -131,6 +135,21 @@ class _StagedRow:
         self.adopt = adopt
 
 
+class _JournalTap:
+    """One journaled lane's durable-input pipeline: a pure-observer
+    InputRecorder over the lane's request stream feeding a segment WAL
+    (journal/wal.py) at the confirmed frontier. Strictly host-side —
+    the session is never touched, so journaling is observationally
+    neutral to the match (the twin-parity suites run with it on)."""
+
+    __slots__ = ("writer", "recorder", "path")
+
+    def __init__(self, writer, recorder, path):
+        self.writer = writer
+        self.recorder = recorder
+        self.path = path
+
+
 class _Lane:
     """Host-side per-session state: device slot, staged rows, scheduling
     and liveness bookkeeping."""
@@ -151,6 +170,8 @@ class _Lane:
         # invariant monitors (always-on, cheap)
         "max_confirmed_seen", "last_progress_seen", "last_progress_tick",
         "wedge_reported",
+        # durable input journal (attach_journal installs; None = off)
+        "journal",
         # SDC audit lane (maintained only when the host samples audits):
         # frame -> (played inputs u8[P,I], statuses i32[P]) — rollback
         # segments overwrite predicted values with the corrected truth,
@@ -183,6 +204,7 @@ class _Lane:
         # tick) + the fresh confirmed watermark the gate computed —
         # the speculative bubble-filling scheduler's draft keys
         self.starved = False
+        self.journal: Optional[_JournalTap] = None
         self.confirmed_watermark: Optional[int] = None
         self.max_confirmed_seen: Optional[int] = None
         self.last_progress_seen = 0
@@ -235,7 +257,10 @@ class SessionHost:
                  wedge_limit_ticks: int = 256,
                  drive_failure_limit: int = 3,
                  shed_after_stall_ticks: int = 256,
-                 strict_invariants: bool = False):
+                 strict_invariants: bool = False,
+                 journal_dir: Optional[str] = None,
+                 journal_fsync_every: int = 0,
+                 journal_segment_bytes: int = 1 << 18):
         """`max_inflight_rows`: the device-window budget — session tick
         rows admitted past the fence before ready sessions start queuing
         (default: 2 full megabatches' worth). `idle_timeout_ms`: sessions
@@ -325,7 +350,24 @@ class SessionHost:
         monitors (lane progress, confirmed-watermark monotonicity,
         mailbox accounting), which record typed InvariantViolations
         with forensics (`strict_invariants=True` raises them
-        instead)."""
+        instead).
+
+        DURABLE INPUT JOURNAL (docs/DESIGN.md "Durable recovery"):
+        `journal_dir` journals every p2p lane's CONFIRMED input rows to
+        a crash-consistent segment WAL under `journal_dir/lane<key>`
+        (per-lane `attach_journal` gives a caller-chosen path — the
+        fleet agent journals per match island). The tap is a pure
+        observer riding the pump: each host tick drains the lane's
+        confirmed frontier from an InputRecorder into the journal —
+        identical traffic on both serving arms, since the staged
+        request stream is arm-independent by the deterministic-publish
+        contract. `journal_fsync_every` bounds power-loss exposure to N
+        appends (0 = fsync at rotation/checkpoint/drain only; SIGKILL
+        never loses acknowledged appends either way). Journaling is a
+        durability feature, never a liveness dependency: a disk that
+        refuses an append (ENOSPC) degrades THAT lane to unjournaled
+        with a typed JournalStalled + invariant trip — the host keeps
+        serving."""
         from ..network.pump import WirePump, host_tax_histogram
         from ..tpu.backend import MultiSessionDeviceCore
 
@@ -415,6 +457,24 @@ class SessionHost:
         self.drive_failure_limit = drive_failure_limit
         self.shed_after_stall_ticks = shed_after_stall_ticks
         self.strict_invariants = strict_invariants
+        # durable input journal (docs/DESIGN.md "Durable recovery")
+        self._journal_dir = journal_dir
+        self._journal_fsync_every = journal_fsync_every
+        self._journal_segment_bytes = journal_segment_bytes
+        self.journal_lanes_degraded = 0
+        if journal_dir is not None:
+            # instruments exist from construction (the exporter
+            # convention), and the directory exists before the first
+            # lane attaches mid-tick
+            from ..journal import metrics as _jm
+
+            _jm.journal_rows_total()
+            _jm.journal_bytes_total()
+            _jm.journal_segments_total()
+            _jm.journal_fsyncs_total()
+            _jm.journal_stalls_total()
+            _jm.journal_corrupt_segments_total()
+            os.makedirs(journal_dir, exist_ok=True)
         self._quarantines: List[SlotPoisoned] = []
         self.quarantines_total = 0
         self.device_faults = 0
@@ -724,10 +784,12 @@ class SessionHost:
             self._free_slots.append(slot)
             raise
         self.device.reset_slot(slot)
-        self._commit_lane(
+        lane = self._commit_lane(
             session, key, slot, kind, n_players, local_handles,
             max_prediction, 0,
         )
+        if self._journal_dir is not None and lane.kind == "p2p":
+            self.attach_journal(key)
         if GLOBAL_TELEMETRY.enabled:
             GLOBAL_TELEMETRY.record(
                 "host_session_attached", key=str(key), slot=slot
@@ -775,6 +837,8 @@ class SessionHost:
             max_prediction, current_frame,
         )
         lane.pending_inputs = set(pending_inputs)
+        if self._journal_dir is not None and lane.kind == "p2p":
+            self.attach_journal(key)
         if GLOBAL_TELEMETRY.enabled:
             GLOBAL_TELEMETRY.record(
                 "host_session_adopted", key=str(key), slot=claimed,
@@ -794,6 +858,17 @@ class SessionHost:
         lane = self._lanes.pop(key, None)
         if lane is None:
             raise InvalidRequest(f"unknown host key {key!r}")
+        if lane.journal is not None:
+            # final frontier drain + fsync: a detach (migration export,
+            # eviction, quarantine) must not strand confirmed rows in
+            # the recorder
+            try:
+                self._pump_journal_lane(lane)
+                if lane.journal is not None:
+                    lane.journal.writer.close()
+            except (JournalError, OSError):
+                pass
+            lane.journal = None
         if lane.queued_since_tick is not None or lane.rows:
             try:
                 self._ready.remove(key)
@@ -985,6 +1060,12 @@ class SessionHost:
                 lane.pending_inputs.clear()
                 lane.ticks_advanced += 1
                 lane.last_activity_ms = self.clock.now_ms()
+                if lane.journal is not None:
+                    # pure observer: the tap tracks the same ordered
+                    # request stream the backend consumes, BEFORE any
+                    # staging can fail — last-write-wins rollback
+                    # corrections included
+                    lane.journal.recorder.observe(requests)
                 try:
                     self._stage(lane, requests)
                 except Exception as exc:
@@ -1027,6 +1108,12 @@ class SessionHost:
             self._m_tax_parse.observe(
                 (_time.perf_counter() - t_parse) * 1000.0
             )
+
+        # 2b. durable journal: drain each journaled lane's confirmed
+        # frontier into its segment WAL (a host-side pure observer —
+        # rows below the frontier are final by the protocol, so the
+        # journal never records a value a rollback could still change)
+        self._pump_journals()
 
         # 3. dispatch megabatches under the device-window budget (env
         # blocks still dispatch synchronously; in resident mode session
@@ -1311,6 +1398,205 @@ class SessionHost:
         call (the fleet agent polls this every step)."""
         out, self._quarantines = self._quarantines, []
         return out
+
+    # ------------------------------------------------------------------
+    # durable input journal (docs/DESIGN.md "Durable recovery")
+    # ------------------------------------------------------------------
+
+    def attach_journal(self, key: Any, path: Optional[str] = None, *,
+                       meta: Optional[dict] = None,
+                       fsync_every: Optional[int] = None,
+                       segment_bytes: Optional[int] = None) -> Optional[str]:
+        """Journal one hosted p2p lane's confirmed input rows at `path`
+        (default `journal_dir/lane<key>`). Resumes an existing journal
+        at the same path — the writer's open-time scan truncates a torn
+        tail and retains the recorded rows, so a restore's redrive is
+        VERIFIED against the durable bytes instead of re-appended.
+        Returns the journal path, or None when the journal could not be
+        opened (corrupt beyond continuity): the lane then serves
+        unjournaled — durability degrades, serving never does."""
+        from ..journal.wal import JournalWriter
+        from ..utils.replay import InputRecorder
+
+        lane = self._lanes[key]
+        if lane.kind != "p2p":
+            raise InvalidRequest(
+                f"only p2p lanes journal (lane {key!r} is {lane.kind})"
+            )
+        if lane.journal is not None:
+            raise InvalidRequest(f"lane {key!r} already journals")
+        if path is None:
+            if self._journal_dir is None:
+                raise InvalidRequest(
+                    "attach_journal needs a path on a host without "
+                    "journal_dir"
+                )
+            path = os.path.join(self._journal_dir, f"lane{key}")
+        base_meta = {
+            "kind": "ggrs-input-journal",
+            "game_cls": type(self.game).__name__,
+            "num_players": lane.num_players,
+            "input_size": self.game.input_size,
+            "num_entities": getattr(self.game, "num_entities", None),
+            **(meta or {}),
+        }
+        try:
+            writer = JournalWriter(
+                path,
+                meta=base_meta,
+                segment_bytes=(
+                    segment_bytes
+                    if segment_bytes is not None
+                    else self._journal_segment_bytes
+                ),
+                fsync_every=(
+                    fsync_every
+                    if fsync_every is not None
+                    else self._journal_fsync_every
+                ),
+            )
+        except (JournalError, OSError) as exc:
+            # raw OSError covers the writer's own disk touches
+            # (makedirs, scan repair, segment open) — an unwritable
+            # disk at attach time must degrade, not fail admission with
+            # the lane already committed
+            self._journal_fault(lane, exc, stage="open")
+            return None
+        lane.journal = _JournalTap(
+            writer,
+            InputRecorder(
+                base_frame=writer.next_frame,
+                # anchor unanchored (sparse-saving) first segments at
+                # the lane's actual frame, not 0 — a mid-match adopt
+                # would otherwise misfile rows
+                next_frame=lane.current_frame,
+            ),
+            path,
+        )
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "journal_attached", key=str(key), path=path,
+                resumed_frames=writer.next_frame,
+            )
+        return path
+
+    def journal_frontier(self, key: Any) -> Optional[int]:
+        """Frames durably journaled for a lane (None when unjournaled)
+        — what the fleet heartbeat reports per match."""
+        tap = self._lanes[key].journal
+        return tap.writer.next_frame if tap is not None else None
+
+    def journal_tail(self, key: Any) -> Optional[dict]:
+        """Final-drain the lane's journal, then snapshot the rows NOT
+        yet durable (played-but-unconfirmed at this instant) — a
+        migration ticket carries them so the destination's recorder
+        covers the hole between the durable frontier and the first
+        frame the destination will observe itself."""
+        lane = self._lanes[key]
+        if lane.journal is None:
+            return None
+        self._pump_journal_lane(lane)
+        if lane.journal is None:  # the final drain degraded it
+            return None
+        return lane.journal.recorder.pending_rows()
+
+    def seed_journal_tail(self, key: Any, rows: dict) -> None:
+        """Pre-observe a source recorder's pending rows into an adopted
+        lane's tap (see journal_tail)."""
+        tap = self._lanes[key].journal
+        if tap is not None and rows:
+            tap.recorder.seed_rows(rows)
+
+    def _journal_fault(self, lane: _Lane, exc: Exception, *,
+                       stage: str) -> None:
+        """DEGRADE-TO-UNJOURNALED: the journal is a durability feature,
+        never a liveness dependency — a refused append (ENOSPC), a
+        corrupt resume or a redrive/journal divergence detaches the
+        TAP, trips a typed invariant for the operator, and the lane
+        keeps serving."""
+        from ..journal.metrics import journal_stalls_total
+
+        tap = lane.journal
+        lane.journal = None
+        if tap is not None:
+            try:
+                tap.writer.close()
+            except (JournalError, OSError):
+                pass
+        self.journal_lanes_degraded += 1
+        if isinstance(exc, (JournalStalled, OSError)):
+            # unconditional like the wal.py counters: the disk-refusal
+            # signal must not depend on the telemetry toggle
+            journal_stalls_total().inc()
+        self._trip_invariant(
+            "journal_degraded", key=lane.key, frame=lane.current_frame,
+            info=(
+                f"lane {lane.key!r} journal degraded at {stage}: "
+                f"{type(exc).__name__}: {exc}"
+            ),
+        )
+
+    def _pump_journal_lane(self, lane: _Lane) -> None:
+        """Drain one lane's confirmed frontier into its journal: rows
+        the recorder re-observed below the resume watermark verify
+        against the durable bytes (the restore-redrive overlap), fresh
+        confirmed rows append. Every failure path degrades typed."""
+        tap = lane.journal
+        if tap is None:
+            return
+        sl = getattr(lane.session, "sync_layer", None)
+        if sl is None:
+            return
+        # the AS-PLAYED confirmed frontier: sync_layer raises
+        # last_confirmed_frame only inside advance_frame, AFTER the
+        # rollback pass corrected every misprediction below it (its
+        # discard assert is exactly "first_incorrect >= frame"), so
+        # rows < watermark hold truth under the recorder's
+        # last-write-wins rule. The LIVE min-over-peers frontier is
+        # deliberately not used: an input can arrive without ever being
+        # re-played (the tail of a match), leaving the recorder's row a
+        # stale prediction — journaling it would diverge across peers.
+        confirmed = sl.last_confirmed_frame - 1
+        if confirmed < 0:
+            return
+        rec = tap.recorder
+        rec.confirm_through(confirmed)
+        try:
+            if self.fault_seam is not None and hasattr(
+                self.fault_seam, "before_journal_append"
+            ):
+                self.fault_seam.before_journal_append(tap.path)
+            for f, inp, st in rec.take_stale(confirmed):
+                tap.writer.verify_row(f, inp, canonical_statuses(st))
+            drained = rec.drain_confirmed()
+            if drained is not None:
+                start, inputs, st = drained
+                tap.writer.append_rows(
+                    start, inputs, canonical_statuses(st)
+                )
+        except (JournalError, OSError, InvalidRequest) as exc:
+            # InvalidRequest = a frame gap the writer refused (an
+            # adoption hole no ticket tail covered): durability for
+            # this lane is over, serving is not
+            self._journal_fault(lane, exc, stage="append")
+
+    def _pump_journals(self) -> None:
+        for lane in self._lanes.values():
+            if lane.journal is not None:
+                self._pump_journal_lane(lane)
+
+    def flush_journals(self) -> None:
+        """Drain every journaled lane's frontier and fsync the active
+        segments — the checkpoint/drain/export durability point."""
+        for lane in list(self._lanes.values()):
+            self._pump_journal_lane(lane)
+            tap = lane.journal
+            if tap is None:
+                continue
+            try:
+                tap.writer.sync()
+            except (JournalError, OSError) as exc:
+                self._journal_fault(lane, exc, stage="sync")
 
     def _launch_drafts(self) -> None:
         """Collect every starved p2p lane that can be drafted this tick
@@ -2209,6 +2495,7 @@ class SessionHost:
         The periodic crash-recovery story — a kill→restore rebuilds a
         host from the latest checkpoint (serve/migrate.HostGroup)."""
         self._flush_ready("checkpoint")
+        self.flush_journals()
         self._save_checkpoint(path)
         if GLOBAL_TELEMETRY.enabled:
             GLOBAL_TELEMETRY.record(
@@ -2225,6 +2512,7 @@ class SessionHost:
         if the flush cannot make progress."""
         self._draining = True
         self._flush_ready("drain")
+        self.flush_journals()
         if checkpoint_path is not None:
             self._save_checkpoint(checkpoint_path)
         self._drained = True
@@ -2293,6 +2581,42 @@ class SessionHost:
             "harvest_timeouts": self.harvest_timeouts,
             "invariant_trips": len(self.invariant_trips),
             "shedding_admission": self._shed_admission,
+            # durable input journal (absent when no lane journals, so
+            # old readers stay compatible)
+            **(
+                {
+                    "journal": {
+                        "lanes": sum(
+                            1
+                            for lane in self._lanes.values()
+                            if lane.journal is not None
+                        ),
+                        "frames_journaled": sum(
+                            lane.journal.writer.frames_journaled
+                            for lane in self._lanes.values()
+                            if lane.journal is not None
+                        ),
+                        "bytes_written": sum(
+                            lane.journal.writer.bytes_written
+                            for lane in self._lanes.values()
+                            if lane.journal is not None
+                        ),
+                        "fsyncs": sum(
+                            lane.journal.writer.fsyncs
+                            for lane in self._lanes.values()
+                            if lane.journal is not None
+                        ),
+                        "degraded": self.journal_lanes_degraded,
+                    }
+                }
+                if self._journal_dir is not None
+                or self.journal_lanes_degraded
+                or any(
+                    lane.journal is not None
+                    for lane in self._lanes.values()
+                )
+                else {}
+            ),
             **(
                 {
                     "sdc_audit": {

@@ -42,10 +42,23 @@ class InputRecorder:
         recorder.save("match.npz")
     """
 
-    def __init__(self):
+    def __init__(self, base_frame: Frame = 0,
+                 next_frame: Optional[Frame] = None):
+        """`base_frame` > 0 resumes recording mid-lineage: frames below
+        it are treated as already drained (the journal tap's resume
+        path, where the durable store already holds them) — they are
+        still observed (a restore's redrive re-advances them) but
+        surface through `take_stale` for verification instead of
+        `drain_confirmed`. `next_frame` anchors the first observed
+        segment when it carries no Save/Load (sparse-saving ticks): a
+        recorder attached to a MID-MATCH session must anchor at that
+        session's current frame, or an unanchored first tick would
+        misfile its rows at frame 0."""
         self._rows: Dict[Frame, Tuple[np.ndarray, np.ndarray]] = {}
         self._confirmed: Frame = -1
-        self._next_frame: Frame = 0  # O(1) anchor for save/load-less ticks
+        self._drained: Frame = base_frame  # frames below: freed/persisted
+        # O(1) anchor for save/load-less ticks
+        self._next_frame: Frame = next_frame if next_frame is not None else 0
 
     def observe(self, requests: List[Any]) -> None:
         """Track every AdvanceFrame's inputs; a rollback's corrected
@@ -83,21 +96,110 @@ class InputRecorder:
 
     @property
     def confirmed_frames(self) -> int:
-        """Number of leading frames that are final."""
-        n = 0
+        """The confirmed-final frontier: frames [0, n) are final. Rows
+        below `drained_through` may already be freed (drain_confirmed);
+        the count remains ABSOLUTE, so undrained callers see the
+        original semantics unchanged."""
+        n = self._drained
         while n <= self._confirmed and n in self._rows:
             n += 1
         return n
 
+    @property
+    def drained_through(self) -> Frame:
+        """Frames below this were handed to drain_confirmed (or declared
+        pre-persisted via base_frame) and freed."""
+        return self._drained
+
     def confirmed_script(self) -> Tuple[np.ndarray, np.ndarray]:
         """(inputs u8[F, P, I], statuses i32[F, P]) for the confirmed
-        prefix — the replayable recording."""
+        UNDRAINED tail (the whole prefix when nothing was drained) —
+        the replayable recording."""
         n = self.confirmed_frames
-        if n == 0:
+        if n <= self._drained:
             raise ValueError("nothing confirmed yet")
-        inputs = np.stack([self._rows[f][0] for f in range(n)])
-        statuses = np.stack([self._rows[f][1] for f in range(n)])
+        frames = range(self._drained, n)
+        inputs = np.stack([self._rows[f][0] for f in frames])
+        statuses = np.stack([self._rows[f][1] for f in frames])
         return inputs, statuses
+
+    def drain_confirmed(
+        self,
+    ) -> Optional[Tuple[Frame, np.ndarray, np.ndarray]]:
+        """Hand over the confirmed rows not yet drained and FREE them —
+        the journal tap's cadence call, which is what keeps a
+        match-long recording from accumulating every row in memory
+        (the rows live on in the durable store instead). Returns
+        (start_frame, inputs u8[F, P, I], statuses i32[F, P]) or None
+        when the frontier hasn't moved. `confirmed_script()` stays
+        correct for the undrained tail.
+
+        Leading-gap re-anchor: a MID-MATCH adopted session never
+        observes the frames its previous host played, yet those frames
+        are already final — a drain anchored below its first observed
+        row would wait forever while rows pile up. A final-but-missing
+        row at the anchor can never be observed anymore (observation
+        precedes confirmation on any path that still runs), so the
+        anchor jumps to the first observed final row."""
+        n = self.confirmed_frames
+        if n <= self._drained and self._drained not in self._rows:
+            candidates = [
+                f for f in self._rows
+                if f >= self._drained and f <= self._confirmed
+            ]
+            if candidates:
+                self._drained = min(candidates)
+                n = self.confirmed_frames
+        if n <= self._drained:
+            return None
+        start = self._drained
+        frames = range(start, n)
+        inputs = np.stack([self._rows[f][0] for f in frames])
+        statuses = np.stack([self._rows[f][1] for f in frames])
+        for f in frames:
+            del self._rows[f]
+        self._drained = n
+        return start, inputs, statuses
+
+    def pending_rows(self) -> Dict[Frame, Tuple[np.ndarray, np.ndarray]]:
+        """Snapshot of every undrained observed row (confirmed tail AND
+        still-mutable predictions) — what a migration ticket carries so
+        the receiving host's recorder can keep journaling across the
+        hole between the source's durable frontier and the first frame
+        the destination will itself observe."""
+        return {
+            f: (inp.copy(), st.copy())
+            for f, (inp, st) in self._rows.items()
+        }
+
+    def seed_rows(
+        self, rows: Dict[Frame, Tuple[np.ndarray, np.ndarray]]
+    ) -> None:
+        """Adopt a source recorder's pending rows (see pending_rows) —
+        later observations overwrite seeded values under the same
+        last-write-wins rule, so a rollback correcting a seeded
+        prediction wins exactly as it would have on the source."""
+        for f, (inp, st) in rows.items():
+            if f not in self._rows:
+                self._rows[f] = (
+                    np.asarray(inp, dtype=np.uint8),
+                    np.asarray(st, dtype=np.int32),
+                )
+            self._next_frame = max(self._next_frame, f + 1)
+
+    def take_stale(
+        self, through: Frame
+    ) -> List[Tuple[Frame, np.ndarray, np.ndarray]]:
+        """Remove and return re-observed rows BELOW the drained
+        watermark that are confirmed-final again (frame <= `through`):
+        a restore-from-checkpoint redrives frames the journal already
+        holds, and the tap verifies those against the durable bytes
+        instead of re-appending them. Rows above `through` stay — they
+        may still be predictions a rollback will correct."""
+        stale = sorted(
+            f for f in self._rows if f < self._drained and f <= through
+        )
+        return [(f, *self._rows.pop(f)) for f in stale]
 
     def save(self, path: str, game=None) -> None:
         """Persist the confirmed prefix; `game` stamps identity fields so

@@ -84,6 +84,17 @@
 #   exporters (scripts/fault_smoke.py, CPU jax, <1 min). Also runs in
 #   the default flow (step 2f): device fault domains are a correctness
 #   surface, not an optional extra.
+#   --journal-smoke drives a deterministic in-process fleet with
+#   per-match durable input journaling on through TOTAL host loss —
+#   one agent frozen (the SIGKILL-equivalent) AND its checkpoint
+#   ticket destroyed — gated on the failover ladder's journal-only
+#   tier rebuilding every victim match from genesis (batched megabatch
+#   redrive), zero desyncs, bitwise checksum-history + state-digest
+#   parity vs the unfaulted twin, typed quarantine of an injected
+#   segment corruption, and the journal/recovery instruments through
+#   BOTH exporters (scripts/journal_smoke.py, CPU jax, ~1 min). Also
+#   runs in the default flow (step 2g): durability is a correctness
+#   surface, not an optional extra.
 #   --lint runs the determinism/trace/fence/wire static-analysis gate
 #   (python -m ggrs_tpu.analysis, pure AST, no jax, seconds) against
 #   analysis/baseline.toml, then the retrace-sanitizer smoke
@@ -182,6 +193,12 @@ if [ "${1:-}" = "--fault-smoke" ]; then
   exit $?
 fi
 
+if [ "${1:-}" = "--journal-smoke" ]; then
+  echo "== journal smoke (durable journal + journal-only point-in-time recovery) =="
+  JAX_PLATFORMS=cpu python scripts/journal_smoke.py
+  exit $?
+fi
+
 if [ "${1:-}" = "--spec-smoke" ]; then
   echo "== spec smoke (speculative bubble-filling, single-device + sharded) =="
   GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
@@ -218,6 +235,9 @@ GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/resident_smoke.py
 
 echo "== [2f/5] fault smoke (device fault domains end to end) =="
 GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/fault_smoke.py
+
+echo "== [2g/5] journal smoke (durable journal + journal-only recovery) =="
+JAX_PLATFORMS=cpu python scripts/journal_smoke.py
 
 if [ "$FAST" = "0" ]; then
   echo "== [3/5] UBSAN build + native/wire tests =="

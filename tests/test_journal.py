@@ -1,0 +1,758 @@
+"""Durable input journal + point-in-time recovery.
+
+The durability contract under test: with journaling enabled, losing the
+ENTIRE host — process, RAM, checkpoint ticket — loses zero confirmed
+frames, because the journal's crash-consistent confirmed-row log plus
+the determinism contract (simulation = pure function of (initial state,
+confirmed inputs)) rebuild the match bit-exactly by resimulation. The
+storm half: SIGKILL at any instant (mid-append, mid-rotation) never
+yields a partial record on reopen, injected segment corruption surfaces
+as typed JournalCorrupt with recovery falling to the next ladder tier,
+and a disk refusing appends degrades the lane to unjournaled — never a
+wedged host.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ggrs_tpu.errors import InvalidRequest, JournalCorrupt, JournalStalled
+from ggrs_tpu.journal import (
+    JournalWriter,
+    batch_resim_journals,
+    corrupt_segment,
+    journal_coverage,
+    journal_files,
+    read_journal_script,
+    scan_journal,
+    scripts_from_journal,
+    seed_journal,
+)
+
+PLAYERS = 2
+ENTITIES = 8
+
+
+def _rows(frames, players=PLAYERS, input_size=1, seed=0):
+    rng = np.random.default_rng(seed)
+    inputs = rng.integers(0, 16, size=(frames, players, input_size),
+                          dtype=np.uint8)
+    statuses = np.zeros((frames, players), np.int32)
+    return inputs, statuses
+
+
+# ----------------------------------------------------------------------
+# record framing, rotation, resume
+# ----------------------------------------------------------------------
+
+
+def test_wal_roundtrip_across_rotations(tmp_path):
+    path = str(tmp_path / "j")
+    inputs, statuses = _rows(83)
+    w = JournalWriter(path, meta={"match_id": 7}, segment_bytes=300)
+    rng = np.random.default_rng(1)
+    off = 0
+    while off < 83:
+        n = min(int(rng.integers(1, 7)), 83 - off)
+        assert w.append_rows(off, inputs[off:off + n],
+                             statuses[off:off + n]) == n
+        off += n
+    w.close()
+    assert w.rotations > 2  # the 300-byte budget forced real rotations
+    got_i, got_s, meta = read_journal_script(path)
+    np.testing.assert_array_equal(got_i, inputs)
+    np.testing.assert_array_equal(got_s, statuses)
+    assert meta["match_id"] == 7
+
+    # resume: the writer picks up at the durable frontier, verifies the
+    # redriven overlap bit-for-bit, appends fresh rows past it
+    w2 = JournalWriter(path, segment_bytes=300)
+    assert w2.next_frame == 83
+    more_i, more_s = _rows(6, seed=9)
+    w2.append_rows(80, np.concatenate([inputs[80:], more_i[:3]]),
+                   np.concatenate([statuses[80:], more_s[:3]]))
+    assert w2.verified_rows == 3 and w2.next_frame == 86
+    w2.close()
+    got_i, _, _ = read_journal_script(path)
+    assert got_i.shape[0] == 86
+
+    # a diverging overlap is typed corruption, not silent adoption
+    w3 = JournalWriter(path, segment_bytes=300)
+    bad = inputs[70:72].copy()
+    bad[0, 0, 0] ^= 1
+    with pytest.raises(JournalCorrupt):
+        w3.append_rows(70, bad, statuses[70:72])
+    w3.close()
+
+    # a gap above the frontier can never silently enter the journal
+    w4 = JournalWriter(path, segment_bytes=300)
+    with pytest.raises(InvalidRequest):
+        w4.append_rows(90, more_i, more_s)
+    w4.close()
+
+
+def test_torn_tail_truncated_never_a_partial_record(tmp_path):
+    path = str(tmp_path / "j")
+    inputs, statuses = _rows(20)
+    w = JournalWriter(path)
+    w.append_rows(0, inputs, statuses)
+    w.close()
+    seg = sorted(
+        n for n in os.listdir(path) if n.endswith(".wal")
+    )[-1]
+    # crash residue: a torn half-record at the tail
+    with open(os.path.join(path, seg), "ab") as f:
+        f.write(b"\xa7\x02\x10\x00\x00\x00partial")
+    scan = scan_journal(path, repair=True)
+    assert scan.next_frame == 20 and scan.torn_bytes > 0
+    got_i, _ = scan.script()
+    np.testing.assert_array_equal(got_i, inputs)
+    # the repair truncated in place: a fresh writer appends cleanly
+    w2 = JournalWriter(path)
+    assert w2.next_frame == 20
+    w2.append_rows(20, *_rows(3, seed=5))
+    w2.close()
+    assert read_journal_script(path)[0].shape[0] == 23
+
+
+def test_corrupt_segment_quarantined_typed(tmp_path):
+    path = str(tmp_path / "j")
+    inputs, statuses = _rows(60)
+    w = JournalWriter(path, segment_bytes=250)
+    for f in range(60):
+        w.append_rows(f, inputs[f:f + 1], statuses[f:f + 1])
+    w.close()
+    names = sorted(n for n in os.listdir(path) if n.endswith(".wal"))
+    assert len(names) >= 3
+    corrupt_segment(path, segment=1)
+    scan = scan_journal(path, repair=True)
+    # typed verdict, quarantined file, usable contiguous prefix (which
+    # keeps the corrupt segment's CRC-valid LEADING records)
+    assert scan.corrupt and isinstance(scan.corrupt[0], JournalCorrupt)
+    assert scan.gap
+    assert any(n.endswith(".corrupt") for n in os.listdir(path))
+    got_i, _ = scan.script()
+    assert 0 < got_i.shape[0] < 60
+    np.testing.assert_array_equal(got_i, inputs[: got_i.shape[0]])
+    # a writer refuses to append over the gap — typed, not a crash
+    with pytest.raises(JournalCorrupt):
+        JournalWriter(path)
+
+
+def test_final_segment_mid_corruption_quarantines_not_truncates(tmp_path):
+    """An SDC flip in the MIDDLE of the active segment (valid records
+    still follow it) is corruption, not crash tearing: the scan must
+    quarantine typed instead of silently truncating acknowledged
+    durable rows — only a flip with nothing valid after it is
+    indistinguishable from a tear."""
+    path = str(tmp_path / "j")
+    inputs, statuses = _rows(30)
+    w = JournalWriter(path)  # one big segment: everything is "final"
+    for f in range(30):
+        w.append_rows(f, inputs[f:f + 1], statuses[f:f + 1])
+    w.close()
+    corrupt_segment(path, segment=0)  # mid-file: records follow
+    scan = scan_journal(path, repair=True)
+    assert scan.corrupt and isinstance(scan.corrupt[0], JournalCorrupt)
+    assert any(n.endswith(".corrupt") for n in os.listdir(path))
+    # the valid leading rows are still recovered by THIS scan
+    got_i, _ = scan.script()
+    assert 0 < got_i.shape[0] < 30
+    np.testing.assert_array_equal(got_i, inputs[: got_i.shape[0]])
+
+
+def test_resume_refuses_identity_mismatch(tmp_path):
+    """The self-describing META is checked at resume: a key collision
+    onto another match's journal refuses typed instead of splicing two
+    lineages (or spuriously failing verify later)."""
+    path = str(tmp_path / "j")
+    w = JournalWriter(path, meta={"match_id": 7, "num_players": PLAYERS})
+    w.append_rows(0, *_rows(5))
+    w.close()
+    with pytest.raises(JournalCorrupt):
+        JournalWriter(path, meta={"match_id": 8})
+    # same identity resumes fine
+    w2 = JournalWriter(path, meta={"match_id": 7})
+    assert w2.next_frame == 5
+    w2.close()
+
+
+def test_seize_and_seed_roundtrip(tmp_path):
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    inputs, statuses = _rows(31)
+    w = JournalWriter(src, meta={"match_id": 3}, segment_bytes=200)
+    w.append_rows(0, inputs, statuses)
+    w.close()
+    files = journal_files(src)
+    assert files
+    seed_journal(dst, files)
+    got_i, got_s, meta = read_journal_script(dst)
+    np.testing.assert_array_equal(got_i, inputs)
+    assert meta["match_id"] == 3
+    with pytest.raises(InvalidRequest):
+        seed_journal(dst, {"../escape": b"x"})
+    # a re-seed CLEARS stale residue first: a previous hosting's
+    # higher-index segment must not splice into the seized history
+    with open(os.path.join(dst, "seg-000000ff.wal"), "wb") as f:
+        f.write(b"stale lineage")
+    seed_journal(dst, files)
+    assert not os.path.exists(os.path.join(dst, "seg-000000ff.wal"))
+    got_i2, _, _ = read_journal_script(dst)
+    np.testing.assert_array_equal(got_i2, inputs)
+
+
+# ----------------------------------------------------------------------
+# satellite: InputRecorder drain API — bounded memory, correct tail
+# ----------------------------------------------------------------------
+
+
+def test_recorder_drain_frees_rows_keeps_tail_correct():
+    from ggrs_tpu.types import AdvanceFrame, InputStatus
+    from ggrs_tpu.utils.replay import InputRecorder
+
+    def adv(v):
+        return AdvanceFrame(
+            inputs=[(bytes([v]), InputStatus.CONFIRMED)] * PLAYERS
+        )
+
+    full = InputRecorder()
+    draining = InputRecorder()
+    drained_rows = []
+    for f in range(40):
+        full.observe([adv(f)])
+        draining.observe([adv(f)])
+        if f and f % 7 == 0:
+            full.confirm_through(f - 3)
+            draining.confirm_through(f - 3)
+            out = draining.drain_confirmed()
+            if out is not None:
+                start, inputs, statuses = out
+                assert start == len(drained_rows)
+                drained_rows.extend(inputs[:, 0, 0].tolist())
+    # memory actually freed: only the undrained tail remains
+    assert len(draining._rows) < len(full._rows)
+    assert draining.drained_through == len(drained_rows) > 0
+    # confirm a little further WITHOUT draining: the undrained tail
+    full.confirm_through(37)
+    draining.confirm_through(37)
+    # absolute frontier identical on both recorders...
+    assert draining.confirmed_frames == full.confirmed_frames
+    # ...and the undrained tail script matches the full recorder's slice
+    f_i, f_s = full.confirmed_script()
+    t_i, t_s = draining.confirmed_script()
+    np.testing.assert_array_equal(t_i, f_i[draining.drained_through:])
+    np.testing.assert_array_equal(t_s, f_s[draining.drained_through:])
+    # drained + tail reassemble the full confirmed prefix exactly
+    assert drained_rows == f_i[: len(drained_rows), 0, 0].tolist()
+
+
+def test_mid_match_adoption_rebases_fresh_journal(tmp_path):
+    """A mid-match adopted lane (migration without carried bytes) never
+    observes the frames its previous host played: the recorder
+    re-anchors its drain at the first observed final row and an EMPTY
+    journal re-bases onto that first append — recording first_frame > 0
+    (tail coverage; the genesis-resim tier refuses it by design)
+    instead of waiting forever while rows pile up."""
+    from ggrs_tpu.types import AdvanceFrame, InputStatus
+    from ggrs_tpu.utils.replay import InputRecorder
+
+    rec = InputRecorder()
+    rec._next_frame = 50  # the adopt point: frames 0..49 played elsewhere
+    for f in range(50, 70):
+        rec.observe([AdvanceFrame(
+            inputs=[(bytes([f % 200]), InputStatus.CONFIRMED)] * PLAYERS
+        )])
+    rec.confirm_through(64)
+    out = rec.drain_confirmed()
+    assert out is not None
+    start, inputs, statuses = out
+    assert start == 50 and inputs.shape[0] == 15
+    path = str(tmp_path / "rebase")
+    w = JournalWriter(path, meta={"match_id": 1})
+    w.append_rows(start, inputs, statuses)
+    assert w.base_frame == 50 and w.next_frame == 65
+    w.close()
+    got_i, _, meta = read_journal_script(path)
+    assert meta["first_frame"] == 50
+    assert got_i.shape[0] == 15
+    # a resumed writer agrees with the rebased base
+    w2 = JournalWriter(path)
+    assert w2.base_frame == 50 and w2.next_frame == 65
+    w2.close()
+
+
+# ----------------------------------------------------------------------
+# satellite: kill-mid-write regression — the real-SIGKILL hammer
+# (test_checkpoint.py's pattern pointed at appends AND rotation)
+# ----------------------------------------------------------------------
+
+
+def test_journal_survives_real_sigkill_mid_append_and_rotation(tmp_path):
+    """A child appends rows in a tight loop with a tiny segment budget
+    (so the kill races appends AND rotations); SIGKILLed at an
+    arbitrary instant, the reopened journal must yield a contiguous,
+    bit-correct prefix of what the child acknowledged — never a
+    partial or corrupted record."""
+    path = str(tmp_path / "hammer")
+    code = (
+        "import sys, numpy as np\n"
+        "sys.path.insert(0, %r)\n"
+        "from ggrs_tpu.journal import JournalWriter\n"
+        "w = JournalWriter(%r, meta={'m': 1}, segment_bytes=256,\n"
+        "                  fsync_every=0)\n"
+        "f = w.next_frame\n"
+        "while True:\n"
+        "    n = 1 + f %% 3\n"
+        "    inp = np.full((n, 2, 1), f %% 251, np.uint8)\n"
+        "    for k in range(n):\n"
+        "        inp[k] = (f + k) %% 251\n"
+        "    st = np.zeros((n, 2), np.int32)\n"
+        "    w.append_rows(f, inp, st)\n"
+        "    f += n\n" % (os.getcwd(), path)
+    )
+    for round_ in range(2):
+        child = subprocess.Popen([sys.executable, "-c", code],
+                                 cwd=os.getcwd())
+        try:
+            deadline = time.monotonic() + 15
+            while not os.path.isdir(path) or not os.listdir(path):
+                assert child.poll() is None, "writer died before writing"
+                assert time.monotonic() < deadline, "writer never wrote"
+                time.sleep(0.01)
+            time.sleep(0.3 + 0.2 * round_)  # let it hammer rotations
+        finally:
+            child.send_signal(signal.SIGKILL)
+            child.wait(timeout=10)
+        scan = scan_journal(path, repair=True)
+        # no corrupt segments — SIGKILL can only tear the TAIL
+        assert scan.corrupt == [] and not scan.gap
+        assert scan.next_frame > 0
+        inputs, statuses = scan.script()
+        # every recovered row holds exactly the value the child wrote
+        np.testing.assert_array_equal(
+            inputs[:, 0, 0],
+            (np.arange(scan.next_frame) % 251).astype(np.uint8),
+        )
+        # round 2 RESUMES over the truncated tail and keeps hammering:
+        # kill-mid-rotation must leave a resumable journal
+
+
+# ----------------------------------------------------------------------
+# the host tap: parity, ENOSPC degrade
+# ----------------------------------------------------------------------
+
+
+def _twin_with_journal(tmp_path, specs, *, mesh=None, name="jr"):
+    from ggrs_tpu.fleet.island import make_game, run_twin
+    from ggrs_tpu.serve.host import SessionHost
+    from ggrs_tpu.utils.clock import FakeClock
+
+    game = make_game(players=PLAYERS, entities=ENTITIES)
+    host = SessionHost(
+        game,
+        max_prediction=8,
+        num_players=PLAYERS,
+        max_sessions=sum(s.players for s in specs),
+        clock=FakeClock(),
+        idle_timeout_ms=0,
+        mesh=mesh,
+        journal_dir=str(tmp_path / name),
+    )
+    islands = run_twin(specs, host=host, game=game)
+    return game, host, islands
+
+
+def _specs(n, *, ticks=60, wan_first=True):
+    from ggrs_tpu.fleet.island import MatchSpec
+
+    return [
+        MatchSpec(match_id=m, players=PLAYERS, ticks=ticks, seed=300 + m,
+                  entities=ENTITIES,
+                  wan={} if (wan_first and m == 0) else None)
+        for m in range(n)
+    ]
+
+
+def test_host_journal_peers_identical_and_resim_parity(tmp_path):
+    """The acceptance triangle on a hosted fleet: every peer of a match
+    journals bit-identical confirmed rows; the journal-derived submit
+    scripts equal what the players actually fed in; and a batched
+    megabatch resimulation from the journal ALONE reproduces the live
+    desync detector's checksum history bit-for-bit."""
+    specs = _specs(2)
+    game, host, islands = _twin_with_journal(tmp_path, specs)
+    jdir = str(tmp_path / "jr")
+    paths = sorted(os.path.join(jdir, n) for n in os.listdir(jdir))
+    assert len(paths) == 4  # every p2p lane journaled
+    scripts = [read_journal_script(p)[:2] for p in paths]
+    # lanes 0/1 = match 0's peers, 2/3 = match 1's (attach order)
+    for a, b in ((0, 1), (2, 3)):
+        n = min(scripts[a][0].shape[0], scripts[b][0].shape[0])
+        assert n > 40
+        np.testing.assert_array_equal(scripts[a][0][:n], scripts[b][0][:n])
+        np.testing.assert_array_equal(scripts[a][1][:n], scripts[b][1][:n])
+    # the delay-shifted submit scripts are exactly the played scripts
+    for m, idx in ((0, 0), (1, 2)):
+        isl = islands[m]
+        derived = scripts_from_journal(
+            scripts[idx][0], input_delay=isl.spec.input_delay,
+            ticks=isl.spec.ticks,
+        )
+        cov = journal_coverage(
+            scripts[idx][0], input_delay=isl.spec.input_delay
+        )
+        assert cov > 40
+        for k, script in derived.items():
+            assert script == isl.scripts[k][: len(script)]
+    # journal-only world rebuild: checksum-history parity vs the live run
+    res = batch_resim_journals(game, [scripts[0], scripts[2]])
+    compared = 0
+    for mi, m in enumerate((0, 1)):
+        for peer, hist in islands[m].histories().items():
+            for f, c in hist.items():
+                if f < res[mi]["frames"]:
+                    assert res[mi]["checksums"][f] == c, (m, peer, f)
+                    compared += 1
+    assert compared >= 8
+    sec = host._host_section()["journal"]
+    assert sec["lanes"] == 4 and sec["frames_journaled"] > 160
+    assert sec["degraded"] == 0
+
+
+def test_sharded_host_journal_matches_single_device(tmp_path):
+    """The tap sits above the device layout: a session-mesh host fed
+    identical traffic journals byte-identical files to the
+    single-device twin's."""
+    from ggrs_tpu.parallel.mesh import make_session_mesh
+
+    specs = _specs(1, ticks=48, wan_first=False)
+    _twin_with_journal(tmp_path, specs, name="single")
+    _twin_with_journal(
+        tmp_path, specs, mesh=make_session_mesh(8), name="sharded"
+    )
+    single = journal_files(str(tmp_path / "single" / "lane0"))
+    sharded = journal_files(str(tmp_path / "sharded" / "lane0"))
+    assert single and sorted(single) == sorted(sharded)
+    for name in single:
+        assert single[name] == sharded[name], name
+
+
+def test_enospc_degrades_lane_to_unjournaled_never_wedges(tmp_path):
+    """The storage tier's ENOSPC arm via the deterministic fault seam:
+    an injected filesystem refusal mid-serve degrades the lane's tap
+    (typed JournalStalled accounted + invariant trip) while the match
+    keeps advancing to completion with zero desyncs."""
+    from ggrs_tpu.fleet.island import (
+        FRAME_MS, MatchIsland, make_game, step_islands,
+    )
+    from ggrs_tpu.obs import GLOBAL_TELEMETRY
+    from ggrs_tpu.serve.faults import FaultInjector, FaultPlan
+    from ggrs_tpu.serve.host import SessionHost
+    from ggrs_tpu.utils.clock import FakeClock
+
+    GLOBAL_TELEMETRY.enabled = True
+    GLOBAL_TELEMETRY.dump_dir = str(tmp_path)  # forensics stay out of cwd
+    try:
+        spec = _specs(1, ticks=60, wan_first=False)[0]
+        game = make_game(players=PLAYERS, entities=ENTITIES)
+        host = SessionHost(
+            game, max_prediction=8, num_players=PLAYERS, max_sessions=2,
+            clock=FakeClock(), idle_timeout_ms=0,
+            journal_dir=str(tmp_path / "jr"),
+        )
+        island = MatchIsland.build(spec)
+        island.attach(host)
+        plan = FaultPlan(3, 40, kinds=("journal_stall",),
+                         events_per_kind=1, start=16)
+        inj = FaultInjector(host, plan).install()
+        for tick in range(1, 900):
+            inj.advance(tick)
+            step_islands(host, [island])
+            host.clock.advance(FRAME_MS)
+            if island.done:
+                break
+        assert island.done and island.desyncs == 0
+        assert inj.fired["journal_stall"] >= 1
+        assert host.journal_lanes_degraded >= 1
+        assert any(
+            t.invariant == "journal_degraded"
+            for t in host.invariant_trips
+        )
+        # the victim lane serves on, unjournaled; at most the other
+        # lane still journals
+        taps = [
+            lane.journal for lane in host._lanes.values()
+            if lane.journal is not None
+        ]
+        assert len(taps) < 2
+        snap = GLOBAL_TELEMETRY.snapshot()
+        prom = GLOBAL_TELEMETRY.prometheus()
+        for name in ("ggrs_journal_stalls_total", "ggrs_journal_rows_total"):
+            assert name in snap["metrics"] and name in prom
+        assert snap["metrics"]["ggrs_journal_stalls_total"]["values"][""] >= 1
+    finally:
+        GLOBAL_TELEMETRY.enabled = False
+        GLOBAL_TELEMETRY.dump_dir = None
+        GLOBAL_TELEMETRY.reset()
+
+
+# ----------------------------------------------------------------------
+# satellite: journal-backed recovery parity through the fleet ladder
+# ----------------------------------------------------------------------
+
+
+def _rig(tmp_path, **kw):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_fleet_control import Rig
+
+    return Rig(tmp_path, **kw)
+
+
+def _kill_totally(rig, victim):
+    """In-process total host loss: freeze the victim's control conn,
+    DESTROY its checkpoint ticket, and stop stepping it (the process-
+    death analog the real-SIGKILL soak runs in test_fleet_process)."""
+    vcore = rig.agents[victim]
+    vcore.partition(120_000)
+    rig.director.hosts[victim].peer.conn.partitioned = True
+    cp = rig.director.hosts[victim].checkpoint
+    if cp and cp.get("path") and os.path.exists(cp["path"]):
+        os.remove(cp["path"])
+    rig.director.hosts[victim].checkpoint = None
+    rig.agents = [a for a in rig.agents if a is not vcore]
+    return vcore
+
+
+def test_journal_only_failover_bitwise_parity(tmp_path):
+    """SIGKILL-equivalent + ticket destruction: recovery has NOTHING
+    but the seized journal, rebuilds the match from genesis through the
+    batched megabatch redrive, and the finished match is bitwise equal
+    (checksum histories + canonical state digests) to the unfaulted
+    twin — zero confirmed frames lost."""
+    from ggrs_tpu.fleet.chaos import compare_with_twin
+    from test_fleet_control import _spec
+
+    rig = _rig(tmp_path, checkpoint_every=6)
+    specs = [_spec(0, seed=500, ticks=160), _spec(1, seed=501, ticks=160)]
+    owners = {s.match_id: rig.director.place_match(s) for s in specs}
+    for _ in range(60):
+        rig.pump(1)
+    victim = owners[0]
+    _kill_totally(rig, victim)
+    for _ in range(300):
+        rig.pump(1)
+        if rig.director.hosts[victim].state == "dead":
+            break
+    fo = rig.director.failovers[-1]
+    victims_matches = [m for m, h in owners.items() if h == victim]
+    assert fo["tiers"] == {str(m): "journal" for m in victims_matches}
+    assert fo["lost"] == []
+    assert fo.get("journal_replayed_frames", 0) > 20
+    rig.drive_done(cores=rig.agents)
+    reports = rig.director.collect_reports()
+    parity = compare_with_twin(specs, reports, set(victims_matches))
+    assert parity["clean_exact"] and parity["faulted_exact"], parity
+    # the dead host's matches are PLACED again, on a survivor
+    for m in victims_matches:
+        rec = rig.director.matches[m]
+        assert rec["state"] == "placed" and rec["host"] != victim
+
+
+def test_ticket_plus_journal_tier_verifies_tail(tmp_path):
+    """Tier 2: the ticket survives, so failover imports it WITH the
+    seized journal folded in — the survivor's resumed redrive is then
+    verified row-for-row against the journaled tail (verified_rows on
+    the resumed writer), and parity still holds."""
+    from ggrs_tpu.fleet.chaos import compare_with_twin
+    from test_fleet_control import _spec
+
+    rig = _rig(tmp_path, checkpoint_every=6)
+    specs = [_spec(0, seed=700, ticks=160), _spec(1, seed=701, ticks=160)]
+    owners = {s.match_id: rig.director.place_match(s) for s in specs}
+    for _ in range(60):
+        rig.pump(1)
+    victim = owners[0]
+    vcore = rig.agents[victim]
+    vcore.partition(120_000)
+    rig.director.hosts[victim].peer.conn.partitioned = True
+    rig.agents = [a for a in rig.agents if a is not vcore]
+    for _ in range(300):
+        rig.pump(1)
+        if rig.director.hosts[victim].state == "dead":
+            break
+    fo = rig.director.failovers[-1]
+    victims_matches = [m for m, h in owners.items() if h == victim]
+    assert fo["tiers"] == {
+        str(m): "ticket+journal" for m in victims_matches
+    }
+    # restore landed at the exact checkpoint frame (the ticket tier's
+    # original guarantee, unchanged by the journal fold-in)
+    for mid, frames in fo["restored"].items():
+        assert fo["checkpoint_frames"][mid] == frames
+    surv = rig.agents[0]
+    rig.drive_done(cores=[surv])
+    # the survivor's resumed writer verified the redriven tail
+    verified = sum(
+        lane.journal.writer.verified_rows
+        for lane in surv.host._lanes.values()
+        if lane.journal is not None
+    )
+    assert verified > 0
+    reports = rig.director.collect_reports()
+    parity = compare_with_twin(specs, reports, set(victims_matches))
+    assert parity["clean_exact"] and parity["faulted_exact"], parity
+
+
+def test_journal_rebuild_spills_to_a_survivor_with_room(tmp_path):
+    """Match-granular fall-through on the journal tier: when the
+    least-loaded survivor is FULL, the rebuild lands on the next one
+    instead of marking the match lost."""
+    from test_fleet_control import _spec
+
+    rig = _rig(tmp_path, n_agents=3, max_sessions=4, checkpoint_every=6)
+    # hosts 0/1/2 get one match each, then m3 lands on host 0 (lowest id
+    # among least-loaded) — the victim owns TWO matches while each
+    # survivor has room for exactly ONE more
+    specs = [_spec(m, seed=21 + m, ticks=160) for m in range(4)]
+    owners = {s.match_id: rig.director.place_match(s) for s in specs}
+    assert owners == {0: 0, 1: 1, 2: 2, 3: 0}
+    for _ in range(40):
+        rig.pump(1)
+    _kill_totally(rig, 0)
+    for _ in range(400):
+        rig.pump(1)
+        if rig.director.hosts[0].state == "dead":
+            break
+    fo = rig.director.failovers[-1]
+    # one rebuild per survivor: the first call rebuilds what fits and
+    # reports the rest failed (HostFull, per-match isolation); the
+    # remaining match falls through to the other survivor
+    assert fo["tiers"] == {"0": "journal", "3": "journal"}
+    assert fo["lost"] == []
+    assert sorted(fo["restored_on_journal"]) == [1, 2]
+    placed_on = {
+        m: rig.director.matches[m]["host"] for m in (0, 3)
+    }
+    assert sorted(placed_on.values()) == [1, 2]
+
+
+@pytest.mark.slow  # the fast single-kill arms above pin each tier;
+# this composes migration (journal rides the ticket) + total loss
+def test_migrated_journal_recovers_on_third_host(tmp_path):
+    """The journal bytes ride migration tickets: migrate a match, then
+    totally lose the DESTINATION — the journal seized there still
+    covers genesis, so tier-3 recovery on a third host stays bitwise
+    exact."""
+    from ggrs_tpu.fleet.chaos import compare_with_twin
+    from test_fleet_control import _spec
+
+    rig = _rig(tmp_path, n_agents=3, checkpoint_every=6)
+    spec = _spec(0, seed=900, ticks=160)
+    src = rig.director.place_match(spec)
+    for _ in range(40):
+        rig.pump(1)
+    dst = (src + 1) % 3
+    rig.director.migrate_match(0, dst)
+    # destination journals from GENESIS: the bytes moved with the ticket
+    dcore = next(
+        a for a in rig.agents if a.host_id == dst
+    )
+    key = dcore._island_journal[0]
+    w = dcore.host._lanes[key].journal.writer
+    assert w.base_frame == 0 and w.next_frame > 10
+    for _ in range(30):
+        rig.pump(1)
+    _kill_totally(rig, dst)
+    for _ in range(300):
+        rig.pump(1)
+        if rig.director.hosts[dst].state == "dead":
+            break
+    fo = rig.director.failovers[-1]
+    assert fo["tiers"] == {"0": "journal"}, fo["tiers"]
+    rig.drive_done(cores=rig.agents)
+    parity = compare_with_twin(
+        [spec], rig.director.collect_reports(), {0}
+    )
+    assert parity["clean_exact"] and parity["faulted_exact"], parity
+
+
+@pytest.mark.parametrize("segment", [1, 0])
+def test_corrupt_seized_journal_typed_fallback(tmp_path, segment):
+    """Storm composition: ticket destroyed AND a seized-journal segment
+    corrupted. A MIDDLE segment quarantines typed and recovery still
+    rebuilds from the surviving genesis prefix (shorter, but bitwise on
+    the unfaulted-twin contract); the FIRST segment takes genesis with
+    it, so the match is recorded LOST — typed, never a crashed director
+    or agent."""
+    from ggrs_tpu.fleet.chaos import compare_with_twin
+    from test_fleet_control import _spec
+
+    rig = _rig(tmp_path, checkpoint_every=6)
+    for core in rig.agents:
+        core.journal_segment_bytes = 300  # several segments per match
+    spec = _spec(0, seed=333, ticks=160)
+    victim = rig.director.place_match(spec)
+    for _ in range(80):
+        rig.pump(1)
+    vcore = [a for a in rig.agents if a.host_id == victim][0]
+    jpath = vcore._journal_path(0)
+    _kill_totally(rig, victim)
+    names = sorted(n for n in os.listdir(jpath) if n.endswith(".wal"))
+    assert len(names) >= 3  # the corruption target is NON-final
+    # segment 0 is hit INSIDE its META record: no valid leading rows
+    # survive, so genesis coverage is truly gone (a flip past the META
+    # leaves a salvageable genesis prefix — scan keeps valid leading
+    # records of a corrupt segment by design)
+    corrupt_segment(jpath, segment=segment,
+                    offset=8 if segment == 0 else None)
+    for _ in range(300):
+        rig.pump(1)
+        if rig.director.hosts[victim].state == "dead":
+            break
+    fo = rig.director.failovers[-1]
+    surv = rig.agents[0]
+    assert surv.terminated is None
+    if segment == 0:
+        # genesis gone: typed loss, fleet keeps breathing
+        assert fo["lost"] == [0] and fo["tiers"] == {}
+        assert rig.director.matches[0]["state"] == "lost"
+        rig.pump(5)
+    else:
+        # genesis prefix survives the quarantine: journal-tier recovery
+        # still lands, and the finished match is bitwise the twin
+        assert fo["tiers"] == {"0": "journal"} and fo["lost"] == []
+        rig.drive_done(cores=[surv])
+        parity = compare_with_twin(
+            [spec], rig.director.collect_reports(), {0}
+        )
+        assert parity["clean_exact"] and parity["faulted_exact"], parity
+
+
+def test_journal_disabled_agent_falls_back_to_lost(tmp_path):
+    """journal=False agents behave exactly like the pre-journal fleet:
+    a destroyed ticket means a lost match (the old contract), with no
+    journal machinery in the failover path."""
+    from test_fleet_control import _spec
+
+    rig = _rig(tmp_path, checkpoint_every=6)
+    for core in rig.agents:
+        core.journal_enabled = False
+        core.journal_dir = None
+    spec = _spec(0, seed=44, ticks=120)
+    victim = rig.director.place_match(spec)
+    for _ in range(40):
+        rig.pump(1)
+    _kill_totally(rig, victim)
+    for _ in range(300):
+        rig.pump(1)
+        if rig.director.hosts[victim].state == "dead":
+            break
+    fo = rig.director.failovers[-1]
+    assert fo["lost"] == [0] and fo["tiers"] == {}
+    assert fo["journal_matches"] == []
