@@ -2044,6 +2044,157 @@ def bench_spec_bubble(sessions=16, ticks=240, entities=1024,
     }
 
 
+def bench_learned_model(sessions=16, ticks=240, entities=1024,
+                        max_prediction=8, players=4, hole_every=40,
+                        hole_len=14, seed=13, reps=3):
+    """The learning loop's value arm: bench_spec_bubble's starved-fleet
+    traffic shape served by a speculation=True host drafting from a
+    TRAINED ArrayInputModel (fitted, untimed, on a journal of the same
+    seeded traffic) vs an identical host drafting from the online
+    Counter model that learns as it serves. Same seeds, same scheduling,
+    ABBA-interleaved, lift = ratio of medians:
+
+    - learned_spec_hit_rate vs online_spec_hit_rate: does arriving with
+      the traffic's statistics already fitted adopt more drafted frames
+      than learning them during the run;
+    - learned_spec_fps_lift: trained-arm wall-clock session-ticks/sec
+      over the online arm's — what a registry rollout actually buys."""
+    import shutil
+    import tempfile
+
+    from ggrs_tpu.learn import train_from_journal
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.network.sockets import InMemoryNetwork
+    from ggrs_tpu.obs import enable_global_telemetry
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.serve.loadgen import (
+        build_matches,
+        drive_scripted,
+        held_scripts,
+        starve_on_tick,
+        sync_fleet,
+    )
+    from ggrs_tpu.utils.clock import FakeClock
+
+    enable_global_telemetry()
+
+    # --- untimed: journal the traffic shape once, fit the model -------
+    # (small entities: the scripts — the only thing training sees — are
+    # a function of (matches, ticks, seed), not of state size)
+    tmp = tempfile.mkdtemp(prefix="ggrs_learn_bench_")
+    try:
+        clock = FakeClock()
+        net = InMemoryNetwork(
+            clock, latency_ms=20, jitter_ms=5, loss=0.01, seed=seed
+        )
+        host = SessionHost(
+            ExGame(num_players=players, num_entities=16),
+            max_prediction=max_prediction, num_players=players,
+            max_sessions=sessions + players, clock=clock,
+            idle_timeout_ms=0, warmup=True, journal_dir=tmp,
+            max_inflight_rows=4 * (sessions + players),
+        )
+        matches = build_matches(
+            host, net, clock, sessions=sessions,
+            max_prediction=max_prediction, seed=seed,
+        )
+        sync_fleet(host, matches, clock)
+        drive_scripted(
+            host, matches, clock, held_scripts(matches, ticks, seed), ticks
+        )
+        for keys in matches:
+            for k in keys:
+                host.detach(k)  # close every lane's writer
+        # num_players pinned to the host width: the fleet mixes 2/3/4-
+        # player matches, narrower journals pad up in the trainer
+        model, _ = train_from_journal([tmp], seed=seed, num_players=players)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    def run(trained):
+        clock = FakeClock()
+        net = InMemoryNetwork(
+            clock, latency_ms=20, jitter_ms=5, loss=0.01, seed=seed
+        )
+        host = SessionHost(
+            ExGame(num_players=players, num_entities=entities),
+            max_prediction=max_prediction,
+            num_players=players,
+            max_sessions=sessions + players,
+            clock=clock,
+            idle_timeout_ms=0,
+            warmup=True,
+            speculation=True,
+            max_inflight_rows=4 * (sessions + players),
+        )
+        matches = build_matches(
+            host, net, clock, sessions=sessions,
+            max_prediction=max_prediction, seed=seed,
+        )
+        sync_fleet(host, matches, clock)
+        if trained:
+            host.install_input_model(model)
+        scripts = held_scripts(matches, ticks, seed)
+        host.device.block_until_ready()
+        t0 = time.perf_counter()
+        drive_scripted(
+            host, matches, clock, scripts, ticks,
+            on_tick=starve_on_tick(
+                net, matches, hole_every=hole_every, hole_len=hole_len
+            ),
+        )
+        host.device.block_until_ready()
+        dt = time.perf_counter() - t0
+        n_sessions = sum(len(keys) for keys in matches)
+        host.drain()
+        return {
+            "session_ticks_per_sec": round(n_sessions * ticks / dt, 1),
+            "frames_served_from_speculation":
+                host.frames_served_from_speculation,
+            "spec_hit_rate": round(host.spec_hit_rate, 4),
+            "spec": host._spec.section(),
+            "desyncs": host.desyncs_observed,
+        }
+
+    # ABBA-interleaved reps, the bench_spec_bubble discipline; the
+    # speculation counters are traffic-determined, so they come from the
+    # last run of each arm
+    samples_tr, samples_on = [], []
+    trained_res = online_res = None
+    for k in range(max(reps, 1)):
+        for arm in ((True, False) if k % 2 == 0 else (False, True)):
+            res = run(arm)
+            if arm:
+                trained_res = res
+                samples_tr.append(res["session_ticks_per_sec"])
+            else:
+                online_res = res
+                samples_on.append(res["session_ticks_per_sec"])
+    p50_tr = sorted(samples_tr)[len(samples_tr) // 2]
+    p50_on = sorted(samples_on)[len(samples_on) // 2]
+    return {
+        "sessions": sessions,
+        "ticks": ticks,
+        "entities": entities,
+        "max_prediction": max_prediction,
+        "hole_every": hole_every,
+        "hole_len": hole_len,
+        "reps": max(reps, 1),
+        "model_version": model.version,
+        "model_examples": int(model.tables.support.sum()),
+        "model_vocab": model.tables.vocab_size,
+        "trained": trained_res,
+        "online": online_res,
+        "samples_trained": samples_tr,
+        "samples_online": samples_on,
+        "session_ticks_per_sec_trained_p50": p50_tr,
+        "session_ticks_per_sec_online_p50": p50_on,
+        "learned_spec_hit_rate": trained_res["spec_hit_rate"],
+        "online_spec_hit_rate": online_res["spec_hit_rate"],
+        "learned_spec_fps_lift": round(p50_tr / max(p50_on, 1e-9), 3),
+    }
+
+
 def bench_resident_loop(sessions=16, ticks=240, entities=256,
                         resident_ticks=16, reps=3, seed=11):
     """THE same-run A/B for the device-resident serving loop: identical
@@ -2641,6 +2792,7 @@ def main():
         "chaos_fps_retained", "fps_retained_under_device_faults",
         "frames_served_from_speculation",
         "spec_hit_rate", "spec_fps_lift",
+        "learned_spec_hit_rate", "learned_spec_fps_lift",
         "resident_speedup", "resident_dispatches_per_tick",
         "journal_fps_ratio", "rto_matches_per_sec",
         "headline_source",
@@ -2952,6 +3104,17 @@ def main():
     ]
     full["spec_hit_rate"] = spec["spec_hit_rate"]
     full["spec_fps_lift"] = spec["spec_fps_lift"]
+    # the learning loop's value arm: a trained ArrayInputModel installed
+    # at the tick boundary vs the online Counter model, same seeded
+    # starved traffic (ABBA-interleaved, medians; training is untimed)
+    learned = phase(
+        "learned_model",
+        f"bench_learned_model(ticks={60 if SMOKE else 240}, "
+        f"reps={1 if SMOKE else 3})",
+        timeout_s=1800,
+    )
+    full["learned_spec_hit_rate"] = learned["learned_spec_hit_rate"]
+    full["learned_spec_fps_lift"] = learned["learned_spec_fps_lift"]
     # the device-resident serving loop: resident host vs its
     # dispatch-per-tick twin on identical seeded traffic (same-run A/B,
     # ABBA-interleaved, bitwise parity asserted inside the arm)

@@ -39,6 +39,12 @@ SCOPE_PREFIXES = (
     # encode/decode/replay path would make "recovery is a pure function
     # of (spec, journal)" silently false
     "ggrs_tpu/journal/",
+    # the learning loop feeds the speculation draft path: extraction,
+    # training and the array model's query path must be pure functions
+    # of (journal bytes, seed) or two hosts training on the same
+    # traffic would draft different futures — and a draft is replayed
+    # bitwise at adoption
+    "ggrs_tpu/learn/",
     "ggrs_tpu/sync_layer.py",
     "ggrs_tpu/input_queue.py",
 )

@@ -219,6 +219,20 @@ POLICIES: Dict[str, FencePolicy] = {
             ("WirePump", "__init__"),
         }),
     ),
+    # trained model tables are frozen at construction — every lane of
+    # every host drafting from version N must read the SAME numbers, so
+    # only ModelTables.__init__ may bind the buffers (and the trainer
+    # builds NEW tables rather than editing served ones); the hazard
+    # cache is derived there once and must never drift from the counts
+    "ggrs_tpu/learn/model.py": FencePolicy(
+        protected=frozenset({
+            "vocab", "switch", "total", "trans", "support",
+            "_hazard", "_vocab_bytes", "_vindex",
+        }),
+        allowed=frozenset({
+            ("ModelTables", "__init__"),
+        }),
+    ),
 }
 
 

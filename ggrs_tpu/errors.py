@@ -99,6 +99,15 @@ class CheckpointIncompatible(GGRSError):
         self.expected = expected
 
 
+class ModelIncompatible(CheckpointIncompatible):
+    """A serialized input-model snapshot cannot be used here: its format
+    version is newer than this build understands, its checksum does not
+    match the registry manifest (truncation/corruption), or its game
+    identity (players, input size) names a different game than the
+    install target. Same shape as its checkpoint parent so registry
+    readers handle both with one except clause."""
+
+
 class MigrationIncompatible(InvalidRequest):
     """A live-migration ticket cannot be imported into the destination
     host: different game config (state tree shapes), input size, window,

@@ -78,7 +78,8 @@ class AgentCore:
                  label: str = "", resident: bool = False,
                  resident_ticks: int = 8, sdc_audit_every: int = 0,
                  journal: bool = True, journal_fsync_every: int = 0,
-                 journal_segment_bytes: int = 1 << 18):
+                 journal_segment_bytes: int = 1 << 18,
+                 speculation: bool = False, speculation_seed: int = 0):
         """`resident=True` runs the agent's SessionHost on the
         device-resident serving loop (PR 13's mailbox + while_loop
         driver) — bit-identical to the dispatch-per-tick agent by the
@@ -110,7 +111,16 @@ class AgentCore:
             resident=resident,
             resident_ticks=resident_ticks,
             sdc_audit_every=sdc_audit_every,
+            speculation=speculation,
+            speculation_seed=speculation_seed,
         )
+        # model-rollout undo buffer: (version, blob) pairs — _cur_model
+        # is what serves now ((None, None) = per-lane online models),
+        # _prev_model is what the last install displaced, so the
+        # director's rollback_model is one cheap local swap-back with
+        # no re-push over the wire
+        self._cur_model: tuple = (None, None)
+        self._prev_model: Optional[tuple] = None
         if warmup:
             # the failover/migration import path runs EAGER per-leaf
             # device updates whose first compile costs whole heartbeats;
@@ -433,6 +443,13 @@ class AgentCore:
                 if self.journal_enabled and self.journal_dir is not None
                 else {}
             ),
+            **(
+                {"model": {
+                    "version": self.host.input_model_version,
+                    "spec_hit_rate": round(self.host.spec_hit_rate, 4),
+                }}
+                if self.host.speculation else {}
+            ),
         }, now_ms=now)
 
     # ------------------------------------------------------------------
@@ -506,11 +523,46 @@ class AgentCore:
         if op == "partition":
             self.partition(int(body.get("ms", 0)))
             return {"partition_ms": body.get("ms", 0)}, b"", None
+        if op == "install_model":
+            return self._op_install_model(body, blob), b"", None
+        if op == "rollback_model":
+            return self._op_rollback_model(), b"", None
         if op == "shutdown":
             return {"bye": True}, b"", "shutdown"
         from ..errors import InvalidRequest
 
         raise InvalidRequest(f"unknown fleet op {op!r}")
+
+    def _op_install_model(self, body: dict, blob: bytes) -> dict:
+        """Deserialize a registry blob and hot-swap it into the host's
+        speculation planner. Identity/format mismatches raise typed and
+        become an error reply — the director sees exactly which host
+        refused and why, and the host keeps serving its old model."""
+        from ..learn.model import ArrayInputModel
+
+        model = ArrayInputModel.from_bytes(blob)
+        version = body.get("version", model.version)
+        self.host.install_input_model(model, version=version)
+        self._prev_model = self._cur_model
+        self._cur_model = (version, blob)
+        return {
+            "installed": version,
+            "spec_hit_rate": round(self.host.spec_hit_rate, 4),
+        }
+
+    def _op_rollback_model(self) -> dict:
+        """Undo the last install: restore the displaced model from the
+        local undo buffer ((None, None) reverts to the per-lane online
+        models). Idempotent once — a second rollback with an empty
+        buffer also lands on online, the safe floor."""
+        from ..learn.model import ArrayInputModel
+
+        version, blob = self._prev_model or (None, None)
+        model = ArrayInputModel.from_bytes(blob) if blob else None
+        self.host.install_input_model(model, version=version)
+        self._cur_model = (version, blob)
+        self._prev_model = None
+        return {"rolled_back_to": version}
 
     def _op_spawn(self, body: dict) -> dict:
         if self._draining:

@@ -103,6 +103,11 @@ class HostRecord:
         # match_id -> outcome ("rebuilt" | "lost"): slot quarantines the
         # agent reported handling as mini-failovers
         self.quarantines: Dict[str, str] = {}
+        # learned input-model deploy state from the last heartbeat of a
+        # speculating agent (None on non-speculating hosts): what
+        # rollout_model reads to judge a staged install
+        self.model_version: Optional[int] = None
+        self.model_hit_rate: Optional[float] = None
         self.last_hb_ms = now_ms
         self.hb_misses = 0
         self.admissions_held = False
@@ -353,6 +358,10 @@ class Director:
             if journal is not None:
                 hr.journal = journal.get("matches", {})
                 hr.journal_dir = journal.get("dir")
+            model = body.get("model")
+            if model is not None:
+                hr.model_version = model.get("version")
+                hr.model_hit_rate = model.get("spec_hit_rate")
             hr.desyncs = int(body.get("desyncs", hr.desyncs))
             for mid, outcome in body.get("quarantines", {}).items():
                 # dedup on (match, OUTCOME): a rebuilt match that is
@@ -592,6 +601,80 @@ class Director:
             except (RpcError, RpcTimeout, CircuitOpen):
                 pass  # a dead owner's slots die with it
         rec["state"] = "released"
+
+    # ------------------------------------------------------------------
+    # learned input-model rollout (staged, with instant rollback)
+    # ------------------------------------------------------------------
+
+    def rollout_model(self, blob: bytes, *, version: int,
+                      drive=None, max_regression: float = 0.05) -> dict:
+        """Staged fleet-wide deploy of a published input model: live
+        hosts upgrade ONE at a time (lowest id first). Each install
+        reply carries the host's cumulative spec hit rate at the swap —
+        the baseline; `drive()` is the caller's hook that pushes real
+        traffic and heartbeats through the fleet, after which the
+        freshest heartbeat rate is compared. A drop worse than
+        `max_regression` (absolute) instantly rolls EVERY upgraded host
+        back to the model it displaced (agent-local undo buffer, no
+        re-push over the wire) and stops the rollout. Hosts that refuse
+        the blob typed (ModelIncompatible, timeout, open breaker) are
+        skipped, never fatal — one bad host must not block the fleet.
+        Returns {"version", "installed", "rolled_back", "regressed",
+        "skipped"}."""
+        from ..learn.metrics import model_rollbacks_total
+
+        installed: List[int] = []
+        skipped: Dict[int, str] = {}
+        regressed: Optional[int] = None
+        for hid in sorted(self.hosts):
+            hr = self.hosts[hid]
+            if not hr.alive():
+                skipped[hid] = hr.state
+                continue
+            try:
+                body, _ = self.call(
+                    hr, "install_model", {"version": version}, blob
+                )
+            except (RpcError, RpcTimeout, CircuitOpen) as exc:
+                skipped[hid] = getattr(exc, "kind", type(exc).__name__)
+                continue
+            baseline = body.get("spec_hit_rate")
+            installed.append(hid)
+            hr.model_version = version
+            if drive is not None:
+                drive()
+                self._pump_all()
+                after = hr.model_hit_rate
+                if (baseline is not None and after is not None
+                        and after < baseline - max_regression):
+                    regressed = hid
+                    break
+        if regressed is not None:
+            for hid in installed:
+                hr = self.hosts[hid]
+                try:
+                    body, _ = self.call(hr, "rollback_model")
+                    hr.model_version = body.get("rolled_back_to")
+                except (RpcError, RpcTimeout, CircuitOpen):
+                    pass  # a host lost mid-rollback re-registers cold
+            if GLOBAL_TELEMETRY.enabled:
+                model_rollbacks_total().inc()
+                GLOBAL_TELEMETRY.record(
+                    "model_rollout_rolled_back", version=version,
+                    regressed=regressed, hosts=list(installed),
+                )
+        elif GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "model_rollout", version=version, hosts=list(installed),
+                skipped={str(h): r for h, r in skipped.items()},
+            )
+        return {
+            "version": version,
+            "installed": installed,
+            "rolled_back": regressed is not None,
+            "regressed": regressed,
+            "skipped": skipped,
+        }
 
     # ------------------------------------------------------------------
     # cross-process migration (with crash rollback)

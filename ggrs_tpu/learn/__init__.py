@@ -1,0 +1,60 @@
+"""ggrs_tpu.learn — the learning loop: train the draft input model on
+journaled fleet traffic, version it, hot-swap it into serving.
+
+    dataset.py    journal WAL segments -> vectorized per-player
+                  (run-length, switch, successor) example tensors;
+                  seeded shard-shuffled iteration; live tap off a
+                  SessionHost's recorder frontier
+    model.py      ArrayInputModel: the InputHistoryModel draft/rank
+                  interface over frozen trained count tables — bitwise-
+                  deterministic, trace-safe, cheap to clone per lane
+    trainer.py    one jitted vmapped count/EMA pass over players x
+                  matches; actor/learner rounds on an env fleet
+    registry.py   versioned, checksummed snapshots (atomic_write_bytes
+                  + manifest, the CHECKPOINT_FORMAT_VERSION pattern)
+    metrics.py    the ggrs_model_* instruments
+
+Deploy seam: `SessionHost.install_input_model()` swaps a lane-cloned
+model into the speculation planner at a tick boundary; the fleet
+director pushes registry versions to agents over the RPC plane
+(`Director.rollout_model`) with per-host staged rollout and instant
+rollback on a spec-hit-rate regression.
+
+The package imports numpy only — jax loads lazily inside the trainer's
+accumulate pass, so dataset/registry tooling stays importable on hosts
+without an accelerator stack.
+"""
+
+from .dataset import JournalDataset, LiveTap, discover_journals, extract_examples
+from .model import (
+    HAZARD_BUCKETS,
+    MAX_VOCAB,
+    MODEL_FORMAT_VERSION,
+    ArrayInputModel,
+    ModelTables,
+)
+from .registry import REGISTRY_FORMAT_VERSION, ModelRegistry
+from .trainer import (
+    actor_learner,
+    train_from_journal,
+    train_on_examples,
+    update_tables,
+)
+
+__all__ = [
+    "ArrayInputModel",
+    "HAZARD_BUCKETS",
+    "JournalDataset",
+    "LiveTap",
+    "MAX_VOCAB",
+    "MODEL_FORMAT_VERSION",
+    "ModelRegistry",
+    "ModelTables",
+    "REGISTRY_FORMAT_VERSION",
+    "actor_learner",
+    "discover_journals",
+    "extract_examples",
+    "train_from_journal",
+    "train_on_examples",
+    "update_tables",
+]

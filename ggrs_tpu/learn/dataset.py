@@ -1,0 +1,240 @@
+"""Journal WAL segments -> vectorized per-player training examples.
+
+The journal (journal/wal.py) records every confirmed tick row durably
+and canonically — bit-identical across the peers of a match — which
+makes it free supervised training data for the draft model: the
+simulation's ground truth about WHEN players stop holding a value and
+WHAT they switch to. This module streams a host's `journal_dir` (or a
+fleet's per-agent inventory) into per-match example tensors.
+
+Extraction mirrors `InputHistoryModel`'s finalization discipline
+exactly: rows feed a per-player run tracker in frame order; a
+DISCONNECTED status severs the run like `break_run` (dummy rows are not
+player behavior); the first row of a run starts tracking without
+emitting. Every subsequent tracked frame emits one example —
+
+    (run-length entering the frame, switch-or-hold, held value,
+     successor value)
+
+— so a hazard table fitted on the examples estimates the same
+conditional P(switch | held r frames) the online model's Counter does,
+and two journals of the same match (sharded or single-device host,
+either peer) extract byte-identical example tensors.
+
+Example tensors per match (F = frames with a predecessor, P = players):
+
+    run      i32 [P, F]     frames the value was held entering the frame
+    switched bool[P, F]     did the row change value at this frame
+    src      u8  [P, F, I]  the value held entering the frame
+    dst      u8  [P, F, I]  the row observed at the frame
+    valid    bool[P, F]     tracked (False: severed / not yet tracking)
+
+Iteration is seeded shard-shuffled (`random.Random(seed)` — an owned
+instance, per the DET lint) over discovered matches; `LiveTap` follows a
+live `SessionHost` lane off its recorder frontier (`journal_frontier`)
+so an actor/learner loop can consume rows the host is still serving.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..journal.wal import SEGMENT_PREFIX, SEGMENT_SUFFIX, scan_journal
+
+# types.InputStatus.DISCONNECTED without a jax-adjacent import; statuses
+# at or past it are dummy rows (the planner's `pst[p] >= _DISC` test)
+_DISCONNECTED = 2
+
+
+def extract_examples(inputs: np.ndarray, statuses: np.ndarray) -> dict:
+    """One contiguous confirmed script (u8[F, P, I], i32[F, P]) -> the
+    example tensors documented above. Pure function of the rows: the
+    sharded-vs-single-device byte-parity tests hold it to that."""
+    inputs = np.asarray(inputs, dtype=np.uint8)
+    statuses = np.asarray(statuses, dtype=np.int32)
+    F, P, I = inputs.shape
+    assert statuses.shape == (F, P), (inputs.shape, statuses.shape)
+    run = np.zeros((P, F), dtype=np.int32)
+    switched = np.zeros((P, F), dtype=bool)
+    src = np.zeros((P, F, I), dtype=np.uint8)
+    dst = np.zeros((P, F, I), dtype=np.uint8)
+    valid = np.zeros((P, F), dtype=bool)
+    disc = statuses >= _DISCONNECTED  # [F, P]
+    for p in range(P):
+        cur: Optional[bytes] = None
+        cur_len = 0
+        rows = inputs[:, p]
+        dp = disc[:, p]
+        for f in range(F):
+            if dp[f]:
+                cur = None
+                cur_len = 0
+                continue
+            row = rows[f].tobytes()
+            if cur is None:
+                cur = row
+                cur_len = 1
+                continue
+            valid[p, f] = True
+            run[p, f] = cur_len
+            src[p, f] = np.frombuffer(cur, dtype=np.uint8)
+            dst[p, f] = rows[f]
+            if row == cur:
+                switched[p, f] = False
+                cur_len += 1
+            else:
+                switched[p, f] = True
+                cur = row
+                cur_len = 1
+    return {
+        "run": run, "switched": switched, "src": src, "dst": dst,
+        "valid": valid,
+    }
+
+
+def _has_segments(path: str) -> bool:
+    try:
+        names = os.listdir(path)
+    except (FileNotFoundError, NotADirectoryError):
+        return False
+    return any(
+        n.startswith(SEGMENT_PREFIX) and n.endswith(SEGMENT_SUFFIX)
+        for n in names
+    )
+
+
+def discover_journals(root: str) -> List[str]:
+    """Every journal directory under `root` (inclusive), sorted: a
+    host's `journal_dir` (per-lane `lane<key>/` children), a fleet
+    base_dir's per-agent inventory, or a single journal itself."""
+    found = []
+    if _has_segments(root):
+        found.append(root)
+    for dirpath, dirnames, _files in os.walk(root):
+        dirnames.sort()  # deterministic walk order
+        for d in dirnames:
+            path = os.path.join(dirpath, d)
+            if _has_segments(path):
+                found.append(path)
+    return sorted(set(found))
+
+
+class JournalDataset:
+    """Seeded shard-shuffled stream of per-match example tensors.
+
+    `roots` is one path or a list — each is searched for journal
+    directories (WAL segments). Matches shuffle by `random.Random(seed)`
+    each epoch (epoch index salts the seed), extraction is lazy per
+    match, and a journal whose contiguous prefix is empty (fresh dir,
+    quarantined-to-nothing) yields no tensors rather than failing — the
+    trainer's job is the rows that ARE durable."""
+
+    def __init__(self, roots, *, seed: int = 0):
+        if isinstance(roots, (str, os.PathLike)):
+            roots = [roots]
+        self.paths: List[str] = []
+        for root in roots:
+            self.paths.extend(discover_journals(os.fspath(root)))
+        self.paths = sorted(set(self.paths))
+        self.seed = int(seed)
+        self._meta: Optional[dict] = None
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    def meta(self) -> dict:
+        """Identity of the journaled traffic (players, input size) from
+        the first scannable META record, plus the frame WATERMARK — the
+        total durable frames the dataset covers, stamped into registry
+        manifests so a snapshot says what data it saw."""
+        if self._meta is None:
+            players = input_size = None
+            frames = 0
+            for path in self.paths:
+                scan = scan_journal(path, repair=False)
+                frames += scan.frames
+                if scan.meta:
+                    # a fleet mixes 2/3/4-player matches: the model is
+                    # as wide as the WIDEST journaled match (the host
+                    # width) — narrower matches pad up in the trainer
+                    p = scan.meta.get("num_players")
+                    if p is not None:
+                        players = p if players is None else max(players, p)
+                    if input_size is None:
+                        input_size = scan.meta.get("input_size")
+            self._meta = {
+                "journals": len(self.paths),
+                "num_players": players,
+                "input_size": input_size,
+                "frames": frames,
+            }
+        return self._meta
+
+    def shards(self, *, epoch: int = 0,
+               shuffle: bool = True) -> Iterator[dict]:
+        """Yield one example-tensor dict per match (plus its source
+        path under "path", frame count under "frames")."""
+        order = list(self.paths)
+        if shuffle:
+            random.Random(self.seed ^ (epoch * 0x9E3779B1)).shuffle(order)
+        for path in order:
+            scan = scan_journal(path, repair=False)
+            if not scan.frames:
+                continue
+            inputs, statuses = scan.script()
+            ex = extract_examples(inputs, statuses)
+            ex["path"] = path
+            ex["frames"] = scan.frames
+            yield ex
+
+    def __iter__(self) -> Iterator[dict]:
+        return self.shards()
+
+
+class LiveTap:
+    """Follow one live hosted lane's journal off the recorder frontier.
+
+    `poll()` returns the example tensors for rows made durable since the
+    last poll (None when the frontier hasn't moved), re-reading the
+    on-disk segments — the tap consumes exactly what recovery would, so
+    live training can never see a row durability would lose. The run
+    tracker context crosses polls: `_carry` frames of history are
+    re-extracted so runs spanning a poll boundary keep their lengths
+    (examples already emitted are not re-emitted)."""
+
+    def __init__(self, host, key: Any, path: str, *, carry: int = 256):
+        self.host = host
+        self.key = key
+        self.path = path
+        self._cursor: Optional[int] = None  # first frame not yet emitted
+        self._carry = int(carry)
+
+    def poll(self) -> Optional[dict]:
+        frontier = self.host.journal_frontier(self.key)
+        if frontier is None:
+            return None
+        scan = scan_journal(self.path, repair=False)
+        if not scan.frames:
+            return None
+        if self._cursor is None:
+            self._cursor = scan.base_frame
+        if scan.next_frame <= self._cursor:
+            return None
+        # re-extract from up to `carry` frames before the cursor so run
+        # lengths survive the boundary, then slice off the re-emitted
+        # prefix
+        start = max(scan.base_frame, self._cursor - self._carry)
+        frames = range(start, scan.next_frame)
+        inputs = np.stack([scan.rows[f][0] for f in frames])
+        statuses = np.stack([scan.rows[f][1] for f in frames])
+        ex = extract_examples(inputs, statuses)
+        drop = self._cursor - start
+        out = {k: v[:, drop:] for k, v in ex.items()}
+        out["path"] = self.path
+        out["frames"] = scan.next_frame - self._cursor
+        self._cursor = scan.next_frame
+        return out

@@ -1,0 +1,55 @@
+"""Learning-loop instruments: get-or-create helpers, one definition
+each, shared by the trainer, the model registry, the host hot-swap seam
+and the fleet rollout (the journal/metrics pattern). Registry-driven, so
+both exporters and telemetry snapshots carry them with no extra wiring.
+"""
+
+from __future__ import annotations
+
+from ..obs import GLOBAL_TELEMETRY
+
+
+def model_train_passes_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_model_train_passes_total",
+        "jitted count-accumulation passes the trainer dispatched",
+    )
+
+
+def model_examples_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_model_examples_total",
+        "per-player (run-length, switch, successor) training examples "
+        "consumed — valid rows only, dummy/disconnect rows excluded",
+    )
+
+
+def model_published_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_model_published_total",
+        "model snapshots published to a registry (checksummed, versioned)",
+    )
+
+
+def model_installs_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_model_installs_total",
+        "input-model hot-swaps installed on serving hosts (install + "
+        "revert both count — each is a tick-boundary swap)",
+    )
+
+
+def model_version_gauge():
+    return GLOBAL_TELEMETRY.registry.gauge(
+        "ggrs_model_version",
+        "registry version of the input model a host currently serves "
+        "drafts from (0 = the online Counter model)",
+    )
+
+
+def model_rollbacks_total():
+    return GLOBAL_TELEMETRY.registry.counter(
+        "ggrs_model_rollbacks_total",
+        "fleet-wide model rollbacks triggered by a staged rollout's "
+        "spec-hit-rate regression check",
+    )

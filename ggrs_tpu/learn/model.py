@@ -1,0 +1,385 @@
+"""Array-form input model: the trained, hot-swappable draft model.
+
+`InputHistoryModel` (tpu/input_model.py) learns online from one lane's
+finalized rows — a Counter per player, reset at attach, relearning from
+scratch every match. This module is its TRAINED counterpart: the same
+draft/rank interface backed by frozen count TABLES fitted offline over
+journaled fleet traffic (learn/trainer.py), so a fresh lane drafts from
+hour-one statistics instead of a cold Counter.
+
+Layout (all float64 numpy, host-side — never traced):
+
+    vocab   u8 [V, I]   learned value vocabulary, rows sorted by
+                        (-observed count, row bytes): deterministic
+    switch  f64[P, R]   per player: examples at run-length bucket b that
+                        SWITCHED value on the next frame
+    total   f64[P, R]   per player: examples at run-length bucket b
+                        (bucket b covers hold length b+1; the last
+                        bucket aggregates the tail)
+    trans   f64[P, V, V] per player: switch examples src-vocab-id ->
+                        dst-vocab-id
+    support f64[P]      completed holds observed (the MIN_HOLDS gate)
+
+The query path is a pure function of the tables: hazard(t) is the
+Laplace-smoothed conditional (switch[b] + PRIOR) / (total[b] + 2*PRIOR)
+computed once at construction in float64, so `draft_script` /
+`rank_branches` inference is bitwise-deterministic across processes and
+platforms — the determinism contract the speculation twin-parity suite
+holds the draft seam to. `ArrayInputModel` SUBCLASSES `InputHistoryModel`
+and swaps only the per-player stats views, so the speculation planner,
+the beam backend (`TpuRollbackBackend.input_model`) and
+`env/opponents.InputModelOpponent` (an isinstance check) accept either
+model without knowing which they hold.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..errors import ModelIncompatible
+from ..tpu.input_model import HAZARD_PRIOR, InputHistoryModel
+
+# serialized-snapshot format (the CHECKPOINT_FORMAT_VERSION pattern):
+# bump on any layout change; from_bytes refuses newer formats typed
+MODEL_FORMAT_VERSION = 1
+
+# run-length buckets: hold length t maps to bucket min(t, R) - 1; real
+# input holds are dozens of frames, so the tail bucket aggregates the
+# rare long runs instead of spreading counts thin
+HAZARD_BUCKETS = 32
+# value-vocabulary cap: input rows beyond the top MAX_VOCAB by count are
+# out-of-vocabulary (no transition signal; hazard still applies)
+MAX_VOCAB = 64
+
+_MAGIC = b"GGRSMODL"
+_LEN = struct.Struct("<I")
+
+# serialization order is part of the format: (name, dtype)
+_ARRAYS = (
+    ("vocab", "uint8"),
+    ("switch", "float64"),
+    ("total", "float64"),
+    ("trans", "float64"),
+    ("support", "float64"),
+)
+
+
+class ModelTables:
+    """Frozen count tables + derived lookups. Instances are immutable
+    after construction (arrays are marked read-only) and SHARED across
+    every lane-level clone of an ArrayInputModel — cloning a model is
+    O(players), never O(tables)."""
+
+    __slots__ = (
+        "vocab", "switch", "total", "trans", "support", "input_size",
+        "_vindex", "_hazard", "_vocab_bytes",
+    )
+
+    def __init__(self, *, vocab: np.ndarray, switch: np.ndarray,
+                 total: np.ndarray, trans: np.ndarray,
+                 support: np.ndarray, input_size: int):
+        self.vocab = np.ascontiguousarray(vocab, dtype=np.uint8)
+        self.switch = np.ascontiguousarray(switch, dtype=np.float64)
+        self.total = np.ascontiguousarray(total, dtype=np.float64)
+        self.trans = np.ascontiguousarray(trans, dtype=np.float64)
+        self.support = np.ascontiguousarray(support, dtype=np.float64)
+        self.input_size = int(input_size)
+        P, R = self.switch.shape
+        V = self.vocab.shape[0]
+        assert self.vocab.shape == (V, self.input_size)
+        assert self.total.shape == (P, R)
+        assert self.trans.shape == (P, V, V)
+        assert self.support.shape == (P,)
+        for a in (self.vocab, self.switch, self.total, self.trans,
+                  self.support):
+            a.flags.writeable = False
+        self._vocab_bytes: List[bytes] = [
+            self.vocab[i].tobytes() for i in range(V)
+        ]
+        self._vindex: Dict[bytes, int] = {
+            row: i for i, row in enumerate(self._vocab_bytes)
+        }
+        # the whole query path reduces to this one table: float64
+        # host-side arithmetic, identical on every platform
+        self._hazard = (self.switch + HAZARD_PRIOR) / (
+            self.total + 2.0 * HAZARD_PRIOR
+        )
+
+    @property
+    def num_players(self) -> int:
+        return self.switch.shape[0]
+
+    @property
+    def buckets(self) -> int:
+        return self.switch.shape[1]
+
+    @property
+    def vocab_size(self) -> int:
+        return self.vocab.shape[0]
+
+    def vocab_id(self, row: bytes) -> int:
+        """Vocabulary id of a raw input row, -1 when out-of-vocabulary."""
+        return self._vindex.get(row, -1)
+
+    def hazard(self, player: int, t: int) -> float:
+        b = min(max(int(t), 1), self.buckets) - 1
+        return float(self._hazard[player, b])
+
+    def next_values(self, player: int, src: bytes,
+                    limit: int) -> List[Tuple[bytes, float]]:
+        vid = self._vindex.get(src)
+        if vid is None:
+            return []
+        row = self.trans[player, vid]
+        tot = float(row.sum())
+        if tot <= 0.0:
+            return []
+        # deterministic ties: count descending, then vocab id ascending
+        # (vocab order is itself deterministic by construction)
+        order = sorted(
+            (j for j in range(row.shape[0]) if row[j] > 0.0),
+            key=lambda j: (-row[j], j),
+        )
+        return [
+            (self._vocab_bytes[j], float(row[j]) / tot)
+            for j in order[:limit]
+        ]
+
+    def hold_counts_counter(self, player: int) -> Counter:
+        """Bucket -> switch-count Counter, keyed by the bucket's hold
+        length. Exists for `InputModelOpponent`'s hazard-table cache key
+        (any stable fingerprint of the frozen statistics works) and for
+        the `st.hold_counts` surface the online stats expose."""
+        return Counter({
+            b + 1: float(self.switch[player, b])
+            for b in range(self.buckets)
+            if self.switch[player, b] > 0.0
+        })
+
+    def transitions_dict(self, player: int) -> Dict[bytes, Counter]:
+        """src-bytes -> Counter(dst-bytes -> count) view of the trans
+        table — the `st.transitions` surface opponents introspect."""
+        out: Dict[bytes, Counter] = {}
+        tr = self.trans[player]
+        for i in range(self.vocab_size):
+            nz = np.nonzero(tr[i] > 0.0)[0]
+            if nz.size:
+                out[self._vocab_bytes[i]] = Counter({
+                    self._vocab_bytes[int(j)]: float(tr[i, int(j)])
+                    for j in nz
+                })
+        return out
+
+    def meta(self) -> dict:
+        return {
+            "num_players": self.num_players,
+            "input_size": self.input_size,
+            "buckets": self.buckets,
+            "vocab": self.vocab_size,
+            "examples": float(self.total.sum()),
+            "holds": float(self.support.sum()),
+        }
+
+
+class _ArrayPlayerStats:
+    """One player's stats view over shared frozen tables: the same
+    surface as tpu.input_model._PlayerStats (observe / break_run-able
+    run tracking, n_holds, hazard, next_values, hold_counts,
+    transitions), with observe() mutating ONLY the run tracker — the
+    counts never move, which is what makes a mid-serve swap safe to
+    reason about."""
+
+    __slots__ = ("cur_value", "cur_len", "_tables", "_player",
+                 "_hold_counts", "_transitions")
+
+    def __init__(self, tables: ModelTables, player: int):
+        self.cur_value: Optional[bytes] = None
+        self.cur_len = 0
+        self._tables = tables
+        self._player = player
+        self._hold_counts: Optional[Counter] = None
+        self._transitions: Optional[Dict[bytes, Counter]] = None
+
+    # run tracking (the only mutable state; mirrors _PlayerStats.observe
+    # minus the recording half)
+    def observe(self, row: bytes) -> None:
+        if row == self.cur_value:
+            self.cur_len += 1
+            return
+        self.cur_value = row
+        self.cur_len = 1
+
+    # -- frozen-table queries ------------------------------------------
+
+    def n_holds(self) -> int:
+        return int(self._tables.support[self._player])
+
+    def hazard(self, t: int) -> float:
+        return self._tables.hazard(self._player, t)
+
+    def next_values(self, src: bytes,
+                    limit: int = 3) -> List[Tuple[bytes, float]]:
+        return self._tables.next_values(self._player, src, limit)
+
+    # materialized lazily: only opponents introspect these, and only at
+    # bind time — the serving draft path never touches them
+    @property
+    def hold_counts(self) -> Counter:
+        if self._hold_counts is None:
+            self._hold_counts = self._tables.hold_counts_counter(
+                self._player
+            )
+        return self._hold_counts
+
+    @property
+    def transitions(self) -> Dict[bytes, Counter]:
+        if self._transitions is None:
+            self._transitions = self._tables.transitions_dict(self._player)
+        return self._transitions
+
+
+class ArrayInputModel(InputHistoryModel):
+    """Trained drop-in for `InputHistoryModel`: identical draft/rank
+    interface (inherited verbatim — `rank_branches`, `draft_script`,
+    `observe`, `break_run` all run against the stats views), frozen
+    learned tables. `clone()` shares the tables and is what the
+    speculation planner installs per lane."""
+
+    kind = "array"
+
+    def __init__(self, tables: ModelTables, *, version: int = 0):
+        super().__init__(tables.num_players, tables.input_size)
+        self.tables = tables
+        self.version = int(version)
+        self._stats = [
+            _ArrayPlayerStats(tables, p) for p in range(tables.num_players)
+        ]
+
+    def reset(self) -> None:
+        self._stats = [
+            _ArrayPlayerStats(self.tables, p)
+            for p in range(self.num_players)
+        ]
+
+    def clone(self) -> "ArrayInputModel":
+        """Fresh run-tracking views over the SAME tables — one per lane,
+        because lanes observe different finalized streams."""
+        return ArrayInputModel(self.tables, version=self.version)
+
+    # -- migration carry -----------------------------------------------
+    # the tables travel by registry version, not by ticket: only the
+    # transient run trackers export, and they only load into a model of
+    # the same version (otherwise the import is a cold start by design)
+
+    def state_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "num_players": self.num_players,
+            "input_size": self.input_size,
+            "version": self.version,
+            "players": [
+                {
+                    "cur_value": (
+                        st.cur_value.hex()
+                        if st.cur_value is not None else None
+                    ),
+                    "cur_len": st.cur_len,
+                }
+                for st in self._stats
+            ],
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        for field in ("kind", "num_players", "input_size", "version"):
+            found, expected = state.get(field), getattr(self, field)
+            if found != expected:
+                raise ModelIncompatible(
+                    f"array-model state {field} mismatch",
+                    found=found, expected=expected,
+                )
+        for st, sd in zip(self._stats, state["players"]):
+            cv = sd.get("cur_value")
+            st.cur_value = bytes.fromhex(cv) if cv is not None else None
+            st.cur_len = int(sd.get("cur_len", 0))
+
+    # -- serialization (registry snapshots + fleet RPC blobs) ----------
+
+    def to_bytes(self) -> bytes:
+        """Deterministic byte serialization: a JSON header (sorted keys)
+        plus the raw C-order array buffers in fixed format order — the
+        same input always yields the same bytes, so the registry
+        checksum doubles as a content address."""
+        t = self.tables
+        arrays = {name: getattr(t, name) for name, _ in _ARRAYS}
+        header = {
+            "format": MODEL_FORMAT_VERSION,
+            "version": self.version,
+            "num_players": self.num_players,
+            "input_size": self.input_size,
+            "shapes": {
+                name: list(arrays[name].shape) for name, _ in _ARRAYS
+            },
+        }
+        hdr = json.dumps(header, sort_keys=True).encode("utf-8")
+        out = [_MAGIC, _LEN.pack(len(hdr)), hdr]
+        for name, _dtype in _ARRAYS:
+            out.append(arrays[name].tobytes())
+        return b"".join(out)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ArrayInputModel":
+        if data[: len(_MAGIC)] != _MAGIC:
+            raise ModelIncompatible(
+                "model blob lacks the snapshot magic",
+                found=bytes(data[: len(_MAGIC)]), expected=_MAGIC,
+            )
+        off = len(_MAGIC)
+        (hdr_len,) = _LEN.unpack_from(data, off)
+        off += _LEN.size
+        try:
+            header = json.loads(data[off : off + hdr_len].decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+            raise ModelIncompatible(
+                f"model header unreadable: {exc}"
+            ) from exc
+        off += hdr_len
+        if header.get("format") != MODEL_FORMAT_VERSION:
+            raise ModelIncompatible(
+                "model snapshot format version mismatch",
+                found=header.get("format"), expected=MODEL_FORMAT_VERSION,
+            )
+        arrays = {}
+        for name, dtype in _ARRAYS:
+            shape = tuple(header["shapes"][name])
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            itemsize = np.dtype(dtype).itemsize
+            end = off + n * itemsize
+            if end > len(data):
+                raise ModelIncompatible(
+                    "model blob truncated mid-array",
+                    found=len(data), expected=end,
+                )
+            arrays[name] = np.frombuffer(
+                data, dtype=dtype, count=n, offset=off
+            ).reshape(shape).copy()
+            off = end
+        if off != len(data):
+            raise ModelIncompatible(
+                "model blob carries trailing bytes",
+                found=len(data), expected=off,
+            )
+        tables = ModelTables(
+            input_size=int(header["input_size"]), **arrays
+        )
+        if tables.num_players != int(header["num_players"]):
+            raise ModelIncompatible(
+                "model header players disagree with the tables",
+                found=tables.num_players,
+                expected=int(header["num_players"]),
+            )
+        return cls(tables, version=int(header.get("version", 0)))

@@ -1,0 +1,185 @@
+"""Versioned, checksummed input-model snapshots on disk.
+
+The registry is a directory:
+
+    manifest.json          {"format": 1, "models": {"1": {entry}, ...}}
+    model-000001.bin       ArrayInputModel.to_bytes() payloads
+
+Every write goes through `atomic_write_bytes` (tempfile + rename +
+fsync — the checkpoint discipline), so a crash mid-publish leaves either
+the previous manifest or the new one, never a manifest pointing at a
+half-written blob: the blob lands durably BEFORE the manifest names it.
+
+Each manifest entry records what `load` verifies:
+
+    version     monotonically increasing int (the registry assigns it)
+    sha256      of the blob — load() refuses a mismatch typed
+    game        identity: num_players / input_size / game_cls, so a
+                snapshot trained for one game cannot install into
+                another (ModelIncompatible, the checkpoint pattern)
+    watermark   journal frontier the training data covered (dataset
+                meta) — which fleet traffic this model has seen
+    meta        caller extras (bench scores, rollout notes)
+
+`REGISTRY_FORMAT_VERSION` gates the manifest itself: a newer on-disk
+format raises ModelIncompatible instead of misreading entries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ..errors import ModelIncompatible
+from ..obs import GLOBAL_TELEMETRY
+from ..utils.checkpoint import atomic_write_bytes
+from .metrics import model_published_total
+from .model import ArrayInputModel
+
+REGISTRY_FORMAT_VERSION = 1
+_MANIFEST = "manifest.json"
+
+
+def _blob_name(version: int) -> str:
+    return f"model-{version:06d}.bin"
+
+
+class ModelRegistry:
+    """One directory of published model versions."""
+
+    def __init__(self, path: str):
+        self.path = os.fspath(path)
+        os.makedirs(self.path, exist_ok=True)
+        self._manifest = self._read_manifest()
+
+    def _read_manifest(self) -> dict:
+        mpath = os.path.join(self.path, _MANIFEST)
+        try:
+            with open(mpath, "rb") as f:
+                manifest = json.loads(f.read().decode("utf-8"))
+        except FileNotFoundError:
+            return {"format": REGISTRY_FORMAT_VERSION, "models": {}}
+        except (OSError, UnicodeDecodeError, json.JSONDecodeError) as exc:
+            raise ModelIncompatible(
+                f"model-registry manifest unreadable: {exc}"
+            ) from exc
+        if manifest.get("format") != REGISTRY_FORMAT_VERSION:
+            raise ModelIncompatible(
+                "model-registry manifest format mismatch",
+                found=manifest.get("format"),
+                expected=REGISTRY_FORMAT_VERSION,
+            )
+        manifest.setdefault("models", {})
+        return manifest
+
+    def _write_manifest(self) -> None:
+        atomic_write_bytes(
+            os.path.join(self.path, _MANIFEST),
+            json.dumps(self._manifest, sort_keys=True).encode("utf-8"),
+        )
+
+    # ------------------------------------------------------------------
+
+    def versions(self) -> List[int]:
+        return sorted(int(v) for v in self._manifest["models"])
+
+    def latest(self) -> Optional[int]:
+        versions = self.versions()
+        return versions[-1] if versions else None
+
+    def entry(self, version: int) -> dict:
+        e = self._manifest["models"].get(str(int(version)))
+        if e is None:
+            raise ModelIncompatible(
+                "model version absent from the registry",
+                found=int(version), expected=self.versions(),
+            )
+        return e
+
+    def publish(self, model: ArrayInputModel, *,
+                game: Any = None,
+                watermark: Optional[dict] = None,
+                meta: Optional[dict] = None) -> int:
+        """Assign the next version, stamp it into the model, write the
+        checksummed blob durably, then the manifest. Returns the
+        version."""
+        version = (self.latest() or 0) + 1
+        model.version = version
+        blob = model.to_bytes()
+        digest = hashlib.sha256(blob).hexdigest()
+        name = _blob_name(version)
+        atomic_write_bytes(os.path.join(self.path, name), blob)
+        self._manifest["models"][str(version)] = {
+            "version": version,
+            "file": name,
+            "bytes": len(blob),
+            "sha256": digest,
+            "game": {
+                "num_players": model.num_players,
+                "input_size": model.input_size,
+                "game_cls": (
+                    type(game).__name__ if game is not None else None
+                ),
+            },
+            "tables": model.tables.meta(),
+            "watermark": dict(watermark or {}),
+            "meta": dict(meta or {}),
+        }
+        self._write_manifest()
+        if GLOBAL_TELEMETRY.enabled:
+            model_published_total().inc()
+            GLOBAL_TELEMETRY.record(
+                "model_published", version=version, sha256=digest,
+                path=self.path,
+            )
+        return version
+
+    def load_bytes(self, version: Optional[int] = None) -> bytes:
+        """The checksum-verified blob (latest by default) — what the
+        fleet director pushes over the RPC plane."""
+        if version is None:
+            version = self.latest()
+            if version is None:
+                raise ModelIncompatible(
+                    "model registry is empty", found=None, expected=">=1"
+                )
+        e = self.entry(version)
+        path = os.path.join(self.path, e["file"])
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as exc:
+            raise ModelIncompatible(
+                f"model blob unreadable: {exc}",
+                found=e["file"], expected="readable blob",
+            ) from exc
+        digest = hashlib.sha256(blob).hexdigest()
+        if digest != e["sha256"]:
+            raise ModelIncompatible(
+                "model blob checksum mismatch (corrupt registry entry)",
+                found=digest, expected=e["sha256"],
+            )
+        return blob
+
+    def load(self, version: Optional[int] = None, *,
+             game: Any = None) -> ArrayInputModel:
+        """Deserialize a published version (latest by default),
+        verifying checksum and — when `game` is given — game identity."""
+        if version is None:
+            version = self.latest()
+            if version is None:
+                raise ModelIncompatible(
+                    "model registry is empty", found=None, expected=">=1"
+                )
+        model = ArrayInputModel.from_bytes(self.load_bytes(version))
+        if game is not None:
+            if (model.num_players != game.num_players
+                    or model.input_size != game.input_size):
+                raise ModelIncompatible(
+                    "model game identity mismatch",
+                    found=(model.num_players, model.input_size),
+                    expected=(game.num_players, game.input_size),
+                )
+        return model

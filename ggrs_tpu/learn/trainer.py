@@ -1,0 +1,372 @@
+"""The learner: fit ArrayInputModel tables from journaled examples.
+
+Counting, not gradient descent — the draft model is a per-player hazard
+table over run-length buckets plus a value-transition table over a
+learned vocabulary, and fitting it is one batched count accumulation:
+
+    total[p, b]   += examples at run bucket b
+    switch[p, b]  += those that switched value
+    trans[p, s, d] += switch examples src-vocab-id s -> dst-vocab-id d
+    support[p]    += completed holds
+
+The accumulation runs as ONE jitted, player-vmapped pass over stacked
+[match, player, frame] example tensors (integer accumulators — exact),
+module-scope-cached with static (buckets, vocab) so repeated epochs and
+actor/learner rounds reuse the compiled program. Table arithmetic that
+determinism depends on (hazard smoothing, EMA decay) happens HOST-SIDE
+in numpy float64 — the jit pass only counts.
+
+`decay` turns the counts into an EMA across sequential batches
+(new = decay * old + fresh): with a frozen vocabulary carried from the
+prior tables, which is what `actor_learner` uses to keep updating while
+its env fleet generates fresh trajectories from the very model being
+updated (the Parallel-Actors-and-Learners split, on one process).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import GLOBAL_TELEMETRY
+from .dataset import JournalDataset, extract_examples
+from .metrics import model_examples_total, model_train_passes_total
+from .model import (
+    HAZARD_BUCKETS,
+    MAX_VOCAB,
+    ArrayInputModel,
+    ModelTables,
+)
+
+# protected by the FEN lint (analysis/fence.py): the accumulate cache is
+# written once, by _accumulate itself
+_ACCUMULATE = None
+
+
+def _accumulate_impl(run, switched, src_vid, dst_vid, valid, *,
+                     buckets: int, vsize: int):
+    """[M, P, F] example tensors -> per-player integer count deltas.
+    Runs under jit; every branch is static (shapes and the vsize==0
+    case), every accumulator exact int32."""
+    import jax
+    import jax.numpy as jnp
+
+    def one_player(run_p, sw_p, s_p, d_p, va_p):  # each [M, F]
+        w = va_p.astype(jnp.int32)
+        sw = sw_p.astype(jnp.int32) * w
+        b = jnp.clip(run_p - 1, 0, buckets - 1)
+        oh = jax.nn.one_hot(b, buckets, dtype=jnp.int32)  # [M, F, R]
+        total = (oh * w[..., None]).sum(axis=(0, 1))
+        switch = (oh * sw[..., None]).sum(axis=(0, 1))
+        support = sw.sum()
+        if vsize:
+            pair_ok = sw * (s_p >= 0).astype(jnp.int32) * (
+                d_p >= 0
+            ).astype(jnp.int32)
+            idx = jnp.clip(s_p, 0, vsize - 1) * vsize + jnp.clip(
+                d_p, 0, vsize - 1
+            )
+            toh = jax.nn.one_hot(idx, vsize * vsize, dtype=jnp.int32)
+            trans = (toh * pair_ok[..., None]).sum(axis=(0, 1)).reshape(
+                vsize, vsize
+            )
+        else:
+            trans = jnp.zeros((0, 0), dtype=jnp.int32)
+        return total, switch, trans, support
+
+    return jax.vmap(one_player, in_axes=1, out_axes=0)(
+        run, switched, src_vid, dst_vid, valid
+    )
+
+
+def _accumulate(run, switched, src_vid, dst_vid, valid, *,
+                buckets: int, vsize: int):
+    global _ACCUMULATE
+    if _ACCUMULATE is None:
+        import jax
+
+        _ACCUMULATE = jax.jit(
+            _accumulate_impl, static_argnames=("buckets", "vsize")
+        )
+    out = _ACCUMULATE(
+        run, switched, src_vid, dst_vid, valid,
+        buckets=buckets, vsize=vsize,
+    )
+    if GLOBAL_TELEMETRY.enabled:
+        model_train_passes_total().inc()
+    return tuple(np.asarray(a) for a in out)
+
+
+def build_vocab(batches: Sequence[dict], input_size: int,
+                max_vocab: int = MAX_VOCAB) -> np.ndarray:
+    """Learn the value vocabulary: every held value and switch target
+    across the batches, kept to the top `max_vocab` by count with
+    deterministic ties (count descending, then row bytes) — the order
+    that makes two trainings of the same journals produce bit-identical
+    tables."""
+    counts: Counter = Counter()
+    for ex in batches:
+        for rows, mask in (
+            (ex["src"], ex["valid"]),
+            (ex["dst"], ex["valid"] & ex["switched"]),
+        ):
+            picked = rows[mask]
+            if picked.size == 0:
+                continue
+            values, n = np.unique(picked, axis=0, return_counts=True)
+            for i in range(values.shape[0]):
+                counts[values[i].tobytes()] += int(n[i])
+    order = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    rows = [
+        np.frombuffer(b, dtype=np.uint8)
+        for b, _ in order[:max_vocab]
+    ]
+    if not rows:
+        return np.zeros((0, input_size), dtype=np.uint8)
+    return np.stack(rows).astype(np.uint8)
+
+
+def _encode_vids(rows: np.ndarray, vindex: Dict[bytes, int]) -> np.ndarray:
+    """u8[P, F, I] -> i32[P, F] vocab ids (-1 out-of-vocabulary)."""
+    P, F, _I = rows.shape
+    out = np.full((P, F), -1, dtype=np.int32)
+    for p in range(P):
+        for f in range(F):
+            out[p, f] = vindex.get(rows[p, f].tobytes(), -1)
+    return out
+
+
+def _pad_frames(n: int) -> int:
+    """Round the frame axis up to a power of two: bounded distinct jit
+    shapes across journals of different lengths."""
+    p = 8
+    while p < n:
+        p <<= 1
+    return p
+
+
+def update_tables(prior: Optional[ModelTables], batches: Iterable[dict],
+                  *, num_players: int, input_size: int,
+                  buckets: int = HAZARD_BUCKETS,
+                  max_vocab: int = MAX_VOCAB,
+                  decay: float = 1.0) -> ModelTables:
+    """One training pass: count the batches' examples (the jitted
+    vmapped accumulation) and fold them into `prior` with EMA `decay`
+    (None prior = zeros; decay 1.0 = pure accumulation). With a prior,
+    its vocabulary is FROZEN (tables must align across EMA steps);
+    without one, the vocabulary is learned from the batches.
+
+    Matches narrower than `num_players` (a fleet mixes 2/3/4-player
+    matches; the host-level model is as wide as the host) pad up the
+    player axis with invalid rows — player p's table row learns from
+    every match that HAS a player p. Wider matches refuse typed."""
+    batches = list(batches)
+    for ex in batches:
+        if ex["valid"].shape[0] > num_players:
+            raise ValueError(
+                f"example batch has {ex['valid'].shape[0]} players, "
+                f"the model only {num_players}"
+            )
+    if prior is not None:
+        if prior.buckets != buckets or prior.input_size != input_size:
+            raise ValueError(
+                f"prior tables ({prior.buckets} buckets, input "
+                f"{prior.input_size}) disagree with the update "
+                f"({buckets}, {input_size})"
+            )
+        vocab = np.asarray(prior.vocab)
+    else:
+        vocab = build_vocab(batches, input_size, max_vocab)
+    V = vocab.shape[0]
+    vindex = {vocab[i].tobytes(): i for i in range(V)}
+    total = np.zeros((num_players, buckets), dtype=np.float64)
+    switch = np.zeros((num_players, buckets), dtype=np.float64)
+    trans = np.zeros((num_players, V, V), dtype=np.float64)
+    support = np.zeros((num_players,), dtype=np.float64)
+    # group matches by padded frame length: one stacked accumulate call
+    # per shape bucket
+    groups: Dict[int, List[dict]] = {}
+    for ex in batches:
+        F = ex["valid"].shape[1]
+        if F == 0:
+            continue
+        groups.setdefault(_pad_frames(F), []).append(ex)
+    examples_seen = 0
+    for padded in sorted(groups):
+        group = groups[padded]
+        M = len(group)
+        P = num_players
+        run = np.zeros((M, P, padded), dtype=np.int32)
+        sw = np.zeros((M, P, padded), dtype=bool)
+        s_vid = np.full((M, P, padded), -1, dtype=np.int32)
+        d_vid = np.full((M, P, padded), -1, dtype=np.int32)
+        valid = np.zeros((M, P, padded), dtype=bool)
+        for m, ex in enumerate(group):
+            Pm, F = ex["valid"].shape
+            run[m, :Pm, :F] = ex["run"]
+            sw[m, :Pm, :F] = ex["switched"]
+            valid[m, :Pm, :F] = ex["valid"]
+            s_vid[m, :Pm, :F] = _encode_vids(ex["src"], vindex)
+            d_vid[m, :Pm, :F] = _encode_vids(ex["dst"], vindex)
+        d_total, d_switch, d_trans, d_support = _accumulate(
+            run, sw, s_vid, d_vid, valid, buckets=buckets, vsize=V,
+        )
+        total += d_total
+        switch += d_switch
+        if V:
+            trans += d_trans
+        support += d_support
+        examples_seen += int(valid.sum())
+    if GLOBAL_TELEMETRY.enabled and examples_seen:
+        model_examples_total().inc(examples_seen)
+    if prior is not None:
+        decay = float(decay)
+        total = decay * np.asarray(prior.total) + total
+        switch = decay * np.asarray(prior.switch) + switch
+        trans = decay * np.asarray(prior.trans) + trans
+        support = decay * np.asarray(prior.support) + support
+    return ModelTables(
+        vocab=vocab, switch=switch, total=total, trans=trans,
+        support=support, input_size=input_size,
+    )
+
+
+def train_on_examples(batches: Iterable[dict], *, num_players: int,
+                      input_size: int, buckets: int = HAZARD_BUCKETS,
+                      max_vocab: int = MAX_VOCAB,
+                      version: int = 0) -> ArrayInputModel:
+    """Fit a fresh ArrayInputModel from example batches (one pass,
+    learned vocabulary)."""
+    tables = update_tables(
+        None, list(batches), num_players=num_players,
+        input_size=input_size, buckets=buckets, max_vocab=max_vocab,
+    )
+    return ArrayInputModel(tables, version=version)
+
+
+def train_from_journal(roots, *, seed: int = 0,
+                       num_players: Optional[int] = None,
+                       input_size: Optional[int] = None,
+                       buckets: int = HAZARD_BUCKETS,
+                       max_vocab: int = MAX_VOCAB,
+                       version: int = 0,
+                       epochs: int = 1) -> Tuple[ArrayInputModel, dict]:
+    """Train from a host's journal_dir / a fleet's per-agent inventory.
+    Returns (model, watermark) — the watermark is the dataset meta
+    (journal count, frame frontier) the registry stamps into the
+    manifest. Counting is idempotent per example, so epochs > 1 only
+    reweights by an integer factor; the default single epoch is the
+    faithful estimator."""
+    ds = JournalDataset(roots, seed=seed)
+    meta = ds.meta()
+    if num_players is None:
+        num_players = meta.get("num_players")
+    if input_size is None:
+        input_size = meta.get("input_size")
+    if not num_players or not input_size:
+        raise ValueError(
+            "journal inventory carries no identity META — pass "
+            "num_players/input_size explicitly"
+        )
+    tables: Optional[ModelTables] = None
+    for epoch in range(max(1, int(epochs))):
+        tables = update_tables(
+            tables, ds.shards(epoch=epoch), num_players=num_players,
+            input_size=input_size, buckets=buckets, max_vocab=max_vocab,
+            decay=1.0,
+        )
+    return ArrayInputModel(tables, version=version), meta
+
+
+# ----------------------------------------------------------------------
+# actor/learner: an env fleet generates fresh trajectories from learned
+# opponents while the learner folds them back into the tables
+# ----------------------------------------------------------------------
+
+
+class _RecordingOpponent:
+    """Transparent wrapper capturing every acted row — the actor side's
+    trajectory recorder. Duck-typed to env.opponents.Opponent."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.rows: List[np.ndarray] = []
+
+    def bind(self, n_envs: int, input_size: int) -> None:
+        self.inner.bind(n_envs, input_size)
+
+    def act(self, t: int) -> np.ndarray:
+        row = self.inner.act(t)
+        self.rows.append(np.array(row, dtype=np.uint8, copy=True))
+        return row
+
+    def on_reset(self, mask: np.ndarray) -> None:
+        self.inner.on_reset(mask)
+
+    def state_dict(self):
+        return self.inner.state_dict()
+
+    def load_state_dict(self, state) -> None:
+        self.inner.load_state_dict(state)
+
+
+def actor_learner(model, game, *, rounds: int = 2,
+                  steps_per_round: int = 64, num_envs: int = 8,
+                  players: Optional[Sequence[int]] = None,
+                  seed: int = 0, decay: float = 0.5,
+                  buckets: int = HAZARD_BUCKETS,
+                  max_vocab: int = MAX_VOCAB) -> ArrayInputModel:
+    """Actor/learner rounds on one process: each round drives a
+    standalone `RollbackEnv` fleet whose opponent players sample from
+    the CURRENT model (`InputModelOpponent` — accepts the online or the
+    array model), records the trajectories they generate, extracts
+    examples (each env world is one match; non-opponent players are
+    marked disconnected so extraction skips them), and EMA-folds the
+    fresh counts into the tables. Returns the final ArrayInputModel.
+
+    `model` seeds round 0: an ArrayInputModel continues from its tables
+    (frozen vocabulary); an online InputHistoryModel only primes the
+    opponents, and round 0 learns tables from scratch."""
+    from ..env.opponents import InputModelOpponent
+    from ..env.rollback_env import RollbackEnv
+
+    P = game.num_players
+    I = game.input_size
+    if players is None:
+        players = tuple(range(1, P))  # handle 0 stays the agent
+    cur = model
+    tables = cur.tables if isinstance(cur, ArrayInputModel) else None
+    version = getattr(cur, "version", 0)
+    actions = np.zeros((num_envs, 1, I), dtype=np.uint8)
+    for r in range(max(1, int(rounds))):
+        recs = {
+            p: _RecordingOpponent(
+                InputModelOpponent(
+                    cur, seed=seed ^ (r * 0x9E3779B1) ^ p, player=p
+                )
+            )
+            for p in players
+        }
+        env = RollbackEnv(
+            game, num_envs=num_envs, opponents=dict(recs),
+            episode_len=0, auto_reset=False,
+        )
+        env.reset()
+        for _ in range(steps_per_round):
+            env.step(actions)
+        batches = []
+        statuses = np.full((steps_per_round, P), 2, dtype=np.int32)
+        statuses[:, list(players)] = 0
+        for n in range(num_envs):
+            inputs = np.zeros((steps_per_round, P, I), dtype=np.uint8)
+            for p, rec in recs.items():
+                inputs[:, p, :] = np.stack([rows[n] for rows in rec.rows])
+            batches.append(extract_examples(inputs, statuses))
+        tables = update_tables(
+            tables, batches, num_players=P, input_size=I,
+            buckets=buckets, max_vocab=max_vocab, decay=decay,
+        )
+        cur = ArrayInputModel(tables, version=version)
+    return cur

@@ -2681,6 +2681,72 @@ class SessionHost:
             return 0.0
         return self._spec.frames_adopted / self._spec.frames_draftable
 
+    # ------------------------------------------------------------------
+    # input-model hot-swap (ggrs_tpu/learn/ deploy seam)
+    # ------------------------------------------------------------------
+
+    @property
+    def input_model_version(self):
+        """Registry version of the installed draft model (None on a
+        non-speculating host or when drafting from the online model) —
+        what the fleet heartbeat reports."""
+        return self._spec.model_version if self._spec is not None else None
+
+    def install_input_model(self, model, *, version=None) -> None:
+        """Hot-swap the speculation draft model at a tick boundary:
+        every lane drafts its NEXT draft from a clone of `model`
+        (learn.ArrayInputModel — any InputHistoryModel works); None
+        reverts to per-lane online models. Standing drafts keep
+        standing and verify exactly as before — the model feeds only
+        the draft seam, so the never-speculating twin is provably
+        unaffected (the speculation parity suite pins this across the
+        swap). Identity mismatches refuse typed before any lane is
+        touched."""
+        from ..errors import ModelIncompatible
+        from ..learn.metrics import model_installs_total, model_version_gauge
+
+        if self._spec is None:
+            raise InvalidRequest(
+                "install_input_model needs a speculation=True host"
+            )
+        if model is not None:
+            found = (model.num_players, model.input_size)
+            expected = (self._spec.num_players, self._spec.input_size)
+            if found != expected:
+                raise ModelIncompatible(
+                    "input model (players, input_size) mismatch",
+                    found=found, expected=expected,
+                )
+            if version is None:
+                version = getattr(model, "version", None)
+        self._spec.install_model(model, version=version)
+        model_installs_total().inc()
+        model_version_gauge().set(float(version or 0))
+        if GLOBAL_TELEMETRY.enabled:
+            GLOBAL_TELEMETRY.record(
+                "input_model_installed",
+                version=version,
+                model_kind=getattr(model, "kind", None) if model is not None
+                else "online",
+                lanes=len(self._spec._lanes),
+            )
+
+    def export_input_model_state(self, key: Any) -> Optional[dict]:
+        """A lane's learned input statistics by value (None when not
+        speculating) — migration tickets carry this so the destination
+        resumes speculation warm instead of relearning from zero."""
+        if self._spec is None:
+            return None
+        return self._spec.export_model_state(key)
+
+    def import_input_model_state(self, key: Any,
+                                 state: Optional[dict]) -> bool:
+        """Seed an adopted lane's model from exported statistics;
+        incompatible exports degrade to a cold start, never an error."""
+        if self._spec is None or not state:
+            return False
+        return self._spec.import_model_state(key, state)
+
     def telemetry(self) -> dict:
         """One structured snapshot: the process-wide obs snapshot
         (metrics incl. the host instruments, flight-recorder tail, tracer

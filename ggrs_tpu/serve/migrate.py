@@ -84,19 +84,23 @@ class MigrationTicket:
     reference — it IS the continuity the peers observe), the exported
     device slot bytes, and the lane bookkeeping. `slot_state=None` marks
     a restore-from-checkpoint ticket: the destination's stacked worlds
-    already hold the bytes at `slot`."""
+    already hold the bytes at `slot`. `input_stats` carries the source
+    lane's learned input-model statistics by value (None when the source
+    was not speculating) so speculation resumes warm on the destination
+    instead of relearning every player's habits from zero."""
 
     __slots__ = ("session", "key", "slot", "current_frame",
-                 "pending_inputs", "slot_state")
+                 "pending_inputs", "slot_state", "input_stats")
 
     def __init__(self, session, key, slot, current_frame,
-                 pending_inputs, slot_state):
+                 pending_inputs, slot_state, input_stats=None):
         self.session = session
         self.key = key
         self.slot = slot
         self.current_frame = current_frame
         self.pending_inputs = frozenset(pending_inputs)
         self.slot_state = slot_state
+        self.input_stats = input_stats
 
 
 def _resume_endpoints(session, now_ms: int) -> None:
@@ -147,6 +151,7 @@ def export_session(host: SessionHost, key: Any) -> MigrationTicket:
     ticket = MigrationTicket(
         lane.session, key, lane.slot, lane.current_frame,
         set(lane.pending_inputs), slot_state,
+        host.export_input_model_state(key),  # before detach drops the lane
     )
     host.detach(key)
     if GLOBAL_TELEMETRY.enabled:
@@ -174,6 +179,10 @@ def import_session(host: SessionHost, ticket: MigrationTicket, *,
         key=key,
         slot=slot,
     )
+    if ticket.input_stats is not None:
+        # warm the destination's speculation lane; an incompatible or
+        # absent planner degrades to a cold start, never a failed import
+        host.import_input_model_state(new_key, ticket.input_stats)
     _resume_endpoints(ticket.session, host.clock.now_ms())
     return new_key
 

@@ -205,6 +205,12 @@ class SpeculationPlanner:
         self.seed = seed
         self.width = max(1, width)
         self._model_cls = InputHistoryModel
+        # installed trained model (learn.ArrayInputModel): when set,
+        # every lane drafts from a clone of it instead of a fresh
+        # online Counter model
+        self._proto = None
+        self.model_version: Optional[int] = None
+        self.model_swaps = 0
         self._lanes: Dict[Any, _LaneSpec] = {}
         # lifetime stats (host section + bench short line, no telemetry
         # dependency — plain ints like the host's session counters)
@@ -226,9 +232,14 @@ class SpeculationPlanner:
     # lane lifecycle
     # ------------------------------------------------------------------
 
+    def _fresh_model(self):
+        if self._proto is not None:
+            return self._proto.clone()
+        return self._model_cls(self.num_players, self.input_size)
+
     def attach(self, key: Any, *, num_players: Optional[int] = None) -> None:
         self._lanes[key] = _LaneSpec(
-            self._model_cls(self.num_players, self.input_size),
+            self._fresh_model(),
             # per-lane counter-rng stream id: a crc of the host key (a
             # pure function of the key — hash() is process-salted and
             # the DET lint rightly rejects it)
@@ -245,6 +256,53 @@ class SpeculationPlanner:
         ls = self._lanes.pop(key, None)
         if ls is not None and ls.draft is not None:
             self._discard(ls)
+
+    # ------------------------------------------------------------------
+    # model hot-swap (learn/ deploy seam) + migration stats carry
+    # ------------------------------------------------------------------
+
+    def install_model(self, prototype, *, version: Optional[int] = None
+                      ) -> None:
+        """Swap the draft model fleet-wide at a tick boundary: every
+        lane gets a fresh clone of `prototype` (None reverts to per-lane
+        online Counter models). Standing drafts are left STANDING — the
+        verify pass consults only the played rows, never the model, so
+        an in-flight draft stays exactly as adoptable as before the
+        swap; the new model first matters at the next plan_draft. That
+        is also the whole twin-parity argument: the model feeds nothing
+        but the draft seam, and the adopt route is verify-gated, so a
+        never-speculating twin cannot observe which model drafted."""
+        self._proto = prototype
+        self.model_version = version
+        self.model_swaps += 1
+        for ls in self._lanes.values():
+            ls.model = self._fresh_model()
+            # the fresh model's run trackers are cold: the next
+            # record_segment finalization pass re-primes them row by
+            # row, exactly like a newly-attached lane
+
+    def export_model_state(self, key: Any) -> Optional[dict]:
+        """The lane model's learned statistics by value (JSON-safe) —
+        what a migration ticket carries so the destination's speculation
+        resumes warm."""
+        ls = self._lanes.get(key)
+        return ls.model.state_dict() if ls is not None else None
+
+    def import_model_state(self, key: Any, state: Optional[dict]) -> bool:
+        """Load exported statistics into an attached lane's model.
+        Kind/identity mismatches (online stats arriving at a lane
+        drafting from a different installed model) degrade to a cold
+        start — migration must never fail on prediction statistics."""
+        from ..errors import ModelIncompatible
+
+        ls = self._lanes.get(key)
+        if ls is None or not state:
+            return False
+        try:
+            ls.model.load_state_dict(state)
+        except ModelIncompatible:
+            return False
+        return True
 
     # ------------------------------------------------------------------
     # per-segment bookkeeping (host._stage_segment calls this for every
@@ -614,6 +672,9 @@ class SpeculationPlanner:
     def section(self) -> dict:
         """The host telemetry section's speculation block."""
         return {
+            # draft-model provenance: None = the online Counter model
+            "model_version": self.model_version,
+            "model_swaps": self.model_swaps,
             "drafts": self.drafts_launched,
             "frames_drafted": self.frames_drafted,
             "frames_draftable": self.frames_draftable,

@@ -117,6 +117,32 @@ class _PlayerStats:
         ranked = c.most_common(limit)
         return [(v, n / total) for v, n in ranked if n > 0]
 
+    # -- migration carry (JSON-safe: byte values travel hex-encoded) ---
+
+    def state_dict(self) -> dict:
+        return {
+            "cur_value": (
+                self.cur_value.hex() if self.cur_value is not None else None
+            ),
+            "cur_len": self.cur_len,
+            "holds": list(self.holds),
+            "trans_log": [(s.hex(), d.hex()) for s, d in self.trans_log],
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        cv = state.get("cur_value")
+        self.cur_value = bytes.fromhex(cv) if cv is not None else None
+        self.cur_len = int(state.get("cur_len", 0))
+        # the deques are the source of truth; the Counters mirror them
+        self.holds = deque(int(h) for h in state.get("holds", ()))
+        self.hold_counts = Counter(self.holds)
+        self.transitions = {}
+        self.trans_log = deque()
+        for s_hex, d_hex in state.get("trans_log", ()):
+            src, dst = bytes.fromhex(s_hex), bytes.fromhex(d_hex)
+            self.transitions.setdefault(src, Counter())[dst] += 1
+            self.trans_log.append((src, dst))
+
 
 class InputHistoryModel:
     """Per-player hold/transition statistics over finalized input rows.
@@ -133,6 +159,12 @@ class InputHistoryModel:
     # smears over adjacent offsets, and members are too scarce to spend
     # more than this on a single player's timing uncertainty
     MAX_SPECS_PER_PLAYER = 3
+
+    # state_dict discriminator: a migration ticket's exported statistics
+    # only load into the same kind of model (learn.ArrayInputModel
+    # overrides this — its tables are frozen and travel by registry
+    # version, not by ticket)
+    kind = "online"
 
     def __init__(self, num_players: int, input_size: int):
         self.num_players = num_players
@@ -151,6 +183,30 @@ class InputHistoryModel:
 
     def reset(self) -> None:
         self._stats = [_PlayerStats() for _ in self._stats]
+
+    def state_dict(self) -> dict:
+        """Everything learned, by value and JSON-safe — what a migration
+        ticket carries so a migrated session's speculation resumes warm
+        instead of relearning from MIN_HOLDS."""
+        return {
+            "kind": self.kind,
+            "num_players": self.num_players,
+            "input_size": self.input_size,
+            "players": [st.state_dict() for st in self._stats],
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        from ..errors import ModelIncompatible
+
+        for field in ("kind", "num_players", "input_size"):
+            found, expected = state.get(field), getattr(self, field)
+            if found != expected:
+                raise ModelIncompatible(
+                    f"input-model state {field} mismatch",
+                    found=found, expected=expected,
+                )
+        for st, sd in zip(self._stats, state["players"]):
+            st.load_state_dict(sd)
 
     def rank_branches(
         self,
@@ -179,17 +235,13 @@ class InputHistoryModel:
         third of its adoptions that way). The caller composes the specs
         into beam members (beam.branching_beam's prediction stream).
 
-        APPROXIMATION NOTE: the score uses the raw hazard h(run + d - 1)
-        alone — the exact switch-at-offset-d probability is that hazard
-        times the survival product over the intervening frames,
-        prod(1 - h(t)) for t in [run, run + d - 1). Dropping the survival
-        factor biases scores toward LATER offsets whenever hazard rises
-        with hold length (the product shrinks as d grows, and later
-        offsets skip more of it). Ranking-only — adoption correctness
-        never depends on it, and the round-robin allocation plus
-        MAX_SPECS_PER_PLAYER bound the damage to spec ordering within one
-        player; multiply in the survival product if ranking quality on
-        long rollouts ever matters."""
+        The score is the EXACT switch-at-offset-d probability: the
+        hazard h(run + d - 1) times the survival product over the
+        intervening frames, prod(1 - h(t)) for t in [run, run + d - 1),
+        times P(value | held value). (Until PR 18 the survival factor
+        was dropped — a documented approximation that biased scores
+        toward LATER offsets whenever hazard rises with hold length,
+        because later offsets skipped more of the shrinking product.)"""
         per_player: List[List[Tuple[float, int, int, bytes]]] = []
         for p in range(self.num_players):
             if confirmed[p] is None:
@@ -204,16 +256,23 @@ class InputHistoryModel:
             scored: List[Tuple[float, int, int, bytes]] = []
             # the switch can land at any not-yet-confirmed frame: frame
             # frontier + d (d >= 1) means the value was held run + d - 1
-            # frames in total before switching
+            # frames in total before switching; `surv` carries
+            # prod(1 - h(t)) for t in [run, run + d - 1) and must
+            # accumulate across EVERY d, including offsets outside the
+            # beam window — survival through them still discounts later
+            # candidates
+            surv = 1.0
             for d in range(1, rollout + 1):
+                h = st.hazard(run + d - 1)
                 offset = frontier + d - anchor_frame
                 if offset < 0 or offset >= rollout:
+                    surv *= 1.0 - h
                     continue
-                h = st.hazard(run + d - 1)
-                if h <= 0.0:
-                    continue
-                for v, pv in succ:
-                    scored.append((h * pv, p, offset, v))
+                if h > 0.0 and surv > 0.0:
+                    w = h * surv
+                    for v, pv in succ:
+                        scored.append((w * pv, p, offset, v))
+                surv *= 1.0 - h
             if scored:
                 scored.sort(key=lambda t: (-t[0], t[2]))
                 per_player.append(scored[: self.MAX_SPECS_PER_PLAYER])

@@ -95,6 +95,15 @@
 #   BOTH exporters (scripts/journal_smoke.py, CPU jax, ~1 min). Also
 #   runs in the default flow (step 2g): durability is a correctness
 #   surface, not an optional extra.
+#   --learn-smoke runs the whole learning loop end to end: journal a
+#   seeded loadgen fleet, train an ArrayInputModel on the WAL segments,
+#   publish + reload it through a checksummed registry, hot-swap it
+#   into a fresh speculating host and serve starved traffic under
+#   GGRS_SANITIZE=1 — gated on speculation engaging with a positive hit
+#   rate, zero post-warmup recompiles, and the ggrs_model_*
+#   instruments through BOTH exporters (scripts/learn_smoke.py, CPU
+#   jax, ~1-2 min). Also runs in the default flow (step 2h): the
+#   learning loop is a correctness surface, not an optional extra.
 #   --lint runs the determinism/trace/fence/wire static-analysis gate
 #   (python -m ggrs_tpu.analysis, pure AST, no jax, seconds) against
 #   analysis/baseline.toml, then the retrace-sanitizer smoke
@@ -199,6 +208,14 @@ if [ "${1:-}" = "--journal-smoke" ]; then
   exit $?
 fi
 
+if [ "${1:-}" = "--learn-smoke" ]; then
+  echo "== learn smoke (journal -> train -> registry -> hot-swap serve) =="
+  GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python scripts/learn_smoke.py
+  exit $?
+fi
+
 if [ "${1:-}" = "--spec-smoke" ]; then
   echo "== spec smoke (speculative bubble-filling, single-device + sharded) =="
   GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
@@ -238,6 +255,11 @@ GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/fault_smoke.py
 
 echo "== [2g/5] journal smoke (durable journal + journal-only recovery) =="
 JAX_PLATFORMS=cpu python scripts/journal_smoke.py
+
+echo "== [2h/5] learn smoke (journal -> train -> registry -> hot-swap serve) =="
+GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python scripts/learn_smoke.py
 
 if [ "$FAST" = "0" ]; then
   echo "== [3/5] UBSAN build + native/wire tests =="

@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""Learning-loop smoke gate (scripts/check.sh --learn-smoke): the whole
+journal -> train -> registry -> hot-swap pipeline end to end, under
+GGRS_SANITIZE=1:
+
+  1. JOURNAL: a seeded 8-match loadgen fleet serves held-value scripts
+     with every p2p lane journaled (`journal_dir`), leaving a durable
+     per-lane WAL of confirmed input rows;
+  2. TRAIN: `train_from_journal` streams those segments into example
+     tensors and one jitted accumulation pass per shape bucket — the
+     trained ArrayInputModel must have consumed examples for every
+     player and carry the journal-frontier watermark;
+  3. REGISTRY: publish + load round-trips through a checksummed
+     versioned snapshot (`ModelRegistry`), byte-identical;
+  4. SERVE: a fresh SessionHost(speculation=True) installs the loaded
+     version at a tick boundary (`install_input_model`) and serves the
+     same seeded starved traffic shape — speculation engages (frames
+     served from drafts, hit rate > 0), with ZERO post-warmup
+     recompiles (the array model feeds the same jitted draft/adopt
+     programs the online model does);
+  5. the ggrs_model_* instruments (installs counter, version gauge,
+     train passes, examples, published) export through BOTH exporters.
+
+Runs on CPU (JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count=8,
+both self-applied) in about a minute. Exits nonzero with a reason on any
+failure.
+"""
+
+import os
+import re
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("GGRS_SANITIZE", "1")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+from ggrs_tpu import enable_global_telemetry  # noqa: E402
+from ggrs_tpu.obs import GLOBAL_TELEMETRY  # noqa: E402
+
+SESSIONS = 8
+TICKS = 120
+HOLE_EVERY = 30
+HOLE_LEN = 12
+SEED = 7
+
+
+def fail(reason):
+    print(f"learn-smoke FAIL: {reason}")
+    sys.exit(1)
+
+
+def validate_prometheus(text):
+    sample = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(\{[a-zA-Z_][a-zA-Z0-9_:]*="(\\.|[^"\\])*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*\})?'
+        r" -?[0-9.eE+-]+$"
+    )
+    comment = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*( .*)?$")
+    for line in text.strip().splitlines():
+        ok = comment.match(line) if line.startswith("#") else sample.match(line)
+        if not ok:
+            fail(f"unparseable prometheus line: {line!r}")
+    return text
+
+
+def build_fleet(*, speculation, journal_dir=None, starved, seed=SEED):
+    """The PR 10 starved-fleet traffic shape: held-value scripts over a
+    WAN-shaped lossy mesh; `starved=True` blackholes peer 0 of every
+    match for HOLE_LEN ticks every HOLE_EVERY — the outage that makes
+    the scheduler draft. Returns (host, keys, drive) with the drive
+    deferred, so a model can install at the tick boundary between the
+    sync and the scripted serve."""
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.network.sockets import InMemoryNetwork
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.serve.loadgen import (
+        build_matches,
+        drive_scripted,
+        held_scripts,
+        starve_on_tick,
+        sync_fleet,
+    )
+    from ggrs_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    net = InMemoryNetwork(
+        clock, latency_ms=20, jitter_ms=6, loss=0.01, seed=seed
+    )
+    host = SessionHost(
+        ExGame(num_players=4, num_entities=16),
+        max_prediction=8, num_players=4, max_sessions=SESSIONS + 4,
+        clock=clock, idle_timeout_ms=0, warmup=True,
+        speculation=speculation, journal_dir=journal_dir,
+    )
+    matches = build_matches(host, net, clock, sessions=SESSIONS, seed=seed)
+    sync_fleet(host, matches, clock)
+    scripts = held_scripts(matches, TICKS, seed)
+
+    def drive():
+        drive_scripted(
+            host, matches, clock, scripts, TICKS,
+            on_tick=(
+                starve_on_tick(
+                    net, matches, hole_every=HOLE_EVERY, hole_len=HOLE_LEN
+                ) if starved else None
+            ),
+        )
+        host.device.block_until_ready()
+        if host.desyncs_observed:
+            fail(f"fleet desynced (speculation={speculation})")
+
+    return host, [k for keys in matches for k in keys], drive
+
+
+def main():
+    enable_global_telemetry()
+
+    import ggrs_tpu.tpu  # noqa: F401  (installs the GGRS_SANITIZE wrapper)
+    from ggrs_tpu.analysis.sanitize import active_sanitizer
+    from ggrs_tpu.learn import ModelRegistry, train_from_journal
+    from ggrs_tpu.models.ex_game import ExGame
+
+    san = active_sanitizer()
+    if san is None:
+        fail("sanitizer not installed (GGRS_SANITIZE=1 expected)")
+
+    with tempfile.TemporaryDirectory() as tmp:
+        journal_dir = os.path.join(tmp, "journal")
+
+        # --- 1. journal a seeded fleet -------------------------------
+        host, keys, drive = build_fleet(
+            speculation=False, journal_dir=journal_dir, starved=False,
+        )
+        drive()
+        for k in list(keys):
+            host.detach(k)  # final-drain + close every lane's writer
+        segs = [
+            os.path.join(d, f)
+            for d, _, fs in os.walk(journal_dir)
+            for f in fs if f.endswith(".wal")
+        ]
+        if not segs:
+            fail(f"no journal segments written under {journal_dir}")
+
+        # --- 2. train ------------------------------------------------
+        # num_players pinned to the HOST width: the fleet mixes 2/3/4-
+        # player matches and the model must be as wide as the host that
+        # installs it (narrower journals pad up in the trainer)
+        model, watermark = train_from_journal(
+            [journal_dir], seed=SEED, num_players=4,
+        )
+        if model.num_players != 4 or model.input_size != ExGame(
+            num_players=4, num_entities=16
+        ).input_size:
+            fail(f"trained model identity wrong: {model.tables.meta()}")
+        support = model.tables.support
+        if float(support.sum()) <= 0:
+            fail("trained model saw zero examples")
+        if not watermark.get("frames"):
+            fail(f"empty journal watermark: {watermark}")
+        print(
+            f"  trained: players={model.num_players} "
+            f"vocab={model.tables.vocab_size} "
+            f"examples={int(support.sum())} "
+            f"watermark_frames={watermark['frames']}"
+        )
+
+        # --- 3. registry round-trip ----------------------------------
+        reg = ModelRegistry(os.path.join(tmp, "registry"))
+        game = ExGame(num_players=4, num_entities=16)
+        version = reg.publish(model, game=game, watermark=watermark)
+        loaded = reg.load(version, game=game)
+        if loaded.to_bytes() != model.to_bytes():
+            fail("registry round-trip not byte-identical")
+
+        # --- 4. hot-swap into a starved speculating serve ------------
+        base = len(san.recompiles)
+        host_on, _keys_on, drive_on = build_fleet(
+            speculation=True, starved=True,
+        )
+        # install BEFORE the starved drive, at the tick boundary
+        # between the sync and the scripted serve — every draft then
+        # comes from the trained model
+        host_on.install_input_model(loaded)
+        if host_on.input_model_version != version:
+            fail(
+                f"installed version {host_on.input_model_version} "
+                f"!= published {version}"
+            )
+        drive_on()
+        floor = len(san.recompiles)
+        if host_on.frames_served_from_speculation <= 0:
+            fail(
+                "no frames served from speculation under the trained "
+                f"model (section: {host_on._spec.section()})"
+            )
+        if host_on.spec_hit_rate <= 0.0:
+            fail(f"trained-model hit rate not positive: "
+                 f"{host_on._spec.section()}")
+        on_recompiles = san.recompiles[base:floor]
+        if on_recompiles:
+            fail(
+                "post-warmup recompile under the installed model:\n"
+                + "\n".join(e.render() for e in on_recompiles)
+            )
+        sec = host_on._spec.section()
+        if sec["model_version"] != version or sec["model_swaps"] < 1:
+            fail(f"speculation section missed the swap: {sec}")
+        print(
+            f"  served={host_on.frames_served_from_speculation} "
+            f"hit_rate={sec['hit_rate']} version={sec['model_version']}"
+        )
+
+        # --- 5. instruments through both exporters -------------------
+        snap = host_on.telemetry()
+        m = snap["metrics"]
+        for name in (
+            "ggrs_model_train_passes_total",
+            "ggrs_model_examples_total",
+            "ggrs_model_published_total",
+            "ggrs_model_installs_total",
+            "ggrs_model_version",
+        ):
+            if name not in m:
+                fail(f"{name} missing from the snapshot exporter")
+        prom = validate_prometheus(GLOBAL_TELEMETRY.prometheus())
+        for name in (
+            "ggrs_model_train_passes_total",
+            "ggrs_model_examples_total",
+            "ggrs_model_published_total",
+            "ggrs_model_installs_total",
+            "ggrs_model_version",
+        ):
+            if name not in prom:
+                fail(f"{name} missing from the prometheus exporter")
+
+    print("learn-smoke OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -176,7 +176,7 @@ class Rig:
 
     def __init__(self, tmp_path, n_agents=2, *, max_sessions=8,
                  hb_interval_ms=50, suspicion_misses=4,
-                 checkpoint_every=8, seed=1):
+                 checkpoint_every=8, seed=1, **core_kw):
         self.clock = FakeClock()
         self.base = str(tmp_path)
         self.game = ExGame(num_players=2, num_entities=ENTITIES)
@@ -190,19 +190,19 @@ class Rig:
             self.add_agent(max_sessions=max_sessions,
                            hb_interval_ms=hb_interval_ms,
                            checkpoint_every=checkpoint_every,
-                           label=f"a{i}")
+                           label=f"a{i}", **core_kw)
         self.director.on_wait = lambda: self.pump(1, 2)
         self.pump(10)
         assert len(self.director.hosts) == n_agents
 
     def add_agent(self, *, max_sessions=8, hb_interval_ms=50,
-                  checkpoint_every=8, label=""):
+                  checkpoint_every=8, label="", **core_kw):
         a_conn, d_conn = conn_pair()
         core = AgentCore(
             self.game, base_dir=self.base, clock=self.clock,
             max_sessions=max_sessions, num_players=2,
             hb_interval_ms=hb_interval_ms,
-            checkpoint_every=checkpoint_every, label=label,
+            checkpoint_every=checkpoint_every, label=label, **core_kw,
         )
         core.attach_conn(a_conn)
         self.director.attach_conn(d_conn)
@@ -279,6 +279,68 @@ def test_release_match_frees_capacity(tmp_path):
     rig.director.release_match(1)
     rig.pump(3)
     rig.director.place_match(_spec(2, ticks=16))  # fits again
+
+
+# ----------------------------------------------------------------------
+# learned-model rollout: staged deploy + instant rollback
+# ----------------------------------------------------------------------
+
+def test_model_rollout_staged_with_instant_rollback(tmp_path):
+    """The deploy plane (ggrs_tpu/learn/ -> fleet): rollout_model pushes
+    a published blob to live hosts ONE at a time, heartbeats advertise
+    the deployed version and live hit rate, and a hit-rate regression
+    after a staged install instantly rolls every upgraded host back to
+    the model it displaced (the agent-local undo buffer) and stops the
+    rollout before the rest of the fleet is exposed."""
+    import numpy as np
+
+    from ggrs_tpu.learn import extract_examples, train_on_examples
+
+    rig = Rig(tmp_path, speculation=True)
+    # a tiny trained model matching the rig's game identity (2p, 1 byte)
+    vals = []
+    for c in range(10):
+        vals += [5 if c % 2 == 0 else 9] * 6
+    inputs = np.repeat(
+        np.array(vals, dtype=np.uint8).reshape(-1, 1, 1), 2, axis=1
+    )
+    statuses = np.zeros(inputs.shape[:2], dtype=np.int32)
+    model = train_on_examples(
+        [extract_examples(inputs, statuses)], num_players=2, input_size=1,
+    )
+
+    model.version = 1
+    res = rig.director.rollout_model(
+        model.to_bytes(), version=1, drive=lambda: rig.pump(3),
+    )
+    assert res["installed"] == [0, 1] and not res["rolled_back"]
+    assert res["skipped"] == {}
+    for a in rig.agents:
+        assert a.host.input_model_version == 1
+    rig.pump(8)  # heartbeats advertise the deployed version + hit rate
+    for hr in rig.director.hosts.values():
+        assert hr.model_version == 1
+        assert hr.model_hit_rate is not None
+
+    # --- version 2 tanks host 0's hit rate: fleet-wide instant rollback
+    spec0 = rig.agents[0].host._spec
+    spec0.frames_draftable = 100
+    spec0.frames_adopted = 60  # baseline 0.6 reported at the swap
+
+    def regressing_drive():
+        spec0.frames_adopted = 10  # post-deploy rate collapses to 0.1
+        rig.pump(8)  # heartbeats carry the fresh rate to the director
+
+    model.version = 2
+    res2 = rig.director.rollout_model(
+        model.to_bytes(), version=2, drive=regressing_drive,
+    )
+    assert res2["rolled_back"] and res2["regressed"] == 0
+    assert res2["installed"] == [0]  # host 1 never saw version 2
+    # every upgraded host is back on the displaced model, fleet-wide
+    assert rig.agents[0].host.input_model_version == 1
+    assert rig.agents[1].host.input_model_version == 1
+    assert rig.director.hosts[0].model_version == 1
 
 
 # ----------------------------------------------------------------------

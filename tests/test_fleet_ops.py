@@ -209,6 +209,85 @@ def test_export_import_preserves_pending_inputs_and_frame():
     assert h2._lanes[new_key].current_frame == 1
 
 
+def test_migration_carries_input_model_stats():
+    """Speculating hosts: the migration ticket carries the lane's
+    learned input statistics by value (MigrationTicket.input_stats), so
+    the destination resumes WARM — its draft model ranks switch
+    candidates immediately, where a stats-dropped control restarts cold
+    below MIN_HOLDS — and a starved post-handoff drive keeps the
+    speculation hit rate positive with zero desyncs: prediction
+    continuity across the handoff, not a relearn-from-scratch."""
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=10, jitter_ms=0, loss=0.0)
+    h1 = make_host(clock, speculation=True)
+    h2 = make_host(clock, speculation=True)
+    h3 = make_host(clock, speculation=True)
+    p0 = peer(net, clock, "i0", "i1", 0, seed=70)
+    p1 = peer(net, clock, "i1", "i0", 1, seed=71)
+    k0, k1 = h1.attach(p0), h1.attach(p1)
+    sync_all([h1, h2, h3], [p0, p1], clock)
+
+    desyncs = []
+
+    def tick_all():
+        for host in (h1, h2, h3):
+            for _, evs in host.tick().items():
+                desyncs.extend(
+                    e for e in evs if type(e).__name__ == "DesyncDetected"
+                )
+        clock.advance(FRAME_MS)
+
+    # 6-frame toggle holds: the shape the lane models learn from
+    # finalized rows (frames beyond rollback reach)
+    script = lambda h, t: (5 if (t // 6) % 2 == 0 else 9) + 4 * h
+    for t in range(60):
+        h1.submit_input(k0, 0, bytes([script(0, t)]))
+        h1.submit_input(k1, 1, bytes([script(1, t)]))
+        tick_all()
+
+    # --- the handoff: the ticket carries the learned stats by value
+    ticket = export_session(h1, k0)
+    assert ticket.input_stats is not None
+    assert ticket.input_stats["kind"] == "online"
+    assert any(p["holds"] for p in ticket.input_stats["players"])
+    new_k0 = import_session(h2, ticket)
+    warm = h2.export_input_model_state(new_k0)
+    assert warm == ticket.input_stats  # loaded, re-exported: identical
+    warm_model = h2._spec._lanes[new_k0].model
+    assert warm_model._stats[0].n_holds() >= warm_model.MIN_HOLDS
+    # warm: ranks a switch candidate for the held value immediately
+    probe = [(60, bytes([5]), 3), None]
+    assert warm_model.rank_branches(probe, 60, 8, 6)
+
+    # --- control: the same ticket with the stats dropped imports COLD
+    ticket2 = export_session(h2, new_k0)
+    stats2 = ticket2.input_stats
+    ticket2.input_stats = None
+    k3 = import_session(h3, ticket2)
+    cold_model = h3._spec._lanes[k3].model
+    assert cold_model._stats[0].n_holds() == 0
+    assert cold_model.rank_branches(probe, 60, 8, 6) == []
+    # restoring the dropped stats warms the lane back up
+    assert h3.import_input_model_state(k3, stats2)
+    assert h3._spec._lanes[k3].model._stats[0].n_holds() >= 3
+
+    # --- hit-rate continuity: starve the migrated lane on its new home
+    # (peer blackholed past the prediction window); held values make the
+    # recovery a lineage full hit, so adoption must flow post-handoff
+    for t in range(60, 130):
+        if t == 70:
+            net.set_blackhole({"i1"}, True)
+        if t == 84:
+            net.set_blackhole({"i1"}, False)
+        h3.submit_input(k3, 0, bytes([5]))
+        h1.submit_input(k1, 1, bytes([9]))
+        tick_all()
+    sec = h3._spec.section()
+    assert sec["frames_adopted"] > 0 and sec["hit_rate"] > 0.0, sec
+    assert not desyncs, f"handoff drive desynced: {desyncs[:3]}"
+    assert p0.current_frame > 80 and p1.current_frame > 80
+
+
 def test_sparse_saving_hosted_session_survives_wan_rtt():
     """Regression for the prediction-threshold gate under SPARSE SAVING:
     set_last_confirmed_frame clamps the watermark to last_saved_frame,

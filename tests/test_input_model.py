@@ -75,6 +75,40 @@ def test_rank_branches_puts_true_switch_first():
     assert all(pp == 0 for pp, _, _ in preds)
 
 
+def feed_holds(model, player, lengths, a=5, b=9):
+    """Alternate two values, holding each for the next length in
+    `lengths`; a trailing observe closes the final run so every length
+    is recorded."""
+    vals = (a, b)
+    for i, ln in enumerate(lengths):
+        for _ in range(ln):
+            model.observe(player, bytes([vals[i % 2]]))
+    model.observe(player, bytes([vals[len(lengths) % 2]]))
+
+
+def test_rank_branches_survival_discount_beats_raw_hazard():
+    """The exact switch-at-offset-d score is hazard(run+d-1) times the
+    SURVIVAL product over the intervening frames. This distribution
+    makes the two orderings disagree: hazard peaks at hold length 6
+    (raw-hazard ranking would bet on the later offset first), but
+    enough mass switches at 5 that surviving past it is unlikely — the
+    exact score puts the EARLIER offset first. Pinned so the survival
+    factor can't silently regress back to the pre-PR-18 approximation."""
+    m = InputHistoryModel(1, 1)
+    # hold_counts {5: 10, 6: 8}: h(5) ~= 0.538, h(6) ~= 0.895
+    feed_holds(m, 0, [5] * 10 + [6] * 8)
+    st = m._stats[0]
+    # raw hazard prefers the LATER offset...
+    assert st.hazard(6) > st.hazard(5) > 0.4
+    # ...but the survival-discounted score prefers the earlier one
+    assert st.hazard(5) > st.hazard(6) * (1.0 - st.hazard(5))
+    # run=5 at the frontier: offset 1 completes hold 5, offset 2 hold 6
+    preds = m.rank_branches([(100, bytes([5]), 5)], 100, 8, 6)
+    offsets = [off for _p, off, _row in preds]
+    assert offsets[:2] == [1, 2], offsets
+    assert all(row[0] == 9 for _p, _off, row in preds)
+
+
 def test_rank_branches_respects_rollout_bounds():
     m = InputHistoryModel(1, 1)
     feed_toggle(m, 0, hold=6)

@@ -1,0 +1,406 @@
+"""The learning loop (ggrs_tpu/learn/): journal -> dataset -> trainer ->
+registry -> hot-swap, pinned end to end.
+
+Dataset extraction is held to the journal's durability edge cases (empty
+journal, torn tail, mid-rotation segment boundary, disconnect dummy rows
+severing runs) and to the determinism claim that makes fleet journals
+usable as training data at all: the SAME seeded match journaled by a
+sharded host and a single-device host extracts byte-identical example
+tensors.
+
+The acceptance surface is the full loop: journal a seeded starved fleet,
+train an ArrayInputModel on the WAL, publish/load through the registry,
+hot-swap it into a LIVE speculating host at a tick boundary mid-serve —
+the trained model's speculation hit rate must meet or beat the online
+Counter model's on the same seeded starved traffic, while the host stays
+a bitwise replica of a never-speculating twin ACROSS the swap (single
+device and sharded)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ggrs_tpu.errors import ModelIncompatible
+from ggrs_tpu.journal.wal import JournalWriter, scan_journal
+from ggrs_tpu.learn import (
+    ArrayInputModel,
+    JournalDataset,
+    ModelRegistry,
+    extract_examples,
+    train_from_journal,
+    train_on_examples,
+)
+from ggrs_tpu.models.ex_game import ExGame
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.serve import SessionHost
+from ggrs_tpu.serve.loadgen import (
+    build_matches,
+    drive_scripted,
+    held_scripts,
+    starve_on_tick,
+    sync_fleet,
+)
+from ggrs_tpu.utils.clock import FakeClock
+
+from test_speculation import ENTITIES, assert_bitwise_twin, run_starved
+
+SEED = 7
+TICKS = 90
+
+
+# ----------------------------------------------------------------------
+# extraction semantics
+# ----------------------------------------------------------------------
+
+
+def _toggle_inputs(a=5, b=9, hold=6, cycles=8):
+    """u8[F, 1, 1] toggle stream: `hold` frames of a, `hold` of b, ..."""
+    vals = []
+    for c in range(cycles):
+        vals += [a if c % 2 == 0 else b] * hold
+    F = len(vals)
+    inputs = np.array(vals, dtype=np.uint8).reshape(F, 1, 1)
+    statuses = np.zeros((F, 1), dtype=np.int32)
+    return inputs, statuses
+
+
+def test_extract_examples_runs_and_switches():
+    inputs, statuses = _toggle_inputs(hold=3, cycles=2)  # 5,5,5,9,9,9
+    ex = extract_examples(inputs, statuses)
+    # frame 0 starts tracking without emitting
+    assert not ex["valid"][0, 0]
+    assert ex["valid"][0, 1:].all()
+    # holds at run 1,2 then the switch at run 3, then holds again
+    assert ex["run"][0, 1:].tolist() == [1, 2, 3, 1, 2]
+    assert ex["switched"][0].tolist() == [False, False, False, True, False,
+                                          False]
+    assert ex["src"][0, 3, 0] == 5 and ex["dst"][0, 3, 0] == 9
+
+
+def test_extract_disconnect_severs_runs():
+    """DISCONNECTED dummy rows are not player behavior: they sever the
+    run exactly like InputHistoryModel.break_run — no switch example is
+    emitted across the gap, and tracking restarts after it."""
+    inputs = np.array(
+        [5, 5, 5, 5, 0, 0, 7, 7, 7, 7], dtype=np.uint8
+    ).reshape(10, 1, 1)
+    statuses = np.zeros((10, 1), dtype=np.int32)
+    statuses[4:6, 0] = 2  # DISCONNECTED dummy rows
+    ex = extract_examples(inputs, statuses)
+    # no 5 -> 7 transition ever recorded
+    assert ex["switched"].sum() == 0
+    # severed frames and both run-starting frames are invalid
+    assert ex["valid"][0].tolist() == [
+        False, True, True, True,          # run of 5 (frame 0 starts it)
+        False, False,                     # the gap
+        False, True, True, True,          # run of 7 restarts tracking
+    ]
+    # the restarted run counts from 1, not from the pre-gap length
+    assert ex["run"][0, 7:].tolist() == [1, 2, 3]
+
+    # control: the same stream WITHOUT the disconnect does record the
+    # value change as a switch
+    statuses[:] = 0
+    ex2 = extract_examples(inputs, statuses)
+    assert ex2["switched"].sum() == 2  # 5->0 and 0->7
+
+
+# ----------------------------------------------------------------------
+# journal edge cases: empty, torn tail, mid-rotation boundary
+# ----------------------------------------------------------------------
+
+
+def _write_journal(path, inputs, statuses, *, segment_bytes=1 << 18,
+                   meta=None):
+    w = JournalWriter(
+        path,
+        meta=dict(meta or {"num_players": int(inputs.shape[1]),
+                           "input_size": int(inputs.shape[2]),
+                           "first_frame": 0}),
+        segment_bytes=segment_bytes,
+    )
+    # one record per frame so a torn tail costs exactly the final rows
+    for f in range(inputs.shape[0]):
+        w.append_rows(f, inputs[f : f + 1], statuses[f : f + 1])
+    w.close()
+    return w
+
+
+def test_empty_journal_yields_no_examples(tmp_path):
+    # a directory with no segments at all: nothing to train on, and the
+    # missing identity META is a typed refusal, not a zero-wide model
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    ds = JournalDataset(str(empty), seed=0)
+    assert len(ds) == 0 and ds.meta()["frames"] == 0
+    assert list(ds.shards()) == []
+    with pytest.raises(ValueError, match="identity META"):
+        train_from_journal([str(empty)], seed=0)
+    # a journal holding only its META record (writer opened, no rows):
+    # discovered, zero frames, zero examples — but identity known
+    bare = tmp_path / "bare"
+    JournalWriter(str(bare), meta={"num_players": 2, "input_size": 1}).close()
+    model, watermark = train_from_journal([str(bare)], seed=0)
+    assert watermark["frames"] == 0
+    assert float(model.tables.support.sum()) == 0.0
+    assert model.num_players == 2
+
+
+def test_torn_tail_truncates_extraction(tmp_path):
+    """A torn final record (host died mid-write) silently truncates the
+    dataset to the durable prefix — same rows recovery would replay."""
+    inputs, statuses = _toggle_inputs(hold=4, cycles=6)
+    path = str(tmp_path / "torn")
+    _write_journal(path, inputs, statuses)
+    whole = scan_journal(path, repair=False)
+    assert whole.frames == inputs.shape[0]
+    # tear the tail: chop a few bytes off the last segment mid-record
+    segs = sorted(
+        f for f in os.listdir(path) if f.endswith(".wal")
+    )
+    last = os.path.join(path, segs[-1])
+    with open(last, "r+b") as f:
+        f.truncate(os.path.getsize(last) - 5)
+    ds = JournalDataset(path, seed=0)
+    assert ds.meta()["frames"] == inputs.shape[0] - 1
+    (ex,) = list(ds.shards(shuffle=False))
+    ref = extract_examples(inputs[:-1], statuses[:-1])
+    for k in ("run", "switched", "src", "dst", "valid"):
+        np.testing.assert_array_equal(ex[k], ref[k], err_msg=k)
+
+
+def test_mid_rotation_boundary_parity(tmp_path):
+    """Rows spread across many rotated segments extract byte-identically
+    to the same rows in one segment — rotation is invisible to the
+    dataset."""
+    inputs, statuses = _toggle_inputs(hold=5, cycles=10)
+    one = str(tmp_path / "one")
+    many = str(tmp_path / "many")
+    _write_journal(one, inputs, statuses)
+    w = _write_journal(many, inputs, statuses, segment_bytes=128)
+    assert w.rotations > 2  # the boundary case actually occurred
+    ex_one = list(JournalDataset(one, seed=0).shards(shuffle=False))
+    ex_many = list(JournalDataset(many, seed=0).shards(shuffle=False))
+    assert len(ex_one) == len(ex_many) == 1
+    for k in ("run", "switched", "src", "dst", "valid"):
+        np.testing.assert_array_equal(ex_one[0][k], ex_many[0][k],
+                                      err_msg=k)
+
+
+# ----------------------------------------------------------------------
+# the trained model: drop-in InputHistoryModel surface
+# ----------------------------------------------------------------------
+
+
+def _trained_toggle_model(hold=6, cycles=12):
+    inputs, statuses = _toggle_inputs(hold=hold, cycles=cycles)
+    ex = extract_examples(inputs, statuses)
+    return train_on_examples([ex], num_players=1, input_size=1)
+
+
+def test_array_model_learns_hazard_and_transitions():
+    m = _trained_toggle_model(hold=6)
+    st = m._stats[0]
+    assert st.n_holds() >= 8
+    # the hazard spikes at the true hold length and stays low before it
+    assert st.hazard(6) > 0.7
+    assert st.hazard(3) < 0.2
+    assert st.next_values(bytes([5]))[0][0] == bytes([9])
+    assert st.next_values(bytes([9]))[0][0] == bytes([5])
+    # the inherited rank_branches runs unchanged against the table views
+    preds = m.rank_branches(
+        [(99, bytes([5]), 4)], anchor_frame=98, rollout=8, limit=6
+    )
+    assert preds and preds[0][:2] == (0, 4) and preds[0][2][0] == 9
+    # clones share the frozen tables; run trackers are per-clone
+    c = m.clone()
+    assert c.tables is m.tables
+    c.observe(0, bytes([5]))
+    assert c._stats[0].cur_len == 1 and m._stats[0].cur_len == 0
+
+
+def test_array_model_serialization_round_trip_and_typed_errors():
+    m = _trained_toggle_model()
+    blob = m.to_bytes()
+    m2 = ArrayInputModel.from_bytes(blob)
+    assert m2.to_bytes() == blob  # byte-stable round trip
+    with pytest.raises(ModelIncompatible):
+        ArrayInputModel.from_bytes(b"NOTMODEL" + blob[8:])
+    with pytest.raises(ModelIncompatible):
+        ArrayInputModel.from_bytes(blob[:-16])  # truncated mid-array
+    # run-tracker state only loads into the same version (tables travel
+    # by registry version, not by ticket)
+    other = ArrayInputModel(m.tables, version=m.version + 1)
+    with pytest.raises(ModelIncompatible):
+        other.load_state_dict(m.state_dict())
+
+
+def test_registry_round_trip_and_typed_errors(tmp_path):
+    m = _trained_toggle_model()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    with pytest.raises(ModelIncompatible):
+        reg.load()  # empty registry
+    v1 = reg.publish(m, watermark={"frames": 72})
+    assert v1 == 1 and reg.latest() == 1
+    loaded = reg.load(v1)
+    assert loaded.to_bytes() == m.to_bytes()
+    assert reg.entry(v1)["watermark"]["frames"] == 72
+    with pytest.raises(ModelIncompatible):
+        reg.load(99)  # absent version
+    # game-identity gate: a 1-player model must not load for a 2-player
+    # game
+    with pytest.raises(ModelIncompatible):
+        reg.load(v1, game=ExGame(num_players=2, num_entities=ENTITIES))
+    # a corrupt blob is caught by the manifest checksum, typed
+    blob_path = os.path.join(str(tmp_path / "reg"), reg.entry(v1)["file"])
+    with open(blob_path, "r+b") as f:
+        f.seek(32)
+        b = f.read(1)
+        f.seek(32)
+        f.write(bytes([b[0] ^ 0x40]))
+    with pytest.raises(ModelIncompatible):
+        ModelRegistry(str(tmp_path / "reg")).load(v1)
+
+
+# ----------------------------------------------------------------------
+# the end-to-end loop: journal -> train -> registry -> hot-swap
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_registry(tmp_path_factory):
+    """Journal THE seeded starved traffic shape (single-device fleet),
+    train on the WAL, publish — shared by the loop tests below. Returns
+    (registry, version, journal_dir)."""
+    tmp = tmp_path_factory.mktemp("learn_loop")
+    journal_dir = str(tmp / "journal")
+    host, keys = run_starved(
+        held_scripts, speculation=False, journal_dir=journal_dir,
+        seed=SEED, ticks=TICKS,
+    )
+    for k in keys:
+        host.detach(k)  # final-drain + close every lane's writer
+    # num_players pinned to the HOST width: the fleet mixes 2/3/4-player
+    # matches and the model must be as wide as the host installing it
+    model, watermark = train_from_journal(
+        [journal_dir], seed=SEED, num_players=4,
+    )
+    assert float(model.tables.support.sum()) > 0
+    assert watermark["frames"] > 0
+    reg = ModelRegistry(str(tmp / "registry"))
+    version = reg.publish(
+        model, game=ExGame(num_players=4, num_entities=ENTITIES),
+        watermark=watermark,
+    )
+    return reg, version, journal_dir
+
+
+def run_starved_with_install(model, *, install_tick, mesh=None,
+                             journal_dir=None, sessions=4, ticks=TICKS,
+                             hole_every=30, hole_len=12, seed=SEED):
+    """run_starved's exact traffic (same seeds, same starvation holes),
+    speculating, with `model` hot-swapped in at the `install_tick` tick
+    boundary MID-drive — the serve is live across the swap."""
+    clock = FakeClock()
+    net = InMemoryNetwork(
+        clock, latency_ms=16, jitter_ms=4, loss=0.0, seed=seed
+    )
+    host = SessionHost(
+        ExGame(num_players=4, num_entities=ENTITIES),
+        max_prediction=8, num_players=4, max_sessions=sessions + 4,
+        clock=clock, idle_timeout_ms=0, speculation=True, mesh=mesh,
+        journal_dir=journal_dir,
+    )
+    matches = build_matches(host, net, clock, sessions=sessions, seed=seed)
+    sync_fleet(host, matches, clock)
+    scripts = held_scripts(matches, ticks, seed)
+    starve = starve_on_tick(
+        net, matches, hole_every=hole_every, hole_len=hole_len
+    )
+
+    def on_tick(t):
+        if t == install_tick:
+            host.install_input_model(model)
+        starve(t)
+
+    drive_scripted(host, matches, clock, scripts, ticks, on_tick=on_tick)
+    host.device.block_until_ready()
+    return host, [k for keys in matches for k in keys]
+
+
+def test_learning_loop_end_to_end_single_device(fleet_registry):
+    """The acceptance loop: the registry-loaded trained model installs
+    into a live speculating host at a tick boundary before the first
+    starvation hole; on the same seeded starved traffic its hit rate
+    meets or beats the online Counter model's, and the host stays
+    bitwise identical to a never-speculating twin across the swap."""
+    reg, version, _ = fleet_registry
+    game = ExGame(num_players=4, num_entities=ENTITIES)
+    loaded = reg.load(version, game=game)
+
+    host_online, _ = run_starved(
+        held_scripts, speculation=True, seed=SEED, ticks=TICKS,
+    )
+    online_rate = host_online.spec_hit_rate
+    assert host_online.frames_served_from_speculation > 0
+
+    host_tr, keys_tr = run_starved_with_install(loaded, install_tick=10)
+    assert host_tr.input_model_version == version
+    sec = host_tr._spec.section()
+    assert sec["model_version"] == version and sec["model_swaps"] == 1
+    assert host_tr.frames_served_from_speculation > 0
+    # trained on exactly this traffic: the fleet-wide statistics must
+    # serve at least as well as the in-match online Counter
+    assert host_tr.spec_hit_rate >= online_rate > 0.0, (
+        f"trained {host_tr.spec_hit_rate} < online {online_rate}"
+    )
+
+    host_off, keys_off = run_starved(
+        held_scripts, speculation=False, seed=SEED, ticks=TICKS,
+    )
+    assert_bitwise_twin(host_tr, keys_tr, host_off, keys_off)
+
+
+def test_learning_loop_sharded_swap_parity(fleet_registry, tmp_path):
+    """The sharded arm: the trained model hot-swaps into a session-mesh
+    host mid-serve and the sharded speculating fleet stays bit-identical
+    to the single-device never-speculating twin. The run also journals —
+    its WAL must extract byte-identical example tensors to the
+    single-device fixture journal of the same seeded traffic (the
+    determinism claim that lets a mixed fleet pool its journals)."""
+    from ggrs_tpu.parallel.mesh import make_session_mesh
+
+    reg, version, single_journal = fleet_registry
+    loaded = reg.load(version)
+    sharded_journal = str(tmp_path / "sharded_journal")
+    host_on, keys_on = run_starved_with_install(
+        loaded, install_tick=10, mesh=make_session_mesh(8),
+        journal_dir=sharded_journal,
+    )
+    assert host_on.frames_served_from_speculation > 0
+    assert host_on.input_model_version == version
+
+    host_off, keys_off = run_starved(
+        held_scripts, speculation=False, seed=SEED, ticks=TICKS,
+    )
+    # parity first (the journal taps drain at detach inside the check's
+    # host accessors, so assert before closing lanes)
+    assert_bitwise_twin(host_on, keys_on, host_off, keys_off)
+
+    # sharded-vs-single-device byte parity of the extracted examples
+    for k in keys_on:
+        host_on.detach(k)
+    ds_single = JournalDataset(single_journal, seed=0)
+    ds_sharded = JournalDataset(sharded_journal, seed=0)
+    assert len(ds_single) == len(ds_sharded) > 0
+    singles = list(ds_single.shards(shuffle=False))
+    shardeds = list(ds_sharded.shards(shuffle=False))
+    for ea, eb in zip(singles, shardeds):
+        assert os.path.basename(ea["path"]) == os.path.basename(eb["path"])
+        assert ea["frames"] == eb["frames"]
+        for k in ("run", "switched", "src", "dst", "valid"):
+            np.testing.assert_array_equal(
+                ea[k], eb[k],
+                err_msg=f"{os.path.basename(ea['path'])}:{k}",
+            )
