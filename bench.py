@@ -1905,6 +1905,187 @@ def bench_serve_host(sessions=64, ticks=120, entities=1024,
     }
 
 
+def _capacity_arm(batched, sessions, ticks, entities, seed, floor_reps=600):
+    """One bench_host_capacity arm: a hosted scripted fleet with the
+    pump flavor pinned at host construction (`batched_pump`).
+
+    Two measurements per arm:
+
+    - the PROTOCOL-PLANE FLOOR (headline): after the traffic window,
+      `floor_reps` quiescent pump passes over the synced fleet — frozen
+      clock, drained sockets, no expiring timers — through the one
+      `WirePump.pump` entry both flavors share (legacy sessions route
+      to their per-message `_poll_legacy` loop inside it). This is the
+      O(peers) bookkeeping scan every host tick pays whether or not
+      anything fires — the cost that caps sessions-per-host-at-60Hz,
+      and the axis ISSUE/ROADMAP call "the next wall". Real traffic
+      and timer fires are workload, identical on both flavors, and
+      measured separately below.
+    - the TRAFFIC SPAN (context): the `host/pump` tracer span across a
+      scripted lossy-WAN drive — pump + endpoint + encode + event drain
+      end-to-end, identically bracketed on both flavors (host.py wraps
+      the batched pass and the legacy per-lane loop in the same
+      absolute span). Device megabatch time stays outside the span."""
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.network.sockets import InMemoryNetwork
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.serve.loadgen import (
+        build_matches,
+        drive_scripted,
+        make_scripts,
+        sync_fleet,
+    )
+    from ggrs_tpu.utils.clock import FakeClock
+    from ggrs_tpu.utils.tracing import GLOBAL_TRACER
+
+    clock = FakeClock()
+    net = InMemoryNetwork(
+        clock, latency_ms=20, jitter_ms=5, loss=0.01, seed=seed
+    )
+    host = SessionHost(
+        ExGame(num_players=4, num_entities=entities),
+        max_prediction=8,
+        num_players=4,
+        max_sessions=sessions + 4,
+        clock=clock,
+        idle_timeout_ms=0,
+        batched_pump=batched,
+    )
+    matches = build_matches(host, net, clock, sessions=sessions, seed=seed)
+    n_sessions = sum(len(keys) for keys in matches)
+    sync_fleet(host, matches, clock)
+
+    # traffic window: sync/handshake (compile-adjacent, bursty resend
+    # traffic) excluded; only steady scripted ticks count
+    was_enabled = GLOBAL_TRACER.enabled
+    GLOBAL_TRACER.enabled = True
+    GLOBAL_TRACER.reset()
+    scripts = make_scripts(matches, ticks, seed=seed)
+    desyncs = drive_scripted(host, matches, clock, scripts, ticks)
+    assert not desyncs, f"capacity arm desynced: {desyncs[:3]}"
+    span = GLOBAL_TRACER.stats.get("host/pump")
+    GLOBAL_TRACER.enabled = was_enabled
+    traffic_ms = span.total_ms if span is not None else 0.0
+
+    # protocol-plane floor: quiescent passes, best of two rounds (round
+    # one warms caches; the virtual clock is frozen so nothing expires)
+    pump = host._pump
+    fleet_sessions = [host.session(k) for keys in matches for k in keys]
+    pump.pump(fleet_sessions, isolate=True)  # settle at the frozen now
+    floor_s = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _rep in range(floor_reps):
+            pump.pump(fleet_sessions, isolate=True)
+        dt = (time.perf_counter() - t0) / floor_reps
+        floor_s = dt if floor_s is None else min(floor_s, dt)
+    floor_us_per_session = floor_s * 1e6 / n_sessions
+
+    fleet = pump.fleet
+    arm = {
+        "batched_pump": batched,
+        "sessions": n_sessions,
+        "ticks": ticks,
+        "host_cpu_us_per_session": round(floor_us_per_session, 3),
+        "pump_floor_ms_per_pass": round(floor_s * 1000.0, 4),
+        # extrapolated protocol-plane headroom: how many sessions fit in
+        # one 60Hz host-tick budget at this per-session pump cost
+        "sessions_at_60hz": int((1e6 / 60.0) / floor_us_per_session)
+        if floor_us_per_session
+        else 0,
+        "traffic_pump_ms_total": round(traffic_ms, 3),
+        "traffic_us_per_session_tick": round(
+            traffic_ms * 1000.0 / (n_sessions * ticks), 3
+        )
+        if n_sessions * ticks
+        else 0.0,
+        "fleet_passes": fleet.passes,
+        "fleet_rows_live": fleet.live_rows,
+    }
+    for keys in matches:
+        for k in keys:
+            host.detach(k)
+    return arm
+
+
+def bench_host_capacity(sessions=64, ticks=120, entities=16, seed=7):
+    """Protocol-plane capacity: max sessions per host sustaining 60Hz,
+    vectorized fleet pump (network/endpoint_batch.py) vs the legacy
+    per-peer pump (`batched_pump=False`, the reference arm), on
+    identical seeded scripted traffic. The headline pair:
+
+    - host_cpu_us_per_session: the quiescent pump floor per session —
+      the O(peers) endpoint bookkeeping scan every host tick pays
+      before any real traffic or timer fire (see _capacity_arm);
+    - sessions_at_60hz: sessions one host fits in a 16.7ms tick budget
+      at that per-session cost (protocol plane only — device capacity
+      is bench_serve_host's axis).
+
+    `pump_speedup` is the legacy/batched floor ratio (the acceptance
+    floor is 5x at >= 64 sessions); `traffic_speedup` is the same ratio
+    on the end-to-end traffic span, where shared per-message work
+    (decode/apply, input events, real sends) dilutes it. The crossover
+    pair reruns both flavors on a fleet-of-one (2 sessions <
+    SMALL_FLEET, where the batched host routes to the verbatim scalar
+    twin) — its ratio near or below 1.0 is the "fleet-of-one no slower"
+    witness. Small entity count on purpose: device work is identical
+    across arms and excluded from both measurements; shrinking it just
+    makes the bench cheap."""
+    batched_arm = _capacity_arm(True, sessions, ticks, entities, seed)
+    legacy_arm = _capacity_arm(False, sessions, ticks, entities, seed)
+    assert batched_arm["fleet_passes"] > 0, (
+        "batched capacity arm never took the vectorized protocol plane"
+    )
+    assert legacy_arm["fleet_passes"] == 0, (
+        "legacy capacity arm leaked into the vectorized protocol plane"
+    )
+    # fleet-of-one: 2 sessions (one 2-player match) sit below SMALL_FLEET,
+    # so the batched host must ride the scalar twin — same flavor pair,
+    # longer window (per-tick cost is tiny, noise needs the extra ticks)
+    xover_batched = _capacity_arm(True, 2, ticks * 2, entities, seed)
+    xover_legacy = _capacity_arm(False, 2, ticks * 2, entities, seed)
+    assert xover_batched["fleet_passes"] == 0, (
+        "fleet-of-one took the vectorized plane: crossover broken"
+    )
+    speedup = (
+        legacy_arm["host_cpu_us_per_session"]
+        / batched_arm["host_cpu_us_per_session"]
+        if batched_arm["host_cpu_us_per_session"] else 0.0
+    )
+    traffic_speedup = (
+        legacy_arm["traffic_us_per_session_tick"]
+        / batched_arm["traffic_us_per_session_tick"]
+        if batched_arm["traffic_us_per_session_tick"] else 0.0
+    )
+    xover_ratio = (
+        xover_batched["host_cpu_us_per_session"]
+        / xover_legacy["host_cpu_us_per_session"]
+        if xover_legacy["host_cpu_us_per_session"] else 0.0
+    )
+    return {
+        "sessions": batched_arm["sessions"],
+        "ticks": ticks,
+        "entities": entities,
+        "batched": batched_arm,
+        "legacy": legacy_arm,
+        "host_cpu_us_per_session": batched_arm["host_cpu_us_per_session"],
+        "host_cpu_us_per_session_legacy": legacy_arm[
+            "host_cpu_us_per_session"
+        ],
+        "sessions_at_60hz": batched_arm["sessions_at_60hz"],
+        "sessions_at_60hz_legacy": legacy_arm["sessions_at_60hz"],
+        "pump_speedup": round(speedup, 2),
+        "traffic_speedup": round(traffic_speedup, 2),
+        "crossover_sessions": xover_batched["sessions"],
+        "crossover_us_per_session": xover_batched["host_cpu_us_per_session"],
+        "crossover_us_per_session_legacy": xover_legacy[
+            "host_cpu_us_per_session"
+        ],
+        # ~1.0 = fleet-of-one pays nothing for the batched plumbing
+        "crossover_ratio": round(xover_ratio, 3),
+    }
+
+
 def bench_spec_bubble(sessions=16, ticks=240, entities=1024,
                       max_prediction=8, players=4, hole_every=40,
                       hole_len=14, seed=13, reps=3):
@@ -2787,7 +2968,9 @@ def main():
         "interleaved_spread_pct", "beam_ab_delta_ms", "beam_ab_wins",
         "history_b8_rate", "parity", "async_parity",
         "serve_sessions_per_sec", "serve_occupancy",
-        "serve_fast_dispatch_rate", "env_steps_per_sec",
+        "serve_fast_dispatch_rate", "sessions_at_60hz",
+        "host_cpu_us_per_session", "endpoint_pump_speedup",
+        "env_steps_per_sec",
         "sharded_vs_single_device_speedup",
         "chaos_fps_retained", "fps_retained_under_device_faults",
         "frames_served_from_speculation",
@@ -3028,6 +3211,19 @@ def main():
     full["serve_host_scaling"] = {
         "n16": serve16, "n64": serve64, "n256": serve256,
     }
+    # the vectorized protocol plane (network/endpoint_batch.py): host
+    # protocol tax per session-tick, fleet pump vs the legacy per-peer
+    # reference arm, plus the fleet-of-one crossover witness
+    capacity = phase(
+        "host_capacity",
+        f"bench_host_capacity(sessions={16 if SMOKE else 64}, "
+        f"ticks={30 if SMOKE else 120})",
+        timeout_s=900,
+    )
+    full["host_cpu_us_per_session"] = capacity["host_cpu_us_per_session"]
+    full["sessions_at_60hz"] = capacity["sessions_at_60hz"]
+    full["endpoint_pump_speedup"] = capacity["pump_speedup"]
+    full["host_capacity"] = capacity
     # the RL-env workload (ggrs_tpu/env/): env steps/sec on the same
     # megabatch path, non-interactive training traffic
     env256 = phase(

@@ -47,6 +47,12 @@ SCOPE_PREFIXES = (
     "ggrs_tpu/learn/",
     "ggrs_tpu/sync_layer.py",
     "ggrs_tpu/input_queue.py",
+    # the vectorized protocol plane replays the scalar endpoint state
+    # machines from numpy columns: a wall-clock read or stateful RNG
+    # draw inside the fleet pass would break its bitwise parity contract
+    # with the scalar twin (every timer touch must observe the pass's
+    # hoisted `now`, never its own clock)
+    "ggrs_tpu/network/endpoint_batch.py",
 )
 
 # DET001: wall-clock reads (values differ across peers by construction)

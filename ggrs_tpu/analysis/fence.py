@@ -219,6 +219,25 @@ POLICIES: Dict[str, FencePolicy] = {
             ("WirePump", "__init__"),
         }),
     ),
+    # the vectorized protocol plane's fleet arrays (endpoint_batch.py):
+    # the column dict, row->endpoint/emit tables and allocator state are
+    # shared mutable state every pump pass reads through live views
+    # (_FleetRow, bound _SignalDeques) — only the fleet's declared
+    # alloc/adopt/retire entry points may rebind them; the vectorized
+    # pass derives masks into locals and writes cells through the shared
+    # dict, never rebinding fleet storage
+    "ggrs_tpu/network/endpoint_batch.py": FencePolicy(
+        protected=frozenset({
+            "cols", "eps", "emits", "top", "cap", "free_blocks",
+        }),
+        allowed=frozenset({
+            ("EndpointFleet", "__init__"),
+            ("EndpointFleet", "_grow"),
+            ("EndpointFleet", "_alloc"),
+            ("EndpointFleet", "adopt"),
+            ("EndpointFleet", "retire_session"),
+        }),
+    ),
     # trained model tables are frozen at construction — every lane of
     # every host drafting from version N must read the SAME numbers, so
     # only ModelTables.__init__ may bind the buffers (and the trainer

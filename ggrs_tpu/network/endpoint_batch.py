@@ -1,0 +1,688 @@
+"""Vectorized protocol plane: one array program per pump for the whole
+fleet's endpoints.
+
+PR 13's device-resident loop removed ~15/16 of tick-program dispatches
+yet only broke even end-to-end, because per-peer Python endpoint work —
+timer scans, frame-advantage updates, ack/resend bookkeeping — still
+scales O(peers) in interpreted Python on every pump pass. This module
+moves that scan one layer down, exactly the way network/pump.py moved
+the wire decode: the hot per-peer state of every adopted `PeerEndpoint`
+lives in structured numpy columns (an `EndpointFleet`), and each pump
+pass runs ONE vectorized program over the whole fleet:
+
+  - frame-advantage update: `recv_frame + (rtt//2 * fps)//1000 - cur`
+    as int64 column arithmetic, masked to RUNNING remotes;
+  - timer expiry: every deadline in the 200ms family compared against
+    the pass's hoisted clock in a single boolean-mask pass;
+  - resend/keepalive/quality-report/disconnect candidates and
+    endpoints with queued events or sends selected by `flatnonzero`
+    over dirty flags the `_SignalDeque` append hook maintains.
+
+Only the mask-selected survivors drop into per-peer Python: candidates
+re-run the VERBATIM scalar timer body (`PeerEndpoint._poll_timers`), so
+the masks only need to be a superset snapshot of the fire conditions —
+re-evaluating the exact scalar conditions on the survivors keeps the
+batched and scalar paths bit-identical by construction (the parity twin
+below a `SMALL_FLEET` crossover is the unmodified per-session
+`_pump_post`, auto-selected exactly like pump.py's `SMALL_BATCH` decode
+routing; `batched_pump=False` pins the legacy per-message loop
+end-to-end).
+
+Adoption swaps an endpoint's `_hot` backing store (`_ScalarHot`) for a
+`_FleetRow` view over its column row; retirement copies the row back
+out. Protocol code never knows which backing it runs on. Sessions with
+native (C++) endpoints are never adopted — their hot state lives across
+the FFI boundary — and keep the scalar path.
+
+Fence note (analysis/fence.py FEN001): the fleet columns, the row->
+endpoint tables and the allocator state are shared mutable state reused
+across pump passes; only the fleet's own alloc/adopt/retire entry
+points may rebind them. The per-pass masks are locals derived from the
+columns, so the vectorized pass itself never rebinds fleet state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..errors import GGRSError
+from ..obs import GLOBAL_TELEMETRY, LOG2_BUCKETS
+from ..types import NULL_FRAME
+from .protocol import (
+    _HOT_BOOL_FIELDS,
+    _HOT_INT_FIELDS,
+    KEEP_ALIVE_INTERVAL_MS,
+    QUALITY_REPORT_INTERVAL_MS,
+    RUNNING_RETRY_INTERVAL_MS,
+    SYNC_RETRY_INTERVAL_MS,
+    ProtocolState,
+    _ScalarHot,
+)
+
+# passes over fewer than this many endpoints run the scalar per-session
+# `_pump_post` twin instead: the vectorized pass costs ~10 fixed numpy
+# ops plus a gather per column, which dwarfs a 2-peer standalone
+# session's two direct poll calls (the SMALL_BATCH story one layer up,
+# measured at the same order of magnitude). Hosted fleets of >= 64
+# sessions sit far above it. WirePump snapshots this at construction
+# (`small_fleet`), so tests can force either route per pump instance.
+SMALL_FLEET = 8
+
+_STATES = tuple(ProtocolState)
+_SYNCHRONIZING = ProtocolState.SYNCHRONIZING.value
+_RUNNING = ProtocolState.RUNNING.value
+_DISCONNECTED = ProtocolState.DISCONNECTED.value
+
+
+class _FleetRow:
+    """Thin hot-field view over one fleet-array row: the backing store a
+    `PeerEndpoint` gets on adoption. Each generated property converts to
+    plain Python scalars on read so fleet-adopted endpoints hand out the
+    exact types the scalar twin does (wire encode, dict keys, enum
+    compares)."""
+
+    __slots__ = ("_c", "_r")
+
+    def __init__(self, cols: Dict[str, np.ndarray], row: int):
+        self._c = cols
+        self._r = row
+
+
+def _int_cell(name: str) -> property:
+    def _get(self, _n=name):
+        return int(self._c[_n][self._r])
+
+    def _set(self, value, _n=name):
+        self._c[_n][self._r] = value
+
+    return property(_get, _set)
+
+
+def _int_cell_flagged(name: str) -> property:
+    """Like _int_cell, but writes also raise the fleet-wide `_adv_dirty`
+    latch: the field feeds the vectorized frame-advantage program, so
+    the pass can skip that block entirely while no input has changed
+    (the idle-pump common case)."""
+
+    def _get(self, _n=name):
+        return int(self._c[_n][self._r])
+
+    def _set(self, value, _n=name):
+        c = self._c
+        c[_n][self._r] = value
+        c["_adv_dirty"][0] = True
+
+    return property(_get, _set)
+
+
+def _bool_cell(name: str) -> property:
+    def _get(self, _n=name):
+        return bool(self._c[_n][self._r])
+
+    def _set(self, value, _n=name):
+        self._c[_n][self._r] = bool(value)
+
+    return property(_get, _set)
+
+
+# the frame-advantage inputs: a write to any of them (or to `state`)
+# invalidates the pass's advantage-skip latch below
+_ADV_INPUT_FIELDS = ("recv_frame", "round_trip_time")
+
+for _name in _HOT_INT_FIELDS:
+    setattr(
+        _FleetRow,
+        _name,
+        _int_cell_flagged(_name)
+        if _name in _ADV_INPUT_FIELDS
+        else _int_cell(_name),
+    )
+for _name in _HOT_BOOL_FIELDS:
+    setattr(_FleetRow, _name, _bool_cell(_name))
+
+
+def _set_state(self, value):
+    c = self._c
+    c["state"][self._r] = value.value
+    c["_adv_dirty"][0] = True
+
+
+_FleetRow.state = property(
+    lambda self: _STATES[self._c["state"][self._r]],
+    _set_state,
+)
+del _name
+
+
+class _FleetSession:
+    """Per-adopted-session bookkeeping: the contiguous row block, how
+    many leading rows are remotes (the frame-advantage prefix), and the
+    scalar hooks the per-survivor work needs."""
+
+    __slots__ = ("fleet", "start", "n", "adv_n", "connect_status", "checksums")
+
+    def __init__(self, fleet, start, n, adv_n, connect_status, checksums):
+        self.fleet = fleet
+        self.start = start
+        self.n = n
+        self.adv_n = adv_n
+        self.connect_status = connect_status
+        self.checksums = checksums
+
+
+class _PassPlan:
+    """Cached row geometry for a repeated session set: the concatenated
+    row index array, per-session bounds into it, and the advantage
+    prefix rows. A host pumps the same fleet every tick, so this
+    rebuilds only on adopt/retire or a changed pass set.
+
+    `ix` is the gather index the per-pass column reads use: a plain
+    slice when the session blocks happen to be contiguous in adoption
+    order (the steady hosted case — column reads are then zero-copy
+    views), the fancy row array otherwise. `counts`/`adv_*`/`cks_idx`
+    pre-resolve the per-session geometry so the pass scatters clocks
+    with one np.repeat instead of a per-session slice loop and visits
+    only checksum-carrying sessions in the drain loop."""
+
+    __slots__ = (
+        "rows", "rows_list", "bounds", "ix", "counts",
+        "adv_rows", "adv_idx", "adv_counts", "cks_idx", "last_cur",
+    )
+
+    def __init__(self, rows, rows_list, bounds, ix, counts,
+                 adv_rows, adv_idx, adv_counts, cks_idx):
+        self.last_cur = None  # per-session current_frame of the last pass
+        self.rows = rows
+        self.rows_list = rows_list
+        self.bounds = bounds
+        self.ix = ix
+        self.counts = counts
+        self.adv_rows = adv_rows
+        self.adv_idx = adv_idx
+        self.adv_counts = adv_counts
+        self.cks_idx = cks_idx
+
+
+_INT_COLS = _HOT_INT_FIELDS + ("now", "cur")
+_BOOL_COLS = _HOT_BOOL_FIELDS + ("send_dirty", "events_dirty")
+
+
+class EndpointFleet:
+    """Structured-array home for every adopted endpoint's hot state and
+    the vectorized endpoint/encode phases of a pump pass. One fleet per
+    WirePump: the host's pump adopts its whole session fleet; the
+    module-default pump serves standalone sessions the same way once a
+    pass crosses the SMALL_FLEET crossover."""
+
+    __slots__ = (
+        "cols", "eps", "emits", "top", "cap", "free_blocks",
+        "gen", "live_rows", "live_sessions", "adopted_total", "passes",
+        "_plan_gen", "_plan_sessions", "_plan", "_m_peers",
+    )
+
+    def __init__(self, cap: int = 64):
+        self.cap = cap
+        self.top = 0
+        cols: Dict[str, np.ndarray] = {}
+        for name in _INT_COLS:
+            cols[name] = np.zeros(cap, dtype=np.int64)
+        for name in _BOOL_COLS:
+            cols[name] = np.zeros(cap, dtype=bool)
+        cols["state"] = np.zeros(cap, dtype=np.uint8)
+        # fleet-wide latch, not a row column (never grows): any write to
+        # an advantage input re-arms the vectorized advantage block
+        cols["_adv_dirty"] = np.ones(1, dtype=bool)
+        self.cols = cols
+        self.eps: List[Any] = []
+        self.emits: List[Any] = []
+        self.free_blocks: List[Tuple[int, int]] = []
+        self.gen = 0
+        self.live_rows = 0
+        self.live_sessions = 0
+        self.adopted_total = 0
+        self.passes = 0
+        self._plan_gen = -1
+        self._plan_sessions: List[Any] = []
+        self._plan: Optional[_PassPlan] = None
+        self._m_peers = GLOBAL_TELEMETRY.registry.histogram(
+            "ggrs_endpoint_batch_peers",
+            "endpoints covered per vectorized protocol-plane pass",
+            buckets=LOG2_BUCKETS,
+        )
+
+    # ------------------------------------------------------------------
+    # adoption / retirement (the only writers of fleet storage)
+    # ------------------------------------------------------------------
+
+    def _grow(self, need: int) -> None:
+        cap = self.cap
+        while cap < need:
+            cap *= 2
+        cols = self.cols
+        for name, arr in list(cols.items()):
+            if name == "_adv_dirty":  # fleet-wide latch, not per-row
+                continue
+            grown = np.zeros(cap, dtype=arr.dtype)
+            grown[: self.top] = arr[: self.top]
+            # rebind IN the shared dict: every _FleetRow and bound
+            # _SignalDeque resolves columns through it, so views never
+            # go stale across growth
+            cols[name] = grown
+        self.cap = cap
+
+    def _alloc(self, n: int) -> int:
+        for bi, (bs, bn) in enumerate(self.free_blocks):
+            if bn == n:
+                del self.free_blocks[bi]
+                return bs
+        if self.top + n > self.cap:
+            self._grow(self.top + n)
+        start = self.top
+        self.top += n
+        while len(self.eps) < self.top:
+            self.eps.append(None)
+            self.emits.append(None)
+        return start
+
+    def adopt(self, session: Any) -> bool:
+        """Hoist `session`'s endpoints into fleet rows. Returns False
+        (and leaves the session scalar) when it is not fleetable —
+        native endpoints, or no endpoints at all. Idempotent; a session
+        adopted by another fleet (standalone pump -> host pump) is
+        retired there first."""
+        st = getattr(session, "_fleet_state", None)
+        if st is not None:
+            if st.fleet is self:
+                return True
+            st.fleet.retire_session(session)
+        profile = session._fleet_profile()
+        if profile is None:
+            return False
+        eps = profile["endpoints"]
+        emits = profile["emits"]
+        n = len(eps)
+        start = self._alloc(n)
+        cols = self.cols
+        for i, ep in enumerate(eps):
+            row = start + i
+            hot = ep._hot
+            cols["state"][row] = hot.state.value
+            for name in _HOT_INT_FIELDS:
+                cols[name][row] = getattr(hot, name)
+            for name in _HOT_BOOL_FIELDS:
+                cols[name][row] = getattr(hot, name)
+            cols["send_dirty"][row] = False
+            cols["events_dirty"][row] = False
+            ep._hot = _FleetRow(cols, row)
+            self.eps[row] = ep
+            self.emits[row] = emits[i]
+            # bind AFTER clearing the flags: a non-empty queue re-marks
+            ep.send_queue.bind(cols, row, "send_dirty")
+            ep.event_queue.bind(cols, row, "events_dirty")
+        session._fleet_state = _FleetSession(
+            self, start, n, profile["adv_n"],
+            profile["connect_status"], profile["checksums"],
+        )
+        self.live_rows += n
+        self.live_sessions += 1
+        self.adopted_total += n
+        self.gen += 1
+        return True
+
+    def retire_session(self, session: Any) -> None:
+        """Copy the session's rows back into standalone `_ScalarHot`
+        stores and free the block (host detach, fleet handoff). The
+        endpoints keep working scalar — bit-identically."""
+        st = getattr(session, "_fleet_state", None)
+        if st is None or st.fleet is not self:
+            return
+        cols = self.cols
+        for row in range(st.start, st.start + st.n):
+            ep = self.eps[row]
+            if ep is not None:
+                hot = _ScalarHot()
+                hot.state = _STATES[int(cols["state"][row])]
+                for name in _HOT_INT_FIELDS:
+                    setattr(hot, name, int(cols[name][row]))
+                for name in _HOT_BOOL_FIELDS:
+                    setattr(hot, name, bool(cols[name][row]))
+                ep._hot = hot
+                ep.send_queue.unbind()
+                ep.event_queue.unbind()
+            self.eps[row] = None
+            self.emits[row] = None
+        self.free_blocks.append((st.start, st.n))
+        self.live_rows -= st.n
+        self.live_sessions -= 1
+        self.gen += 1
+        session._fleet_state = None
+
+    # ------------------------------------------------------------------
+    # the vectorized pass
+    # ------------------------------------------------------------------
+
+    def _pass_plan(self, sessions: Sequence[Any]) -> _PassPlan:
+        # cache hit on (generation, same session objects in order): an
+        # element-wise identity sweep, so the steady per-pass cost is a
+        # zip of `is` checks, not a 2x-per-pump key-tuple rebuild over
+        # attribute chains. Element identity (not list identity) also
+        # hits for the encode phase's freshly-built `live` list.
+        if self._plan_gen == self.gen and len(self._plan_sessions) == len(
+            sessions
+        ):
+            for a, b in zip(self._plan_sessions, sessions):
+                if a is not b:
+                    break
+            else:
+                return self._plan
+        bounds = np.empty(len(sessions) + 1, dtype=np.int64)
+        bounds[0] = 0
+        counts = np.empty(len(sessions), dtype=np.int64)
+        parts: List[np.ndarray] = []
+        adv_parts: List[np.ndarray] = []
+        adv_idx: List[int] = []
+        adv_counts: List[int] = []
+        cks_idx: List[int] = []
+        off = 0
+        contiguous = True
+        expected = None
+        for i, s in enumerate(sessions):
+            st = s._fleet_state
+            if expected is not None and st.start != expected:
+                contiguous = False
+            expected = st.start + st.n
+            parts.append(np.arange(st.start, st.start + st.n, dtype=np.int64))
+            if st.adv_n:
+                adv_parts.append(
+                    np.arange(st.start, st.start + st.adv_n, dtype=np.int64)
+                )
+                adv_idx.append(i)
+                adv_counts.append(st.adv_n)
+            if st.checksums:
+                cks_idx.append(
+                    (i, getattr(s, "_pending_checksum_report", None))
+                )
+            off += st.n
+            bounds[i + 1] = off
+            counts[i] = st.n
+        rows = (
+            np.concatenate(parts) if parts else np.empty(0, dtype=np.int64)
+        )
+        plan = _PassPlan(
+            rows=rows,
+            rows_list=rows.tolist(),
+            bounds=bounds,
+            ix=(
+                slice(int(rows[0]), int(rows[0]) + rows.size)
+                if contiguous and rows.size
+                else rows
+            ),
+            counts=counts,
+            adv_rows=(
+                np.concatenate(adv_parts)
+                if adv_parts
+                else np.empty(0, dtype=np.int64)
+            ),
+            adv_idx=adv_idx,
+            adv_counts=np.asarray(adv_counts, dtype=np.int64),
+            cks_idx=cks_idx,
+        )
+        self._plan_gen = self.gen
+        self._plan_sessions = list(sessions)
+        self._plan = plan
+        return plan
+
+    def endpoint_phase(
+        self,
+        sessions: Sequence[Any],
+        nows: Sequence[int],
+        isolate: bool,
+        errors: List[Tuple[Any, Exception]],
+        failed: Set[int],
+    ) -> None:
+        """Advantage + timers + events + checksum drains for the whole
+        pass in one array program; per-endpoint Python only for the
+        mask-selected survivors. Scalar-twin order per session:
+        advantage -> timers -> events -> checksums (the verbatim
+        `_pump_endpoint` sequence)."""
+        plan = self._pass_plan(sessions)
+        rows = plan.rows
+        if rows.size == 0:
+            return
+        cols = self.cols
+        now_col = cols["now"]
+        cur_col = cols["cur"]
+        ix = plan.ix
+        # clock scatter: hosted fleets share one virtual clock, so the
+        # common case is a single broadcast fill; mixed clocks fall back
+        # to one np.repeat over the pass geometry (never a per-session
+        # loop)
+        n0 = nows[0]
+        uniform_now = True
+        for v in nows:
+            if v != n0:
+                uniform_now = False
+                break
+        if uniform_now:
+            now_col[ix] = n0
+        else:
+            now_col[ix] = np.repeat(
+                np.asarray(nows, dtype=np.int64), plan.counts
+            )
+
+        self.passes += 1
+        if GLOBAL_TELEMETRY.enabled:
+            self._m_peers.observe(rows.size)
+
+        # -- frame advantage, vectorized over every RUNNING remote -----
+        # The block is a pure function of (state, recv_frame,
+        # round_trip_time, fps, current_frame); writes to the first
+        # three raise the fleet-wide `_adv_dirty` latch, so a pass that
+        # covers every live row may skip the whole block while no input
+        # changed — the idle-pump floor. Partial passes (standalone
+        # sessions sharing the fleet) never trust the latch: clearing it
+        # for a subset would starve the rows the pass did not cover.
+        adv = plan.adv_rows
+        if adv.size:
+            cur_vals = [
+                sessions[i].sync_layer.current_frame for i in plan.adv_idx
+            ]
+            adv_dirty = cols["_adv_dirty"]
+            full_pass = rows.size == self.live_rows
+            if (
+                not full_pass
+                or adv_dirty[0]
+                or cur_vals != plan.last_cur
+            ):
+                cur_col[adv] = np.repeat(
+                    np.asarray(cur_vals, dtype=np.int64),
+                    plan.adv_counts,
+                )
+                a_state = cols["state"][adv]
+                a_recv = cols["recv_frame"][adv]
+                a_cur = cur_col[adv]
+                mask = (
+                    (a_state == _RUNNING)
+                    & (a_recv != NULL_FRAME)
+                    & (a_cur != NULL_FRAME)
+                )
+                if mask.any():
+                    ping = cols["round_trip_time"][adv] >> 1
+                    remote = a_recv + (ping * cols["fps"][adv]) // 1000
+                    cols["local_frame_advantage"][adv[mask]] = (
+                        remote - a_cur
+                    )[mask]
+                if full_pass:
+                    adv_dirty[0] = False
+                    plan.last_cur = cur_vals
+
+        # -- timer expiry: ONE comparison pass for the 200ms family ----
+        # (`ix` reads are zero-copy views on the contiguous steady path)
+        state = cols["state"][ix]
+        # folded form `a < now - C` (not `a + C < now`): on the shared-
+        # clock path `now_r` is a Python int, so the subtraction costs
+        # nothing and each family is one array compare
+        now_r = n0 if uniform_now else now_col[ix]
+        last_recv = cols["last_recv_time"][ix]
+        cand = (state == _SYNCHRONIZING) & (
+            cols["last_sync_request_time"][ix]
+            < now_r - SYNC_RETRY_INTERVAL_MS
+        )
+        running = state == _RUNNING
+        cand |= running & (
+            cols["running_last_input_recv"][ix]
+            < now_r - RUNNING_RETRY_INTERVAL_MS
+        )
+        cand |= running & (
+            cols["running_last_quality_report"][ix]
+            < now_r - QUALITY_REPORT_INTERVAL_MS
+        )
+        cand |= running & (
+            cols["last_send_time"][ix] < now_r - KEEP_ALIVE_INTERVAL_MS
+        )
+        cand |= (
+            running
+            & ~cols["disconnect_notify_sent"][ix]
+            & (last_recv + cols["disconnect_notify_start_ms"][ix] < now_r)
+        )
+        cand |= (
+            running
+            & ~cols["disconnect_event_sent"][ix]
+            & (last_recv + cols["disconnect_timeout_ms"][ix] < now_r)
+        )
+        cand |= (state == _DISCONNECTED) & (
+            cols["shutdown_timeout"][ix] < now_r
+        )
+        work = cand | cols["events_dirty"][ix]
+        widx = np.flatnonzero(work)
+        if widx.size:
+            # per-session spans of the survivors: one searchsorted, not
+            # one slice per session — and only work-carrying sessions
+            # are visited at all
+            pos = np.searchsorted(widx, plan.bounds)
+            eps = self.eps
+            emits = self.emits
+            events_dirty = cols["events_dirty"]
+            rows_list = plan.rows_list
+            for i in np.flatnonzero(pos[1:] > pos[:-1]).tolist():
+                s = sessions[i]
+                st = s._fleet_state
+                try:
+                    span = widx[pos[i] : pos[i + 1]].tolist()
+                    connect_status = st.connect_status
+                    now_i = nows[i]
+                    for j in span:
+                        if cand[j]:
+                            # survivors re-run the verbatim scalar timer
+                            # body: the mask is a superset snapshot, the
+                            # recheck is what keeps bitwise parity
+                            eps[rows_list[j]]._poll_timers(
+                                connect_status, now_i
+                            )
+                    pending = None
+                    for j in span:
+                        r = rows_list[j]
+                        if events_dirty[r]:
+                            events_dirty[r] = False
+                            q = eps[r].event_queue
+                            if q:
+                                if pending is None:
+                                    pending = []
+                                # snapshot-then-handle, the scalar poll's
+                                # list()/clear() semantics
+                                pending.append((emits[r], list(q)))
+                                q.clear()
+                    if pending is not None:
+                        for emit, evs in pending:
+                            for ev in evs:
+                                emit(ev)
+                except GGRSError as exc:
+                    if not isolate:
+                        raise
+                    failed.add(s)
+                    errors.append((s, exc))
+        # -- checksum drains: only checksum-carrying sessions, and only
+        # when their pending queue is non-empty (the len() guard is the
+        # same first line _pump_checksums itself runs — hoisting it here
+        # keeps the steady-state pass free of per-session method calls).
+        # Cross-session order relative to the survivor loop above is
+        # free: sessions share no protocol state and per-destination
+        # send order is fixed by the encode phase's row order.
+        for i, pcr in plan.cks_idx:
+            if pcr is not None and not len(pcr):
+                continue
+            s = sessions[i]
+            if s in failed:
+                continue
+            try:
+                s._pump_checksums()
+            except GGRSError as exc:
+                if not isolate:
+                    raise
+                failed.add(s)
+                errors.append((s, exc))
+
+    def pending_sends(self, sessions: Sequence[Any]) -> bool:
+        """True when any endpoint in the pass has a dirty send queue.
+        Lets the pump skip building the per-session sink/out plumbing
+        (and the whole encode pass) on quiescent pumps — the common
+        case between timer fires."""
+        plan = self._pass_plan(sessions)
+        if plan.rows.size == 0:
+            return False
+        return bool(self.cols["send_dirty"][plan.ix].any())
+
+    def encode_phase(
+        self,
+        sessions: Sequence[Any],
+        outs: Sequence[Optional[List[Tuple[bytes, Any]]]],
+        isolate: bool,
+        errors: List[Tuple[Any, Exception]],
+        failed: Set[int],
+    ) -> None:
+        """Send drain for endpoints with queued wire only (`send_dirty`
+        flags), in per-session endpoint order — the scalar drain loop
+        minus the O(peers) empty-queue scan."""
+        plan = self._pass_plan(sessions)
+        rows = plan.rows
+        if rows.size == 0:
+            return
+        send_dirty = self.cols["send_dirty"]
+        widx = np.flatnonzero(send_dirty[plan.ix])
+        if widx.size == 0:
+            return
+        pos = np.searchsorted(widx, plan.bounds)
+        eps = self.eps
+        rows_list = plan.rows_list
+        for i in np.flatnonzero(pos[1:] > pos[:-1]).tolist():
+            s = sessions[i]
+            out = outs[i]
+            try:
+                for j in widx[pos[i] : pos[i + 1]].tolist():
+                    r = rows_list[j]
+                    send_dirty[r] = False
+                    if out is None:
+                        eps[r].send_all_messages(s.socket)
+                    else:
+                        eps[r].drain_sends(out)
+            except GGRSError as exc:
+                if not isolate:
+                    raise
+                failed.add(s)
+                errors.append((s, exc))
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Registry-independent snapshot for host.telemetry()."""
+        return {
+            "rows_live": self.live_rows,
+            "sessions_adopted": self.live_sessions,
+            "rows_capacity": self.cap,
+            "adopted_total": self.adopted_total,
+            "vectorized_passes": self.passes,
+        }

@@ -13,8 +13,22 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 
+# IP + UDP header bytes per datagram, for kbps accounting (the one
+# definition protocol.py and the native shim both rate against)
+UDP_HEADER_SIZE = 28
+
+
 @dataclass
 class NetworkStats:
+    """A point-in-time snapshot; field provenance under the vectorized
+    protocol plane (network/endpoint_batch.py): `ping_ms`,
+    `local_frames_behind` and `remote_frames_behind` read the fleet's
+    hot columns through the endpoint's row view, the byte/packet rates
+    and jitter/loss estimators stay per-endpoint scalars (touched only
+    on actual message traffic, never scanned by the pump), so the
+    snapshot is identical whether the endpoint is fleet-adopted or
+    standalone."""
+
     send_queue_len: int = 0
     ping_ms: int = 0
     kbps_sent: int = 0
@@ -24,3 +38,21 @@ class NetworkStats:
     kbps_recv: int = 0
     jitter_ms: int = 0
     packets_lost: int = 0
+
+    @classmethod
+    def from_endpoint(cls, ep, seconds: int) -> "NetworkStats":
+        """Rate the endpoint's counters over a `seconds`-long window.
+        Validation (sync state, window age) stays with the caller —
+        this is pure field arithmetic, shared by every snapshot site."""
+        total_sent = ep.bytes_sent + ep.packets_sent * UDP_HEADER_SIZE
+        total_recv = ep.bytes_recv + ep.packets_recv * UDP_HEADER_SIZE
+        return cls(
+            send_queue_len=len(ep.pending_output),
+            ping_ms=ep.round_trip_time,
+            kbps_sent=(total_sent // int(seconds)) // 1024,
+            local_frames_behind=ep.local_frame_advantage,
+            remote_frames_behind=ep.remote_frame_advantage,
+            kbps_recv=(total_recv // int(seconds)) // 1024,
+            jitter_ms=int(round(ep.jitter_ms)),
+            packets_lost=ep.packets_lost,
+        )

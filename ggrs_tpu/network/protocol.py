@@ -46,10 +46,9 @@ from .messages import (
     SyncRequest,
     encode_message,
 )
-from .network_stats import NetworkStats
+from .network_stats import UDP_HEADER_SIZE, NetworkStats
 from .sockets import NonBlockingSocket
 
-UDP_HEADER_SIZE = 28  # IP + UDP header bytes, for kbps accounting
 NUM_SYNC_PACKETS = 5
 UDP_SHUTDOWN_TIMER_MS = 5000
 PENDING_OUTPUT_SIZE = 128
@@ -67,6 +66,90 @@ class ProtocolState(enum.Enum):
     RUNNING = 2
     DISCONNECTED = 3
     SHUTDOWN = 4
+
+
+# ----------------------------------------------------------------------
+# hot-state storage (the vectorized protocol plane's seam)
+# ----------------------------------------------------------------------
+#
+# The per-peer fields the fleet-wide pump pass (network/endpoint_batch.py)
+# needs as numpy columns: timer deadlines, clocks, frame-advantage inputs
+# and the receive watermark. PeerEndpoint reads/writes them through the
+# generated properties below, which indirect through `self._hot` — a
+# plain `_ScalarHot` record for standalone endpoints (the scalar twin),
+# swapped for a `_FleetRow` view over the fleet arrays when a WirePump's
+# EndpointFleet adopts the endpoint. Protocol code is storage-agnostic:
+# the same method bodies run bit-identically on either backing, which is
+# what makes batched-vs-scalar parity hold by construction.
+
+_HOT_INT_FIELDS = (
+    "last_send_time",
+    "last_recv_time",
+    "last_sync_request_time",
+    "running_last_input_recv",
+    "running_last_quality_report",
+    "shutdown_timeout",
+    "round_trip_time",
+    "local_frame_advantage",
+    "remote_frame_advantage",
+    "recv_frame",  # highest received input frame (watermark, NULL_FRAME=-1)
+    "disconnect_timeout_ms",
+    "disconnect_notify_start_ms",
+    "fps",
+    "magic",
+)
+_HOT_BOOL_FIELDS = (
+    "disconnect_notify_sent",
+    "disconnect_event_sent",
+)
+
+
+class _ScalarHot:
+    """Standalone backing store for the hot fields: one plain slot per
+    field, zero indirection beyond the attribute itself."""
+
+    __slots__ = _HOT_INT_FIELDS + _HOT_BOOL_FIELDS + ("state",)
+
+
+class _SignalDeque(deque):
+    """deque that flips a fleet dirty flag on append. Standalone (cols
+    is None) the append costs one None-check; adopted, it marks the
+    owning row so the vectorized pass visits ONLY endpoints that
+    actually queued something — the O(live peers) scan the fleet pass
+    replaces with O(dirty peers)."""
+
+    __slots__ = ("cols", "row", "flag")
+
+    def __init__(self):
+        super().__init__()
+        self.cols = None
+        self.row = 0
+        self.flag = ""
+
+    def bind(self, cols, row: int, flag: str) -> None:
+        self.cols = cols
+        self.row = row
+        self.flag = flag
+        if self:  # queued before adoption: visible to the next pass
+            cols[flag][row] = True
+
+    def unbind(self) -> None:
+        self.cols = None
+
+    def append(self, item) -> None:
+        c = self.cols
+        if c is not None:
+            c[self.flag][self.row] = True
+        deque.append(self, item)
+
+
+# cumulative input-window resends fired by the RUNNING retry timer —
+# fleet-wide (the vectorized pass and the scalar twin both count here)
+_m_resends = GLOBAL_TELEMETRY.registry.counter(
+    "ggrs_endpoint_resends_total",
+    "input windows re-sent by the RUNNING retry timer "
+    "(cumulative-ack resend of the whole un-acked window)",
+)
 
 
 # Endpoint -> session events (src/network/protocol.rs:96-116)
@@ -122,6 +205,10 @@ class PeerEndpoint:
         clock: Optional[Clock] = None,
         rng: Optional[_random.Random] = None,
     ):
+        # hot-field backing store FIRST: every property write below lands
+        # in it (swapped for a fleet-array row view on adoption)
+        self._hot: Any = _ScalarHot()
+
         self.clock = clock or Clock()
         rng = rng or _random.Random()
         magic = 0
@@ -138,8 +225,8 @@ class PeerEndpoint:
         self.input_size = input_size
         self.fps = fps
 
-        self.send_queue: Deque[Message] = deque()
-        self.event_queue: Deque[Any] = deque()
+        self.send_queue: Deque[Message] = _SignalDeque()
+        self.event_queue: Deque[Any] = _SignalDeque()
 
         self.state = ProtocolState.INITIALIZING
         self.sync_remaining_roundtrips = NUM_SYNC_PACKETS
@@ -164,10 +251,13 @@ class PeerEndpoint:
             NULL_FRAME,
             bytes(input_size * local_players),
         )
-        # received input history for delta decoding
+        # received input history for delta decoding; recv_frame is the
+        # hoisted max(recv_inputs) watermark the fleet pass reads as a
+        # column (maintained at the sole insert site in _on_input_fields)
         self.recv_inputs: Dict[Frame, bytes] = {
             NULL_FRAME: bytes(input_size * len(self.handles))
         }
+        self.recv_frame = NULL_FRAME
 
         self.time_sync = TimeSync(peer_label=str(peer_addr))
         self.local_frame_advantage = 0
@@ -293,7 +383,23 @@ class PeerEndpoint:
         its per-endpoint loop (one read per pass, not per endpoint)."""
         if now is None:
             now = self.clock.now_ms()
-        if self.state == ProtocolState.SYNCHRONIZING:
+        self._poll_timers(connect_status, now)
+        events = list(self.event_queue)
+        self.event_queue.clear()
+        return events
+
+    def _poll_timers(
+        self, connect_status: Sequence[ConnectionStatus], now: int
+    ) -> None:
+        """The timer family, factored out of poll() so the vectorized
+        fleet pass (network/endpoint_batch.py) can run it verbatim on
+        mask-selected candidates: the fleet's boolean masks are a
+        SUPERSET snapshot of these conditions, and re-evaluating the
+        exact scalar conditions here keeps both paths bit-identical
+        (e.g. a resend that refreshes last_send_time must suppress the
+        keep-alive the snapshot mask still flagged)."""
+        state = self.state
+        if state == ProtocolState.SYNCHRONIZING:
             # Deliberate divergence from the reference (protocol.rs:353):
             # retries key off the last sync REQUEST, not the last send of
             # anything. A Synchronizing endpoint also answers the running
@@ -302,15 +408,17 @@ class PeerEndpoint:
             # starving handshake retries once the final SyncReply is lost
             # (a livelock our tampering fuzz exposed).
             if self.last_sync_request_time + SYNC_RETRY_INTERVAL_MS < now:
-                self._send_sync_request()
-        elif self.state == ProtocolState.RUNNING:
+                self._send_sync_request(now)
+        elif state == ProtocolState.RUNNING:
             if self.running_last_input_recv + RUNNING_RETRY_INTERVAL_MS < now:
-                self._send_pending_output(connect_status)
+                if self.pending_output and GLOBAL_TELEMETRY.enabled:
+                    _m_resends.inc()
+                self._send_pending_output(connect_status, now)
                 self.running_last_input_recv = now
             if self.running_last_quality_report + QUALITY_REPORT_INTERVAL_MS < now:
-                self._send_quality_report()
+                self._send_quality_report(now)
             if self.last_send_time + KEEP_ALIVE_INTERVAL_MS < now:
-                self._queue_message(KeepAlive())
+                self._queue_message(KeepAlive(), now)
             if (
                 not self.disconnect_notify_sent
                 and self.last_recv_time + self.disconnect_notify_start_ms < now
@@ -324,13 +432,9 @@ class PeerEndpoint:
             ):
                 self.event_queue.append(EvDisconnected())
                 self.disconnect_event_sent = True
-        elif self.state == ProtocolState.DISCONNECTED:
+        elif state == ProtocolState.DISCONNECTED:
             if self.shutdown_timeout < now:
                 self.state = ProtocolState.SHUTDOWN
-
-        events = list(self.event_queue)
-        self.event_queue.clear()
-        return events
 
     # ------------------------------------------------------------------
     # sending
@@ -390,7 +494,10 @@ class PeerEndpoint:
             chunks.append(pi.buf)
         return frame, b"".join(chunks)
 
-    def _send_pending_output(self, connect_status: Sequence[ConnectionStatus]) -> None:
+    def _send_pending_output(
+        self, connect_status: Sequence[ConnectionStatus],
+        now: Optional[int] = None,
+    ) -> None:
         """(src/network/protocol.rs:468-493)
 
         Divergence from the reference, which asserts the encoded window fits
@@ -423,29 +530,31 @@ class PeerEndpoint:
             ack_frame=self._last_recv_frame(),
             bytes_=payload,
         )
-        self._queue_message(body)
+        self._queue_message(body, now)
 
-    def _send_input_ack(self) -> None:
-        self._queue_message(InputAck(ack_frame=self._last_recv_frame()))
+    def _send_input_ack(self, now: Optional[int] = None) -> None:
+        self._queue_message(InputAck(ack_frame=self._last_recv_frame()), now)
 
-    def _send_sync_request(self) -> None:
-        self.last_sync_request_time = self.clock.now_ms()
+    def _send_sync_request(self, now: Optional[int] = None) -> None:
+        self.last_sync_request_time = now if now is not None else self.clock.now_ms()
         nonce = self._rng.getrandbits(32)
         self.sync_random_requests.add(nonce)
-        self._queue_message(SyncRequest(random_request=nonce))
+        self._queue_message(SyncRequest(random_request=nonce), now)
 
-    def _send_quality_report(self) -> None:
-        self.running_last_quality_report = self.clock.now_ms()
+    def _send_quality_report(self, now: Optional[int] = None) -> None:
+        if now is None:
+            now = self.clock.now_ms()
+        self.running_last_quality_report = now
         adv = max(-128, min(127, self.local_frame_advantage))
-        self._queue_message(QualityReport(frame_advantage=adv, ping=self.clock.now_ms()))
+        self._queue_message(QualityReport(frame_advantage=adv, ping=now), now)
 
     def send_checksum_report(self, frame_to_send: Frame, checksum: int) -> None:
         self._queue_message(ChecksumReport(checksum=checksum, frame=frame_to_send))
 
-    def _queue_message(self, body: Any) -> None:
+    def _queue_message(self, body: Any, now: Optional[int] = None) -> None:
         msg = Message(magic=self.magic, body=body)
         self.packets_sent += 1
-        self.last_send_time = self.clock.now_ms()
+        self.last_send_time = now if now is not None else self.clock.now_ms()
         wire_len = len(encode_message(msg))
         self.bytes_sent += wire_len
         if GLOBAL_TELEMETRY.enabled:
@@ -506,6 +615,7 @@ class PeerEndpoint:
         self, kind: int, magic: int, wire_len: int,
         a: int = 0, b: int = 0, c: int = 0,
         statuses: Sequence[Tuple[Any, int]] = (), payload: bytes = b"",
+        now: Optional[int] = None,
     ) -> None:
         """Field-level receive: one decoded datagram's worth of scalars,
         positionally matched to network/pump.py's record layout (kind,
@@ -513,13 +623,18 @@ class PeerEndpoint:
         traffic frequency. Scalar meanings: INPUT a=start_frame,
         b=ack_frame, c=flags; INPUT_ACK a=ack_frame; QUALITY_REPORT
         a=frame_advantage, b=ping; QUALITY_REPLY a=pong; SYNC_* a=nonce;
-        CHECKSUM_REPORT a=frame, b=checksum."""
+        CHECKSUM_REPORT a=frame, b=checksum. `now` is the pump pass's
+        hoisted clock — every timer/stats touch this datagram causes
+        observes the same instant (one clock read per pass, not per
+        message)."""
         if self.state == ProtocolState.SHUTDOWN:
             return
         # packet auth: filter foreign magics once the peer is known
         if self.remote_magic != 0 and magic != self.remote_magic:
             return
-        self.last_recv_time = self.clock.now_ms()
+        if now is None:
+            now = self.clock.now_ms()
+        self.last_recv_time = now
         self.packets_recv += 1
         self.bytes_recv += wire_len
         if GLOBAL_TELEMETRY.enabled:
@@ -530,22 +645,24 @@ class PeerEndpoint:
             self.event_queue.append(EvNetworkResumed())
 
         if kind == MSG_INPUT:
-            self._on_input_fields(a, b, bool(c & 1), statuses, payload)
+            self._on_input_fields(a, b, bool(c & 1), statuses, payload, now)
         elif kind == MSG_INPUT_ACK:
             self._pop_pending_output(a)
         elif kind == MSG_QUALITY_REPORT:
-            self._on_quality_report_fields(a, b)
+            self._on_quality_report_fields(a, b, now)
         elif kind == MSG_QUALITY_REPLY:
-            self._on_quality_reply_pong(a)
+            self._on_quality_reply_pong(a, now)
         elif kind == MSG_SYNC_REQUEST:
-            self._queue_message(SyncReply(random_reply=a))
+            self._queue_message(SyncReply(random_reply=a), now)
         elif kind == MSG_SYNC_REPLY:
-            self._on_sync_reply_nonce(magic, a)
+            self._on_sync_reply_nonce(magic, a, now)
         elif kind == MSG_CHECKSUM_REPORT:
             self._on_checksum_report_fields(a, b)
         # MSG_KEEP_ALIVE: nothing beyond the recv-time update
 
-    def _on_sync_reply_nonce(self, magic: int, nonce: int) -> None:
+    def _on_sync_reply_nonce(
+        self, magic: int, nonce: int, now: Optional[int] = None
+    ) -> None:
         if self.state != ProtocolState.SYNCHRONIZING:
             return
         if nonce not in self.sync_random_requests:
@@ -559,7 +676,7 @@ class PeerEndpoint:
                     count=NUM_SYNC_PACKETS - self.sync_remaining_roundtrips,
                 )
             )
-            self._send_sync_request()
+            self._send_sync_request(now)
         else:
             self.state = ProtocolState.RUNNING
             self.event_queue.append(EvSynchronized())
@@ -569,6 +686,7 @@ class PeerEndpoint:
         self, start_frame: Frame, ack_frame: Frame,
         disconnect_requested: bool,
         statuses: Sequence[Tuple[Any, int]], payload: bytes,
+        now: Optional[int] = None,
     ) -> None:
         """(src/network/protocol.rs:616-689) — `statuses` items are
         (disconnected, last_frame) pairs straight off the wire decode."""
@@ -613,7 +731,9 @@ class PeerEndpoint:
         ref = self.recv_inputs.get(decode_frame)
         if ref is None:
             return
-        self.running_last_input_recv = self.clock.now_ms()
+        self.running_last_input_recv = (
+            now if now is not None else self.clock.now_ms()
+        )
 
         # bound the decode at the largest legitimate payload — the sender
         # never has more than PENDING_OUTPUT_SIZE un-acked frames in flight —
@@ -629,9 +749,10 @@ class PeerEndpoint:
         per_player = self.input_size
         for i, inp_bytes in enumerate(decoded):
             inp_frame = start_frame + i
-            if inp_frame <= self._last_recv_frame():
+            if inp_frame <= self.recv_frame:
                 continue  # already have it
             self.recv_inputs[inp_frame] = inp_bytes
+            self.recv_frame = inp_frame  # watermark: inserts are ascending
             # re-split the endpoint-level bytes into per-player inputs
             assert len(inp_bytes) == per_player * len(self.handles)
             for j, handle in enumerate(self.handles):
@@ -640,7 +761,7 @@ class PeerEndpoint:
                     EvInput(input=PlayerInput(inp_frame, buf), player=handle)
                 )
 
-        self._send_input_ack()
+        self._send_input_ack(now)
 
         # GC received inputs beyond 2x the prediction window
         horizon = self._last_recv_frame() - 2 * self.max_prediction
@@ -652,7 +773,9 @@ class PeerEndpoint:
         while self.pending_output and self.pending_output[0][0] <= ack_frame:
             self.last_acked_input = self.pending_output.popleft()
 
-    def _on_quality_report_fields(self, frame_advantage: int, ping: int) -> None:
+    def _on_quality_report_fields(
+        self, frame_advantage: int, ping: int, now: Optional[int] = None
+    ) -> None:
         self.remote_frame_advantage = frame_advantage
         # packet-loss estimate from sequence gaps: the peer's reports fire
         # every QUALITY_REPORT_INTERVAL_MS carrying its strictly-increasing
@@ -672,10 +795,11 @@ class PeerEndpoint:
                 if GLOBAL_TELEMETRY.enabled:
                     self._m_lost.inc(missed)
         self._last_quality_ping = max(self._last_quality_ping or 0, ping)
-        self._queue_message(QualityReply(pong=ping))
+        self._queue_message(QualityReply(pong=ping), now)
 
-    def _on_quality_reply_pong(self, pong: int) -> None:
-        now = self.clock.now_ms()
+    def _on_quality_reply_pong(self, pong: int, now: Optional[int] = None) -> None:
+        if now is None:
+            now = self.clock.now_ms()
         # network-controlled value: a pong from the future (clock skew or a
         # crafted packet) must not produce a negative RTT or crash the
         # session (parity with the C++ endpoint, endpoint.cpp)
@@ -708,17 +832,22 @@ class PeerEndpoint:
 
     def update_local_frame_advantage(self, local_frame: Frame) -> None:
         """Estimate the remote's current frame from its last input plus
-        half-RTT (src/network/protocol.rs:268-277)."""
-        if local_frame == NULL_FRAME or self._last_recv_frame() == NULL_FRAME:
+        half-RTT (src/network/protocol.rs:268-277). The vectorized twin
+        (network/endpoint_batch.py) runs the identical arithmetic over
+        the fleet's recv_frame / round_trip_time columns."""
+        recv_frame = self.recv_frame
+        if local_frame == NULL_FRAME or recv_frame == NULL_FRAME:
             return
         ping = self.round_trip_time // 2
-        remote_frame = self._last_recv_frame() + (ping * self.fps) // 1000
+        remote_frame = recv_frame + (ping * self.fps) // 1000
         self.local_frame_advantage = remote_frame - local_frame
 
-    def network_stats(self) -> NetworkStats:
+    def network_stats(self, now: Optional[int] = None) -> NetworkStats:
         if self.state not in (ProtocolState.SYNCHRONIZING, ProtocolState.RUNNING):
             raise NotSynchronized()
-        seconds = (self.clock.now_ms() - self.stats_start_time) // 1000
+        if now is None:
+            now = self.clock.now_ms()
+        seconds = (now - self.stats_start_time) // 1000
         if seconds == 0:
             # distinguishable from the unsynchronized case — but only once
             # the endpoint actually IS synchronized: mid-handshake, "not
@@ -727,18 +856,30 @@ class PeerEndpoint:
             if self.state == ProtocolState.RUNNING:
                 raise StatsWindowTooYoung()
             raise NotSynchronized()
-        total_sent = self.bytes_sent + self.packets_sent * UDP_HEADER_SIZE
-        total_recv = self.bytes_recv + self.packets_recv * UDP_HEADER_SIZE
-        return NetworkStats(
-            send_queue_len=len(self.pending_output),
-            ping_ms=self.round_trip_time,
-            kbps_sent=(total_sent // int(seconds)) // 1024,
-            local_frames_behind=self.local_frame_advantage,
-            remote_frames_behind=self.remote_frame_advantage,
-            kbps_recv=(total_recv // int(seconds)) // 1024,
-            jitter_ms=int(round(self.jitter_ms)),
-            packets_lost=self.packets_lost,
-        )
+        return NetworkStats.from_endpoint(self, seconds)
 
     def _last_recv_frame(self) -> Frame:
-        return max(self.recv_inputs.keys())
+        return self.recv_frame
+
+
+# ----------------------------------------------------------------------
+# hot-field properties: PeerEndpoint.<field> indirects through the
+# swappable backing store (see the _HOT_* tables above). Installed after
+# the class body so the method sources above read like plain attribute
+# code — which is exactly what they compile to on the _ScalarHot twin.
+# ----------------------------------------------------------------------
+
+
+def _hot_property(name: str) -> property:
+    def _get(self, _n=name):
+        return getattr(self._hot, _n)
+
+    def _set(self, value, _n=name):
+        setattr(self._hot, _n, value)
+
+    return property(_get, _set)
+
+
+for _name in _HOT_INT_FIELDS + _HOT_BOOL_FIELDS + ("state",):
+    setattr(PeerEndpoint, _name, _hot_property(_name))
+del _name

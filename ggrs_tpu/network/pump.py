@@ -38,6 +38,7 @@ import numpy as np
 
 from ..errors import GGRSError
 from ..obs import GLOBAL_TELEMETRY, LOG2_BUCKETS, LOG2_BUCKETS_MS
+from .endpoint_batch import SMALL_FLEET, EndpointFleet
 from .messages import (
     MSG_CHECKSUM_REPORT,
     MSG_INPUT,
@@ -334,14 +335,16 @@ def record_to_message(rec: tuple, wire: bytes):
 
 def host_tax_histogram():
     """Get-or-create THE ggrs_host_tax_ms instrument — one definition
-    shared by WirePump (phase=pump) and SessionHost (parse/drain), so
-    the help text and buckets cannot drift between registration sites."""
+    shared by WirePump (phase=pump/endpoint/encode) and SessionHost
+    (parse/drain), so the help text and buckets cannot drift between
+    registration sites."""
     return GLOBAL_TELEMETRY.registry.histogram(
         "ggrs_host_tax_ms",
         "host-side tax per tick, split by phase "
-        "(pump = socket drain + batched decode/apply + protocol "
-        "timers + batched send; parse = request-grammar staging; "
-        "drain = checksum-ledger/fence drains)",
+        "(pump = socket drain + batched decode/apply; endpoint = "
+        "frame-advantage/timer/event/checksum phase, vectorized or "
+        "scalar; encode = send drain + batched socket ship; parse = "
+        "request-grammar staging; drain = checksum-ledger/fence drains)",
         ("phase",),
         buckets=LOG2_BUCKETS_MS,
     )
@@ -354,28 +357,51 @@ class WirePump:
     per-socket batches. One instance serves a whole SessionHost (or a
     single standalone session via the module-default pump).
 
-    A session participates through three small hooks (P2PSession and
+    A session participates through a few small hooks (P2PSession and
     SpectatorSession both provide them):
       - `_pump_routes()` -> {addr: ((endpoint, handle_decoded|None,
         handle_wire|None), ...)} — the per-address dispatch table;
-      - `_pump_post(wire_out)` — frame-advantage update, endpoint
-        timers, event handling, and send drain into `wire_out` (or the
-        legacy per-message send when `wire_out` is None);
+      - `_pump_now()` — one hoisted clock read for the whole pass;
+      - `_pump_endpoint(now)` / `_pump_encode(wire_out)` — the scalar
+        timer/event phase and send drain (`_pump_post` composes them
+        for the legacy loop);
+      - `_fleet_size()` / `_fleet_profile()` / `_fleet_state` — the
+        vectorized protocol plane's adoption seam (endpoint_batch.py);
       - `socket` — must expose receive_all_wire/send_wire_batch for the
         batched path; anything else falls back to the session's legacy
-        `_poll_legacy()` loop, unbatched but identical in behavior."""
+        `_poll_legacy()` loop, unbatched but identical in behavior;
+      - `_pump_recv` — session-owned cache slot (init None) where the
+        pump pins the bound `receive_all_wire` after first resolution.
 
-    __slots__ = ("staging", "_m_batch", "_m_tax")
+    Endpoint-phase routing mirrors the decode crossover: passes with at
+    least `small_fleet` endpoints run the fleet's one-array-program
+    phases (adopting sessions on first contact); smaller passes — a
+    standalone 2-peer session, a fleet-of-one host — keep the verbatim
+    scalar twin, which is faster there for the same reason scalar
+    decode wins below SMALL_BATCH. Cross-session phase ordering (all
+    endpoint phases, then all encodes) is parity-safe: every receive
+    already landed in the recv/apply phase above, sessions share no
+    protocol state, and per-destination send order is preserved."""
+
+    __slots__ = (
+        "staging", "fleet", "small_fleet",
+        "_m_batch", "_m_tax", "_m_tax_endpoint", "_m_tax_encode",
+    )
 
     def __init__(self):
         self.staging = PumpStaging()
+        self.fleet = EndpointFleet()
+        self.small_fleet = SMALL_FLEET
         _reg = GLOBAL_TELEMETRY.registry
         self._m_batch = _reg.histogram(
             "ggrs_pump_batch_msgs",
             "datagrams decoded per batched pump pass",
             buckets=LOG2_BUCKETS,
         )
-        self._m_tax = host_tax_histogram().labels("pump")
+        _tax = host_tax_histogram()
+        self._m_tax = _tax.labels("pump")
+        self._m_tax_endpoint = _tax.labels("endpoint")
+        self._m_tax_encode = _tax.labels("encode")
 
     def pump(
         self, sessions: Sequence[Any], isolate: bool = False
@@ -393,7 +419,14 @@ class WirePump:
         datagrams: List[Tuple[int, Any, bytes]] = []
         batched: List[Any] = []
         for s in sessions:
-            recv = getattr(s.socket, "receive_all_wire", None)
+            # bound receive_all_wire is cached on the session (sockets
+            # are pinned at construction); sessions without the batch
+            # hook re-resolve each pass on the legacy path
+            recv = s._pump_recv
+            if recv is None and s.batched_pump:
+                recv = getattr(s.socket, "receive_all_wire", None)
+                if recv is not None:
+                    s._pump_recv = recv
             if recv is None or not s.batched_pump:
                 try:
                     s._poll_legacy()
@@ -407,6 +440,10 @@ class WirePump:
             for addr, wire in recv():
                 datagrams.append((si, addr, wire))
 
+        # per-session hoisted clock: every timer/stats touch of this
+        # pass — apply AND endpoint phase — observes one instant (read
+        # lazily so sessions with independent clocks each get their own)
+        nows: List[Optional[int]] = [None] * len(batched)
         failed: set = set()
         if datagrams:
             if len(datagrams) <= SMALL_BATCH:
@@ -420,12 +457,16 @@ class WirePump:
                 routes = route_cache[si]
                 if routes is None:
                     routes = route_cache[si] = batched[si]._pump_routes()
+                now = nows[si]
+                if now is None:
+                    now = nows[si] = batched[si]._pump_now()
                 try:
                     for _ep, fast, raw in routes.get(addr, ()):
                         if fast is not None:
                             fast(
                                 rec[0], rec[1], len(wire),
                                 rec[2], rec[3], rec[4], rec[5], rec[6],
+                                now,
                             )
                         elif raw is not None:
                             raw(wire)
@@ -434,17 +475,103 @@ class WirePump:
                         raise
                     failed.add(si)
                     errors.append((batched[si], exc))
+        if tel.enabled:
+            self._m_batch.observe(len(datagrams))
+            t1 = _time.perf_counter()
+            self._m_tax.observe((t1 - t0) * 1000.0)
 
+        post: List[Tuple[Any, int]] = []
+        # hosted fleets share one clock object: memoize the read so an
+        # idle 64-session pump costs one now_ms, not 64 (each session's
+        # cached `_pump_clock` makes the identity check safe — equal
+        # clock object, equal instant, bit-identical to per-session reads)
+        memo_clock: Any = None
+        memo_now = 0
         for si, s in enumerate(batched):
             if si in failed:
+                continue
+            now = nows[si]
+            if now is None:
+                c = getattr(s, "_pump_clock", None)
+                if c is not None and c is memo_clock:
+                    now = memo_now
+                else:
+                    now = s._pump_now()
+                    memo_clock = getattr(s, "_pump_clock", None)
+                    memo_now = now
+            post.append((s, now))
+
+        # ---- endpoint phase: vectorized above the crossover ----------
+        fleet = self.fleet
+        fleet_sessions: List[Any] = []
+        fleet_nows: List[int] = []
+        scalar_sessions: List[Tuple[Any, int]] = []
+        # crossover with hysteresis: the O(sessions) size sum only runs
+        # while nothing is adopted; once the fleet is live, every pass
+        # takes the fleet branch (adopt() itself is the identity check,
+        # and retirement on detach drains live_sessions back to zero)
+        if fleet.live_sessions or (
+            sum(s._fleet_size() for s, _ in post) >= self.small_fleet
+        ):
+            for s, now in post:
+                st = getattr(s, "_fleet_state", None)
+                if st is not None and st.fleet is fleet:
+                    fleet_sessions.append(s)
+                    fleet_nows.append(now)
+                elif fleet.adopt(s):
+                    fleet_sessions.append(s)
+                    fleet_nows.append(now)
+                else:
+                    scalar_sessions.append((s, now))
+        else:
+            scalar_sessions = post
+
+        post_failed: set = set()
+        if fleet_sessions:
+            fleet.endpoint_phase(
+                fleet_sessions, fleet_nows, isolate, errors, post_failed
+            )
+        for s, now in scalar_sessions:
+            try:
+                s._pump_endpoint(now)
+            except GGRSError as exc:
+                if not isolate:
+                    raise
+                post_failed.add(s)
+                errors.append((s, exc))
+        if tel.enabled:
+            t2 = _time.perf_counter()
+            self._m_tax_endpoint.observe((t2 - t1) * 1000.0)
+
+        # ---- encode phase: drain queued sends into per-socket batches -
+        if fleet_sessions:
+            live = [
+                s for s in fleet_sessions if s not in post_failed
+            ]
+            # quiescent pumps (no endpoint queued a send this pass) skip
+            # the sink/out plumbing and the encode pass entirely
+            if fleet.pending_sends(live):
+                sinks = [
+                    getattr(s.socket, "send_wire_batch", None)
+                    for s in live
+                ]
+                outs: List[Optional[List[Tuple[bytes, Any]]]] = [
+                    ([] if sink is not None else None) for sink in sinks
+                ]
+                fleet.encode_phase(live, outs, isolate, errors, post_failed)
+                for s, sink, out in zip(live, sinks, outs):
+                    if sink is not None and out and s not in post_failed:
+                        sink(out)
+        for s, _now in scalar_sessions:
+            if s in post_failed:
                 continue
             try:
                 sink = getattr(s.socket, "send_wire_batch", None)
                 if sink is None:
-                    s._pump_post(None)
+                    s._pump_encode(None)
                 else:
                     out: List[Tuple[bytes, Any]] = []
-                    s._pump_post(out)
+                    s._pump_encode(out)
                     if out:
                         sink(out)
             except GGRSError as exc:
@@ -453,8 +580,9 @@ class WirePump:
                 errors.append((s, exc))
 
         if tel.enabled:
-            self._m_batch.observe(len(datagrams))
-            self._m_tax.observe((_time.perf_counter() - t0) * 1000.0)
+            self._m_tax_encode.observe(
+                (_time.perf_counter() - t2) * 1000.0
+            )
         return errors
 
 

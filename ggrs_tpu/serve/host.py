@@ -2629,6 +2629,9 @@ class SessionHost:
                 if self._audit_every
                 else {}
             ),
+            # vectorized protocol plane (network/endpoint_batch.py):
+            # row occupancy + pass counts of this host's pump fleet
+            "endpoint_fleet": self._pump.fleet.stats(),
             "sessions": sessions,
             "envs": [env._env_section() for env in self._envs],
             # speculative bubble-filling hit rate and volume (absent on

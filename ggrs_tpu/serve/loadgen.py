@@ -190,6 +190,7 @@ def run_loadgen(
     idle_timeout_ms: int = 0,
     warmup: bool = True,
     sync_ticks: int = 400,
+    batched: bool = True,
 ) -> Dict[str, Any]:
     """Spin up >= `sessions` scripted peers in 2-4-player matches on one
     SessionHost over a seeded lossy InMemoryNetwork and drive them
@@ -200,7 +201,10 @@ def run_loadgen(
     passing a host lets bench arms reuse a warmed core across runs.
     `profile` plugs a per-link FaultProfile (e.g. serve.chaos.WanProfile)
     into the virtual network in place of the flat latency/jitter/loss
-    knobs — WAN-shaped soaks without the full chaos schedule."""
+    knobs — WAN-shaped soaks without the full chaos schedule.
+    `batched=False` builds the host with the legacy per-message pump
+    (and pins every attached session legacy too) — the parity/bench
+    reference arm against the batched + vectorized protocol plane."""
     clock = FakeClock()
     net = InMemoryNetwork(
         clock,
@@ -225,6 +229,7 @@ def run_loadgen(
             clock=clock,
             idle_timeout_ms=idle_timeout_ms,
             warmup=warmup,
+            batched_pump=batched,
         )
     matches = build_matches(
         host,

@@ -145,6 +145,12 @@ class P2PSession:
         # per-message loop — the parity suite's reference arm.
         self.batched_pump = True
         self._pump_routes_cache = None
+        self._pump_clock = None  # cached by _pump_now on first resolution
+        self._pump_recv = None  # bound receive_all_wire, cached by the pump
+        # vectorized protocol plane (network/endpoint_batch.py): set by
+        # EndpointFleet.adopt when a pump pass crosses the SMALL_FLEET
+        # crossover; None means the endpoints run the scalar twin
+        self._fleet_state = None
         # monotonic advance counter: stamps checksum-report captures so
         # the pump-side flush stays behind the capture frontier
         self._advance_serial = 0
@@ -195,7 +201,11 @@ class P2PSession:
     def on_host_detach(self) -> None:
         """Called by SessionHost.detach/evict: the session is standalone
         again (its device slot is recycled; any un-dispatched rows were
-        dropped with it)."""
+        dropped with it). A fleet-adopted session retires to scalar hot
+        state here — the host's pump owns the fleet rows, and a detached
+        session must not keep views into them."""
+        if self._fleet_state is not None:
+            self._fleet_state.fleet.retire_session(self)
         self._host = None
         self._host_key = None
 
@@ -398,11 +408,37 @@ class P2PSession:
             self._pump_routes_cache = routes
         return routes
 
-    def _pump_post(self, wire_out=None) -> None:
+    def _pump_now(self) -> int:
+        """One hoisted clock read for a whole pump pass: every timer and
+        stats touch in the pass observes this single instant (no per-peer
+        clock syscalls, no cross-peer timer skew within a pass). The
+        clock object is cached on first resolution — every endpoint of a
+        session shares the clock it was built with, so the registry scan
+        is pure lookup overhead on the per-pump hot path."""
+        clock = self._pump_clock
+        if clock is not None:
+            return clock.now_ms()
+        for reg in (self.player_reg.remotes, self.player_reg.spectators):
+            for endpoint in reg.values():
+                self._pump_clock = endpoint.clock
+                return endpoint.clock.now_ms()
+        return 0
+
+    def _pump_post(self, wire_out=None, now=None) -> None:
         """Timer/event/send phase of one pump pass, shared verbatim by
-        the batched pump and the legacy loop. `wire_out` collects
-        (wire, addr) pairs for a batched socket drain; None sends
-        per-message as before."""
+        the batched pump's scalar crossover path and the legacy loop.
+        `wire_out` collects (wire, addr) pairs for a batched socket
+        drain; None sends per-message as before."""
+        if now is None:
+            now = self._pump_now()
+        self._pump_endpoint(now)
+        self._pump_encode(wire_out)
+
+    def _pump_endpoint(self, now) -> None:
+        """Frame-advantage + timer + event + checksum phase — the scalar
+        twin of EndpointFleet.endpoint_phase (network/endpoint_batch.py),
+        which replays exactly this sequence per session on the rows its
+        masks select."""
         remotes = self.player_reg.remotes
         spectators = self.player_reg.spectators
         current = self.sync_layer.current_frame
@@ -411,7 +447,6 @@ class P2PSession:
                 endpoint.update_local_frame_advantage(current)
 
         endpoints = list(remotes.values()) + list(spectators.values())
-        now = endpoints[0].clock.now_ms() if endpoints else None
         events = []
         for endpoint in endpoints:
             handles = list(endpoint.handles)
@@ -426,12 +461,58 @@ class P2PSession:
         # pump, not the tick — see _pump_checksums
         self._pump_checksums()
 
+    def _pump_encode(self, wire_out=None) -> None:
+        """Send-drain phase — the scalar twin of
+        EndpointFleet.encode_phase, which drains only the endpoints the
+        send-dirty flags select."""
+        endpoints = list(self.player_reg.remotes.values()) + list(
+            self.player_reg.spectators.values()
+        )
         if wire_out is None:
             for endpoint in endpoints:
                 endpoint.send_all_messages(self.socket)
         else:
             for endpoint in endpoints:
                 endpoint.drain_sends(wire_out)
+
+    # ------------------------------------------------------------------
+    # vectorized protocol plane (network/endpoint_batch.py)
+    # ------------------------------------------------------------------
+
+    def _fleet_size(self) -> int:
+        return len(self.player_reg.remotes) + len(self.player_reg.spectators)
+
+    def _fleet_profile(self):
+        """What EndpointFleet.adopt needs to hoist this session's
+        endpoints into fleet rows, or None when the session is not
+        fleetable (native endpoints keep their hot state across the FFI
+        boundary; endpoint-less solo sessions have nothing to hoist).
+        Row order is remotes-then-spectators — the scalar phase order —
+        with the remotes prefix (`adv_n`) carrying the vectorized
+        frame-advantage update."""
+        remotes = list(self.player_reg.remotes.values())
+        spectators = list(self.player_reg.spectators.values())
+        endpoints = remotes + spectators
+        if not endpoints:
+            return None
+        if any(not isinstance(ep, PeerEndpoint) for ep in endpoints):
+            return None
+        emits = []
+        for ep in endpoints:
+            handles = list(ep.handles)
+            addr = ep.peer_addr
+            emits.append(
+                lambda event, _h=handles, _a=addr, _s=self: _s._handle_event(
+                    event, _h, _a
+                )
+            )
+        return {
+            "endpoints": endpoints,
+            "emits": emits,
+            "adv_n": len(remotes),
+            "connect_status": self.local_connect_status,
+            "checksums": True,
+        }
 
     def _pump_checksums(self) -> None:
         """Opportunistic, non-blocking drain of pending desync-detection

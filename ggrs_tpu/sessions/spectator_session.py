@@ -78,6 +78,10 @@ class SpectatorSession:
         # batched wire pump toggle + route cache (see P2PSession's twins)
         self.batched_pump = True
         self._pump_routes_cache = None
+        self._pump_recv = None  # bound receive_all_wire, cached by the pump
+        # vectorized protocol plane (network/endpoint_batch.py): set by
+        # EndpointFleet.adopt, None while scalar (see P2PSession's twin)
+        self._fleet_state = None
 
     def on_host_attach(self, host: Any, key: Any) -> None:
         """SessionHost.attach hook; see P2PSession.on_host_attach."""
@@ -91,6 +95,8 @@ class SpectatorSession:
         self._host_key = key
 
     def on_host_detach(self) -> None:
+        if self._fleet_state is not None:
+            self._fleet_state.fleet.retire_session(self)
         self._host = None
         self._host_key = None
 
@@ -184,14 +190,50 @@ class SpectatorSession:
             self._pump_routes_cache = routes
         return routes
 
-    def _pump_post(self, wire_out=None) -> None:
+    def _pump_now(self) -> int:
+        """One hoisted clock read per pump pass (P2PSession twin)."""
+        return self.host.clock.now_ms()
+
+    def _pump_post(self, wire_out=None, now=None) -> None:
+        if now is None:
+            now = self._pump_now()
+        self._pump_endpoint(now)
+        self._pump_encode(wire_out)
+
+    def _pump_endpoint(self, now) -> None:
         addr = self.host.peer_addr
-        for event in self.host.poll(self.host_connect_status):
+        for event in self.host.poll(self.host_connect_status, now):
             self._handle_event(event, addr)
+
+    def _pump_encode(self, wire_out=None) -> None:
         if wire_out is None:
             self.host.send_all_messages(self.socket)
         else:
             self.host.drain_sends(wire_out)
+
+    # vectorized protocol plane (network/endpoint_batch.py) ------------
+
+    def _fleet_size(self) -> int:
+        return 1
+
+    def _fleet_profile(self):
+        """One fleet row — the host endpoint. No frame-advantage prefix
+        (spectators never call update_local_frame_advantage from the
+        pump; it runs on EvInput receipt) and no checksum drain."""
+        from ..network.protocol import PeerEndpoint
+
+        if not isinstance(self.host, PeerEndpoint):
+            return None
+        addr = self.host.peer_addr
+        return {
+            "endpoints": [self.host],
+            "emits": [
+                lambda event, _a=addr, _s=self: _s._handle_event(event, _a)
+            ],
+            "adv_n": 0,
+            "connect_status": self.host_connect_status,
+            "checksums": False,
+        }
 
     def _inputs_at_frame(self, frame_to_grab: Frame):
         """(src/sessions/p2p_spectator_session.rs:173-202)"""

@@ -34,6 +34,14 @@
 #   batched wire pump is the taken path and the steady-state tick never
 #   blocked on a checksum device drain (scripts/pump_smoke.py, CPU jax,
 #   <1 min).
+#   --endpoint-smoke runs a 64-session WAN-profile loadgen fleet under
+#   GGRS_SANITIZE=1 and asserts — via ggrs_endpoint_batch_peers /
+#   ggrs_endpoint_resends_total / the pump|endpoint|encode tax split —
+#   that the vectorized protocol plane is the taken path at fleet
+#   scale, that forced outage holes fire resends through the candidate
+#   mask, zero desyncs, zero drain-blocked ticks post-sync, and that a
+#   fleet-of-one host stays on the scalar twin
+#   (scripts/endpoint_smoke.py, CPU jax, <1 min).
 #   --env-smoke runs a 256-world RollbackEnv rollout with auto-reset plus
 #   a snapshot->branch->restore backtracking episode under GGRS_SANITIZE=1
 #   and asserts zero post-warmup recompiles, megabatch coalescing, the
@@ -164,6 +172,12 @@ if [ "${1:-}" = "--pump-smoke" ]; then
   exit $?
 fi
 
+if [ "${1:-}" = "--endpoint-smoke" ]; then
+  echo "== endpoint smoke (vectorized protocol plane + crossover routing) =="
+  GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/endpoint_smoke.py
+  exit $?
+fi
+
 if [ "${1:-}" = "--env-smoke" ]; then
   echo "== env smoke (256-world rollout + backtracking, recompile-clean) =="
   GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/env_smoke.py
@@ -260,6 +274,9 @@ echo "== [2h/5] learn smoke (journal -> train -> registry -> hot-swap serve) =="
 GGRS_SANITIZE=1 JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python scripts/learn_smoke.py
+
+echo "== [2i/5] endpoint smoke (vectorized protocol plane + crossover) =="
+GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/endpoint_smoke.py
 
 if [ "$FAST" = "0" ]; then
   echo "== [3/5] UBSAN build + native/wire tests =="

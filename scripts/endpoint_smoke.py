@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+"""Endpoint smoke (scripts/check.sh --endpoint-smoke): asserts the
+vectorized protocol plane (network/endpoint_batch.py) is ACTUALLY the
+taken path on a realistic hosted scenario — a 64-session WAN-profile
+loadgen fleet on one SessionHost — and that crossover routing holds:
+
+  1. ggrs_endpoint_batch_peers (endpoints per vectorized pass) must be
+     nonzero with per-pass coverage at fleet scale: a silent fallback
+     to the per-peer scalar scan would keep every test green while
+     quietly restoring the O(peers) host tax.
+  2. ggrs_endpoint_resends_total must be nonzero: the WAN outage holes
+     force 200ms+ input gaps, so the RUNNING retry timer must fire
+     through the vectorized candidate mask (a mask that never selects
+     anything is as wrong as one that always does).
+  3. zero desyncs and ZERO drain-blocked ticks post-sync: the array
+     program carries the exact scalar protocol, and the drain-free
+     tick contract survives the phase split.
+  4. ggrs_host_tax_ms must carry the split pump|endpoint|encode phases
+     (plus parse/drain), so capacity-bench attributions are live.
+  5. crossover: a fleet-of-one host (2 endpoints < SMALL_FLEET) must
+     stay on the scalar twin — zero vectorized passes, no adoption.
+
+CPU jax, deterministic virtual time, < 1 min.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _hist_cell(reg, name):
+    inst = reg.get(name)
+    if inst is None:
+        return 0, 0
+    cell = inst.snapshot()["values"].get("", {})
+    return cell.get("count", 0), cell.get("sum", 0)
+
+
+def main() -> int:
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.network.sockets import InMemoryNetwork
+    from ggrs_tpu.obs import GLOBAL_TELEMETRY, enable_global_telemetry
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.serve.chaos import WanProfile
+    from ggrs_tpu.serve.loadgen import (
+        build_matches,
+        drive_scripted,
+        make_scripts,
+        starve_on_tick,
+        sync_fleet,
+    )
+    from ggrs_tpu.utils.clock import FakeClock
+
+    enable_global_telemetry()
+    clock = FakeClock()
+    # WAN-shaped wire: bursty Gilbert-Elliott loss, cross-region latency,
+    # real reordering — the protocol plane must hold its invariants under
+    # retransmits and gaps, not just on a clean LAN
+    net = InMemoryNetwork(clock, profile=WanProfile(seed=7), seed=7)
+    host = SessionHost(
+        ExGame(num_players=4, num_entities=16),
+        max_prediction=8, num_players=4, max_sessions=70,
+        clock=clock, idle_timeout_ms=0,
+    )
+    assert host.batched_pump, "SessionHost must default to the batched pump"
+    matches = build_matches(host, net, clock, sessions=64, seed=7)
+    n_sessions = sum(len(keys) for keys in matches)
+    sync_fleet(host, matches, clock, max_ticks=1200)
+
+    # steady state starts here (sync-phase compiles may have blocked)
+    GLOBAL_TELEMETRY.registry.reset()
+    passes_before = host._pump.fleet.passes
+    ticks = 120
+    scripts = make_scripts(matches, ticks, seed=7)
+    # outage holes: peer 0 of every match goes dark 15 ticks (240ms of
+    # virtual time > the 200ms retry interval) every 40 — the cumulative-
+    # ack resend path MUST fire through the vectorized candidate mask
+    on_tick = starve_on_tick(net, matches, hole_every=40, hole_len=15)
+    desyncs = drive_scripted(host, matches, clock, scripts, ticks,
+                             on_tick=on_tick)
+    host.drain()
+
+    reg = GLOBAL_TELEMETRY.registry
+    failures = []
+
+    peers_count, peers_sum = _hist_cell(reg, "ggrs_endpoint_batch_peers")
+    if not peers_count or not peers_sum:
+        failures.append(
+            "ggrs_endpoint_batch_peers never observed a pass: the "
+            "vectorized protocol plane was NOT taken at fleet scale"
+        )
+    mean_peers = peers_sum / peers_count if peers_count else 0
+    if mean_peers < host._pump.small_fleet:
+        failures.append(
+            f"mean peers/vectorized pass {mean_peers:.1f} below the "
+            f"crossover ({host._pump.small_fleet}): adoption is leaking "
+            "sessions back to the scalar twin"
+        )
+    if host._pump.fleet.passes <= passes_before:
+        failures.append("EndpointFleet.passes did not advance post-sync")
+
+    resends = reg.get("ggrs_endpoint_resends_total")
+    resends_v = resends.value if resends else 0
+    if not resends_v:
+        failures.append(
+            "ggrs_endpoint_resends_total stayed zero through forced "
+            "240ms input gaps: the RUNNING retry timer never fired "
+            "through the vectorized candidate mask"
+        )
+
+    blocked = reg.get("ggrs_drain_blocked_ticks_total")
+    blocked_v = blocked.value if blocked else 0
+    if blocked_v:
+        failures.append(
+            f"ggrs_drain_blocked_ticks_total = {blocked_v} in steady "
+            "state: the tick path blocked on checksum device drains"
+        )
+
+    tax = reg.get("ggrs_host_tax_ms")
+    phases = set()
+    if tax is not None:
+        for key, cell in tax._children.items():
+            if cell.count:
+                phases.add(key[0] if key else "")
+    missing = {"pump", "endpoint", "encode", "parse", "drain"} - phases
+    if missing:
+        failures.append(
+            f"ggrs_host_tax_ms missing phase observations: {sorted(missing)}"
+        )
+
+    if desyncs:
+        failures.append(f"fleet desynced: {desyncs[:3]}")
+
+    # --- crossover: a fleet-of-one host stays on the scalar twin ------
+    clock2 = FakeClock()
+    net2 = InMemoryNetwork(clock2, latency_ms=15, jitter_ms=5, loss=0.02,
+                           seed=9)
+    host2 = SessionHost(
+        ExGame(num_players=4, num_entities=16),
+        max_prediction=8, num_players=4, max_sessions=6,
+        clock=clock2, idle_timeout_ms=0, warmup=False,
+    )
+    matches2 = build_matches(host2, net2, clock2, sessions=2, seed=9)
+    sync_fleet(host2, matches2, clock2)
+    drive_scripted(host2, matches2, clock2,
+                   make_scripts(matches2, 40, seed=9), 40)
+    if host2._pump.fleet.passes or host2._pump.fleet.live_rows:
+        failures.append(
+            "fleet-of-one host took the vectorized plane: crossover "
+            "routing is broken (scalar twin must win below SMALL_FLEET)"
+        )
+
+    print(
+        f"endpoint smoke: {n_sessions} sessions x {ticks} ticks, "
+        f"{int(peers_sum)} endpoint-passes over {int(peers_count)} "
+        f"vectorized pumps (mean {mean_peers:.1f} peers/pass), "
+        f"resends={int(resends_v)}, drain_blocked_ticks={int(blocked_v)}, "
+        f"tax phases={sorted(phases)}, desyncs={len(desyncs)}, "
+        f"fleet-of-one passes={host2._pump.fleet.passes}"
+    )
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1
+    print("endpoint smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

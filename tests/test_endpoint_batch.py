@@ -1,0 +1,430 @@
+"""Vectorized protocol plane parity (network/endpoint_batch.py).
+
+The fleet pass replaces the per-peer Python timer/event/send scan with
+one array program per pump; these tests pin that the replacement is
+BIT-IDENTICAL to the scalar twin it rides above:
+
+  1. view parity: adopting an endpoint swaps its hot-state backing for
+     a fleet-row view and retiring swaps it back, with every field
+     surviving bit-exact and live mutation visible through both;
+  2. mesh parity: seeded lossy/reordering/duplicating 2-player meshes
+     driven forced-fleet vs forced-scalar vs legacy pin identical wire
+     bytes per socket IN SEND ORDER, identical endpoint state,
+     identical NetworkStats and bitwise checksum histories — Python
+     and native endpoints;
+  3. lifecycle parity: adopt -> retire -> re-adopt mid-run changes
+     nothing observable;
+  4. crossover: a fleet-of-one pass stays on the scalar twin (no
+     adoption), matching pump.py's SMALL_BATCH routing story;
+  5. hosted parity: a SessionHost fleet above the crossover takes the
+     vectorized plane (nonzero fleet passes) and stays bitwise equal,
+     device state included, to the scalar-twin and legacy-pump hosts.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from ggrs_tpu import DesyncDetection, PlayerType, SessionBuilder, SessionState
+from ggrs_tpu.errors import GGRSError
+from ggrs_tpu.native import available
+from ggrs_tpu.network.endpoint_batch import EndpointFleet, _FleetRow
+from ggrs_tpu.network.messages import encode_message
+from ggrs_tpu.network.protocol import (
+    _HOT_BOOL_FIELDS,
+    _HOT_INT_FIELDS,
+    PeerEndpoint,
+    _ScalarHot,
+)
+from ggrs_tpu.network.pump import GLOBAL_PUMP
+from ggrs_tpu.network.sockets import InMemoryNetwork
+from ggrs_tpu.utils.clock import FakeClock
+
+BIG = 1 << 30  # a small_fleet no pass ever reaches: pins the scalar twin
+
+
+class WireTap:
+    """Socket wrapper recording every datagram shipped, in send order —
+    the bitwise witness that two pump configurations put IDENTICAL bytes
+    on the wire in IDENTICAL order."""
+
+    def __init__(self, sock):
+        self._sock = sock
+        self.sent = []
+
+    def send_to(self, msg, addr):
+        self.sent.append((encode_message(msg), addr))
+        self._sock.send_to(msg, addr)
+
+    def send_wire(self, wire, addr):
+        self.sent.append((bytes(wire), addr))
+        self._sock.send_wire(wire, addr)
+
+    def send_wire_batch(self, batch):
+        for wire, addr in batch:
+            self.sent.append((bytes(wire), addr))
+        self._sock.send_wire_batch(batch)
+
+    def receive_all_wire(self):
+        return self._sock.receive_all_wire()
+
+    def receive_all_messages(self):
+        return self._sock.receive_all_messages()
+
+
+def endpoint_state(ep):
+    """Observable endpoint state, hot fields included (works through
+    either backing store)."""
+    state = {
+        "state": ep.state,
+        "remote_magic": ep.remote_magic,
+        "packets_recv": ep.packets_recv,
+        "bytes_recv": ep.bytes_recv,
+        "packets_sent": ep.packets_sent,
+        "bytes_sent": ep.bytes_sent,
+        "pending": list(ep.pending_output),
+        "recv_inputs": dict(ep.recv_inputs),
+        "recv_frame": ep.recv_frame,
+        "connect": [(s.disconnected, s.last_frame)
+                    for s in ep.peer_connect_status],
+        "checksums": dict(ep.checksum_history),
+        "events": list(ep.event_queue),
+        "sends": [encode_message(m) for m in ep.send_queue],
+    }
+    for name in _HOT_INT_FIELDS + _HOT_BOOL_FIELDS:
+        state[name] = getattr(ep, name)
+    return state
+
+
+def network_stats_or_none(ep):
+    try:
+        return ep.network_stats()
+    except GGRSError:
+        return None
+
+
+def make_endpoint(seed, clock):
+    return PeerEndpoint(
+        handles=[1], peer_addr="peer", num_players=2, local_players=1,
+        max_prediction=8, disconnect_timeout_ms=2000,
+        disconnect_notify_start_ms=500, fps=60, input_size=1,
+        clock=clock, rng=random.Random(seed),
+    )
+
+
+# ----------------------------------------------------------------------
+# 1. row-view adopt/retire roundtrip
+# ----------------------------------------------------------------------
+
+
+class _SoloProfile:
+    """Minimal fleet-adoptable stand-in for a session: one endpoint."""
+
+    def __init__(self, ep):
+        self.ep = ep
+        self.emitted = []
+        self._fleet_state = None
+
+    def _fleet_profile(self):
+        return {
+            "endpoints": [self.ep],
+            "emits": [self.emitted.append],
+            "adv_n": 0,
+            "connect_status": [],
+            "checksums": False,
+        }
+
+
+def test_adopt_retire_roundtrip_is_bit_exact():
+    clock = FakeClock()
+    clock.advance(1234)
+    ep = make_endpoint(3, clock)
+    ep.synchronize()  # non-trivial hot state: magic, timers, queued sync
+    before = endpoint_state(ep)
+    assert isinstance(ep._hot, _ScalarHot)
+
+    fleet = EndpointFleet(cap=2)
+    holder = _SoloProfile(ep)
+    assert fleet.adopt(holder)
+    assert isinstance(ep._hot, _FleetRow)
+    assert fleet.live_rows == 1 and fleet.live_sessions == 1
+    assert endpoint_state(ep) == before  # the view changes nothing
+
+    # mutation through the view lands in the columns and reads back as
+    # plain Python scalars
+    row = holder._fleet_state.start
+    ep.round_trip_time = 42
+    assert fleet.cols["round_trip_time"][row] == 42
+    assert ep.round_trip_time == 42 and type(ep.round_trip_time) is int
+    ep.disconnect_notify_sent = True
+    assert bool(fleet.cols["disconnect_notify_sent"][row]) is True
+    ep.round_trip_time = before["round_trip_time"]
+    ep.disconnect_notify_sent = before["disconnect_notify_sent"]
+
+    # queue appends while adopted set the dirty flags
+    assert not fleet.cols["events_dirty"][row]
+    ep.event_queue.append("ev")
+    assert fleet.cols["events_dirty"][row]
+    ep.event_queue.clear()
+
+    fleet.retire_session(holder)
+    assert isinstance(ep._hot, _ScalarHot)
+    assert holder._fleet_state is None
+    assert fleet.live_rows == 0 and fleet.free_blocks == [(0, 1)]
+    assert endpoint_state(ep) == before
+
+    # adopting again reuses the freed block and growth keeps views live
+    assert fleet.adopt(holder)
+    assert holder._fleet_state.start == 0
+    others = [_SoloProfile(make_endpoint(9 + i, clock)) for i in range(4)]
+    for o in others:
+        assert fleet.adopt(o)  # forces _grow past cap=2
+    assert fleet.cap >= 5
+    ep.round_trip_time = 77  # view must still hit the (rebound) columns
+    assert fleet.cols["round_trip_time"][holder._fleet_state.start] == 77
+
+
+def test_native_sessions_are_unfleetable():
+    if not available():
+        pytest.skip("native library not built")
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=0, jitter_ms=0, loss=0.0, seed=1)
+    s = (
+        SessionBuilder(input_size=1)
+        .with_num_players(2)
+        .with_clock(clock)
+        .with_native_endpoints(True)
+        .add_player(PlayerType.local(), 0)
+        .add_player(PlayerType.remote("b"), 1)
+        .start_p2p_session(net.socket("a"))
+    )
+    assert s._fleet_profile() is None
+    assert not EndpointFleet(cap=2).adopt(s)
+
+
+# ----------------------------------------------------------------------
+# 2./3. mesh parity: fleet vs scalar vs legacy on hostile wire
+# ----------------------------------------------------------------------
+
+
+def drive_mesh(mode, use_native, ticks=120, loss=0.05, duplicate=0.08,
+               seed=11, lifecycle=False):
+    """2-player mesh over a seeded lossy/reordering/duplicating wire.
+
+    mode: "fleet" pins the vectorized plane (crossover forced to 0),
+    "scalar" pins the scalar twin (crossover unreachable), "legacy"
+    pins the per-message pump end-to-end. All nondeterminism is seeded
+    and all clocks virtual, so any cross-mode difference is a real
+    behavioral divergence. `lifecycle=True` additionally retires and
+    re-adopts mid-run (fleet mode only) — it must change nothing."""
+    from stubs import GameStub
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=15, jitter_ms=6, loss=loss,
+                          duplicate=duplicate, seed=seed)
+
+    def build(my_addr, other_addr, local_handle):
+        b = (
+            SessionBuilder(input_size=1)
+            .with_num_players(2)
+            .with_max_prediction_window(8)
+            .with_clock(clock)
+            .with_desync_detection_mode(DesyncDetection.on(interval=10))
+            .with_rng(random.Random(hash(my_addr) & 0xFFFF))
+        )
+        if use_native:
+            b = b.with_native_endpoints(True)
+        b = b.add_player(PlayerType.local(), local_handle)
+        b = b.add_player(PlayerType.remote(other_addr), 1 - local_handle)
+        return b.start_p2p_session(WireTap(net.socket(my_addr)))
+
+    sessions = [build("a", "b", 0), build("b", "a", 1)]
+    games = [GameStub(), GameStub()]
+    saved = GLOBAL_PUMP.small_fleet
+    if mode == "fleet":
+        GLOBAL_PUMP.small_fleet = 0
+    elif mode == "scalar":
+        GLOBAL_PUMP.small_fleet = BIG
+    elif mode == "legacy":
+        for s in sessions:
+            s.batched_pump = False
+    # any other mode keeps the real SMALL_FLEET crossover
+    try:
+        for _ in range(400):
+            for s in sessions:
+                s.poll_remote_clients()
+                s.events()
+            clock.advance(20)
+            if all(
+                s.current_state() == SessionState.RUNNING for s in sessions
+            ):
+                break
+        else:
+            raise AssertionError("mesh failed to synchronize")
+
+        script = random.Random(seed ^ 0xBEEF)
+        inputs = [
+            [script.randrange(16) for _ in range(ticks)] for _ in range(2)
+        ]
+        for t in range(ticks):
+            if lifecycle and t == ticks // 3:
+                # retire mid-run: endpoints drop back to scalar hot
+                # state; the next pump pass re-adopts them
+                for s in sessions:
+                    if s._fleet_state is not None:
+                        s._fleet_state.fleet.retire_session(s)
+            for i, s in enumerate(sessions):
+                s.add_local_input(i, bytes([inputs[i][t]]))
+                games[i].handle_requests(s.advance_frame())
+                s.events()
+            clock.advance(16)
+
+        adopted = sum(s._fleet_state is not None for s in sessions)
+        report = []
+        for s, g in zip(sessions, games):
+            remotes = list(s.player_reg.remotes.values())
+            report.append({
+                "frame": s.current_frame,
+                "checksum_history": dict(s.local_checksum_history),
+                "connect": [(c.disconnected, c.last_frame)
+                            for c in s.local_connect_status],
+                "game_state": (g.gs.frame, g.gs.state),
+                "wire": list(s.socket.sent),
+                "endpoints": [
+                    endpoint_state(ep) if not use_native else None
+                    for ep in remotes
+                ],
+                "stats": [network_stats_or_none(ep) for ep in remotes],
+            })
+        return report, adopted
+    finally:
+        GLOBAL_PUMP.small_fleet = saved
+        for s in sessions:
+            if s._fleet_state is not None:
+                s._fleet_state.fleet.retire_session(s)
+
+
+@pytest.mark.parametrize(
+    "use_native", [False] + ([True] if available() else [])
+)
+def test_mesh_parity_fleet_vs_scalar_vs_legacy(use_native):
+    fleet, fleet_adopted = drive_mesh("fleet", use_native)
+    scalar, scalar_adopted = drive_mesh("scalar", use_native)
+    legacy, _ = drive_mesh("legacy", use_native)
+    assert fleet == scalar
+    assert scalar_adopted == 0
+    if use_native:
+        # native endpoints are unfleetable by design: the forced-fleet
+        # run must have routed them to the scalar twin
+        assert fleet_adopted == 0
+    else:
+        assert fleet_adopted == 2
+        # wire bytes per socket in send order are the strongest pin;
+        # make sure the run put real traffic AND stats on them
+        assert all(len(r["wire"]) > 50 for r in fleet)
+        assert all(st is not None for r in fleet for st in r["stats"])
+        assert all(r["checksum_history"] for r in fleet)
+    # the legacy per-message pump sends per-datagram instead of batched,
+    # but the BYTES per socket in order must match exactly
+    for fr, lr in zip(fleet, legacy):
+        assert fr["wire"] == lr["wire"]
+        assert fr["checksum_history"] == lr["checksum_history"]
+        assert fr["frame"] == lr["frame"]
+        assert fr["game_state"] == lr["game_state"]
+        assert fr["connect"] == lr["connect"]
+        assert fr["stats"] == lr["stats"]
+
+
+def test_mesh_parity_survives_adopt_retire_cycles():
+    cycled, _ = drive_mesh("fleet", False, lifecycle=True)
+    scalar, _ = drive_mesh("scalar", False)
+    assert cycled == scalar
+
+
+def test_crossover_fleet_of_one_stays_scalar():
+    """Below SMALL_FLEET the pump must keep the scalar twin: standalone
+    small meshes never pay adoption or the fixed vectorized-pass cost."""
+    assert GLOBAL_PUMP.small_fleet >= 2
+    passes_before = GLOBAL_PUMP.fleet.passes
+    report, adopted = drive_mesh("default", False, ticks=40)
+    assert adopted == 0
+    assert GLOBAL_PUMP.fleet.passes == passes_before
+    assert report[0]["checksum_history"]
+
+
+# ----------------------------------------------------------------------
+# 5. hosted parity: vectorized vs scalar twin vs legacy pump
+# ----------------------------------------------------------------------
+
+
+def build_hosted_fleet(mode, seed=13):
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.serve.loadgen import (
+        build_matches,
+        drive_scripted,
+        make_scripts,
+        sync_fleet,
+    )
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock, latency_ms=20, jitter_ms=8, loss=0.03,
+                          duplicate=0.02, seed=seed)
+    host = SessionHost(
+        ExGame(num_players=4, num_entities=16),
+        max_prediction=8, num_players=4, max_sessions=12,
+        clock=clock, idle_timeout_ms=0,
+        batched_pump=(mode != "legacy"),
+    )
+    if mode == "scalar":
+        host._pump.small_fleet = BIG
+    matches = build_matches(host, net, clock, sessions=8, seed=seed)
+    sync_fleet(host, matches, clock)
+    ticks = 60
+    scripts = make_scripts(matches, ticks, seed=seed)
+    desyncs = drive_scripted(host, matches, clock, scripts, ticks)
+    assert not desyncs, f"hosted fleet desynced (mode={mode})"
+    host.device.block_until_ready()
+    return host, matches
+
+
+def test_hosted_fleet_vectorized_parity():
+    import jax
+
+    host_f, matches_f = build_hosted_fleet("fleet")
+    host_s, matches_s = build_hosted_fleet("scalar")
+    host_l, matches_l = build_hosted_fleet("legacy")
+    # the default host is above the crossover: the vectorized plane ran
+    assert host_f._pump.fleet.passes > 0
+    assert host_f._pump.fleet.live_rows >= host_f._pump.small_fleet
+    assert host_s._pump.fleet.passes == 0
+    stats = host_f._host_section()["endpoint_fleet"]
+    assert stats["vectorized_passes"] > 0 and stats["rows_live"] > 0
+
+    keys = [
+        [k for keys in m for k in keys]
+        for m in (matches_f, matches_s, matches_l)
+    ]
+    assert len(keys[0]) == len(keys[1]) == len(keys[2]) >= 8
+    for kf, ks, kl in zip(*keys):
+        sf = host_f.session(kf)
+        ss = host_s.session(ks)
+        sl = host_l.session(kl)
+        assert sf.current_frame == ss.current_frame == sl.current_frame
+        assert (
+            sf.local_checksum_history
+            == ss.local_checksum_history
+            == sl.local_checksum_history
+        )
+        for ref_host, ref_key in ((host_s, ks), (host_l, kl)):
+            a = host_f.device.state_numpy(host_f._lanes[kf].slot)
+            b = ref_host.device.state_numpy(ref_host._lanes[ref_key].slot)
+            for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+                assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+    # detach retires every fleet row; the fleet must drain to empty
+    for k in list(keys[0]):
+        host_f.detach(k)
+    assert host_f._pump.fleet.live_rows == 0
+    assert host_f._pump.fleet.live_sessions == 0
