@@ -1981,6 +1981,22 @@ def _capacity_arm(batched, sessions, ticks, entities, seed, floor_reps=600):
         floor_s = dt if floor_s is None else min(floor_s, dt)
     floor_us_per_session = floor_s * 1e6 / n_sessions
 
+    # allocation tax of the quiescent pump pass (tracemalloc delta over
+    # a short traced window, OUTSIDE the timed one — tracing skews
+    # timing): steady-state pump passes should allocate ~nothing, and
+    # this number is the regression canary the ALLOC lint pass and the
+    # runtime freeze_allocations() budget both guard
+    import tracemalloc
+
+    alloc_reps = 64
+    tracemalloc.start()
+    alloc_base = tracemalloc.get_traced_memory()[0]
+    for _rep in range(alloc_reps):
+        pump.pump(fleet_sessions, isolate=True)
+    alloc_delta = tracemalloc.get_traced_memory()[0] - alloc_base
+    tracemalloc.stop()
+    alloc_kb_per_tick = max(0.0, alloc_delta / 1024.0 / alloc_reps)
+
     fleet = pump.fleet
     arm = {
         "batched_pump": batched,
@@ -2001,6 +2017,7 @@ def _capacity_arm(batched, sessions, ticks, entities, seed, floor_reps=600):
         else 0.0,
         "fleet_passes": fleet.passes,
         "fleet_rows_live": fleet.live_rows,
+        "alloc_kb_per_tick": round(alloc_kb_per_tick, 2),
     }
     for keys in matches:
         for k in keys:
@@ -2074,6 +2091,8 @@ def bench_host_capacity(sessions=64, ticks=120, entities=16, seed=7):
         ],
         "sessions_at_60hz": batched_arm["sessions_at_60hz"],
         "sessions_at_60hz_legacy": legacy_arm["sessions_at_60hz"],
+        "alloc_kb_per_tick": batched_arm["alloc_kb_per_tick"],
+        "alloc_kb_per_tick_legacy": legacy_arm["alloc_kb_per_tick"],
         "pump_speedup": round(speedup, 2),
         "traffic_speedup": round(traffic_speedup, 2),
         "crossover_sessions": xover_batched["sessions"],
@@ -2447,9 +2466,28 @@ def bench_resident_loop(sessions=16, ticks=240, entities=256,
             dev.megabatches - base_mega
             + dev.driver_dispatches - base_driver
         )
+        # steady-state allocation tax (tracemalloc delta per host tick)
+        # over a SHORT traced extension of the same traffic — outside
+        # the timed window, since tracing skews throughput; both arms
+        # drive the same extension so the bitwise-parity check below
+        # still compares identical fleets
+        import tracemalloc
+
+        alloc_ticks = 32
+        extra = make_scripts(matches, alloc_ticks, seed=seed + 1)
+        tracemalloc.start()
+        alloc_base = tracemalloc.get_traced_memory()[0]
+        desyncs2 = drive_scripted(host, matches, clock, extra, alloc_ticks)
+        host.device.block_until_ready()
+        alloc_delta = tracemalloc.get_traced_memory()[0] - alloc_base
+        tracemalloc.stop()
+        assert not desyncs2, f"alloc window desynced: {desyncs2[:3]}"
         res = {
             "session_ticks_per_sec": round(n_sessions * ticks / dt, 1),
             "dispatches_per_tick": round(tick_dispatches / ticks, 3),
+            "alloc_kb_per_tick": round(
+                max(0.0, alloc_delta / 1024.0 / alloc_ticks), 2
+            ),
         }
         if resident:
             res["vticks_per_dispatch"] = round(
@@ -2498,6 +2536,8 @@ def bench_resident_loop(sessions=16, ticks=240, entities=256,
         ),
         "dispatches_per_tick_resident": res_info["dispatches_per_tick"],
         "dispatches_per_tick_twin": last[False][0]["dispatches_per_tick"],
+        "alloc_kb_per_tick_resident": res_info["alloc_kb_per_tick"],
+        "alloc_kb_per_tick_twin": last[False][0]["alloc_kb_per_tick"],
         "vticks_per_dispatch": res_info["vticks_per_dispatch"],
         "mailbox_overflows": res_info["mailbox_overflows"],
         "bitwise_parity": True,
@@ -2970,6 +3010,7 @@ def main():
         "serve_sessions_per_sec", "serve_occupancy",
         "serve_fast_dispatch_rate", "sessions_at_60hz",
         "host_cpu_us_per_session", "endpoint_pump_speedup",
+        "capacity_alloc_kb_per_tick", "resident_alloc_kb_per_tick",
         "env_steps_per_sec",
         "sharded_vs_single_device_speedup",
         "chaos_fps_retained", "fps_retained_under_device_faults",
@@ -3223,6 +3264,8 @@ def main():
     full["host_cpu_us_per_session"] = capacity["host_cpu_us_per_session"]
     full["sessions_at_60hz"] = capacity["sessions_at_60hz"]
     full["endpoint_pump_speedup"] = capacity["pump_speedup"]
+    if "alloc_kb_per_tick" in capacity:  # absent in pre-alloc-probe runs
+        full["capacity_alloc_kb_per_tick"] = capacity["alloc_kb_per_tick"]
     full["host_capacity"] = capacity
     # the RL-env workload (ggrs_tpu/env/): env steps/sec on the same
     # megabatch path, non-interactive training traffic
@@ -3324,6 +3367,10 @@ def main():
     full["resident_dispatches_per_tick"] = resident[
         "dispatches_per_tick_resident"
     ]
+    if "alloc_kb_per_tick_resident" in resident:
+        full["resident_alloc_kb_per_tick"] = resident[
+            "alloc_kb_per_tick_resident"
+        ]
     # durable input journal: the write tax (fsync-cadence sweep) and
     # the recovery-time objective (journal-only batched resim)
     journal = phase(

@@ -1,6 +1,6 @@
-"""Static-analysis suite + runtime retrace sanitizer.
+"""Static-analysis suite + runtime sanitizers.
 
-Four source-level passes guard the invariants the rollback core's
+Six source-level passes guard the invariants the rollback core's
 guarantees rest on (run as `python -m ggrs_tpu.analysis`, gated by
 `scripts/check.sh --lint` against `analysis/baseline.toml`):
 
@@ -12,10 +12,19 @@ guarantees rest on (run as `python -m ggrs_tpu.analysis`, gated by
                                  through the async-fence entry points
   wire_contract      WIRE001-004 Python and C++ stacks cannot silently
                                  drift on formats, layouts or bounds
+  alloc              ALLOC001-004 the steady-state tick path allocates
+                                 nothing (containers, closures, strings,
+                                 argument repacking on the hot spine)
+  exceptions         EXC001-002  every raise is typed (GGRSError) and
+                                 broad excepts re-raise or record
 
-The runtime companion (`GGRS_SANITIZE=1`, analysis/sanitize.py) wraps
-jax.jit to attribute every program compile to its call site and assert
-the megabatch jit cache against the dispatch-bucket budget mid-serve.
+The runtime companions (`GGRS_SANITIZE=1`, analysis/sanitize.py): the
+retrace sanitizer wraps jax.jit to attribute every program compile to
+its call site and assert the megabatch jit cache against the
+dispatch-bucket budget mid-serve; `freeze_allocations()` budgets
+allocator growth per host tick post-warmup with tracemalloc provenance
+on trips; `transfer_guard_scope()` turns implicit device->host syncs
+inside the post-freeze dispatch/drive regions into typed hard errors.
 
 This package imports no jax (the sanitizer imports it lazily at
 install), so the lint gate runs anywhere the repo checks out.

@@ -4,6 +4,7 @@ baseline, print what's new, exit nonzero on any unbaselined finding.
     python -m ggrs_tpu.analysis                 # the gate
     python -m ggrs_tpu.analysis --list-rules    # rule table
     python -m ggrs_tpu.analysis --no-baseline   # raw findings
+    python -m ggrs_tpu.analysis --json          # machine-readable records
     python -m ggrs_tpu.analysis --passes determinism,fence
     python -m ggrs_tpu.analysis --write-baseline  # re-audit: rewrite the
         allowlist from current findings (justifications start as TODO and
@@ -16,6 +17,7 @@ findings, 2 usage/internal error.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -39,6 +41,10 @@ def main(argv=None) -> int:
                     help="report every finding, audited or not")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline from current findings")
+    ap.add_argument("--json", action="store_true",
+                    help="emit fresh findings as JSON records on stdout "
+                    "(rule/path/symbol/line/message; exit codes "
+                    "unchanged) so CI can archive lint artifacts")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--root", help="repo root (default: auto-detect)")
     args = ap.parse_args(argv)
@@ -104,8 +110,20 @@ def main(argv=None) -> int:
 
     fresh, suppressed, stale = apply_baseline(findings, entries)
 
-    for f in fresh:
-        print(f.render())
+    if args.json:
+        print(json.dumps(
+            [
+                {
+                    "rule": f.rule, "path": f.path, "line": f.line,
+                    "symbol": f.symbol, "message": f.message,
+                }
+                for f in fresh
+            ],
+            indent=2,
+        ))
+    else:
+        for f in fresh:
+            print(f.render())
     for e in stale:
         print(
             f"note: stale baseline entry {e.rule} {e.path} [{e.symbol}] "

@@ -1,0 +1,467 @@
+"""Pass 5 — hot-path allocation lint (ALLOC001..ALLOC004).
+
+The serving doctrine (docs/DESIGN.md "Host tick tax"): the steady-state
+host tick allocates nothing. Staging buffers are pooled and rebound only
+through the fence entry points FEN001 names, decode scratch is reused
+across pump passes, and the tick path never builds strings. PR 10 and
+PR 15 both shipped review fixes for regressions of exactly this class —
+this pass makes the reviewer's eyeball a gate.
+
+Reachability: the same module-local resolver trace_discipline uses
+(`_index_functions` / `_resolve_fn_ref`), seeded from the HOT_ENTRIES
+table below — the per-tick serving spine: SessionHost.tick and the
+dispatch/drive paths under it, WirePump.pump, the EndpointFleet pass
+phases, mailbox stage/commit/take_cycle, the journal writer's append and
+the input recorder's observe/drain. Everything those functions call that
+resolves within the same module is hot too, EXCEPT the names in
+COLD_CALLS — the pooled-growth / adopt / recovery entry points that are
+amortized or fault-path by contract. Cross-module callees are out of a
+single-file AST pass's reach; the runtime allocation sanitizer
+(analysis/sanitize.py freeze_allocations) covers the dynamic remainder.
+
+Rules, scoped to hot functions:
+
+  ALLOC001  per-ITERATION container allocation: a list/dict/set literal,
+            comprehension or np.zeros/empty/arange/concatenate call
+            inside a for/while body. Per-pass setup (one scratch list
+            per pump) amortizes over the batch; per-iteration allocation
+            multiplies with fleet size, every tick.
+  ALLOC002  per-call closures: a lambda, nested def or functools.partial
+            built on the tick path allocates a function object (and a
+            cell chain) per call.
+  ALLOC003  string building (f-string, .format, .join, %-formatting) on
+            the tick path. Exempt inside `raise`/`assert`, except
+            handlers and telemetry-guarded blocks — error and
+            observability paths are cold by contract.
+  ALLOC004  argument repacking: a hot function whose signature takes
+            *args/**kwargs packs a fresh tuple/dict per call; a `**`
+            splat at a hot call site builds a dict per call; sorted()
+            inside a loop body materializes a list per iteration.
+
+Cold contexts (never flagged): except-handler bodies, `raise`/`assert`
+expressions, blocks guarded by a telemetry `.enabled` / `fault_seam` /
+`__debug__` test, and `x is None` lazy-init guards (allocate-once
+idioms).
+
+Genuinely-exempt sites get a named entry in EXEMPTIONS below — a policy
+decision reviewed in this file, with its justification — never a
+baseline.toml entry. The baseline stays empty.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .engine import (
+    Repo,
+    call_name,
+    enclosing_function,
+    finding,
+    parent_of,
+)
+from .findings import Finding
+from .trace_discipline import _index_functions, _resolve_fn_ref
+
+# ---------------------------------------------------------------------------
+# the hot-entry table: per module, the qualified entry points of the
+# steady-state serving spine. The reachability walk closes over their
+# module-local callees.
+# ---------------------------------------------------------------------------
+
+HOT_ENTRIES: Dict[str, Tuple[str, ...]] = {
+    "ggrs_tpu/serve/host.py": (
+        "SessionHost.tick",
+    ),
+    "ggrs_tpu/network/pump.py": (
+        "WirePump.pump",
+    ),
+    "ggrs_tpu/network/endpoint_batch.py": (
+        "EndpointFleet.endpoint_phase",
+        "EndpointFleet.encode_phase",
+        "EndpointFleet.pending_sends",
+    ),
+    "ggrs_tpu/tpu/backend.py": (
+        "MultiSessionDeviceCore.dispatch",
+        "MultiSessionDeviceCore.dispatch_rows",
+        "MultiSessionDeviceCore.stage_mailbox_row",
+        "MultiSessionDeviceCore.commit_mailbox",
+        "MultiSessionDeviceCore.drive_mailbox",
+        "ShardedMultiSessionDeviceCore._dispatch_staged",
+    ),
+    "ggrs_tpu/tpu/mailbox.py": (
+        "DeviceMailbox.stage",
+        "DeviceMailbox.commit",
+        "DeviceMailbox.take_cycle",
+    ),
+    "ggrs_tpu/journal/wal.py": (
+        "JournalWriter.append_rows",
+    ),
+    "ggrs_tpu/utils/replay.py": (
+        "InputRecorder.observe",
+        "InputRecorder.drain_confirmed",
+    ),
+}
+
+# callee names the walk does NOT descend into: the amortized / fault-path
+# entry points reachable from hot code whose bodies are cold by contract.
+# Growth and adopt/retire paths are the pooled-staging idioms FEN001
+# names (they run on fleet churn, not steady state); the recovery ladder
+# and quarantine run exactly when the steady state is already broken.
+COLD_CALLS = frozenset({
+    # pooled growth / adoption (pump.py, endpoint_batch.py, backend.py)
+    "ensure", "_grow", "_alloc", "adopt", "retire_session",
+    "adopt_sessions", "_adopt_fleet",
+    # host lifecycle + fault recovery (serve/host.py): these run when
+    # the steady state is already broken (or on the sampled/periodic
+    # cold cadence), so their allocations are not tick-path churn
+    "_run_gc", "_maybe_audit", "_resolve_audits", "_launch_drafts",
+    "_recover_drive_failure", "_on_device_fault", "_quarantine_lane",
+    "_degrade_resident", "evict", "detach", "_check_invariants",
+    "quarantine", "_trip_invariant", "_journal_fault", "write_forensics",
+    # device-side cold entry points (tpu/backend.py)
+    "warmup", "reset_slot", "adopt_slot", "checksum_slots",
+    "_acquire_stage", "_acquire_multi_buf", "_acquire_draft_stage",
+    "_acquire_commit_stage",
+    # journal segment rotation / first-append rebase amortize over a
+    # segment's rows (rebase runs at most once per journal lifetime)
+    "_rotate", "_open_segment", "_rebase_segment",
+    # runtime-sanitizer cold arms (analysis/sanitize.py): the budget
+    # trip takes tracemalloc snapshots and the guard patch swaps class
+    # descriptors — both run exactly when the steady-state contract is
+    # already violated (or once at scope open), never per clean tick
+    "_trip_alloc_budget", "_patch_transfer_guard",
+    "_unpatch_transfer_guard", "_transfer_trip",
+})
+
+# named policy exemptions: (rule, path, enclosing symbol) -> why this
+# site is allowed to allocate. Reviewed here, not in the baseline.
+EXEMPTIONS: Dict[Tuple[str, str, str], str] = {
+    ("ALLOC001", "ggrs_tpu/network/endpoint_batch.py",
+     "EndpointFleet._pass_plan"):
+        "plan rebuild only runs on adopt/retire or a changed pass set; "
+        "the steady-state pump takes the identity-sweep cache hit above",
+    ("ALLOC001", "ggrs_tpu/network/endpoint_batch.py",
+     "EndpointFleet.endpoint_phase"):
+        "the event snapshot (`list(q)`) is the scalar poll's "
+        "list()/clear() parity contract and runs only on event-carrying "
+        "rows (connect/interrupt transitions), never the steady-state "
+        "pass",
+    ("ALLOC001", "ggrs_tpu/utils/replay.py", "InputRecorder.observe"):
+        "the recorder's contract IS one durable (inputs, statuses) row "
+        "per advanced frame; rows are owned by _rows until "
+        "drain_confirmed frees them, so per-frame materialization "
+        "cannot pool",
+}
+
+_CONTAINER_CALLS = {
+    "list", "dict", "set", "bytearray", "deque", "collections.deque",
+    "np.zeros", "np.empty", "np.ones", "np.full", "np.arange",
+    "np.array", "np.concatenate", "np.repeat",
+    "numpy.zeros", "numpy.empty", "numpy.ones", "numpy.full",
+    "numpy.arange", "numpy.array", "numpy.concatenate", "numpy.repeat",
+}
+
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp)
+
+
+class _HotFn:
+    __slots__ = ("node", "path", "via")
+
+    def __init__(self, node: ast.AST, path: str, via: str):
+        self.node = node
+        self.path = path
+        self.via = via
+
+
+def _inline_seeds(tree: ast.Module) -> Tuple[str, ...]:
+    """A module-level `__ggrs_hot__ = ("Class.method", ...)` assignment
+    declares hot entry points inline — how test fixtures (and any future
+    out-of-table module) opt their functions into this pass."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__ggrs_hot__":
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        return tuple(
+                            e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        )
+    return ()
+
+
+def _seed_nodes(tree: ast.Module, names: Tuple[str, ...]) -> List[Tuple[ast.AST, str]]:
+    """Resolve 'Class.method' / 'func' seed names to def nodes."""
+    classes: Dict[str, ast.ClassDef] = {}
+    top: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            classes[node.name] = node
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            top[node.name] = node
+    out: List[Tuple[ast.AST, str]] = []
+    for name in names:
+        if "." in name:
+            cls_name, meth = name.split(".", 1)
+            cls = classes.get(cls_name)
+            if cls is None:
+                continue
+            for item in cls.body:
+                if (
+                    isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and item.name == meth
+                ):
+                    out.append((item, name))
+                    break
+        elif name in top:
+            out.append((top[name], name))
+    return out
+
+
+def find_hot_functions(tree: ast.Module, path: str) -> Dict[int, _HotFn]:
+    seeds = HOT_ENTRIES.get(path, ()) + _inline_seeds(tree)
+    if not seeds:
+        return {}
+    by_scope, methods = _index_functions(tree)
+    hot: Dict[int, _HotFn] = {}
+    for node, name in _seed_nodes(tree, seeds):
+        hot[id(node)] = _HotFn(node, path, name)
+    changed = True
+    while changed:
+        changed = False
+        for entry in list(hot.values()):
+            for node in ast.walk(entry.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _cold_context(node, entry.node):
+                    # a callee invoked only from except handlers / raise
+                    # arguments / telemetry-guarded blocks is fault-path,
+                    # not tick-path: the call site's coldness is the
+                    # callee's coldness
+                    continue
+                hit = _resolve_fn_ref(node.func, node, by_scope, methods)
+                if hit is None:
+                    continue
+                fn = hit[0]
+                if id(fn) in hot:
+                    continue
+                fn_name = getattr(fn, "name", "<lambda>")
+                if fn_name in COLD_CALLS:
+                    continue
+                hot[id(fn)] = _HotFn(fn, path, entry.via)
+                changed = True
+    return hot
+
+
+def _walk_own_body(fn: ast.AST):
+    """Walk a function body without descending into nested defs/lambdas
+    (the reachability walk marks those hot separately when called)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _guard_is_cold(test: ast.AST) -> bool:
+    """Telemetry `.enabled` checks, fault-seam arms, `__debug__` and
+    `x is None` lazy-init guards mark a block cold/amortized."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) and node.attr in (
+            "enabled", "fault_seam",
+        ):
+            return True
+        if isinstance(node, ast.Name) and node.id in (
+            "__debug__", "fault_seam",
+        ):
+            return True
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            if any(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators
+            ):
+                return True
+    return False
+
+
+def _in_loop_body(node: ast.AST, fn: ast.AST) -> bool:
+    """Inside the BODY of a for/while of `fn` — the region that re-runs
+    per iteration. A for's iterable and a while's test evaluate once per
+    loop entry / once per iteration respectively, but the idiomatic
+    `for x in list(...)` snapshot is a per-pass cost, not per-iteration:
+    only body (and For.orelse never re-runs) statements count."""
+    child: ast.AST = node
+    cur = parent_of(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+        if isinstance(cur, (ast.For, ast.While)) and any(
+            s is child for s in cur.body
+        ):
+            return True
+        child = cur
+        cur = parent_of(cur)
+    return False
+
+
+def _cold_context(node: ast.AST, fn: ast.AST) -> bool:
+    cur = parent_of(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.ExceptHandler, ast.Raise, ast.Assert)):
+            return True
+        if isinstance(cur, ast.If) and _guard_is_cold(cur.test):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+        cur = parent_of(cur)
+    return False
+
+
+def _lint_hot_fn(entry: _HotFn, out: List[Finding]) -> None:
+    fn, path = entry.node, entry.path
+    via = entry.via
+
+    # ALLOC004 — signature packing
+    args = fn.args
+    if args.vararg is not None or args.kwarg is not None:
+        star = (
+            f"*{args.vararg.arg}" if args.vararg is not None
+            else f"**{args.kwarg.arg}"
+        )
+        out.append(finding(
+            "ALLOC004", path, fn,
+            f"hot function (reachable from {via}) takes {star}: packs a "
+            "fresh tuple/dict per call on the tick path — use explicit "
+            "parameters",
+        ))
+
+    for node in _walk_own_body(fn):
+        if _cold_context(node, fn):
+            continue
+        # ALLOC001 — per-iteration containers
+        if _in_loop_body(node, fn):
+            alloc = None
+            if isinstance(node, _COMPREHENSIONS):
+                alloc = type(node).__name__
+            elif isinstance(node, (ast.List, ast.Dict, ast.Set)):
+                alloc = f"{type(node).__name__.lower()} literal"
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _CONTAINER_CALLS:
+                    alloc = f"{name}()"
+                elif name == "sorted":
+                    out.append(finding(
+                        "ALLOC004", path, node,
+                        f"sorted() inside a loop of a hot function "
+                        f"(reachable from {via}) materializes a list per "
+                        "iteration — hoist or sort once per pass",
+                    ))
+            if alloc is not None:
+                out.append(finding(
+                    "ALLOC001", path, node,
+                    f"{alloc} allocated per loop iteration in a hot "
+                    f"function (reachable from {via}); hoist it to "
+                    "per-pass scratch or a pooled buffer",
+                ))
+        # ALLOC002 — per-call closures
+        if isinstance(node, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)):
+            if enclosing_function(node) is fn or _nested_in(node, fn):
+                kind = (
+                    "lambda" if isinstance(node, ast.Lambda)
+                    else f"nested def {node.name}"
+                )
+                out.append(finding(
+                    "ALLOC002", path, node,
+                    f"{kind} builds a function object per call of a hot "
+                    f"function (reachable from {via}); hoist it to module "
+                    "or method scope",
+                ))
+        elif isinstance(node, ast.Call) and call_name(node) in (
+            "functools.partial", "partial",
+        ):
+            out.append(finding(
+                "ALLOC002", path, node,
+                f"functools.partial() allocates a callable per call of a "
+                f"hot function (reachable from {via}); bind it once",
+            ))
+        # ALLOC003 — string building
+        str_kind: Optional[str] = None
+        if isinstance(node, ast.JoinedStr):
+            str_kind = "f-string"
+        elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "format":
+                str_kind = ".format()"
+            elif (
+                node.func.attr == "join"
+                and isinstance(node.func.value, ast.Constant)
+                and isinstance(node.func.value.value, str)
+            ):
+                # str joins only: b"".join is the pooled byte-staging
+                # idiom (one C-speed copy), not string building
+                str_kind = ".join()"
+        elif (
+            isinstance(node, ast.BinOp)
+            and isinstance(node.op, ast.Mod)
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.left.value, str)
+        ):
+            str_kind = "%-formatting"
+        if str_kind is not None:
+            out.append(finding(
+                "ALLOC003", path, node,
+                f"{str_kind} builds a string on the tick path (reachable "
+                f"from {via}); strings belong on error/telemetry paths "
+                "only",
+            ))
+        # ALLOC004 — call-site dict splat
+        if isinstance(node, ast.Call) and any(
+            kw.arg is None for kw in node.keywords
+        ):
+            out.append(finding(
+                "ALLOC004", path, node,
+                f"**-splat at a hot call site (reachable from {via}) "
+                "builds a dict per call — pass keywords explicitly",
+            ))
+
+
+def _nested_in(node: ast.AST, fn: ast.AST) -> bool:
+    cur = parent_of(node)
+    while cur is not None:
+        if cur is fn:
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+        cur = parent_of(cur)
+    return False
+
+
+def run(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    for path in repo.python_files():
+        tree = repo.tree(path)
+        for entry in find_hot_functions(tree, path).values():
+            _lint_hot_fn(entry, out)
+    seen: Set[Tuple[str, str, int, str]] = set()
+    deduped: List[Finding] = []
+    for f in out:
+        if (f.rule, f.path, f.symbol) in EXEMPTIONS:
+            continue
+        # one nested f-string/comprehension can surface as two AST
+        # nodes on one line — one report per (rule, line, symbol)
+        key = (f.rule, f.path, f.line, f.symbol)
+        if key in seen:
+            continue
+        seen.add(key)
+        deduped.append(f)
+    return deduped
+
+
+def exemption_for(f: Finding) -> Optional[str]:
+    """The policy-table justification a finding would have matched (test
+    and tooling hook; the run() filter above uses the same key)."""
+    return EXEMPTIONS.get((f.rule, f.path, f.symbol))

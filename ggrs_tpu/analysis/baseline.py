@@ -18,6 +18,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
+from ..errors import GGRSError
 from .findings import Finding
 
 
@@ -36,8 +37,9 @@ class BaselineEntry:
         return (self.rule, self.path, self.symbol)
 
 
-class BaselineError(ValueError):
-    pass
+class BaselineError(GGRSError, ValueError):
+    """Malformed baseline.toml (EXC001-typed; the ValueError face keeps
+    pre-discipline callers working)."""
 
 
 def _closing_quote(value: str) -> int:

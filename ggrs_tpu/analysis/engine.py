@@ -165,19 +165,31 @@ def finding(rule: str, path: str, node: ast.AST, message: str) -> Finding:
 # pass runner
 # ---------------------------------------------------------------------------
 
-PASS_NAMES = ("determinism", "trace_discipline", "fence", "wire_contract")
+PASS_NAMES = (
+    "determinism", "trace_discipline", "fence", "wire_contract",
+    "alloc", "exceptions",
+)
 
 
 def run_passes(
     repo: Repo, passes: Optional[Iterable[str]] = None
 ) -> List[Finding]:
-    from . import determinism, fence, trace_discipline, wire_contract
+    from . import (
+        alloc,
+        determinism,
+        exceptions,
+        fence,
+        trace_discipline,
+        wire_contract,
+    )
 
     table = {
         "determinism": determinism.run,
         "trace_discipline": trace_discipline.run,
         "fence": fence.run,
         "wire_contract": wire_contract.run,
+        "alloc": alloc.run,
+        "exceptions": exceptions.run,
     }
     selected = list(passes) if passes is not None else list(PASS_NAMES)
     findings: List[Finding] = []

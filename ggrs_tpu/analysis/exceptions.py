@@ -1,0 +1,235 @@
+"""Pass 6 — typed-error discipline (EXC001..EXC002), repo-wide.
+
+The error contract (errors.py): every failure the library surfaces is a
+GGRSError subclass, carrying enough context for the operator to act
+without a debugger — WHICH lane wedged at WHAT depth, WHICH segment is
+corrupt at WHAT offset. A bare ValueError deep in a parse loop breaks
+that contract twice: callers can't route it (fleet isolation catches
+GGRSError, so an untyped raise crashes the whole host tick), and the
+operator gets a message with no blast radius. PR 15's review caught
+several of these by hand; this pass is that review, every push.
+
+  EXC001  every `raise` in ggrs_tpu/ must raise a GGRSError subclass
+          (resolved by a repo-wide class-hierarchy fixpoint, so
+          `class DecodeError(GGRSError, ValueError)` in another module
+          counts), a permitted stdlib signal (NotImplementedError for
+          abstract seams, SystemExit/KeyboardInterrupt for process
+          control, StopIteration for protocols), or a re-raise: bare
+          `raise`, `raise e` of a name bound by an enclosing
+          `except ... as e`, `raise e.with_traceback(...)`, or
+          `raise err` where `err` was assigned in the same function
+          from an allowed class (the construct-record-raise idiom the
+          invariant-trip path uses).
+  EXC002  a bare `except:` / `except Exception` / `except BaseException`
+          handler must re-raise (typed or not) or record a flight event
+          (`.record(...)` / `write_forensics(...)`) — swallowing
+          arbitrary failures silently is how a quarantine path loses the
+          one stack trace that explained the outage. Narrowing the
+          except type is also a fix.
+
+Multiple inheritance is the sanctioned migration path: re-parenting a
+local hierarchy as `class FrameError(GGRSError, ValueError)` keeps every
+existing `except ValueError` caller working while giving the fleet
+router a typed handle. Genuinely-exempt sites (a seam that must mirror a
+stdlib contract) get a named entry in EXEMPTIONS — never a baseline
+entry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .engine import (
+    Repo,
+    dotted_name,
+    enclosing_function,
+    finding,
+    parent_of,
+)
+from .findings import Finding
+
+# stdlib raises that are contracts, not failures
+_ALLOWED_STDLIB = frozenset({
+    "NotImplementedError",  # abstract-seam markers
+    "SystemExit",           # process control (fleet agent main loops)
+    "KeyboardInterrupt",
+    "StopIteration",
+    "StopAsyncIteration",
+})
+
+_BROAD = frozenset({"Exception", "BaseException"})
+
+# flight-event entry points that make a swallowed broad except auditable
+_RECORD_CALLS = frozenset({"record", "write_forensics"})
+
+# named policy exemptions: (rule, path, enclosing symbol) -> why.
+EXEMPTIONS: Dict[Tuple[str, str, str], str] = {
+    ("EXC001", "ggrs_tpu/native/sockets.py",
+     "NativeUdpNonBlockingSocket.__init__"):
+        "bind failure mirrors the stdlib socket contract (the transport "
+        "factory catches OSError uniformly for the Python and native "
+        "implementations); a GGRSError face here would force every "
+        "caller to special-case which socket flavor it constructed",
+}
+
+
+def ggrs_error_classes(repo: Repo) -> Set[str]:
+    """Transitive GGRSError subclasses by name, closed over every file
+    in the repo (name-based: a cross-module base resolves by its last
+    dotted segment, the same coarseness the baseline key uses)."""
+    bases: Dict[str, Set[str]] = {}
+    for path in repo.python_files():
+        tree = repo.tree(path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                bs = bases.setdefault(node.name, set())
+                for b in node.bases:
+                    name = dotted_name(b)
+                    if name:
+                        bs.add(name.split(".")[-1])
+    ggrs: Set[str] = {"GGRSError"}
+    changed = True
+    while changed:
+        changed = False
+        for name, bs in bases.items():
+            if name not in ggrs and bs & ggrs:
+                ggrs.add(name)
+                changed = True
+    return ggrs
+
+
+def _caught_names(node: ast.AST) -> Set[str]:
+    """Names bound by enclosing `except ... as e` handlers."""
+    names: Set[str] = set()
+    cur = parent_of(node)
+    while cur is not None:
+        if isinstance(cur, ast.ExceptHandler) and cur.name:
+            names.add(cur.name)
+        cur = parent_of(cur)
+    return names
+
+
+def _lint_raise(
+    node: ast.Raise, path: str, ggrs: Set[str], out: List[Finding]
+) -> None:
+    exc = node.exc
+    if exc is None:
+        return  # bare re-raise
+    if isinstance(exc, ast.Name):
+        if exc.id in _caught_names(node):
+            return  # `raise e` of a caught exception
+        if _locally_typed_name(node, exc.id, ggrs):
+            return  # construct-record-raise: err = GGRSError(...); raise err
+    if (
+        isinstance(exc, ast.Call)
+        and isinstance(exc.func, ast.Attribute)
+        and exc.func.attr == "with_traceback"
+    ):
+        return  # `raise e.with_traceback(tb)` re-raise idiom
+    target = exc.func if isinstance(exc, ast.Call) else exc
+    name = dotted_name(target)
+    if name is not None:
+        last = name.split(".")[-1]
+        if last in ggrs or last in _ALLOWED_STDLIB:
+            return
+        out.append(finding(
+            "EXC001", path, node,
+            f"raise {last}: not a GGRSError subclass — type it "
+            "(multiple inheritance keeps existing except clauses "
+            "working) so fleet isolation can route it",
+        ))
+    else:
+        out.append(finding(
+            "EXC001", path, node,
+            "raise of a dynamic expression: the error contract needs a "
+            "statically-typed GGRSError subclass here",
+        ))
+
+
+def _locally_typed_name(node: ast.Raise, name: str, ggrs: Set[str]) -> bool:
+    """`raise err` where the enclosing function assigns
+    `err = SomeAllowedClass(...)` — the construct-record-raise idiom
+    (build the typed error, log/stash it, then raise the same object)."""
+    fn = enclosing_function(node)
+    if fn is None:
+        return False
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Assign) or not isinstance(sub.value, ast.Call):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == name for t in sub.targets
+        ):
+            continue
+        cls = dotted_name(sub.value.func)
+        if cls is not None:
+            last = cls.split(".")[-1]
+            if last in ggrs or last in _ALLOWED_STDLIB:
+                return True
+    return False
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for ty in types:
+        name = dotted_name(ty)
+        if name is not None and name.split(".")[-1] in _BROAD:
+            return True
+    return False
+
+
+def _handler_reraises_or_records(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr in _RECORD_CALLS:
+                    return True
+                if isinstance(f, ast.Name) and f.id in _RECORD_CALLS:
+                    return True
+    return False
+
+
+def _lint_handler(
+    handler: ast.ExceptHandler, path: str, out: List[Finding]
+) -> None:
+    if not _handler_is_broad(handler):
+        return
+    if _handler_reraises_or_records(handler):
+        return
+    shown = (
+        "bare except" if handler.type is None
+        else f"except {ast.unparse(handler.type)}"
+    )
+    out.append(finding(
+        "EXC002", path, handler,
+        f"{shown} swallows arbitrary failures without re-raising or "
+        "recording a flight event — narrow the type, re-raise typed, or "
+        "record provenance",
+    ))
+
+
+def run(repo: Repo) -> List[Finding]:
+    ggrs = ggrs_error_classes(repo)
+    out: List[Finding] = []
+    for path in repo.python_files():
+        tree = repo.tree(path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Raise):
+                _lint_raise(node, path, ggrs, out)
+            elif isinstance(node, ast.ExceptHandler):
+                _lint_handler(node, path, out)
+    return [
+        f for f in out
+        if (f.rule, f.path, f.symbol) not in EXEMPTIONS
+    ]
+
+
+def exemption_for(f: Finding) -> Optional[str]:
+    """The policy-table justification a finding would have matched."""
+    return EXEMPTIONS.get((f.rule, f.path, f.symbol))

@@ -40,6 +40,28 @@ RULES: Dict[str, str] = {
     "FEN001": "device-core shared state mutated outside the "
               "fence/dispatch entry points (staging pools, plan cache and "
               "the inflight carry are only coherent under the fence)",
+    # hot-path allocation (functions reachable from the tick/pump spine)
+    "ALLOC001": "per-iteration container allocation in a hot function "
+                "(list/dict/set/np constructors in a loop body churn the "
+                "allocator every tick; pool or hoist per-pass scratch)",
+    "ALLOC002": "per-call closure on the tick path "
+                "(lambda/nested def/functools.partial allocates a "
+                "function object per call; hoist it)",
+    "ALLOC003": "string building on the tick path "
+                "(f-string/.format/.join belong on error and telemetry "
+                "paths only)",
+    "ALLOC004": "argument repacking in a hot function "
+                "(*args/**kwargs signatures, **-splat call sites and "
+                "per-iteration sorted() allocate per call)",
+    # typed-error discipline (repo-wide raise/except contract)
+    "EXC001": "raise of a non-GGRSError "
+              "(untyped errors escape fleet isolation and carry no "
+              "blast-radius context; subclass GGRSError, multiple "
+              "inheritance keeps old except clauses working)",
+    "EXC002": "broad except that neither re-raises nor records "
+              "(a swallowed Exception loses the one stack trace that "
+              "explained the outage; narrow it, re-raise, or record a "
+              "flight event)",
     # wire contract (Python <-> native format/constant drift)
     "WIRE001": "message type code drift between network/messages.py and "
                "native/endpoint.cpp",

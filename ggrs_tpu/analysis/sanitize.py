@@ -1,5 +1,38 @@
-"""Runtime retrace sanitizer: `GGRS_SANITIZE=1` turns "unexpected
-recompile" from a perf mystery into a pointed report.
+"""Runtime sanitizers: `GGRS_SANITIZE=1` turns "unexpected recompile",
+"the tick path started allocating" and "something synced the device
+mid-dispatch" from perf mysteries into pointed reports.
+
+Three companions live here, sharing one lifecycle idea — *freeze* at
+the end of warmup, then treat any violation of the steady-state
+contract as a recorded (or raised) event with provenance:
+
+  RetraceSanitizer      wraps jax.jit; post-freeze compiles are flagged
+                        with call-site stacks (details below).
+  AllocationSanitizer   `freeze_allocations()` budgets net allocator
+                        growth per host tick (sys.getallocatedblocks
+                        delta); a tick that exceeds the budget records a
+                        flight event with a tracemalloc top-5 diff and
+                        bumps `ggrs_alloc_budget_trips_total`; every
+                        tick feeds the `ggrs_alloc_per_tick` histogram.
+                        Trips record, never raise — the host keeps
+                        serving while the operator gets the leak's
+                        provenance.
+  transfer_guard_scope  wraps the post-warmup dispatch/drive regions;
+                        while the retrace sanitizer is installed AND
+                        frozen, an implicit device->host read
+                        (`ArrayImpl._value` / `.item`, i.e. float(),
+                        bool(), np.asarray-via-__array__ on a device
+                        buffer) raises typed ImplicitHostTransfer with
+                        the call site, and jax's own
+                        transfer_guard_device_to_host("disallow") is
+                        entered for device backends where the XLA layer
+                        sees transfers Python can't. Known gap:
+                        `np.asarray` on a fully-replicated CPU array
+                        can take the buffer-protocol fast path without
+                        touching `_value`; on real device backends the
+                        jax guard covers it.
+
+Retrace sanitizer detail:
 
 The static pass (TRC004) catches per-call jit caches it can see; this is
 the dynamic complement. When installed, `jax.jit` is wrapped so every
@@ -29,12 +62,14 @@ scenario without leaking the patch.
 from __future__ import annotations
 
 import os
+import sys
+import tracemalloc
 import traceback
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from ..errors import RetraceBudgetExceeded
+from ..errors import ImplicitHostTransfer, RetraceBudgetExceeded
 
 
 @dataclass
@@ -313,3 +348,323 @@ def maybe_install_from_env() -> Optional[RetraceSanitizer]:
     if os.environ.get("GGRS_SANITIZE") == "1":
         return install_sanitizer()
     return None
+
+
+# ----------------------------------------------------------------------
+# allocation sanitizer — the dynamic complement to the ALLOC pass
+# ----------------------------------------------------------------------
+
+# steady-state headroom in allocator blocks per tick: the tick path's
+# contract is zero *retained* allocation, but transient churn (event
+# dicts handed to the caller, device-array wrappers replacing last
+# tick's) nets out with jitter, and tracemalloc itself books traces.
+# A leak regresses by thousands of blocks per tick, so the default sits
+# an order of magnitude above observed steady-state noise while staying
+# an order below any real regression.
+DEFAULT_ALLOC_BUDGET_BLOCKS = 512
+
+
+@dataclass
+class AllocTripEvent:
+    tick: int        # sanitizer-local tick index (since freeze)
+    blocks: int      # net allocator-block growth this tick
+    budget: int
+    label: str
+    top: List[str] = field(default_factory=list)  # "file:line +sizeKiB (+N blocks)"
+
+    def provenance(self) -> str:
+        return self.top[0] if self.top else "<no tracemalloc diff>"
+
+    def render(self) -> str:
+        lines = [
+            f"[tick {self.tick}] ALLOC BUDGET TRIP: +{self.blocks} blocks "
+            f"(budget {self.budget}, frozen as '{self.label}')"
+        ]
+        lines.extend(f"    {t}" for t in self.top)
+        return "\n".join(lines)
+
+
+class AllocationSanitizer:
+    """Per-tick allocation budget for the post-warmup steady state.
+
+    `freeze(label)` snapshots `sys.getallocatedblocks()` and starts
+    tracemalloc; each `note_tick()` (SessionHost.tick calls it once per
+    cycle) books the net block delta into `ggrs_alloc_per_tick` and,
+    when the delta exceeds the budget, records a flight event carrying
+    the tracemalloc top-5 growth sites since the last clean point, bumps
+    `ggrs_alloc_budget_trips_total`, and REBASES — one leaking callsite
+    produces a trip per leaking tick, each pointing at the fresh growth,
+    not one giant diff that smears provenance across the run."""
+
+    def __init__(self, budget_blocks: Optional[int] = None):
+        env = os.environ.get("GGRS_ALLOC_BUDGET")
+        self.budget = (
+            budget_blocks if budget_blocks is not None
+            else int(env) if env else DEFAULT_ALLOC_BUDGET_BLOCKS
+        )
+        self.trips: List[AllocTripEvent] = []
+        self.ticks_seen = 0
+        self.freeze_label: Optional[str] = None
+        self._frozen = False
+        self._last_blocks = 0
+        self._base_snapshot = None
+        self._started_tracemalloc = False
+        self._m_per_tick = None
+        self._m_trips = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def freeze(self, label: str = "steady-state") -> "AllocationSanitizer":
+        from ..obs import GLOBAL_TELEMETRY, LOG2_BUCKETS
+
+        reg = GLOBAL_TELEMETRY.registry
+        self._m_per_tick = reg.histogram(
+            "ggrs_alloc_per_tick",
+            "net allocator-block growth per host tick post-freeze "
+            "(negative deltas clip to 0)",
+            buckets=LOG2_BUCKETS,
+        )
+        self._m_trips = reg.counter(
+            "ggrs_alloc_budget_trips_total",
+            "host ticks whose net allocation exceeded the frozen budget",
+        )
+        self.freeze_label = label
+        # a freeze opens a new steady-state epoch: stats from an earlier
+        # freeze (a previous backend's serve, a previous test) are that
+        # epoch's story, not this one's
+        self.trips.clear()
+        self.ticks_seen = 0
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+            self._started_tracemalloc = True
+        self._base_snapshot = tracemalloc.take_snapshot()
+        self._last_blocks = sys.getallocatedblocks()
+        self._frozen = True
+        return self
+
+    def thaw(self) -> None:
+        self._frozen = False
+        self.freeze_label = None
+        self._base_snapshot = None
+        if self._started_tracemalloc and tracemalloc.is_tracing():
+            tracemalloc.stop()
+        self._started_tracemalloc = False
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen
+
+    # -- the per-tick probe (host hot path: no allocation off-trip) -----
+
+    def note_tick(self) -> None:
+        if not self._frozen:
+            return
+        now = sys.getallocatedblocks()
+        delta = now - self._last_blocks
+        self._last_blocks = now
+        if delta < 0:
+            delta = 0
+        self.ticks_seen += 1
+        self._m_per_tick.observe(delta)
+        if delta > self.budget:
+            self._trip_alloc_budget(delta)
+
+    def _trip_alloc_budget(self, delta: int) -> None:
+        """Cold arm: tracemalloc diff, flight event, rebase."""
+        from ..obs import GLOBAL_TELEMETRY
+
+        top: List[str] = []
+        if self._base_snapshot is not None:
+            snap = tracemalloc.take_snapshot()
+            stats = snap.compare_to(self._base_snapshot, "lineno")
+            for st in stats:
+                if st.size_diff <= 0:
+                    continue
+                tb = st.traceback[0]
+                fn = tb.filename
+                if fn.endswith("sanitize.py") or "tracemalloc" in fn:
+                    continue  # the probe's own bookkeeping is not the leak
+                top.append(
+                    f"{fn}:{tb.lineno} +{st.size_diff / 1024:.1f}KiB "
+                    f"(+{st.count_diff} blocks)"
+                )
+                if len(top) >= 5:
+                    break
+            self._base_snapshot = snap  # rebase: next trip diffs fresh growth
+        ev = AllocTripEvent(
+            tick=self.ticks_seen, blocks=delta, budget=self.budget,
+            label=self.freeze_label or "", top=top,
+        )
+        self.trips.append(ev)
+        self._m_trips.inc()
+        tel = GLOBAL_TELEMETRY
+        if tel.enabled:
+            tel.record(
+                "alloc_budget_trip", tick=self.ticks_seen, blocks=delta,
+                budget=self.budget, provenance=ev.provenance(),
+            )
+        # the block count moved while we took the snapshot; re-anchor so
+        # the NEXT tick is charged for its own growth only
+        self._last_blocks = sys.getallocatedblocks()
+
+    def report(self) -> str:
+        lines = [
+            f"allocation sanitizer: {self.ticks_seen} ticks observed, "
+            f"{len(self.trips)} budget trip(s) "
+            f"(budget {self.budget} blocks/tick)"
+        ]
+        lines.extend(ev.render() for ev in self.trips)
+        return "\n".join(lines)
+
+
+_ALLOC_SANITIZER: Optional[AllocationSanitizer] = None
+
+
+def freeze_allocations(
+    budget_blocks: Optional[int] = None, label: str = "steady-state"
+) -> AllocationSanitizer:
+    """Declare warmup complete for the ALLOCATOR: from here, every host
+    tick is budgeted. Call after the backend's warmup_scope closes (the
+    first ticks through a cold core legitimately allocate programs,
+    pools and rings). Idempotent: re-freezing re-anchors the baseline."""
+    global _ALLOC_SANITIZER
+    if _ALLOC_SANITIZER is None:
+        _ALLOC_SANITIZER = AllocationSanitizer(budget_blocks)
+    elif budget_blocks is not None:
+        _ALLOC_SANITIZER.budget = budget_blocks
+    _ALLOC_SANITIZER.freeze(label)
+    return _ALLOC_SANITIZER
+
+
+def thaw_allocations() -> None:
+    if _ALLOC_SANITIZER is not None:
+        _ALLOC_SANITIZER.thaw()
+
+
+def active_alloc_sanitizer() -> Optional[AllocationSanitizer]:
+    """The frozen allocation sanitizer, or None (the zero-cost case —
+    the host tick's probe is one None check)."""
+    s = _ALLOC_SANITIZER
+    return s if s is not None and s.frozen else None
+
+
+# ----------------------------------------------------------------------
+# transfer guard — implicit device->host syncs become typed errors
+# ----------------------------------------------------------------------
+
+# module state rather than a class: the patch target (ArrayImpl) is
+# process-global, so the guard is too. depth counts nested scopes; the
+# class methods are swapped in when the first scope opens and restored
+# when the last closes, so an unsanitized process never pays for it.
+_TRANSFER_DEPTH = 0
+_TRANSFER_ORIG_VALUE = None
+_TRANSFER_ORIG_ITEM = None
+_TRANSFER_CLS = None
+_M_TRANSFER_TRIPS = None
+
+
+def _transfer_trip(api: str, context: str) -> None:
+    from ..obs import GLOBAL_TELEMETRY
+
+    frames = _call_stack()
+    prov = frames[-1] if frames else "<unknown>"
+    tel = GLOBAL_TELEMETRY
+    if tel.enabled:
+        if _M_TRANSFER_TRIPS is not None:
+            _M_TRANSFER_TRIPS.inc()
+        tel.record(
+            "implicit_host_transfer", api=api, context=context,
+            provenance=prov,
+        )
+    raise ImplicitHostTransfer(
+        f"implicit device->host transfer via {api} inside the "
+        f"post-warmup '{context}' region at {prov} — a host read here "
+        "serializes the dispatch pipeline; stage through the pooled "
+        "host buffers (mailbox/drain pass) or move the read off the "
+        "tick path"
+    )
+
+
+def _patch_transfer_guard(context: str) -> None:
+    global _TRANSFER_ORIG_VALUE, _TRANSFER_ORIG_ITEM, _TRANSFER_CLS
+    from jax._src import array as jax_array
+
+    cls = jax_array.ArrayImpl
+    orig_value = cls.__dict__.get("_value")
+    orig_item = cls.__dict__.get("item")
+    _TRANSFER_CLS = cls
+    _TRANSFER_ORIG_VALUE = orig_value
+    _TRANSFER_ORIG_ITEM = orig_item
+    fget = orig_value.fget if isinstance(orig_value, property) else orig_value
+
+    def _guarded_value(self):
+        if _TRANSFER_DEPTH > 0:
+            _transfer_trip("ArrayImpl._value", context)
+        return fget(self)
+
+    def _guarded_item(self, *args):
+        if _TRANSFER_DEPTH > 0:
+            _transfer_trip("ArrayImpl.item", context)
+        return orig_item(self, *args)
+
+    cls._value = property(_guarded_value)
+    if orig_item is not None:
+        cls.item = _guarded_item
+
+
+def _unpatch_transfer_guard() -> None:
+    global _TRANSFER_ORIG_VALUE, _TRANSFER_ORIG_ITEM, _TRANSFER_CLS
+    cls = _TRANSFER_CLS
+    if cls is None:
+        return
+    if _TRANSFER_ORIG_VALUE is not None:
+        cls._value = _TRANSFER_ORIG_VALUE
+    if _TRANSFER_ORIG_ITEM is not None:
+        cls.item = _TRANSFER_ORIG_ITEM
+    _TRANSFER_CLS = None
+    _TRANSFER_ORIG_VALUE = None
+    _TRANSFER_ORIG_ITEM = None
+
+
+@contextmanager
+def transfer_guard_scope(context: str = "dispatch"):
+    """Guard a dispatch/drive region against implicit device->host
+    syncs. Active ONLY when the retrace sanitizer is installed
+    (GGRS_SANITIZE=1) AND frozen — during warmup, jax itself reads
+    buffers while compiling, and an unsanitized process takes the
+    no-patch fast path (one global read, no allocation).
+
+    Two layers: the ArrayImpl patch catches Python-visible reads
+    (float()/bool()/.item()/__array__ -> _value) on EVERY backend
+    including CPU, where jax's own guard exempts same-device transfers;
+    jax's transfer_guard_device_to_host("disallow") additionally covers
+    XLA-level implicit transfers on real device backends. Explicit
+    jax.device_get stays legal under the jax guard — the drain pass's
+    pooled readback is the sanctioned path (it runs outside this
+    scope)."""
+    global _TRANSFER_DEPTH
+    san = active_sanitizer()
+    if san is None or san.frozen_at is None:
+        yield
+        return
+    import jax
+
+    global _M_TRANSFER_TRIPS
+    if _M_TRANSFER_TRIPS is None:
+        from ..obs import GLOBAL_TELEMETRY
+
+        _M_TRANSFER_TRIPS = GLOBAL_TELEMETRY.registry.counter(
+            "ggrs_transfer_guard_trips_total",
+            "implicit device->host transfers caught inside guarded "
+            "post-warmup dispatch/drive regions",
+        )
+    _TRANSFER_DEPTH += 1
+    if _TRANSFER_DEPTH == 1:
+        _patch_transfer_guard(context)
+    try:
+        with jax.transfer_guard_device_to_host("disallow"):
+            yield
+    finally:
+        _TRANSFER_DEPTH -= 1
+        if _TRANSFER_DEPTH == 0:
+            _unpatch_transfer_guard()

@@ -117,7 +117,8 @@ def _safe_int(expr: str) -> Optional[int]:
     if re.fullmatch(r"[\d\s+*()x-]+", expr) and not expr.strip().startswith("-"):
         try:
             return int(eval(expr, {"__builtins__": {}}))  # noqa: S307
-        except Exception:
+        except (SyntaxError, ValueError, TypeError, ArithmeticError,
+                RecursionError, MemoryError):
             return None
     try:
         return int(expr, 0)

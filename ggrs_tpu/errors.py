@@ -355,3 +355,64 @@ class RetraceBudgetExceeded(GGRSError):
     dispatch signature escaped canonicalization (every compile carries
     stack provenance in the message). Raised only with GGRS_SANITIZE=1 /
     an installed sanitizer — production paths never pay the check."""
+
+
+class ImplicitHostTransfer(GGRSError):
+    """The transfer sanitizer caught an implicit device->host
+    materialization (float()/bool()/.item()/np conversion of a device
+    array) inside a post-warmup resident drive or dispatch region. Each
+    such sync serializes the host against the device pipeline — the
+    exact stall class the resident loop exists to avoid — and would be
+    invisible in tests that only check outputs. Raised only with
+    GGRS_SANITIZE=1 / an installed sanitizer inside transfer_guard_scope
+    after freeze; production paths never pay the check."""
+
+
+# ---------------------------------------------------------------------------
+# stdlib bridge errors (EXC001 discipline)
+#
+# Every raise in the repo must be typed — a GGRSError — so fleet
+# isolation can attribute blast radius and flight recorders capture
+# context. But plenty of sites have a decade of callers (and stdlib
+# conventions) expecting ValueError / TypeError / AssertionError /
+# KeyError / TimeoutError. The bridges below dual-inherit: `except
+# ValueError` keeps catching exactly what it caught before, while
+# `except GGRSError` now sees the whole typed surface. New code should
+# prefer the specific hierarchy above; bridges are for contracts whose
+# stdlib face is load-bearing.
+# ---------------------------------------------------------------------------
+
+
+class ConfigError(GGRSError, ValueError):
+    """Invalid configuration or argument value at a construction/setup
+    seam (bad window size, malformed key, out-of-range knob). The
+    ValueError face keeps pre-discipline callers and tests working."""
+
+
+class DataFormatError(GGRSError, ValueError):
+    """Malformed bytes or arrays at a decode/parse seam (truncated
+    varint, bad RLE run, shape mismatch in a recorded script). Sites
+    that already have a richer typed error (DecodeError, JournalCorrupt)
+    should raise that instead."""
+
+
+class TypeContractError(GGRSError, TypeError):
+    """A value of the wrong kind crossed an API seam (unknown message
+    class, non-Request in a request list). TypeError face preserved."""
+
+
+class ContractViolation(GGRSError, AssertionError):
+    """An internal invariant a caller cannot trigger through the public
+    API failed — the typed replacement for bare `raise AssertionError`
+    (AssertionError face preserved for callers treating it as such)."""
+
+
+class RegistryMiss(GGRSError, KeyError):
+    """A name was looked up in a registry (kernel adapters, metric
+    families) that has no such entry. KeyError face preserved."""
+
+
+class DeadlineExceeded(GGRSError, TimeoutError):
+    """A wait on an external process/resource ran out of time (chaos
+    harness child processes, drain deadlines). TimeoutError face
+    preserved."""

@@ -326,10 +326,17 @@ class AgentCore:
                     # verifies against it
                     self._attach_island_journal(restored[0])
                     outcome = "rebuilt"
-            except Exception:  # noqa: BLE001 - a failed rebuild must
-                # degrade to "match lost", never take the agent (and
-                # its innocent matches) down with it
+            except Exception as exc:  # noqa: BLE001 - a failed rebuild
+                # must degrade to "match lost", never take the agent
+                # (and its innocent matches) down with it — but the
+                # rebuild's stack IS the outage explanation, so it goes
+                # to the flight recorder before we move on
                 outcome = "lost"
+                if GLOBAL_TELEMETRY.enabled:
+                    GLOBAL_TELEMETRY.record(
+                        "fleet_rebuild_failed", match=mid,
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
         self.quarantines[mid] = outcome
         if GLOBAL_TELEMETRY.enabled:
             GLOBAL_TELEMETRY.record(
@@ -485,6 +492,11 @@ class AgentCore:
             # rebind's EADDRINUSE data-plane fence) must become a typed
             # error REPLY, never a dead agent taking innocent matches
             # with it
+            if GLOBAL_TELEMETRY.enabled:
+                GLOBAL_TELEMETRY.record(
+                    "fleet_op_failed", op=op,
+                    error=f"{type(exc).__name__}: {exc}",
+                )
             self.peer.reply(self.epoch, rid, {
                 "kind": type(exc).__name__, "error": str(exc),
             }, ok=False, now_ms=now)

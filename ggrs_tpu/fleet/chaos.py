@@ -41,7 +41,7 @@ import tempfile
 import time as _time
 from typing import Any, Dict, List, Optional
 
-from ..errors import CircuitOpen, RpcTimeout
+from ..errors import CircuitOpen, DeadlineExceeded, RpcTimeout
 from ..serve.chaos import ChaosEvent
 from .director import Director
 from .island import MatchSpec, run_twin
@@ -251,7 +251,7 @@ def run_process_chaos(
             director.step()
             _time.sleep(0.005)
             if _time.monotonic() > deadline:
-                raise TimeoutError(
+                raise DeadlineExceeded(
                     f"only {len(director.hosts)}/{agents} agents "
                     f"registered (logs in {base_dir})"
                 )
@@ -511,7 +511,7 @@ def run_process_chaos(
                 break
             _time.sleep(0.004)
         else:
-            raise TimeoutError(
+            raise DeadlineExceeded(
                 f"chaos drive did not finish (progress "
                 f"{placed_progress()}/{ticks}, logs in {base_dir})"
             )
@@ -604,8 +604,8 @@ def run_process_chaos(
         for p in procs:
             try:
                 p.wait(timeout=10)
-            except Exception:
-                pass
+            except (subprocess.TimeoutExpired, OSError):
+                pass  # best-effort reap; the kill above already landed
         if own_dir and completed:
             # a harness-owned temp tree (fleet tickets carry whole
             # device residues) must not pile up across soak runs; a

@@ -35,7 +35,7 @@ import random
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..errors import InvalidRequest
+from ..errors import ContractViolation, InvalidRequest
 from ..network.sockets import InMemoryNetwork, UdpNonBlockingSocket
 from ..sessions.builder import SessionBuilder
 from ..types import DesyncDetection, PlayerType, SessionState
@@ -436,7 +436,7 @@ def run_twin(specs: List[MatchSpec], *, host=None, max_steps: int = 20_000,
         step_islands(host, todo)
         host.clock.advance(FRAME_MS)
     else:
-        raise AssertionError("twin islands failed to finish")
+        raise ContractViolation("twin islands failed to finish")
     for island in islands.values():
         island._twin_host = host  # digest access for the comparator
     return islands

@@ -25,7 +25,7 @@ import random
 import time as _time
 from typing import Any, Callable, Dict, Optional
 
-from ..errors import CircuitOpen, Fenced, RpcTimeout
+from ..errors import CircuitOpen, Fenced, FleetError, RpcTimeout
 from ..obs import GLOBAL_TELEMETRY
 from ..utils.clock import Clock
 from .metrics import rpc_retries_total
@@ -85,7 +85,7 @@ class CircuitBreaker:
             self.open_until_ms = now_ms + self.cooldown_ms
 
 
-class RpcError(Exception):
+class RpcError(FleetError):
     """A structured error REPLY from the peer: `kind` names the remote
     exception type (HostFull, InvalidRequest, ...) so callers route on
     it without string-matching messages."""

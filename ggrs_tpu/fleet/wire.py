@@ -34,6 +34,8 @@ import struct
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..errors import GGRSError
+
 FLEET_MAGIC = 0x47F1
 FLEET_WIRE_VERSION = 1
 
@@ -50,7 +52,7 @@ MAX_JSON_LEN = 1 << 20
 MAX_BLOB_LEN = 1 << 30
 
 
-class FrameError(ValueError):
+class FrameError(GGRSError, ValueError):
     """The byte stream is not speaking this protocol (bad magic/version/
     length): the connection is poisoned and must be dropped — unlike the
     datagram plane, a stream cannot resynchronize past garbage."""

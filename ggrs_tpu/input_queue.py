@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import List, Tuple
 
+from .errors import ContractViolation
 from .frame_info import PlayerInput
 from .obs import GLOBAL_TELEMETRY
 from .types import NULL_FRAME, Frame, InputStatus
@@ -61,7 +62,7 @@ class InputQueue:
         offset = requested_frame % INPUT_QUEUE_LENGTH
         if self.inputs[offset].frame == requested_frame:
             return self.inputs[offset]
-        raise AssertionError(
+        raise ContractViolation(
             f"no confirmed input for requested frame {requested_frame}"
         )
 

@@ -58,7 +58,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..errors import InvalidRequest, JournalCorrupt, JournalStalled
+from ..errors import (
+    DataFormatError,
+    InvalidRequest,
+    JournalCorrupt,
+    JournalStalled,
+)
 from .metrics import (
     journal_bytes_total,
     journal_corrupt_segments_total,
@@ -127,7 +132,7 @@ def decode_rows(payload: bytes) -> Tuple[int, np.ndarray, np.ndarray]:
     n_inp = count * players * input_size
     n_st = count * players * 4
     if len(payload) != off + n_inp + n_st:
-        raise ValueError(
+        raise DataFormatError(
             f"ROWS payload length {len(payload)} != header-implied "
             f"{off + n_inp + n_st}"
         )
@@ -413,13 +418,12 @@ class JournalWriter:
         os.makedirs(path, exist_ok=True)
         scan = scan_journal(path, repair=True)
         if scan.gap:
-            raise (
-                scan.corrupt[0]
-                if scan.corrupt
-                else JournalCorrupt(
-                    "journal frame continuity broken", path=path
-                )
-            )
+            # chain the quarantined segment's typed error (if any) so
+            # the operator sees WHICH segment broke continuity
+            cause = scan.corrupt[0] if scan.corrupt else None
+            raise JournalCorrupt(
+                "journal frame continuity broken", path=path
+            ) from cause
         names = _list_segments(path)
         self.next_frame = scan.next_frame
         self.base_frame = scan.base_frame
@@ -559,9 +563,12 @@ class JournalWriter:
             return 0
         start = start_frame + skip
         record = encode_rows(start, inputs[skip:], statuses[skip:])
+        if self._fd is None:
+            raise JournalStalled(
+                "journal append refused: writer is closed",
+                path=self.path, errno=0,
+            )
         try:
-            if self._fd is None:
-                raise OSError(0, "journal writer is closed")
             self._fd.write(record)
             self._fd.flush()
             self._since_fsync += 1

@@ -30,6 +30,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..errors import ConfigError, DataFormatError
 from ..obs import GLOBAL_TELEMETRY
 from .dataset import JournalDataset, extract_examples
 from .metrics import model_examples_total, model_train_passes_total
@@ -165,13 +166,13 @@ def update_tables(prior: Optional[ModelTables], batches: Iterable[dict],
     batches = list(batches)
     for ex in batches:
         if ex["valid"].shape[0] > num_players:
-            raise ValueError(
+            raise DataFormatError(
                 f"example batch has {ex['valid'].shape[0]} players, "
                 f"the model only {num_players}"
             )
     if prior is not None:
         if prior.buckets != buckets or prior.input_size != input_size:
-            raise ValueError(
+            raise DataFormatError(
                 f"prior tables ({prior.buckets} buckets, input "
                 f"{prior.input_size}) disagree with the update "
                 f"({buckets}, {input_size})"
@@ -266,7 +267,7 @@ def train_from_journal(roots, *, seed: int = 0,
     if input_size is None:
         input_size = meta.get("input_size")
     if not num_players or not input_size:
-        raise ValueError(
+        raise ConfigError(
             "journal inventory carries no identity META — pass "
             "num_players/input_size explicitly"
         )

@@ -14,6 +14,8 @@ import ctypes
 import os
 from typing import List, Optional
 
+from ..errors import DataFormatError
+
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "libggrs_native.so")
 _ABI_VERSION = 5
 # native/input_queue.cpp MAX_INPUT_SIZE — builder validates against this
@@ -106,7 +108,7 @@ def rle_decode(
         out = ctypes.create_string_buffer(cap)
         n = lib.ggrs_rle_decode(data, len(data), out, cap)
     if n < 0:
-        raise ValueError(f"malformed RLE stream (code {n})")
+        raise DataFormatError(f"malformed RLE stream (code {n})")
     return out.raw[:n]
 
 
@@ -127,7 +129,9 @@ def delta_decode(reference: bytes, data: bytes) -> List[bytes]:
     assert lib is not None
     m = len(reference)
     if m == 0 or len(data) % m != 0:
-        raise ValueError("delta payload not a multiple of the reference size")
+        raise DataFormatError(
+            "delta payload not a multiple of the reference size"
+        )
     k = len(data) // m
     out = ctypes.create_string_buffer(max(1, len(data)))
     lib.ggrs_delta_encode(reference, m, data, k, out)  # XOR is an involution

@@ -6,6 +6,7 @@ from __future__ import annotations
 import ctypes
 from typing import Tuple
 
+from ..errors import GGRSError
 from ..frame_info import PlayerInput
 from ..types import NULL_FRAME, Frame, InputStatus
 from . import load
@@ -19,7 +20,7 @@ _ERRORS = {
 }
 
 
-class NativeQueueError(AssertionError):
+class NativeQueueError(GGRSError, AssertionError):
     """Mapped from native error codes; AssertionError so callers treating the
     Python twin's asserts as the contract behave identically."""
 

@@ -30,6 +30,7 @@ from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 from ..errors import (
+    ContractViolation,
     InvalidRequest,
     MismatchedChecksum,
     NotSynchronized,
@@ -260,8 +261,8 @@ class _NativeSessionBase:
             try:
                 value = getattr(self, attr, None)
                 section[attr] = int(value() if callable(value) else value)
-            except Exception:
-                pass
+            except (TypeError, ValueError):
+                pass  # attr absent/None on this session flavor
         snap["session"] = section
         return snap
 
@@ -327,7 +328,7 @@ class _NativeSessionBase:
             raise InvalidRequest("Local Player cannot be disconnected.")
         if rc == _SERR_ALREADY_DISCONNECTED:
             raise InvalidRequest("Player already disconnected.")
-        raise AssertionError(f"native session internal error (code {rc})")
+        raise ContractViolation(f"native session internal error (code {rc})")
 
     def _convert_requests(self, n: int) -> List[Request]:
         isz = self.input_size

@@ -59,6 +59,7 @@ import hmac
 import os
 from typing import Any, List, Tuple
 
+from ..errors import ConfigError, TypeContractError
 from .messages import Message, decode_all, encode_message
 
 TAG_LEN = 8
@@ -172,12 +173,14 @@ class AuthenticatedSocket:
         sender_id: bytes | None = None,
     ):
         if len(key) != KEY_LEN:
-            raise ValueError(f"key must be {KEY_LEN} bytes, got {len(key)}")
+            raise ConfigError(f"key must be {KEY_LEN} bytes, got {len(key)}")
         # tags cover exact wire bytes, so the inner transport must expose
         # them (a message-level-only socket re-decodes before we could
         # verify); both shipped transports do
         if not hasattr(inner, "receive_all_wire") or not hasattr(inner, "send_wire"):
-            raise TypeError("AuthenticatedSocket requires a wire-capable socket")
+            raise TypeContractError(
+                "AuthenticatedSocket requires a wire-capable socket"
+            )
         self.inner = inner
         self.key = bytes(key)
         self.dropped = 0  # observability: tag-verification failures
@@ -188,7 +191,7 @@ class AuthenticatedSocket:
         if sender_id is None:
             sender_id = os.urandom(SENDER_ID_LEN)
         elif len(sender_id) != SENDER_ID_LEN:
-            raise ValueError(f"sender_id must be {SENDER_ID_LEN} bytes")
+            raise ConfigError(f"sender_id must be {SENDER_ID_LEN} bytes")
         self.sender_id = bytes(sender_id)
         # domain separation, equal-length in both modes: without it a mode
         # mismatch would still MAC-verify and mis-frame trailing bytes, and

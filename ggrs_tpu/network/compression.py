@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Iterable, List
 
+from ..errors import DataFormatError
+
 
 def _write_varint(out: bytearray, v: int) -> None:
     while True:
@@ -32,7 +34,7 @@ def _read_varint(buf: bytes, off: int) -> tuple[int, int]:
     v = 0
     while True:
         if off >= len(buf):
-            raise ValueError("truncated varint")
+            raise DataFormatError("truncated varint")
         b = buf[off]
         off += 1
         v |= (b & 0x7F) << shift
@@ -40,7 +42,7 @@ def _read_varint(buf: bytes, off: int) -> tuple[int, int]:
             return v, off
         shift += 7
         if shift > 35:
-            raise ValueError("varint too long")
+            raise DataFormatError("varint too long")
 
 
 TOKEN_LITERAL = 0
@@ -101,10 +103,10 @@ def rle_decode(data: bytes, max_output: int = MAX_DECODE_OUTPUT) -> bytes:
         kind = v & 3
         length = v >> 2
         if len(out) + length > max_output:
-            raise ValueError("decoded output exceeds limit")
+            raise DataFormatError("decoded output exceeds limit")
         if kind == TOKEN_LITERAL:
             if off + length > len(data):
-                raise ValueError("truncated literal run")
+                raise DataFormatError("truncated literal run")
             out += data[off : off + length]
             off += length
         elif kind == TOKEN_ZEROS:
@@ -112,7 +114,7 @@ def rle_decode(data: bytes, max_output: int = MAX_DECODE_OUTPUT) -> bytes:
         elif kind == TOKEN_ONES:
             out += b"\xff" * length
         else:
-            raise ValueError("invalid RLE token")
+            raise DataFormatError("invalid RLE token")
     return bytes(out)
 
 
@@ -129,7 +131,9 @@ def delta_encode(reference: bytes, pending: Iterable[bytes]) -> bytes:
 def delta_decode(reference: bytes, data: bytes) -> List[bytes]:
     """(src/network/compression.rs:49-57)"""
     if len(reference) == 0 or len(data) % len(reference) != 0:
-        raise ValueError("delta payload not a multiple of the reference size")
+        raise DataFormatError(
+            "delta payload not a multiple of the reference size"
+        )
     out = []
     for i in range(0, len(data), len(reference)):
         chunk = data[i : i + len(reference)]

@@ -16,6 +16,7 @@ import struct
 from dataclasses import dataclass, field
 from typing import List, Tuple, Union
 
+from ..errors import GGRSError, TypeContractError
 from ..sync_layer import ConnectionStatus
 from ..types import NULL_FRAME, Frame
 
@@ -184,11 +185,12 @@ def _encode_message_uncached(msg: Message) -> bytes:
         )
     if isinstance(body, KeepAlive):
         return _HEADER.pack(msg.magic, MSG_KEEP_ALIVE)
-    raise TypeError(f"unknown message body {body!r}")
+    raise TypeContractError(f"unknown message body {body!r}")
 
 
-class DecodeError(ValueError):
-    pass
+class DecodeError(GGRSError, ValueError):
+    """Undecodable wire bytes (EXC001-typed; ValueError face keeps the
+    drop-the-datagram callers working)."""
 
 
 def decode_message(buf: bytes) -> Message:

@@ -36,7 +36,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..errors import GGRSError
+from ..errors import DataFormatError, GGRSError
 from ..obs import GLOBAL_TELEMETRY, LOG2_BUCKETS, LOG2_BUCKETS_MS
 from .endpoint_batch import SMALL_FLEET, EndpointFleet
 from .messages import (
@@ -329,7 +329,7 @@ def record_to_message(rec: tuple, wire: bytes):
     elif kind == MSG_KEEP_ALIVE:
         body = KeepAlive()
     else:
-        raise ValueError(f"unknown record kind {kind}")
+        raise DataFormatError(f"unknown record kind {kind}")
     return Message(magic, body, _wire=bytes(wire))
 
 

@@ -19,6 +19,8 @@ from __future__ import annotations
 from bisect import bisect_left
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from ..errors import ConfigError
+
 # default fixed log-scale buckets (upper bounds, `le` semantics);
 # +Inf is implicit as the overflow bucket
 LOG2_BUCKETS: Tuple[float, ...] = tuple(float(2**k) for k in range(0, 11))
@@ -196,7 +198,7 @@ class _Instrument:
     def labels(self, *labelvalues) -> object:
         key = tuple(str(v) for v in labelvalues)
         if len(key) != len(self.labelnames):
-            raise ValueError(
+            raise ConfigError(
                 f"{self.name}: expected {len(self.labelnames)} label values "
                 f"({self.labelnames}), got {len(key)}"
             )
@@ -214,7 +216,9 @@ class _Instrument:
     # unlabeled convenience: metric.inc()/set()/observe() act on the () child
     def _default(self):
         if self.labelnames:
-            raise ValueError(f"{self.name} is labeled {self.labelnames}; use .labels()")
+            raise ConfigError(
+                f"{self.name} is labeled {self.labelnames}; use .labels()"
+            )
         return self.labels()
 
     def reset(self) -> None:
@@ -370,7 +374,7 @@ class MetricsRegistry:
             m = cls(name, help, tuple(labelnames), **kw)
             self._metrics[name] = m
         elif type(m) is not cls:
-            raise ValueError(
+            raise ConfigError(
                 f"metric {name!r} already registered as {m.kind}, not {cls.kind}"
             )
         return m

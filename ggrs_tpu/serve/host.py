@@ -50,8 +50,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.sanitize import active_alloc_sanitizer
 from ..journal.wal import canonical_statuses
 from ..errors import (
+    ConfigError,
     DeviceDispatchFailed,
     DrainStalled,
     GGRSError,
@@ -107,6 +109,10 @@ def _array_is_ready(arr) -> bool:
 
 
 _ARRAY_IS_READY = None
+
+# the "no env rows for this group" sentinel (shared: the dispatch loop
+# must not build a (0, []) default per megabatch pass)
+_NO_ENV: Tuple[int, tuple] = (0, ())
 
 
 class _StagedRow:
@@ -378,7 +384,7 @@ class SessionHost:
             # inputs (the same contract the single-session beam enforces)
             contract = getattr(game, "statuses_contract", None)
             if contract != "disconnect-only":
-                raise ValueError(
+                raise ConfigError(
                     "host speculation adopts drafts rolled out with "
                     "all-CONFIRMED statuses; declare statuses_contract = "
                     "'disconnect-only' on the game class to opt in "
@@ -413,6 +419,11 @@ class SessionHost:
         self._free_slots = list(range(max_sessions - 1, -1, -1))
         # keys with staged rows, ARRIVAL order (the backpressure queue)
         self._ready: deque = deque()
+        # per-pass scratch reused across megabatch passes — the dispatch
+        # loop allocates nothing per pass (ALLOC001 discipline)
+        self._picked_scratch: List[Tuple[_Lane, _StagedRow]] = []
+        self._adopts_scratch: List[Tuple[_Lane, _StagedRow]] = []
+        self._groups_scratch: Dict[Any, List[Tuple[_Lane, _StagedRow]]] = {}
         self._draining = False
         self._drained = False
         self._tick_index = 0
@@ -972,7 +983,13 @@ class SessionHost:
         the device-window budget, then run eviction/GC. Returns the
         events each session surfaced this tick, keyed by host key."""
         with GLOBAL_TRACER.span("host/tick", absolute=True):
-            return self._tick_impl()
+            out = self._tick_impl()
+        san = active_alloc_sanitizer()
+        if san is not None:
+            # outside the span so the probe charges this tick's churn,
+            # not the tracer's bookkeeping, to the allocation budget
+            san.note_tick()
+        return out
 
     def _tick_impl(self) -> Dict[Any, List[Event]]:
         self._tick_index += 1
@@ -2035,14 +2052,20 @@ class SessionHost:
     # ------------------------------------------------------------------
 
     def _stage(self, lane: _Lane, requests: List[Request]) -> None:
-        segment: List[Request] = []
-        for req in requests:
-            if isinstance(req, LoadGameState) and segment:
-                self._stage_segment(lane, segment)
-                segment = []
-            segment.append(req)
-        if segment:
-            self._stage_segment(lane, segment)
+        # split BEFORE each LoadGameState (a load begins a new segment).
+        # Steady-state traffic carries no loads, so the whole batch
+        # stages as one segment with zero copies; only rollback ticks
+        # pay the per-segment slice.
+        if not requests:
+            return
+        start = 0
+        for i in range(1, len(requests)):
+            if isinstance(requests[i], LoadGameState):
+                self._stage_segment(lane, requests[start:i])
+                start = i
+        self._stage_segment(
+            lane, requests if start == 0 else requests[start:]
+        )
 
     def _parse_staging(self):
         """The host-wide pooled parse triple (inputs, statuses,
@@ -2209,13 +2232,19 @@ class SessionHost:
         inflight budget is exhausted they retire the fence and dispatch
         anyway rather than queue."""
         core = self.device.core
-        # env-staged rows for this pass: gkey -> (max last_active, rows)
+        # env-staged rows for this pass: gkey -> [max last_active, rows]
         env_groups: Dict[Any, List] = {}
         for env in self._envs:
             for gkey, la, entries in env._take_staged():
-                slot = env_groups.setdefault(gkey, [0, []])
-                slot[0] = max(slot[0], la)
+                slot = env_groups.get(gkey)
+                if slot is None:
+                    slot = env_groups[gkey] = [0, []]
+                if la > slot[0]:
+                    slot[0] = la
                 slot[1].extend(entries)
+        picked = self._picked_scratch
+        adopts = self._adopts_scratch
+        groups = self._groups_scratch
         while self._ready or env_groups:
             budget = self.max_inflight_rows - self.device.poll_retired()
             if budget <= 0:
@@ -2224,15 +2253,23 @@ class SessionHost:
                 # env rows must land THIS tick: retire the fence and
                 # take the dispatch slot the budget was protecting
                 self.device.block_until_ready()
-            env_rows = sum(len(e) for _, e in env_groups.values())
+            env_rows = 0
+            for _la, e in env_groups.values():
+                env_rows += len(e)
             take = min(
                 max(budget, 0),
                 len(self._ready),
                 max(self.device.capacity - env_rows, 0),
             )
-            picked: List[Tuple[_Lane, _StagedRow]] = []
-            adopts: List[Tuple[_Lane, _StagedRow]] = []
-            for key in list(self._ready)[:take]:
+            picked.clear()
+            adopts.clear()
+            groups.clear()
+            # _ready is a deque in arrival order; nothing retires (and
+            # so mutates it) until the picking loop is done
+            for key in self._ready:
+                if take <= 0:
+                    break
+                take -= 1
                 lane = self._lanes[key]
                 staged = lane.rows[0]
                 if staged.adopt is not None:
@@ -2252,20 +2289,21 @@ class SessionHost:
                 )
                 self._retire_row(lane, staged, batch, 0)
             if self.depth_routing:
-                groups: Dict[Any, List[Tuple[_Lane, _StagedRow]]] = {}
                 for lane, staged in picked:
                     gkey = (
                         "fast"
                         if staged.fast
                         else self.device.depth_bucket_for(staged.last_active)
                     )
-                    groups.setdefault(gkey, []).append((lane, staged))
+                    g = groups.get(gkey)
+                    if g is None:
+                        g = groups[gkey] = []
+                    g.append((lane, staged))
             else:
-                groups = {None: picked}
-            for gkey in list(env_groups):
-                groups.setdefault(gkey, [])
+                groups[None] = picked
             for gkey, group in groups.items():
-                env_la, env_entries = env_groups.pop(gkey, (0, []))
+                env = env_groups.pop(gkey, None) if env_groups else None
+                env_la, env_entries = env if env is not None else _NO_ENV
                 if self.mesh is not None:
                     # lane-packing affinity: order each megabatch's rows
                     # by the shard that owns their world, so the staged
@@ -2273,19 +2311,34 @@ class SessionHost:
                     # slots they gather/scatter (stable sorts — in-shard
                     # arrival order, and the one-row-per-slot invariant,
                     # are untouched; env rows carry no save bindings)
-                    group.sort(
-                        key=lambda ls: self.device.shard_of(ls[0].slot)
-                    )
-                    env_entries.sort(
-                        key=lambda e: self.device.shard_of(e[0])
-                    )
+                    group.sort(key=self._shard_key_lane)
+                    if env_entries:
+                        env_entries.sort(key=self._shard_key_entry)
                 batch, group = self._dispatch_group(
                     gkey, group, env_entries, env_la
                 )
                 for k, (lane, staged) in enumerate(group):
                     self._retire_row(lane, staged, batch, k * core.window)
+            while env_groups:
+                # env-only depth groups (no session row picked for their
+                # bucket this pass) dispatch on their own
+                gkey, (env_la, env_entries) = env_groups.popitem()
+                if self.mesh is not None and env_entries:
+                    env_entries.sort(key=self._shard_key_entry)
+                batch, group = self._dispatch_group(
+                    gkey, (), env_entries, env_la
+                )
+                for k, (lane, staged) in enumerate(group):
+                    self._retire_row(lane, staged, batch, k * core.window)
         if GLOBAL_TELEMETRY.enabled:
             self._m_queue_depth.set(len(self._ready))
+
+    def _shard_key_lane(self, ls):
+        """Lane-packing sort key (hoisted: no per-pass lambda)."""
+        return self.device.shard_of(ls[0].slot)
+
+    def _shard_key_entry(self, e):
+        return self.device.shard_of(e[0])
 
     def _dispatch_group(self, gkey, group, env_entries, env_la):
         """Dispatch one depth group behind the fault-containment ladder:
@@ -2297,50 +2350,53 @@ class SessionHost:
         (checksum batch | None, surviving group) with save-binding
         positions matching the surviving entries."""
         for attempt in range(3):
-            group = [
-                (lane, staged) for lane, staged in group if not lane.failed
-            ]
-            # session entries FIRST: save bindings index the batch by
-            # position, and env rows need no post-dispatch binding
-            entries = [
-                (lane.slot, staged.row) for lane, staged in group
-            ] + env_entries
-            if not entries:
-                return None, group
             try:
-                if gkey == "fast":
-                    batch, _bucket = self.device.dispatch(
-                        entries, fast=True
-                    )
-                elif gkey is None:
-                    batch, _bucket = self.device.dispatch(entries)
-                else:
-                    la = max(
-                        [staged.last_active for _, staged in group]
-                        + [env_la],
-                    )
-                    batch, _bucket = self.device.dispatch(
-                        entries, last_active=la
-                    )
-                return batch, group
+                return self._dispatch_group_once(
+                    gkey, group, env_entries, env_la
+                )
             except DeviceDispatchFailed as exc:
-                self._on_device_fault(exc)
-                if attempt == 0:
-                    continue  # transient: the retry re-runs identically
-                culprits = [
-                    lane for lane, _ in group
-                    if lane.slot in set(exc.slots)
-                ]
-                if not culprits:
-                    raise
-                for lane in culprits:
-                    self.quarantine(
-                        lane.key, "dispatch_failed", error=exc
-                    )
+                group = self._dispatch_group_fault(exc, attempt, group)
         raise DeviceDispatchFailed(
             "megabatch dispatch still failing after quarantine",
             op="megabatch",
         )
+
+    def _dispatch_group_once(self, gkey, group, env_entries, env_la):
+        """One dispatch attempt — the steady-state body: per-call scratch
+        only, nothing allocated per retry iteration."""
+        group = [ls for ls in group if not ls[0].failed]
+        # session entries FIRST: save bindings index the batch by
+        # position, and env rows need no post-dispatch binding
+        entries = [(lane.slot, staged.row) for lane, staged in group]
+        entries.extend(env_entries)
+        if not entries:
+            return None, group
+        if gkey == "fast":
+            batch, _bucket = self.device.dispatch(entries, fast=True)
+        elif gkey is None:
+            batch, _bucket = self.device.dispatch(entries)
+        else:
+            la = env_la
+            for _, staged in group:
+                if staged.last_active > la:
+                    la = staged.last_active
+            batch, _bucket = self.device.dispatch(entries, last_active=la)
+        return batch, group
+
+    def _dispatch_group_fault(self, exc, attempt, group):
+        """The containment ladder's fault arm (cold: runs only when a
+        dispatch already raised). Returns the surviving group for the
+        next attempt."""
+        self._on_device_fault(exc)
+        if attempt == 0:
+            return group  # transient: the retry re-runs identically
+        slots = set(exc.slots)
+        culprits = [lane for lane, _ in group if lane.slot in slots]
+        if not culprits:
+            raise  # unattributed: the whole device is suspect
+        for lane in culprits:
+            self.quarantine(lane.key, "dispatch_failed", error=exc)
+        return [ls for ls in group if not ls[0].failed]
 
     def _retire_row(self, lane: _Lane, staged: _StagedRow, batch,
                     base: int) -> None:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import random
 from typing import Any, Dict, List, Optional
 
+from ..errors import ContractViolation
 from ..network.sockets import InMemoryNetwork
 from ..sessions.builder import SessionBuilder
 from ..types import DesyncDetection, PlayerType, SessionState
@@ -88,7 +89,7 @@ def sync_fleet(host, matches, clock, *, max_ticks: int = 800) -> None:
             for k in keys
         ):
             return
-    raise AssertionError(
+    raise ContractViolation(
         f"fleet of {sum(len(m) for m in matches)} sessions failed to "
         f"synchronize within {max_ticks} ticks"
     )

@@ -24,7 +24,12 @@ from typing import Any, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from ..analysis.sanitize import active_sanitizer, warmup_scope
+from ..analysis.sanitize import (
+    active_sanitizer,
+    transfer_guard_scope,
+    warmup_scope,
+)
+from ..errors import ConfigError, ContractViolation, TypeContractError
 from ..obs import (
     GLOBAL_TELEMETRY,
     LOG2_BUCKETS,
@@ -354,7 +359,7 @@ def parse_request_segment(
             slots.append((pending_save, req))
             pending_save = None
         else:
-            raise TypeError(f"unknown request {req!r}")
+            raise TypeContractError(f"unknown request {req!r}")
     trailing_save = pending_save
 
     count = len(slots)
@@ -547,7 +552,7 @@ class TpuRollbackBackend:
             and self.core._beam_sharding is not None
             and beam_width % mesh.shape["beam"] != 0
         ):
-            raise ValueError(
+            raise ConfigError(
                 f"beam_width={beam_width} must divide evenly over the mesh's "
                 f"beam axis ({mesh.shape['beam']}) — an indivisible beam "
                 "would silently run replicated, wasting every beam shard"
@@ -569,7 +574,7 @@ class TpuRollbackBackend:
             # ENFORCED, not assumed: games declare it explicitly
             contract = getattr(game, "statuses_contract", None)
             if contract != "disconnect-only":
-                raise ValueError(
+                raise ConfigError(
                     "beam speculation adopts trajectories rolled out with "
                     "all-CONFIRMED statuses, which is only correct for games "
                     "whose step reads statuses solely to substitute "
@@ -2151,7 +2156,7 @@ class MultiSessionDeviceCore:
         for b in self.buckets:
             if b >= n:
                 return b
-        raise AssertionError(f"{n} rows exceed the largest bucket")
+        raise ContractViolation(f"{n} rows exceed the largest bucket")
 
     def depth_bucket_for(self, last_active: int) -> int:
         """Smallest depth-bucket pad target covering a 1-based last
@@ -2159,7 +2164,7 @@ class MultiSessionDeviceCore:
         for d in self.depth_buckets:
             if d >= last_active:
                 return d
-        raise AssertionError(
+        raise ContractViolation(
             f"{last_active} slots exceed the window ({self.core.window})"
         )
 
@@ -2355,9 +2360,15 @@ class MultiSessionDeviceCore:
         # hit dynamics). sig_depth 0 = the fast path, None = unrouted
         # full window.
         self.plan_cache.note(("megabatch", bucket, sig_depth), metrics=False)
-        self.rings, self.states, his, los = fn(
-            self.rings, self.states, idx, rows, *fn_args
-        )
+        with transfer_guard_scope("megabatch dispatch"):
+            # no-op unless GGRS_SANITIZE armed the sanitizer AND warmup
+            # froze it: then an implicit device->host read inside the
+            # dispatch (a stray float()/.item() on a live buffer) raises
+            # ImplicitHostTransfer with its call site instead of
+            # silently serializing the pipeline
+            self.rings, self.states, his, los = fn(
+                self.rings, self.states, idx, rows, *fn_args
+            )
         san = active_sanitizer()
         if san is not None:
             # GGRS_SANITIZE: the megabatch jit cache must stay on the
@@ -2865,21 +2876,25 @@ class MultiSessionDeviceCore:
             self.fault_seam.before_dispatch("resident_drive", slots)
         self.commit_mailbox()
         marks, n_rows, max_la, all_fast, vt_fast, future = mbox.take_cycle()
-        if all_fast:
-            nslots = 1
-            self.plan_cache.note(("resident_drive", 0), metrics=False)
-            self.rings, self.states, his, los = self._driver_fast_fn(
-                self.rings, self.states, mbox.rows_dev, marks
-            )
-        else:
-            nslots = self.depth_bucket_for(max_la)
-            self.plan_cache.note(
-                ("resident_drive", nslots), metrics=False
-            )
-            self.rings, self.states, his, los = self._driver_fn(
-                self.rings, self.states, mbox.rows_dev, marks, vt_fast,
-                nslots,
-            )
+        with transfer_guard_scope("resident drive"):
+            # guards the driver dispatch only: `marks` is the mailbox's
+            # host-side counts copy, so the `int(marks.max())` readback
+            # below is host math, not a device sync
+            if all_fast:
+                nslots = 1
+                self.plan_cache.note(("resident_drive", 0), metrics=False)
+                self.rings, self.states, his, los = self._driver_fast_fn(
+                    self.rings, self.states, mbox.rows_dev, marks
+                )
+            else:
+                nslots = self.depth_bucket_for(max_la)
+                self.plan_cache.note(
+                    ("resident_drive", nslots), metrics=False
+                )
+                self.rings, self.states, his, los = self._driver_fn(
+                    self.rings, self.states, mbox.rows_dev, marks, vt_fast,
+                    nslots,
+                )
         san = active_sanitizer()
         if san is not None:
             san.check_dispatch_budget(

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..errors import ConfigError, RegistryMiss
 from ..ops import fixed_point as fx
 
 GOLDEN = np.int32(np.uint32(fx.GOLDEN32).view(np.int32))
@@ -624,7 +625,7 @@ def get_adapter(game) -> PlaneAdapter:
     for cls in type(game).__mro__:
         if cls in _ADAPTERS:
             return _ADAPTERS[cls](game)
-    raise KeyError(
+    raise RegistryMiss(
         f"no pallas PlaneAdapter registered for {type(game).__name__}; use "
         "the XLA backend or register_adapter()"
     )
@@ -664,7 +665,7 @@ class PallasSyncTestCore:
         self.adapter = get_adapter(game)
         vmem_est = self.vmem_estimate(game, check_distance, self.adapter)
         if not interpret and vmem_est > self.VMEM_BUDGET_BYTES:
-            raise ValueError(
+            raise ConfigError(
                 f"world too large for the VMEM-resident kernel: ~{vmem_est >> 20}MB "
                 f"of plane windows exceeds the validated {self.VMEM_BUDGET_BYTES >> 20}MB "
                 "budget; use the XLA backend for this configuration"

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..errors import ContractViolation
+
 from ..obs import DISPATCH_DEPTH_BUCKETS, GLOBAL_TELEMETRY
 
 
@@ -622,7 +624,7 @@ class ResimCore:
         for v in self.branchless_variants():
             if v >= last_active:
                 return v
-        raise AssertionError(
+        raise ContractViolation(
             f"no variant covers {last_active} slots (variants end in window)"
         )
 

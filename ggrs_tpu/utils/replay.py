@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..errors import ConfigError, DataFormatError
 from ..types import AdvanceFrame, Frame, LoadGameState, SaveGameState
 
 
@@ -117,7 +118,7 @@ class InputRecorder:
         the replayable recording."""
         n = self.confirmed_frames
         if n <= self._drained:
-            raise ValueError("nothing confirmed yet")
+            raise ConfigError("nothing confirmed yet")
         frames = range(self._drained, n)
         inputs = np.stack([self._rows[f][0] for f in frames])
         statuses = np.stack([self._rows[f][1] for f in frames])
@@ -232,7 +233,7 @@ def load_replay(path: str, game=None) -> Tuple[np.ndarray, np.ndarray]:
             if str(got) != str(want):
                 # a replay against the wrong world diverges silently;
                 # refuse loudly (and not via assert, which -O strips)
-                raise ValueError(
+                raise DataFormatError(
                     f"replay was recorded on {field}={got}, not {want}"
                 )
     return np.asarray(z["inputs"]), np.asarray(z["statuses"])
@@ -257,7 +258,7 @@ def _replay_core(game, inputs, statuses, tick_backend, start_state,
     if start_state is not None:
         got = int(np.asarray(start_state["frame"]))
         if got != start_frame:
-            raise ValueError(
+            raise DataFormatError(
                 f"seek state is frame {got}, recording offset is "
                 f"{start_frame}"
             )
@@ -341,12 +342,14 @@ def load_seek_checkpoint(path: str, game=None):
 
     tree, meta = load_device_checkpoint(path)
     if meta.get("kind") != "ReplaySeekpoint":
-        raise ValueError(f"not a replay seek point: {meta.get('kind')!r}")
+        raise DataFormatError(
+            f"not a replay seek point: {meta.get('kind')!r}"
+        )
     if game is not None and "game_cls" in meta:
         if meta["game_cls"] != type(game).__name__ or meta[
             "num_entities"
         ] != game.num_entities:
-            raise ValueError(
+            raise DataFormatError(
                 f"seek point was saved on {meta['game_cls']}"
                 f"/{meta['num_entities']}, not {type(game).__name__}"
                 f"/{game.num_entities}"

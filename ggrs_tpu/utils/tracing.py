@@ -17,6 +17,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
+from ..errors import ConfigError
+
 
 @dataclass
 class SpanStats:
@@ -124,7 +126,9 @@ class Tracer:
                 self.stats, key=lambda n: (-self.stats[n].total_ns, n)
             )
         else:
-            raise ValueError(f"sort_by must be 'name' or 'total', got {sort_by!r}")
+            raise ConfigError(
+                f"sort_by must be 'name' or 'total', got {sort_by!r}"
+            )
         width = max([len("span")] + [len(n) for n in names])
         lines = [
             f"{'span':{width}s} {'count':>8s} {'mean ms':>10s} {'max ms':>10s} {'total ms':>10s}"

@@ -39,9 +39,10 @@
 #   ggrs_endpoint_resends_total / the pump|endpoint|encode tax split —
 #   that the vectorized protocol plane is the taken path at fleet
 #   scale, that forced outage holes fire resends through the candidate
-#   mask, zero desyncs, zero drain-blocked ticks post-sync, and that a
-#   fleet-of-one host stays on the scalar twin
-#   (scripts/endpoint_smoke.py, CPU jax, <1 min).
+#   mask, zero desyncs, zero drain-blocked ticks post-sync, ZERO
+#   per-tick allocation-budget trips over the measured window
+#   (freeze_allocations armed), and that a fleet-of-one host stays on
+#   the scalar twin (scripts/endpoint_smoke.py, CPU jax, <1 min).
 #   --env-smoke runs a 256-world RollbackEnv rollout with auto-reset plus
 #   a snapshot->branch->restore backtracking episode under GGRS_SANITIZE=1
 #   and asserts zero post-warmup recompiles, megabatch coalescing, the
@@ -76,9 +77,10 @@
 #   SessionHost(resident=True) — device mailbox + lax.while_loop
 #   virtual-tick driver — under GGRS_SANITIZE=1, gated on
 #   vticks-per-dispatch p50 > 1, zero mailbox overflows, zero desyncs,
-#   zero post-warmup recompiles, the jit cache within
-#   dispatch_bucket_budget(), and the mailbox instruments through BOTH
-#   exporters (scripts/resident_smoke.py, CPU jax, <1 min). Also runs
+#   zero post-warmup recompiles, ZERO per-tick allocation-budget trips
+#   over the measured window (freeze_allocations armed), the jit cache
+#   within dispatch_bucket_budget(), and the mailbox instruments
+#   through BOTH exporters (scripts/resident_smoke.py, CPU jax, <1 min). Also runs
 #   in the default flow (step 2e): the resident loop is a correctness
 #   surface, not an optional extra.
 #   --fault-smoke runs a seeded FaultPlan firing >= 1 of EVERY
@@ -112,18 +114,20 @@
 #   instruments through BOTH exporters (scripts/learn_smoke.py, CPU
 #   jax, ~1-2 min). Also runs in the default flow (step 2h): the
 #   learning loop is a correctness surface, not an optional extra.
-#   --lint runs the determinism/trace/fence/wire static-analysis gate
-#   (python -m ggrs_tpu.analysis, pure AST, no jax, seconds) against
-#   analysis/baseline.toml, then the retrace-sanitizer smoke
-#   (GGRS_SANITIZE=1 scripts/lint_smoke.py). Also step 0 of the default
-#   flow: the cheapest gate runs first.
+#   --lint runs the determinism/trace/fence/wire/alloc/exceptions
+#   static-analysis gate (python -m ggrs_tpu.analysis, pure AST, no
+#   jax, seconds) against analysis/baseline.toml, then the runtime-
+#   sanitizer smoke (GGRS_SANITIZE=1 scripts/lint_smoke.py: seeded
+#   retrace, seeded alloc-budget leak, planted implicit host sync —
+#   each caught with provenance; healthy twins silent). Also step 0 of
+#   the default flow: the cheapest gate runs first.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 run_lint() {
-  echo "== static analysis gate (determinism/trace/fence/wire) =="
+  echo "== static analysis gate (determinism/trace/fence/wire/alloc/exceptions) =="
   python -m ggrs_tpu.analysis
-  echo "== retrace sanitizer smoke (GGRS_SANITIZE=1) =="
+  echo "== runtime sanitizer smoke (GGRS_SANITIZE=1: retrace/alloc/transfer) =="
   GGRS_SANITIZE=1 JAX_PLATFORMS=cpu python scripts/lint_smoke.py
 }
 

@@ -2,15 +2,22 @@
 retrace-unstable toy program, with stack provenance pointing at the line
 that caused it (scripts/check.sh --lint runs this after the static gate).
 
-Two scenarios:
+Four scenarios:
   1. a shape-churning jitted step (the classic unstable program: every
      call a new shape, every call a retrace) — the sanitizer must record
      one recompile per churned call AND name THIS file in the provenance;
   2. a stable hosted-style dispatch loop after warmup/freeze — the
      sanitizer must stay silent (zero recompiles), so the tool can't cry
-     wolf on healthy steady state.
+     wolf on healthy steady state;
+  3. an alloc-churning tick loop (retains objects every tick) — the
+     allocation sanitizer must trip its per-tick budget with tracemalloc
+     provenance naming THIS file, while the preceding transient-churn
+     loop stays trip-free;
+  4. a planted implicit device->host sync inside a transfer_guard_scope
+     — must raise typed ImplicitHostTransfer naming the call site, and
+     the patch must be fully restored after the scope.
 
-Exit 0 when both hold; nonzero with the report otherwise.
+Exit 0 when all hold; nonzero with the report otherwise.
 """
 
 import os
@@ -84,6 +91,74 @@ def main() -> int:
         print(san.report(), file=sys.stderr)
         return 1
     print("OK: stable loop recompile-clean under the sanitizer")
+
+    # --- scenario 3: the seeded allocation regression ------------------
+    from ggrs_tpu.analysis.sanitize import (
+        freeze_allocations,
+        thaw_allocations,
+    )
+
+    asan = freeze_allocations(budget_blocks=256, label="lint_smoke alloc")
+    for _ in range(32):  # healthy: transient churn nets to ~zero
+        scratch = [0] * 16
+        scratch.clear()
+        asan.note_tick()
+    if asan.trips:
+        print("FAIL: transient churn tripped the alloc budget:",
+              file=sys.stderr)
+        print(asan.report(), file=sys.stderr)
+        return 1
+    hoard = []
+    for _ in range(3):  # the leak: retained growth every tick
+        hoard.extend(object() for _ in range(5000))
+        asan.note_tick()
+    print(asan.report())
+    if not asan.trips:
+        print("FAIL: seeded allocation leak never tripped the budget",
+              file=sys.stderr)
+        return 1
+    if not any(this_file in ev.provenance() for ev in asan.trips):
+        print(
+            "FAIL: alloc trip provenance does not point at the leak in "
+            f"{this_file}",
+            file=sys.stderr,
+        )
+        return 1
+    thaw_allocations()
+    print(
+        f"OK: seeded alloc leak tripped {len(asan.trips)} time(s), "
+        f"provenance -> {this_file}"
+    )
+
+    # --- scenario 4: the planted implicit host sync --------------------
+    from ggrs_tpu.analysis.sanitize import transfer_guard_scope
+    from ggrs_tpu.errors import ImplicitHostTransfer
+
+    dev = jnp.arange(8.0)
+    float(dev.sum())  # unguarded: legal anywhere
+    san.freeze("lint_smoke transfer")
+    tripped = False
+    try:
+        with transfer_guard_scope("lint_smoke dispatch"):
+            float(dev.sum())  # the planted sync
+    except ImplicitHostTransfer as exc:
+        tripped = True
+        if this_file not in str(exc):
+            print(
+                "FAIL: transfer trip does not name the sync site in "
+                f"{this_file}: {exc}",
+                file=sys.stderr,
+            )
+            return 1
+    if not tripped:
+        print("FAIL: planted implicit sync escaped the transfer guard",
+              file=sys.stderr)
+        return 1
+    if float(dev.sum()) != 28.0:  # patch restored outside the scope
+        print("FAIL: transfer guard left ArrayImpl patched",
+              file=sys.stderr)
+        return 1
+    print("OK: planted implicit sync raised typed ImplicitHostTransfer")
     return 0
 
 

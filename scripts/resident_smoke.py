@@ -94,6 +94,12 @@ def build_fleet(seed=7):
     base_driver = host.device.driver_dispatches
     GLOBAL_TELEMETRY.registry.reset()
     scripts = make_scripts(matches, TICKS, seed=seed)
+    # arm the allocation budget for the measured window: every host tick
+    # from here is charged against the steady-state budget (the host's
+    # tick() carries the probe), and main() asserts zero trips
+    from ggrs_tpu.analysis.sanitize import freeze_allocations
+
+    freeze_allocations(label="resident steady state")
     desyncs = drive_scripted(host, matches, clock, scripts, TICKS)
     host.device.block_until_ready()
     if desyncs:
@@ -139,6 +145,27 @@ def main():
             "post-warmup recompile on the resident host:\n"
             + "\n".join(e.render() for e in recompiles)
         )
+
+    from ggrs_tpu.analysis.sanitize import (
+        active_alloc_sanitizer,
+        thaw_allocations,
+    )
+
+    asan = active_alloc_sanitizer()
+    if asan is None:
+        fail("allocation sanitizer not armed for the measured window")
+    if asan.ticks_seen < TICKS:
+        fail(
+            f"allocation probe saw {asan.ticks_seen} ticks "
+            f"(expected >= {TICKS})"
+        )
+    if asan.trips:
+        fail(
+            "steady-state resident tick blew the allocation budget:\n"
+            + asan.report()
+        )
+    alloc_ticks = asan.ticks_seen
+    thaw_allocations()
 
     dev = host.device
     # --- 1. amortization engaged -------------------------------------
@@ -196,7 +223,8 @@ def main():
         f"resident-smoke OK: vticks_p50={p50} "
         f"dispatches_per_tick={rate:.3f} "
         f"driver_dispatches={dev.driver_dispatches} "
-        f"cache={cache}/{budget}"
+        f"cache={cache}/{budget} "
+        f"alloc_trips=0/{alloc_ticks}t"
     )
 
 

@@ -762,3 +762,449 @@ def test_hosted_serve_recompile_clean_under_sanitizer(sanitizer):
     finally:
         GLOBAL_TELEMETRY.enabled = False
         GLOBAL_TELEMETRY.reset()
+
+
+# ----------------------------------------------------------------------
+# alloc (ALLOC001..ALLOC004) — fixtures opt in via __ggrs_hot__
+# ----------------------------------------------------------------------
+
+
+def test_alloc001_per_iteration_container_fires_and_scratch_clean():
+    bad = {"ggrs_tpu/serve/fx.py": (
+        "__ggrs_hot__ = ('Host.tick',)\n"
+        "class Host:\n"
+        "    def tick(self, lanes):\n"
+        "        for lane in lanes:\n"
+        "            rows = [lane.row]\n"
+        "            self.emit(rows)\n"
+        "    def emit(self, rows):\n"
+        "        pass\n"
+    )}
+    rules, _ = rules_fired(bad, ["alloc"])
+    assert rules == ["ALLOC001"]
+    clean = {"ggrs_tpu/serve/fx.py": (
+        "__ggrs_hot__ = ('Host.tick',)\n"
+        "class Host:\n"
+        "    def __init__(self):\n"
+        "        self._scratch = []\n"
+        "    def tick(self, lanes):\n"
+        "        scratch = self._scratch\n"
+        "        scratch.clear()\n"
+        "        for lane in lanes:\n"
+        "            scratch.append(lane.row)\n"
+        "        self.emit(scratch)\n"
+        "    def emit(self, rows):\n"
+        "        pass\n"
+    )}
+    assert rules_fired(clean, ["alloc"])[0] == []
+
+
+def test_alloc001_reaches_through_callees():
+    # the allocation sits two calls below the declared hot entry
+    bad = {"ggrs_tpu/serve/fx.py": (
+        "__ggrs_hot__ = ('Host.tick',)\n"
+        "class Host:\n"
+        "    def tick(self, lanes):\n"
+        "        self._pump(lanes)\n"
+        "    def _pump(self, lanes):\n"
+        "        self._drain(lanes)\n"
+        "    def _drain(self, lanes):\n"
+        "        for lane in lanes:\n"
+        "            lane.out = {'k': lane.row}\n"
+    )}
+    rules, found = rules_fired(bad, ["alloc"])
+    assert rules == ["ALLOC001"]
+    assert found[0].symbol == "Host._drain"
+
+
+def test_alloc001_cold_contexts_do_not_fire():
+    # lazy-init guard, except handler and raise argument are cold by
+    # contract: they allocate only off the steady state
+    clean = {"ggrs_tpu/serve/fx.py": (
+        "__ggrs_hot__ = ('Host.tick',)\n"
+        "class Host:\n"
+        "    def tick(self, lanes):\n"
+        "        for lane in lanes:\n"
+        "            q = self.groups.get(lane.key)\n"
+        "            if q is None:\n"
+        "                q = self.groups[lane.key] = []\n"
+        "            q.append(lane.row)\n"
+        "            try:\n"
+        "                lane.step()\n"
+        "            except RuntimeError:\n"
+        "                self.failed = [lane.key]\n"
+    )}
+    assert rules_fired(clean, ["alloc"])[0] == []
+
+
+def test_alloc002_per_call_closures():
+    bad = {"ggrs_tpu/serve/fx.py": (
+        "__ggrs_hot__ = ('Host.tick',)\n"
+        "class Host:\n"
+        "    def tick(self, rows):\n"
+        "        rows.sort(key=lambda r: r.slot)\n"
+    )}
+    rules, _ = rules_fired(bad, ["alloc"])
+    assert rules == ["ALLOC002"]
+    clean = {"ggrs_tpu/serve/fx.py": (
+        "__ggrs_hot__ = ('Host.tick',)\n"
+        "class Host:\n"
+        "    def tick(self, rows):\n"
+        "        rows.sort(key=self._slot_key)\n"
+        "    def _slot_key(self, r):\n"
+        "        return r.slot\n"
+    )}
+    assert rules_fired(clean, ["alloc"])[0] == []
+
+
+def test_alloc003_string_building_vs_pooled_bytes():
+    bad = {"ggrs_tpu/serve/fx.py": (
+        "__ggrs_hot__ = ('pump',)\n"
+        "def pump(rows):\n"
+        "    return f'batch of {len(rows)}'\n"
+    )}
+    rules, _ = rules_fired(bad, ["alloc"])
+    assert rules == ["ALLOC003"]
+    # b''.join is the sanctioned pooled byte-staging flush, and strings
+    # on the raise path are cold
+    clean = {"ggrs_tpu/serve/fx.py": (
+        "__ggrs_hot__ = ('pump',)\n"
+        "def pump(chunks, n):\n"
+        "    if n < 0:\n"
+        "        raise ValueError(f'bad row count {n}')\n"
+        "    return b''.join(chunks)\n"
+    )}
+    assert rules_fired(clean, ["alloc"])[0] == []
+
+
+def test_alloc004_packing_and_sorted_in_loop():
+    bad = {"ggrs_tpu/serve/fx.py": (
+        "__ggrs_hot__ = ('Host.tick',)\n"
+        "class Host:\n"
+        "    def tick(self, *rows, **opts):\n"
+        "        for group in self.groups:\n"
+        "            for e in sorted(group):\n"
+        "                e.go()\n"
+    )}
+    rules, _ = rules_fired(bad, ["alloc"])
+    assert sorted(rules) == ["ALLOC004", "ALLOC004"]
+    clean = {"ggrs_tpu/serve/fx.py": (
+        "__ggrs_hot__ = ('Host.tick',)\n"
+        "class Host:\n"
+        "    def tick(self, rows, opts):\n"
+        "        batch = sorted(rows)\n"
+        "        for e in batch:\n"
+        "            e.go()\n"
+    )}
+    assert rules_fired(clean, ["alloc"])[0] == []
+
+
+def test_alloc_unseeded_module_not_linted():
+    # no __ggrs_hot__ and not in the entry table: nothing is hot
+    files = {"ggrs_tpu/serve/fx.py": (
+        "def helper(rows):\n"
+        "    for r in rows:\n"
+        "        out = [r]\n"
+    )}
+    assert rules_fired(files, ["alloc"])[0] == []
+
+
+# ----------------------------------------------------------------------
+# exceptions (EXC001..EXC002)
+# ----------------------------------------------------------------------
+
+
+def test_exc001_untyped_raise_fires_and_bridge_clean():
+    bad = {"ggrs_tpu/tpu/fx.py": (
+        "def f(n):\n"
+        "    raise ValueError('bad: %d' % n)\n"
+    )}
+    rules, _ = rules_fired(bad, ["exceptions"])
+    assert rules == ["EXC001"]
+    # the bridge hierarchy resolves across files by the repo-wide
+    # class fixpoint: FxError IS a GGRSError even though the raise
+    # site's module never mentions GGRSError
+    clean = {
+        "ggrs_tpu/tpu/fx_err.py": (
+            "class FxError(GGRSError, ValueError):\n"
+            "    pass\n"
+        ),
+        "ggrs_tpu/tpu/fx.py": (
+            "def f(n):\n"
+            "    raise FxError('bad row count')\n"
+        ),
+    }
+    assert rules_fired(clean, ["exceptions"])[0] == []
+
+
+def test_exc001_reraise_idioms_are_clean():
+    clean = {"ggrs_tpu/tpu/fx.py": (
+        "class FxError(GGRSError, ValueError):\n"
+        "    pass\n"
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except FxError as e:\n"
+        "        note(e)\n"
+        "        raise\n"
+        "def h():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except FxError as e:\n"
+        "        raise e.with_traceback(None)\n"
+        "def k():\n"
+        "    err = FxError('wedged')\n"
+        "    note(err)\n"
+        "    raise err\n"
+    )}
+    assert rules_fired(clean, ["exceptions"])[0] == []
+
+
+def test_exc001_dynamic_expression_fires():
+    bad = {"ggrs_tpu/tpu/fx.py": (
+        "def f(bag):\n"
+        "    raise bag[0]\n"
+    )}
+    rules, found = rules_fired(bad, ["exceptions"])
+    assert rules == ["EXC001"]
+    assert "dynamic expression" in found[0].message
+
+
+def test_exc002_swallowing_broad_except():
+    bad = {"ggrs_tpu/tpu/fx.py": (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )}
+    rules, _ = rules_fired(bad, ["exceptions"])
+    assert rules == ["EXC002"]
+    # recording a flight event, or re-raising, redeems the broad catch
+    clean = {"ggrs_tpu/tpu/fx.py": (
+        "def f(tel):\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as exc:\n"
+        "        tel.record('fx_failed', error=str(exc))\n"
+        "def h():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except BaseException:\n"
+        "        raise\n"
+    )}
+    assert rules_fired(clean, ["exceptions"])[0] == []
+
+
+def test_cli_json_records(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    root = tmp_path / "repo"
+    (root / "ggrs_tpu" / "tpu").mkdir(parents=True)
+    (root / "ggrs_tpu" / "tpu" / "bad.py").write_text(
+        "import time\ndef f():\n    raise ValueError(time.time())\n"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo_root)
+    proc = subprocess.run(
+        [sys.executable, "-m", "ggrs_tpu.analysis", "--root", str(root),
+         "--no-baseline", "--json"],
+        capture_output=True, text=True, env=env,
+    )
+    assert proc.returncode == 1  # exit codes unchanged by --json
+    recs = json.loads(proc.stdout)
+    assert recs, "expected findings as JSON records"
+    for rec in recs:
+        assert set(rec) == {"rule", "path", "line", "symbol", "message"}
+        assert isinstance(rec["line"], int)
+    assert {r["rule"] for r in recs} == {"DET001", "EXC001"}
+
+
+# ----------------------------------------------------------------------
+# allocation sanitizer
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture
+def alloc_sanitizer_cleanup():
+    from ggrs_tpu.analysis.sanitize import thaw_allocations
+    from ggrs_tpu.obs import GLOBAL_TELEMETRY
+
+    GLOBAL_TELEMETRY.enabled = True
+    GLOBAL_TELEMETRY.registry.reset()
+    GLOBAL_TELEMETRY.recorder.clear()
+    yield
+    thaw_allocations()
+    GLOBAL_TELEMETRY.enabled = False
+    GLOBAL_TELEMETRY.reset()
+
+
+def test_alloc_sanitizer_seeded_regression_trips(alloc_sanitizer_cleanup):
+    from ggrs_tpu.analysis.sanitize import (
+        active_alloc_sanitizer,
+        freeze_allocations,
+    )
+    from ggrs_tpu.obs import GLOBAL_TELEMETRY
+
+    san = freeze_allocations(budget_blocks=256, label="seeded test")
+    assert active_alloc_sanitizer() is san
+
+    for _ in range(20):  # healthy ticks: transient churn only
+        scratch = [0] * 8
+        scratch.clear()
+        san.note_tick()
+    assert san.trips == [], san.report()
+
+    hoard = []  # the seeded regression: retained growth every tick
+    for _ in range(3):
+        hoard.extend(object() for _ in range(5000))
+        san.note_tick()
+    assert len(san.trips) >= 1, san.report()
+    ev = san.trips[0]
+    assert ev.blocks > 256 and ev.budget == 256
+    assert "test_analysis.py" in ev.provenance()  # tracemalloc names us
+
+    reg = GLOBAL_TELEMETRY.registry
+    assert reg.get("ggrs_alloc_budget_trips_total").value >= 1
+    hist = reg.get("ggrs_alloc_per_tick").snapshot()["values"][""]
+    assert hist["count"] == 23
+    snap = GLOBAL_TELEMETRY.snapshot()
+    trip_events = [
+        e for e in snap["events"] if e["kind"] == "alloc_budget_trip"
+    ]
+    assert trip_events and "test_analysis.py" in trip_events[0]["provenance"]
+    prom = GLOBAL_TELEMETRY.prometheus()
+    assert "ggrs_alloc_budget_trips_total" in prom
+    assert "ggrs_alloc_per_tick_count" in prom
+
+
+def test_alloc_sanitizer_thaw_disarms(alloc_sanitizer_cleanup):
+    from ggrs_tpu.analysis.sanitize import (
+        active_alloc_sanitizer,
+        freeze_allocations,
+        thaw_allocations,
+    )
+
+    san = freeze_allocations(budget_blocks=1, label="thaw test")
+    thaw_allocations()
+    assert active_alloc_sanitizer() is None
+    keep = [object() for _ in range(4096)]
+    san.note_tick()  # no-op while thawed
+    assert san.trips == [] and keep
+
+
+def test_alloc_sanitizer_healthy_hosted_serve_silent(alloc_sanitizer_cleanup):
+    """The acceptance gate's positive control: a hosted steady-state
+    serve, ticked through SessionHost.tick (which carries the
+    note_tick probe), must stay under the DEFAULT budget — the tick
+    path's zero-steady-state-allocation claim, asserted at runtime."""
+    from ggrs_tpu import PlayerType, SessionBuilder
+    from ggrs_tpu.analysis.sanitize import freeze_allocations
+    from ggrs_tpu.models.ex_game import ExGame
+    from ggrs_tpu.network.sockets import InMemoryNetwork
+    from ggrs_tpu.serve import SessionHost
+    from ggrs_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    net = InMemoryNetwork(clock)
+    host = SessionHost(
+        ExGame(num_players=2, num_entities=8),
+        max_prediction=4,
+        num_players=2,
+        max_sessions=4,
+        clock=clock,
+        warmup=True,
+    )
+    keys = []
+    for i in range(3):
+        b = (
+            SessionBuilder(input_size=1)
+            .with_num_players(2)
+            .with_max_prediction_window(4)
+        )
+        for h in range(2):
+            b = b.add_player(PlayerType.local(), h)
+        session = b.start_p2p_session(net.socket(("solo", i)))
+        keys.append(host.attach(session))
+
+    def drive(ticks, base):
+        for t in range(ticks):
+            for i, key in enumerate(keys):
+                for h in range(2):
+                    host.submit_input(
+                        key, h, bytes([(base + t * 3 + h + i) % 16])
+                    )
+            host.tick()
+            clock.advance(16)
+
+    drive(8, 0)  # warm: caches, pools and lazy slots fill here
+    san = freeze_allocations(label="hosted steady state")
+    drive(24, 8)
+    host.device.block_until_ready()
+    assert san.ticks_seen == 24
+    assert san.trips == [], (
+        "steady-state host tick blew the allocation budget:\n"
+        + san.report()
+    )
+
+
+# ----------------------------------------------------------------------
+# transfer guard
+# ----------------------------------------------------------------------
+
+
+def test_transfer_guard_trips_on_planted_sync(sanitizer):
+    import jax.numpy as jnp
+
+    from ggrs_tpu.analysis.sanitize import transfer_guard_scope
+    from ggrs_tpu.errors import GGRSError, ImplicitHostTransfer
+    from ggrs_tpu.obs import GLOBAL_TELEMETRY
+
+    GLOBAL_TELEMETRY.enabled = True
+    GLOBAL_TELEMETRY.registry.reset()
+    GLOBAL_TELEMETRY.recorder.clear()
+    try:
+        x = jnp.arange(4.0)
+        assert float(x.sum()) == 6.0  # warm, unguarded
+        sanitizer.freeze("transfer test")
+        with pytest.raises(ImplicitHostTransfer) as ei:
+            with transfer_guard_scope("resident drive"):
+                float(x.sum())  # the planted implicit sync
+        assert isinstance(ei.value, GGRSError)  # fleet isolation routes it
+        assert "resident drive" in str(ei.value)
+        assert "test_analysis.py" in str(ei.value)
+
+        with pytest.raises(ImplicitHostTransfer):
+            with transfer_guard_scope("dispatch"):
+                x.sum().item()
+
+        snap = GLOBAL_TELEMETRY.snapshot()
+        kinds = [e["kind"] for e in snap["events"]]
+        assert kinds.count("implicit_host_transfer") == 2
+        reg = GLOBAL_TELEMETRY.registry
+        assert reg.get("ggrs_transfer_guard_trips_total").value == 2
+        # both patches restored once the scope closed
+        assert float(x.sum()) == 6.0
+        assert x.sum().item() == 6.0
+    finally:
+        GLOBAL_TELEMETRY.enabled = False
+        GLOBAL_TELEMETRY.reset()
+
+
+def test_transfer_guard_inert_unfrozen_and_uninstalled(sanitizer):
+    import jax.numpy as jnp
+
+    from ggrs_tpu.analysis.sanitize import transfer_guard_scope
+
+    x = jnp.ones(3)
+    # installed but NOT frozen: warmup may read buffers freely
+    assert sanitizer.frozen_at is None
+    with transfer_guard_scope("dispatch"):
+        assert float(x.sum()) == 3.0
+
+    # frozen: host reads OUTSIDE the guarded region stay legal (the
+    # drain pass's pooled readback runs outside the scope)
+    sanitizer.freeze("inert test")
+    assert float(x.sum()) == 3.0
